@@ -1,0 +1,155 @@
+"""deepspeed_tpu: a TPU-native distributed training & inference framework.
+
+Provides the DeepSpeed 0.14.5 capability surface (engine object driven by
+a single JSON config, ZeRO sharding, mixed precision, parallelism over a
+device mesh, checkpointing, launcher, inference) re-designed for
+JAX/XLA/Pallas on TPU. Public entry points mirror the reference's
+``deepspeed/__init__.py`` (``initialize`` at __init__.py:69,
+``init_inference`` at 273, ``add_config_arguments`` at 250).
+"""
+
+import os
+import sys
+import types
+from typing import Optional, Union
+
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu import ops  # noqa: F401
+from deepspeed_tpu import module_inject  # noqa: F401
+from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.runtime import lr_schedules  # noqa: F401
+from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
+from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
+from deepspeed_tpu.runtime import zero  # noqa: F401
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=29500,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config=None,
+               mesh_param=None,
+               config_params=None,
+               loss_fn=None,
+               mesh=None):
+    """Initialize the DeepSpeed engine (reference ``__init__.py:69``).
+
+    Arguments:
+        model: a flax module (``apply({'params': p}, *batch)`` returns the
+            loss or ``(loss, aux)``) or a plain callable
+            ``f(params, *batch)``.
+        model_parameters: optional pre-initialized parameter pytree
+            (otherwise the engine initializes lazily from the first batch).
+        config: path to a ds_config JSON or a config dict (same schema as
+            the reference; see runtime/config.py).
+        mesh: optional pre-built ``jax.sharding.Mesh`` (otherwise built
+            from the config's ``mesh`` section over all visible devices).
+
+    Returns: tuple of ``engine, optimizer, training_dataloader, lr_scheduler``.
+    """
+    log_dist(f"DeepSpeedTPU info: version={__version__}", ranks=[0])
+
+    assert model is not None, "deepspeed_tpu.initialize requires a model"
+
+    # Disable config or arg based config
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        if hasattr(args, "deepspeed_config") and args.deepspeed_config is not None:
+            config = args.deepspeed_config
+        elif hasattr(args, "deepspeed_config_dict") and args.deepspeed_config_dict is not None:
+            config = args.deepspeed_config_dict
+    assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
+
+    if not comm.is_initialized():
+        comm.init_distributed(distributed_port=distributed_port, dist_init_required=dist_init_required)
+
+    config_class = DeepSpeedConfig(config, mpu=mpu, mesh_device=mesh)
+
+    pp = int(config_class.mesh_shape.get("pipeline_parallel_size", 1)) if config_class.mesh_shape else 1
+    if pp > 1 or _is_pipeline_module(model):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                mpu=mpu,
+                                dist_init_required=dist_init_required,
+                                collate_fn=collate_fn,
+                                config=config,
+                                config_class=config_class,
+                                mesh=mesh,
+                                loss_fn=loss_fn)
+    else:
+        engine = DeepSpeedEngine(args=args,
+                                 model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 mpu=mpu,
+                                 dist_init_required=dist_init_required,
+                                 collate_fn=collate_fn,
+                                 config=config,
+                                 config_class=config_class,
+                                 mesh=mesh,
+                                 loss_fn=loss_fn)
+
+    return_items = [
+        engine,
+        engine.optimizer,
+        engine.training_dataloader,
+        engine.lr_scheduler,
+    ]
+    return tuple(return_items)
+
+
+def _is_pipeline_module(model):
+    try:
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+        return isinstance(model, PipelineModule)
+    except Exception:
+        return False
+
+
+def add_config_arguments(parser):
+    """Add DeepSpeed args to an argparse parser (reference __init__.py:250)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no impact on DeepSpeed backend)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable DeepSpeed (helper flag for user code, no impact on DeepSpeed backend)")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated DeepSpeed json configuration file.")
+    return parser
+
+
+def init_inference(model, config=None, **kwargs):
+    """Initialize the inference engine (reference __init__.py:273)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    log_dist(f"DeepSpeedTPU inference info: version={__version__}", ranks=[0])
+    if isinstance(config, DeepSpeedInferenceConfig):
+        ds_inference_config = config
+    else:
+        config_dict = dict(config or {})
+        config_dict.update(kwargs)
+        ds_inference_config = DeepSpeedInferenceConfig(**config_dict)
+    return InferenceEngine(model, config=ds_inference_config)

@@ -1,0 +1,56 @@
+"""CPU accelerator — the "fake device" for logic tests.
+
+Analogue of the reference's ``accelerator/cpu_accelerator.py`` (the
+reference test-lane backend). Runs the identical JAX code path on host
+CPU, typically with ``--xla_force_host_platform_device_count=N`` to
+emulate an N-chip mesh.
+"""
+
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+
+class CPU_Accelerator(TPU_Accelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "cpu"
+        return f"cpu:{device_index}"
+
+    def is_available(self):
+        return True
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def total_memory(self, device_index=None):
+        try:
+            import psutil
+            return psutil.virtual_memory().total
+        except Exception:
+            return 64 * (1024**3)
+
+    def available_memory(self, device_index=None):
+        try:
+            import psutil
+            return psutil.virtual_memory().available
+        except Exception:
+            return self.total_memory(device_index)
+
+    def memory_allocated(self, device_index=None):
+        try:
+            import psutil
+            vm = psutil.virtual_memory()
+            return vm.total - vm.available
+        except Exception:
+            return 0
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_allocated(device_index)

@@ -1,0 +1,180 @@
+"""TPU accelerator (the primary backend).
+
+Plays the role of the reference's ``accelerator/cuda_accelerator.py``:
+device queries, memory stats (via PJRT ``memory_stats``), dtype support,
+synchronization, and op-builder dispatch for the ``op_builder/tpu``
+registry.
+"""
+
+import os
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+        self._compile_backend = "xla"
+        self._seed = 0
+
+    def _jax(self):
+        import jax
+        return jax
+
+    def _devices(self):
+        return self._jax().devices()
+
+    # Device APIs
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._devices()
+        return devs[device_index or 0]
+
+    def set_device(self, device_index):
+        # JAX addresses all local devices from one process; no-op.
+        pass
+
+    def current_device(self):
+        return 0
+
+    def current_device_name(self):
+        return "tpu:0"
+
+    def device_count(self):
+        return len(self._devices())
+
+    def synchronize(self, device_index=None):
+        import jax
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+    # RNG APIs
+    def random(self):
+        import jax
+        return jax.random
+
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def default_generator(self, device_index):
+        import jax
+        return jax.random.PRNGKey(self._seed)
+
+    # Memory management
+    def empty_cache(self):
+        pass
+
+    def _mem_stats(self, device_index=None):
+        try:
+            dev = self.device(device_index)
+            return dev.memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None):
+        return self._mem_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self._mem_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def reset_max_memory_allocated(self, device_index=None):
+        pass
+
+    def memory_stats(self, device_index=None):
+        return self._mem_stats(device_index)
+
+    def available_memory(self, device_index=None):
+        stats = self._mem_stats(device_index)
+        limit = stats.get("bytes_limit", self.total_memory(device_index))
+        return limit - stats.get("bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        stats = self._mem_stats(device_index)
+        if "bytes_limit" in stats:
+            return stats["bytes_limit"]
+        # v5e default HBM
+        return 16 * (1024**3)
+
+    # Data type support
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        # TPUs compute natively in bf16; fp16 storage is supported, matmul
+        # accumulates via fp32, loss-scaling path is still honored.
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.float8_e4m3fn, jnp.float8_e5m2]
+
+    # Misc
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def is_available(self):
+        try:
+            for d in self._devices():
+                if d.platform in ("tpu", "axon"):
+                    return True
+            return False
+        except Exception:
+            return False
+
+    def range_push(self, msg):
+        try:
+            import jax.profiler
+            self._trace_ctx = jax.profiler.TraceAnnotation(msg)
+            self._trace_ctx.__enter__()
+        except Exception:
+            pass
+
+    def range_pop(self):
+        try:
+            if getattr(self, "_trace_ctx", None) is not None:
+                self._trace_ctx.__exit__(None, None, None)
+                self._trace_ctx = None
+        except Exception:
+            pass
+
+    def lazy_call(self, callback):
+        callback()
+
+    def is_triton_supported(self):
+        return False
+
+    def use_host_timers(self):
+        return True
+
+    def resolves_data_dependency(self):
+        return True
+
+    def handles_memory_backpressure(self):
+        return True
+
+    # Op builder dispatch
+    def op_builder_dir(self):
+        return "op_builder.tpu"
+
+    def create_op_builder(self, class_name):
+        builder_class = self.get_op_builder(class_name)
+        return builder_class() if builder_class is not None else None
+
+    def get_op_builder(self, class_name):
+        from op_builder import tpu as tpu_builders
+        return getattr(tpu_builders, class_name, None)
+
+    def build_extension(self):
+        return None
+
+    def export_envs(self):
+        return ["JAX_", "XLA_", "TPU_", "LIBTPU"]

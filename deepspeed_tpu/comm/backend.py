@@ -1,0 +1,90 @@
+"""Communication backend abstraction.
+
+Analogue of the reference's ``deepspeed/comm/backend.py`` (``Backend`` at
+backend.py:25) and ``deepspeed/comm/torch.py`` (``TorchBackend`` at
+torch.py:90). On TPU there are two distinct communication planes:
+
+- the *compute plane*: XLA collectives (psum/all_gather/reduce_scatter/
+  all_to_all/ppermute) over ICI/DCN, issued inside jit/shard_map against
+  mesh axis names — see ``deepspeed_tpu.comm.comm`` in-jit wrappers;
+- the *control plane*: host-level process coordination (rendezvous,
+  barriers, small CPU all-gathers) via ``jax.distributed`` +
+  ``multihost_utils`` — handled by this backend.
+"""
+
+import os
+
+
+class Backend(object):
+
+    def __init__(self, name="backend", rank=0, size=1):
+        self.name = name
+        # The world size and rank of the world process group
+        self.world_group = None
+        self.world_size = size
+        self.world_rank = rank
+        # Single process group and kv store are crucial to `initialize()`
+        self.process_groups = []
+        self.kv_store = None
+        self.initialized = False
+
+    def is_initialized(self):
+        return self.initialized
+
+    def new_group(self):
+        # create a new standard process group
+        pass
+
+    def init_process_group(self):
+        self.initialized = True
+
+
+class XlaBackend(Backend):
+    """Control-plane backend over ``jax.distributed`` (GRPC rendezvous).
+
+    Plays the role the reference's ``TorchBackend`` (NCCL/Gloo) plays for
+    host coordination; device-plane collectives never go through here.
+    """
+
+    def __init__(self, init_method=None, rank=-1, world_size=-1, timeout=None, name="xla"):
+        super(XlaBackend, self).__init__(name=name)
+        self.single_process = True
+
+    def init_process_group(self, coordinator_address=None, num_processes=None, process_id=None):
+        import jax
+        num_processes = num_processes if num_processes is not None else _int_env("WORLD_SIZE", None)
+        process_id = process_id if process_id is not None else _int_env("RANK", None)
+        coordinator_address = coordinator_address or os.environ.get("MASTER_ADDR")
+        if coordinator_address and os.environ.get("MASTER_PORT"):
+            coordinator_address = f"{coordinator_address}:{os.environ['MASTER_PORT']}"
+
+        if num_processes is not None and num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            self.single_process = False
+        self.world_size = jax.process_count()
+        self.world_rank = jax.process_index()
+        self.initialized = True
+
+    def destroy_process_group(self):
+        if not self.single_process:
+            import jax
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+        self.initialized = False
+
+    def barrier(self, name="ds_barrier"):
+        if self.single_process:
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def _int_env(key, default):
+    val = os.environ.get(key)
+    return int(val) if val is not None else default

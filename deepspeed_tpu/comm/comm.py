@@ -1,0 +1,397 @@
+"""DeepSpeed-shaped communication facade.
+
+Analogue of the reference's ``deepspeed/comm/comm.py`` (module-level
+collectives at comm.py:222-523, ``init_distributed`` at comm.py:604).
+
+Two API planes (see ``deepspeed_tpu/comm/backend.py``):
+
+- **In-jit collectives** take a ``group`` that is a mesh-axis name (or
+  tuple of names) and must be called inside ``shard_map``; they lower
+  straight to XLA collectives over ICI/DCN. These are what the engine's
+  hot loops use.
+- **Host-level ops** (broadcast/all_gather of small host arrays,
+  barrier) coordinate processes across hosts.
+
+Both are wrapped by the comms logger when enabled (reference ``timed_op``
+comm.py:101).
+"""
+
+import os
+import time
+from enum import Enum
+
+import numpy as np
+
+from deepspeed_tpu.comm.backend import XlaBackend
+from deepspeed_tpu.utils.comms_logging import CommsLogger, get_caller_func
+from deepspeed_tpu.utils.logging import logger
+
+
+class ReduceOp(Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    BAND = 4
+    BOR = 5
+    BXOR = 6
+    AVG = 7
+    UNUSED = 8
+
+
+cdb = None  # current distributed backend (control plane)
+comms_logger = CommsLogger()
+timers = None
+
+
+class CommException(Exception):
+    pass
+
+
+def _assert_initialized():
+    assert cdb is not None and cdb.is_initialized(), \
+        "DeepSpeed backend not set, please initialize it using init_distributed()"
+
+
+def is_initialized():
+    return cdb is not None and cdb.is_initialized()
+
+
+def init_distributed(dist_backend="xla",
+                     auto_mpi_discovery=True,
+                     distributed_port=29500,
+                     verbose=True,
+                     timeout=None,
+                     init_method=None,
+                     dist_init_required=None,
+                     config=None,
+                     rank=-1,
+                     world_size=-1):
+    """Initialize the control-plane backend (reference comm.py:604).
+
+    In a single-process setting this is cheap and idempotent. Multi-host
+    jobs rendezvous through ``jax.distributed`` using either explicit
+    rank/world_size/init_method or MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE
+    env (same env contract as the reference launcher).
+    """
+    global cdb
+    if cdb is not None and cdb.is_initialized():
+        return cdb
+    if auto_mpi_discovery and mpi_discovery_possible():
+        mpi_discovery(distributed_port=distributed_port, verbose=verbose)
+    cdb = XlaBackend()
+    kwargs = {}
+    if world_size > 0:
+        kwargs["num_processes"] = world_size
+    if rank >= 0:
+        kwargs["process_id"] = rank
+    if init_method:
+        kwargs["coordinator_address"] = init_method.replace("tcp://", "")
+    cdb.init_process_group(**kwargs)
+    if config is not None:
+        configure(config)
+    return cdb
+
+
+def mpi_discovery_possible():
+    return "OMPI_COMM_WORLD_RANK" in os.environ and "RANK" not in os.environ
+
+
+def mpi_discovery(distributed_port=29500, verbose=True):
+    """Discover rank/world size from OpenMPI env (reference comm.py:673)."""
+    rank = int(os.environ["OMPI_COMM_WORLD_RANK"])
+    world_size = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    os.environ.setdefault("MASTER_ADDR", os.environ.get("HYDRA_BSTRAP_LOCALHOST", "localhost"))
+    os.environ.setdefault("MASTER_PORT", str(distributed_port))
+    if verbose:
+        logger.info(f"Discovered MPI settings of world_rank={rank}, world_size={world_size}")
+
+
+def destroy_process_group(group=None):
+    global cdb
+    if cdb is not None:
+        cdb.destroy_process_group()
+    cdb = None
+
+
+def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None):
+    if deepspeed_config is not None:
+        comms_logger.configure(deepspeed_config.comms_config)
+    if enabled is not None:
+        comms_logger.enabled = enabled
+    if prof_all is not None:
+        comms_logger.prof_all = prof_all
+    if prof_ops is not None:
+        comms_logger.prof_ops = prof_ops
+    if verbose is not None:
+        comms_logger.verbose = verbose
+
+
+# ---------------------------------------------------------------------------
+# Rank / size queries. "rank" is the JAX process index; world size counts
+# devices to preserve the reference's one-rank-per-accelerator arithmetic.
+# ---------------------------------------------------------------------------
+
+def get_rank(group=None):
+    if not is_initialized():
+        return int(os.environ.get("RANK", 0))
+    import jax
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    """World size of a group. ``group=None`` → number of devices."""
+    if group is not None and not isinstance(group, str):
+        try:
+            from deepspeed_tpu.parallel import groups as ds_groups
+            mesh = ds_groups.get_mesh(required=False)
+            if mesh is not None and isinstance(group, (tuple, list)):
+                shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+                return int(np.prod([shape.get(a, 1) for a in group]))
+        except Exception:
+            pass
+    if not is_initialized():
+        return int(os.environ.get("WORLD_SIZE", 1))
+    import jax
+    return jax.device_count()
+
+
+def get_local_rank():
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def get_global_rank(group=None, group_rank=0):
+    return group_rank
+
+
+# ---------------------------------------------------------------------------
+# Comms-logger wrapper
+# ---------------------------------------------------------------------------
+
+def _nbytes(x):
+    try:
+        return int(np.prod(np.shape(x))) * np.dtype(getattr(x, "dtype", np.float32)).itemsize
+    except Exception:
+        return 0
+
+
+def _logged(raw_name, tensor, group, fn, log_name=None, debug=None):
+    if not (comms_logger.enabled and (comms_logger.prof_all or raw_name in comms_logger.prof_ops)):
+        return fn()
+    t0 = time.time()
+    result = fn()
+    try:
+        import jax
+        jax.block_until_ready(result)
+    except Exception:
+        pass
+    latency = time.time() - t0
+    record_name = log_name or raw_name
+    comms_logger.append(raw_name, record_name, latency, _nbytes(tensor), get_world_size(group))
+    return result
+
+
+def log_summary(show_straggler=False):
+    return comms_logger.log_all(show_straggler=show_straggler)
+
+
+# ---------------------------------------------------------------------------
+# In-jit collectives: group = mesh axis name(s); must run inside shard_map.
+# These lower to single XLA ops (psum / all-gather / reduce-scatter /
+# all-to-all / collective-permute) over ICI.
+# ---------------------------------------------------------------------------
+
+def _axis(group):
+    if group is None:
+        from deepspeed_tpu.parallel import groups as ds_groups
+        return ds_groups._get_data_parallel_group()
+    return group
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False, prof=False, log_name="all_reduce", debug=None):
+    import jax
+    axis = _axis(group)
+
+    def do():
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = jax.lax.psum(tensor, axis)
+            if op == ReduceOp.AVG:
+                out = out / get_world_size(axis if isinstance(axis, (tuple, list)) else (axis,))
+            return out
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(tensor, axis)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(tensor, axis)
+        if op == ReduceOp.PRODUCT:
+            import jax.numpy as jnp
+            # exp(sum(log|x|)) with sign parity; zero if any factor is zero.
+            logs = jax.lax.psum(jnp.log(jnp.maximum(jnp.abs(tensor), 1e-45)), axis)
+            neg = jax.lax.psum((tensor < 0).astype(jnp.int32), axis)
+            any_zero = jax.lax.pmax((tensor == 0).astype(jnp.int32), axis)
+            sign = jnp.where(neg % 2 == 1, -1.0, 1.0)
+            return jnp.where(any_zero > 0, jnp.zeros_like(tensor), sign * jnp.exp(logs))
+        raise CommException(f"Unsupported reduce op {op}")
+
+    return _logged("all_reduce", tensor, axis, do, log_name)
+
+
+def inference_all_reduce(tensor, op=ReduceOp.SUM, group=None):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor, group=None, axis_index=0, tiled=False, prof=False, log_name="all_gather", debug=None):
+    import jax
+    ax = _axis(group)
+
+    def do():
+        return jax.lax.all_gather(tensor, ax, axis=axis_index, tiled=tiled)
+
+    return _logged("all_gather", tensor, ax, do, log_name)
+
+
+def all_gather_into_tensor(tensor, group=None, async_op=False, prof=False, log_name="all_gather_into_tensor",
+                           debug=None):
+    """Tiled all-gather along dim 0 (reference's tensor-collective form)."""
+    return all_gather(tensor, group=group, axis_index=0, tiled=True, log_name=log_name)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, scatter_dimension=0, tiled=True, prof=False,
+                   log_name="reduce_scatter", debug=None):
+    import jax
+    ax = _axis(group)
+    assert op in (ReduceOp.SUM, ReduceOp.AVG), "reduce_scatter supports SUM/AVG"
+
+    def do():
+        out = jax.lax.psum_scatter(tensor, ax, scatter_dimension=scatter_dimension, tiled=tiled)
+        if op == ReduceOp.AVG:
+            out = out / get_world_size(ax if isinstance(ax, (tuple, list)) else (ax,))
+        return out
+
+    return _logged("reduce_scatter", tensor, ax, do, log_name)
+
+
+def reduce_scatter_tensor(tensor, op=ReduceOp.SUM, group=None, async_op=False, prof=False,
+                          log_name="reduce_scatter_tensor", debug=None):
+    return reduce_scatter(tensor, op=op, group=group, scatter_dimension=0, tiled=True, log_name=log_name)
+
+
+def all_to_all_single(tensor, group=None, split_axis=0, concat_axis=0, prof=False, log_name="all_to_all_single",
+                      debug=None):
+    import jax
+    ax = _axis(group)
+
+    def do():
+        return jax.lax.all_to_all(tensor, ax, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+    return _logged("all_to_all_single", tensor, ax, do, log_name)
+
+
+def all_to_all(tensor, group=None, split_axis=0, concat_axis=0, tiled=True, prof=False, log_name="all_to_all",
+               debug=None):
+    import jax
+    ax = _axis(group)
+
+    def do():
+        return jax.lax.all_to_all(tensor, ax, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+    return _logged("all_to_all", tensor, ax, do, log_name)
+
+
+def ppermute(tensor, perm, group=None, prof=False, log_name="ppermute", debug=None):
+    import jax
+    ax = _axis(group)
+
+    def do():
+        return jax.lax.ppermute(tensor, ax, perm)
+
+    return _logged("ppermute", tensor, ax, do, log_name)
+
+
+def axis_index(group=None):
+    import jax
+    return jax.lax.axis_index(_axis(group))
+
+
+def broadcast(tensor, src=0, group=None, async_op=False, prof=False, log_name="broadcast", debug=None):
+    """In-jit broadcast from group rank ``src``: select + psum (XLA folds
+    this into an efficient broadcast). For multi-axis groups the flat
+    group rank is the row-major composition of the axes' indices."""
+    import jax
+    import jax.numpy as jnp
+    ax = _axis(group)
+
+    def do():
+        # flat rank over all group axes (row-major, first axis outermost)
+        axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+        flat = jnp.zeros((), jnp.int32)
+        for a in axes:
+            flat = flat * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        masked = jnp.where(flat == src, tensor, jnp.zeros_like(tensor))
+        return jax.lax.psum(masked, ax)
+
+    return _logged("broadcast", tensor, ax, do, log_name)
+
+
+# ---------------------------------------------------------------------------
+# Host-level ops (control plane, outside jit)
+# ---------------------------------------------------------------------------
+
+def barrier(group=None, async_op=False, device_ids=None, prof=False, log_name="barrier", debug=None):
+    _assert_initialized()
+
+    def do():
+        cdb.barrier()
+        return None
+
+    return _logged("barrier", np.zeros(1), group, do, log_name)
+
+
+def host_broadcast(array, src=0):
+    """Broadcast a host array from process ``src`` to all processes."""
+    _assert_initialized()
+    if cdb.single_process:
+        return array
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.broadcast_one_to_all(array, is_source=get_rank() == src))
+
+
+def host_all_gather(array):
+    """Gather host arrays from every process (stacked on a new axis 0)."""
+    _assert_initialized()
+    if cdb.single_process:
+        return np.asarray(array)[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(array))
+
+
+def host_all_reduce(array, op=ReduceOp.SUM):
+    gathered = host_all_gather(np.asarray(array))
+    if op == ReduceOp.SUM:
+        return gathered.sum(axis=0)
+    if op == ReduceOp.MAX:
+        return gathered.max(axis=0)
+    if op == ReduceOp.MIN:
+        return gathered.min(axis=0)
+    if op == ReduceOp.AVG:
+        return gathered.mean(axis=0)
+    raise CommException(f"Unsupported host reduce op {op}")
+
+
+# Aliases matching torch.distributed surface the reference mirrors
+def send(tensor, dst, group=None, tag=0):
+    raise CommException("Point-to-point send/recv are expressed as ppermute on TPU; use comm.ppermute inside jit")
+
+
+def recv(tensor, src, group=None, tag=0):
+    raise CommException("Point-to-point send/recv are expressed as ppermute on TPU; use comm.ppermute inside jit")
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    return barrier(group=group)
+
+
+def initialize(ep_size=1, mpu=None):
+    """Backward-compat alias used by MoE paths in the reference."""
+    init_distributed()

@@ -1,0 +1,19 @@
+"""Comms-logger config (reference ``deepspeed/comm/config.py``)."""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    prof_all: bool = True
+    prof_ops: list = []
+    verbose: bool = False
+    debug: bool = False
+
+
+class DeepSpeedCommsConfig:
+
+    def __init__(self, ds_config):
+        self.comms_logger_enabled = "comms_logger" in ds_config
+        if self.comms_logger_enabled:
+            self.comms_logger = CommsLoggerConfig(**ds_config["comms_logger"])

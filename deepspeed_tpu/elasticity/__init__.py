@@ -1,0 +1,6 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    get_compatible_gpus,
+)

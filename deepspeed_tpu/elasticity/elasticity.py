@@ -1,0 +1,339 @@
+"""Elastic batch-size / chip-count compatibility solver.
+
+Same algorithm family as the reference's
+``deepspeed/elasticity/elasticity.py`` (``compute_elastic_config`` at
+elasticity.py:233, ``get_compatible_gpus`` v0.1/v0.2 at 83/126):
+pre-compute a global batch size highly composite over candidate chip
+counts, so that any world size in range resumes with identical math.
+"""
+
+import json
+import math
+import os
+from math import gcd
+
+from deepspeed_tpu.elasticity.config import (
+    ELASTICITY,
+    ENABLED,
+    ENABLED_DEFAULT,
+    LATEST_ELASTICITY_VERSION,
+    MAX_ACCEPTABLE_BATCH_SIZE,
+    MICRO_BATCHES,
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.utils.logging import logger
+
+# Thirty eight smallest highly composite numbers. The list should be enough
+# to support up to 720K batch size.
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160,
+    25200, 27720, 45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280, 720720
+]
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    candidate_batch_size = []
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidate_batch_size.append(base)
+        else:
+            value = max_acceptable_batch_size // base
+            index = next((i for i, n in enumerate(HCN_LIST) if n > value), len(HCN_LIST) - 1)
+            candidate_batch_size.append(HCN_LIST[index - 1] * base)
+    candidate_batch_size = list(set(candidate_batch_size))
+    logger.info(f"Candidate batch size: {candidate_batch_size}")
+    return candidate_batch_size
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    valid_gpus = []
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch == 0:
+            max_gpus = batch_size // micro_batch
+            if min_valid_gpus <= max_gpus <= max_valid_gpus:
+                valid_gpus.append(max_gpus)
+
+            # find all factors less than max_gpus / 2
+            for i in range(1, max_gpus // 2 + 1):
+                if i > max_valid_gpus:
+                    break
+                if i < min_valid_gpus:
+                    continue
+                if max_gpus % i == 0:
+                    valid_gpus.append(i)
+    valid_gpus = set(valid_gpus)
+    valid_gpus = sorted(list(valid_gpus))
+    return valid_gpus
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus, prefer_larger):
+    max_valid_gpus = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+
+    for batch_size in candidate_batch_sizes:
+        current_valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if (len(current_valid_gpus) > max_valid_gpus or (len(current_valid_gpus) == max_valid_gpus and
+                                                         ((prefer_larger and batch_size > final_batch_size) or
+                                                          (not prefer_larger and batch_size < final_batch_size)))):
+            max_valid_gpus = len(current_valid_gpus)
+            valid_gpus = current_valid_gpus
+            final_batch_size = batch_size
+
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=None, max_gpus=None,
+                             prefer_larger=True):
+    """We use two heuristics to compute the batch size
+        1. We use the Lowest Common Multiple of the micro-batches
+    as the base batch size and scale it by a HCN such that the result is
+    the largest batch size less than the max_acceptable batch size
+        2. We use each of the micro batches as a base and scale it
+    by a HCN such that the result is the largest batch size less than the
+    max_acceptable batch size.
+
+    We then use brute force to count the number of compatible GPU count for
+    each of the aforementioned cases, and return the batch size with the most number of
+    compatible GPU counts in the min-max GPU range if provided, other wise
+    we return the batch size with the most number of total compatible GPU counts.
+
+    Returns:
+        final_batch_size
+        valid_gpus
+    """
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(f"All micro batches must be less than \
+            or equal to max_acceptable_batch_size: {max_acceptable_batch_size}")
+
+    lcm = micro_batches[0]
+    for mb in micro_batches[1:]:
+        lcm = lcm * mb // gcd(lcm, mb)
+
+    base_list = []
+    base_list.extend(micro_batches)
+    base_list.append(lcm)
+
+    candidate_batch_sizes = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+
+    final_batch_size, valid_gpus = get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus,
+                                                       prefer_larger)
+
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v02(micro_batches,
+                             max_acceptable_batch_size,
+                             current_num_gpus,
+                             min_gpus=None,
+                             max_gpus=None,
+                             prefer_larger=True,
+                             num_gpus_per_node=1,
+                             model_parallel_size=1):
+    """Computes a compatible batch size in the presence of model parallelism:
+    the effective data-parallel unit becomes ``dp_size_per_node`` groups.
+
+    Returns:
+        final_batch_size
+        valid_gpus
+        micro-batch size
+    """
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityError(f"In Elasticity v0.2, number of GPUs per node:"
+                              f"{num_gpus_per_node} should be divisible by "
+                              f"model parallel size {model_parallel_size}")
+
+    def get_microbatch(final_batch_size):
+        candidate_microbatch = None
+
+        for micro_batch in micro_batches:
+            if final_batch_size // current_num_gpus % micro_batch == 0:
+                if candidate_microbatch is None:
+                    candidate_microbatch = micro_batch
+                if prefer_larger and candidate_microbatch < micro_batch:
+                    candidate_microbatch = micro_batch
+        return candidate_microbatch
+
+    dp_size_per_node = num_gpus_per_node // model_parallel_size
+
+    final_batch_size, valid_world_size = _get_compatible_gpus_v01(
+        micro_batches,
+        int(max_acceptable_batch_size / dp_size_per_node),
+        int(min_gpus / num_gpus_per_node),
+        int(max_gpus / num_gpus_per_node),  # Passing number of max nodes as Elasticity v2 works at node level
+        prefer_larger=prefer_larger)
+
+    final_batch_size = int(final_batch_size) * dp_size_per_node
+    valid_dp_world_size = [i * dp_size_per_node for i in valid_world_size]
+
+    if current_num_gpus // model_parallel_size in valid_dp_world_size:
+        candidate_microbatch = get_microbatch(final_batch_size)
+        return final_batch_size, valid_dp_world_size, candidate_microbatch
+
+    current_dp_size = (current_num_gpus / num_gpus_per_node) * dp_size_per_node
+    candidate_batch_sizes = []
+    for micro_batch in micro_batches:
+        min_batch_size = micro_batch * current_dp_size
+
+        factor = math.floor(max_acceptable_batch_size / float(min_batch_size))
+        candidate_batch_sizes.append(factor * min_batch_size)
+
+    used_microbatch = None
+    if prefer_larger:
+        candidate_batch_size = max(candidate_batch_sizes)
+    else:
+        candidate_batch_size = min(candidate_batch_sizes)
+
+    candidate_microbatch = get_microbatch(candidate_batch_size)
+
+    return candidate_batch_size, [int(current_dp_size)], candidate_microbatch
+
+
+def get_compatible_gpus(micro_batches,
+                        max_acceptable_batch_size,
+                        min_gpus=None,
+                        max_gpus=None,
+                        prefer_larger=True,
+                        num_gpus_per_node=1,
+                        model_parallel_size=1,
+                        current_num_gpus=None,
+                        version=0.1):
+    if version == 0.1:
+        return _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus, max_gpus, prefer_larger)
+    elif version == 0.2:
+        return _get_compatible_gpus_v02(micro_batches,
+                                        max_acceptable_batch_size,
+                                        current_num_gpus,
+                                        min_gpus=min_gpus,
+                                        max_gpus=max_gpus,
+                                        prefer_larger=prefer_larger,
+                                        num_gpus_per_node=num_gpus_per_node,
+                                        model_parallel_size=model_parallel_size)
+    raise ElasticityError(f"Unknown elasticity version: {version}")
+
+
+def elasticity_enabled(ds_config: dict):
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """Ensure the resource scheduler saw the same elastic config we are using at runtime."""
+    if "DEEPSPEED_ELASTICITY_CONFIG" in os.environ:
+        scheduler_elastic_config_dict = json.loads(os.environ["DEEPSPEED_ELASTICITY_CONFIG"])
+        scheduler_elastic_config = ElasticityConfig(scheduler_elastic_config_dict)
+        runtime_elastic_config = ElasticityConfig(runtime_elastic_config_dict)
+        err_str = "Elastic config '{}={}' seen by resource scheduler does not match config passed to runtime {}={}"
+        if runtime_elastic_config.max_acceptable_batch_size != scheduler_elastic_config.max_acceptable_batch_size:
+            raise ElasticityConfigError(
+                err_str.format("max_acceptable_batch_size", scheduler_elastic_config.max_acceptable_batch_size,
+                               "max_acceptable_batch_size", runtime_elastic_config.max_acceptable_batch_size))
+        if runtime_elastic_config.micro_batches != scheduler_elastic_config.micro_batches:
+            raise ElasticityConfigError(
+                err_str.format("micro_batches", scheduler_elastic_config.micro_batches, "micro_batches",
+                               runtime_elastic_config.micro_batches))
+        if runtime_elastic_config.version != scheduler_elastic_config.version:
+            raise ElasticityConfigError(
+                err_str.format("version", scheduler_elastic_config.version, "version",
+                               runtime_elastic_config.version))
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size=0, return_microbatch=False):
+    """Core deepspeed elasticity API.
+
+    Args:
+        ds_config (dict): DeepSpeed config dictionary/json
+        target_deepspeed_version (str): When called from scheduling
+            infrastructure we want to ensure the user is on a deepspeed version that
+            supports elasticity.
+        world_size (int, optional): Intended/current DP world size, will do some sanity
+            checks to ensure world size is actually valid with the config.
+        return_microbatch (bool, optional): whether to return micro batch size or not.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError("Expected ds_config to be a dictionary but received " f"a {type(ds_config)}, containing: {ds_config}")
+
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(f"'{ELASTICITY}' is missing from config json,"
+                                    " please add it if running an elastic training job.")
+
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elastic_config_dict.get(ENABLED, ENABLED_DEFAULT):
+        raise ElasticityConfigError("Elasticity is not enabled, please enable it "
+                                    "in the config json or don't call this function.")
+
+    ensure_immutable_elastic_config(runtime_elastic_config_dict=elastic_config_dict)
+
+    elastic_config = ElasticityConfig(elastic_config_dict)
+    model_parallel_size = elastic_config.model_parallel_size
+    num_gpus_per_node = elastic_config.num_gpus_per_node
+
+    if model_parallel_size > 1 and float(elastic_config.version) != 0.2:
+        raise ElasticityConfigError("Elasticity V{} " "does not support model-parallel training. Given model-parallel size: "
+                                    "{}".format(elastic_config.version, model_parallel_size))
+
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError("Attempting to run elasticity version " f"{elastic_config.version} but runtime only supports up "
+                                    f"to {LATEST_ELASTICITY_VERSION}")
+
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = get_compatible_gpus(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+            version=0.1)
+    elif float(elastic_config.version) == 0.2:
+        if world_size != 0:
+            current_num_gpus = world_size
+        else:
+            if "WORLD_SIZE" in os.environ and os.getenv("WORLD_SIZE").isdigit():
+                current_num_gpus = int(os.getenv("WORLD_SIZE"))
+            else:
+                WORLD_SIZE = os.getenv("WORLD_SIZE")
+                raise ElasticityConfigError("Elasticity V 0.2 needs WORLD_SIZE to compute valid batch size. "
+                                            f"Either give it as argument to function compute_elastic_config "
+                                            f"or set it as an environment variable. Value of WORLD_SIZE as environment variable is {WORLD_SIZE}")
+
+        final_batch_size, valid_gpus, candidate_microbatch_size = get_compatible_gpus(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            current_num_gpus=current_num_gpus,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+            num_gpus_per_node=num_gpus_per_node,
+            model_parallel_size=model_parallel_size,
+            version=0.2)
+    else:
+        raise ElasticityConfigError(f"Unknown elasticity version: {elastic_config.version}")
+
+    logger.info(f"Valid World Size (GPUs / Model Parallel Size): {valid_gpus}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(f"World size ({world_size}) is not valid " f"with the current list of valid GPU counts: {valid_gpus}")
+
+        # Pick largest valid micro batch size
+        micro_batch_size = None
+        for mbsz in sorted(list(set(elastic_config.micro_batches)), reverse=True):
+            if final_batch_size // world_size % mbsz == 0:
+                micro_batch_size = mbsz
+                break
+        assert micro_batch_size is not None, "Unable to find divisible micro batch size" \
+            f" world_size={world_size} final_batch_size={final_batch_size} and  micro_batches={elastic_config.micro_batches}"
+        return final_batch_size, valid_gpus, micro_batch_size
+
+    if return_microbatch:
+        assert float(elastic_config.version) == 0.2, "Microbatch return is only supported for elasticity v0.2"
+        return final_batch_size, valid_gpus, candidate_microbatch_size
+
+    return final_batch_size, valid_gpus

@@ -1,0 +1,1 @@
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, default_tp_rule

@@ -1,0 +1,59 @@
+"""Automatic tensor-parallel sharding rules.
+
+Analogue of the reference's ``deepspeed/module_inject/auto_tp.py``
+(``AutoTP`` at auto_tp.py:189): instead of physically slicing torch
+Linear weights and inserting allreduce modules, AutoTP here produces a
+``(param_path, shape) -> PartitionSpec`` rule that shards matmul weights
+over the 'tensor' mesh axis — column-parallel (output dim) for QKV /
+gate / up projections, row-parallel (input dim) for output / down
+projections — and XLA inserts the reduction collectives.
+"""
+
+import re
+
+from jax.sharding import PartitionSpec as P
+
+# Column-parallel: shard the output features (last dim of a [in, out] kernel).
+COLUMN_PATTERNS = [
+    r"q_proj", r"k_proj", r"v_proj", r"qkv", r"query", r"key", r"value",
+    r"gate_proj", r"up_proj", r"wi", r"fc1", r"fc_in", r"dense_h_to_4h", r"w1", r"w3",
+]
+# Row-parallel: shard the input features (first dim of a [in, out] kernel).
+ROW_PATTERNS = [
+    r"o_proj", r"out_proj", r"wo", r"fc2", r"fc_out", r"dense_4h_to_h", r"w2", r"attn_out", r"down_proj",
+]
+# Embeddings: shard the vocab/feature dim.
+EMBED_PATTERNS = [r"embed", r"wte", r"lm_head", r"output_layer"]
+
+
+def default_tp_rule(path, shape):
+    """Map a parameter path+shape to a tensor-parallel PartitionSpec."""
+    lowered = path.lower()
+    ndim = len(shape)
+    if ndim < 1:
+        return P()
+    if any(re.search(p, lowered) for p in ROW_PATTERNS):
+        if ndim >= 2:
+            return P(*(("tensor",) + (None,) * (ndim - 1)))
+        return P()  # bias of a row-parallel layer is replicated (added post-reduce)
+    if any(re.search(p, lowered) for p in COLUMN_PATTERNS):
+        return P(*((None,) * (ndim - 1) + ("tensor",)))
+    if any(re.search(p, lowered) for p in EMBED_PATTERNS):
+        if ndim >= 2:
+            return P(*((None,) * (ndim - 1) + ("tensor",)))
+        return P()
+    return P()
+
+
+class AutoTP:
+    """Holds a tp rule; ``tp_parser`` surface kept for parity."""
+
+    def __init__(self, rule=None):
+        self.rule = rule or default_tp_rule
+
+    @staticmethod
+    def tp_parser(model=None):
+        return AutoTP()
+
+    def __call__(self, path, shape):
+        return self.rule(path, shape)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.monitor.monitor import MonitorMaster

@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops import adagrad, adam, lamb, lion
+from deepspeed_tpu.ops.sgd import SGD

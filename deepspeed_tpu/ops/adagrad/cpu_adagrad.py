@@ -1,0 +1,45 @@
+"""Adagrad (host-offload capable).
+
+Capability match for the reference's ``deepspeed/ops/adagrad/cpu_adagrad.py``
+(``DeepSpeedCPUAdagrad`` over ``csrc/adagrad/cpu_adagrad.cpp``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer, OptimizerTransform
+
+
+class DeepSpeedCPUAdagrad(DeepSpeedOptimizer):
+
+    def __init__(self, model_params=None, lr=1e-2, eps=1e-10, weight_decay=0.0, amsgrad=False, fp32_optimizer_states=True):
+        super().__init__(params=model_params, lr=lr, eps=eps, weight_decay=weight_decay)
+
+    def transform(self) -> OptimizerTransform:
+        group = self.param_groups[0]
+        eps = group["eps"]
+        wd = group["weight_decay"]
+
+        def init(params):
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "sum_sq": jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            }
+
+        def update(grads, state, params, lr):
+            def leaf(g, p, s):
+                g = g.astype(jnp.float32)
+                if wd != 0.0:
+                    g = g + wd * p
+                s_new = s + jnp.square(g)
+                p_new = p - lr * g / (jnp.sqrt(s_new) + eps)
+                return p_new, s_new
+
+            out = jax.tree.map(leaf, grads, params, state["sum_sq"])
+            treedef = jax.tree.structure(params)
+            leaves = treedef.flatten_up_to(out)
+            p_new = treedef.unflatten([x[0] for x in leaves])
+            s_new = treedef.unflatten([x[1] for x in leaves])
+            return p_new, {"step": state["step"] + 1, "sum_sq": s_new}
+
+        return OptimizerTransform(init, update)

@@ -1,0 +1,87 @@
+"""Fused LAMB optimizer.
+
+Capability match for the reference's ``deepspeed/ops/lamb/fused_lamb.py``
+(``FusedLamb`` over ``csrc/lamb/fused_lamb_cuda_kernel.cu``): Adam-style
+moments with a per-tensor trust ratio ``||p|| / ||update||``. The
+per-tensor norms are on-chip reductions fused by XLA.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer, OptimizerTransform
+
+
+class FusedLamb(DeepSpeedOptimizer):
+
+    def __init__(self,
+                 params=None,
+                 lr=1e-3,
+                 bias_correction=True,
+                 betas=(0.9, 0.999),
+                 eps=1e-8,
+                 eps_inside_sqrt=False,
+                 weight_decay=0.0,
+                 max_grad_norm=0.0,
+                 max_coeff=10.0,
+                 min_coeff=0.01,
+                 amsgrad=False):
+        if amsgrad:
+            raise RuntimeError("FusedLamb does not support the AMSGrad variant.")
+        super().__init__(params=params, lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                         bias_correction=bias_correction, eps_inside_sqrt=eps_inside_sqrt,
+                         max_coeff=max_coeff, min_coeff=min_coeff)
+
+    def transform(self) -> OptimizerTransform:
+        group = self.param_groups[0]
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        wd = group["weight_decay"]
+        eps_inside = group["eps_inside_sqrt"]
+        max_coeff = group["max_coeff"]
+        min_coeff = group["min_coeff"]
+        bias_correction = group["bias_correction"]
+
+        def init(params):
+            zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "exp_avg": jax.tree.map(zeros, params),
+                "exp_avg_sq": jax.tree.map(zeros, params),
+            }
+
+        def update(grads, state, params, lr):
+            step = state["step"] + 1
+            stepf = step.astype(jnp.float32)
+            if bias_correction:
+                bc1 = 1.0 - beta1**stepf
+                bc2 = 1.0 - beta2**stepf
+            else:
+                bc1 = bc2 = 1.0
+
+            def leaf(g, p, m, v):
+                g = g.astype(jnp.float32)
+                m_new = beta1 * m + (1.0 - beta1) * g
+                v_new = beta2 * v + (1.0 - beta2) * jnp.square(g)
+                if eps_inside:
+                    denom = jnp.sqrt(v_new / bc2 + eps)
+                else:
+                    denom = jnp.sqrt(v_new / bc2) + eps
+                upd = (m_new / bc1) / denom + wd * p
+                p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+                u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+                trust = jnp.where(u_norm > 0, p_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+                trust = jnp.where(p_norm > 0, trust, 1.0)
+                trust = jnp.clip(trust, min_coeff, max_coeff)
+                p_new = p - lr * trust * upd
+                return p_new, m_new, v_new
+
+            out = jax.tree.map(leaf, grads, params, state["exp_avg"], state["exp_avg_sq"])
+            treedef = jax.tree.structure(params)
+            leaves = treedef.flatten_up_to(out)
+            p_new = treedef.unflatten([x[0] for x in leaves])
+            m_new = treedef.unflatten([x[1] for x in leaves])
+            v_new = treedef.unflatten([x[2] for x in leaves])
+            return p_new, {"step": step, "exp_avg": m_new, "exp_avg_sq": v_new}
+
+        return OptimizerTransform(init, update)

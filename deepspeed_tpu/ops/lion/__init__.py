@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.lion.fused_lion import DeepSpeedCPULion, FusedLion

@@ -1,0 +1,67 @@
+"""Base class for DeepSpeed-shaped, jit-friendly optimizers.
+
+The reference ships optimizer *kernels* (csrc/adam/multi_tensor_adam.cu,
+csrc/lamb, csrc/lion) behind torch optimizer classes. On TPU the fusion
+is done by XLA: each optimizer here is a pure ``init/update`` transform
+executed inside the engine's jitted step, so the whole flat update fuses
+into a handful of kernels over the rank-local shard. The class carries
+``param_groups`` purely for LR-scheduler/state-dict API parity.
+"""
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class OptimizerTransform(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[..., Any]
+
+
+class DeepSpeedOptimizer:
+    """API-parity base: hyperparams live in ``param_groups[0]`` (mutable by
+    LR schedulers); ``transform()`` returns the pure functions the engine
+    jits. ``update(grads, state, params, lr)`` returns
+    ``(new_params, new_state)`` where params are the fp32 master values.
+    """
+
+    def __init__(self, params=None, lr=1e-3, weight_decay=0.0, **defaults):
+        self.defaults = dict(lr=lr, weight_decay=weight_decay, **defaults)
+        self.param_groups = [dict(self.defaults, params=params)]
+        self.state = {}
+
+    @property
+    def lr(self):
+        return self.param_groups[0]["lr"]
+
+    def transform(self) -> OptimizerTransform:
+        raise NotImplementedError
+
+    # torch-compatible niceties
+    def state_dict(self):
+        return {"param_groups": [{k: v for k, v in g.items() if k != "params"} for g in self.param_groups]}
+
+    def load_state_dict(self, sd):
+        for g, g_new in zip(self.param_groups, sd.get("param_groups", [])):
+            g.update(g_new)
+
+    def zero_grad(self, set_to_none=True):
+        pass  # grads are functional values on TPU; nothing to zero
+
+
+def bias_correction_terms(step, beta1, beta2):
+    bc1 = 1.0 - beta1**step
+    bc2 = 1.0 - beta2**step
+    return bc1, bc2
+
+
+def tree_update_moment(grads, moments, decay, order):
+    return jax.tree.map(lambda g, m: decay * m + (1 - decay) * (g**order), grads, moments)
+
+
+def global_norm(tree):
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.array(0.0, jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

@@ -1,0 +1,49 @@
+"""SGD with momentum (torch.optim.SGD parity for the 'sgd' config name)."""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer, OptimizerTransform
+
+
+class SGD(DeepSpeedOptimizer):
+
+    def __init__(self, params=None, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False):
+        super().__init__(params=params, lr=lr, momentum=momentum, weight_decay=weight_decay, nesterov=nesterov)
+
+    def transform(self) -> OptimizerTransform:
+        group = self.param_groups[0]
+        mom = group["momentum"]
+        wd = group["weight_decay"]
+        nesterov = group["nesterov"]
+
+        def init(params):
+            if mom == 0.0:
+                return {"step": jnp.zeros((), jnp.int32)}
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "momentum_buffer": jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            }
+
+        def update(grads, state, params, lr):
+            def leaf(g, p, buf=None):
+                g = g.astype(jnp.float32)
+                if wd != 0.0:
+                    g = g + wd * p
+                if buf is None:
+                    return p - lr * g, None
+                buf_new = mom * buf + g
+                d = g + mom * buf_new if nesterov else buf_new
+                return p - lr * d, buf_new
+
+            if mom == 0.0:
+                p_new = jax.tree.map(lambda g, p: leaf(g, p)[0], grads, params)
+                return p_new, {"step": state["step"] + 1}
+            out = jax.tree.map(leaf, grads, params, state["momentum_buffer"])
+            treedef = jax.tree.structure(params)
+            leaves = treedef.flatten_up_to(out)
+            p_new = treedef.unflatten([x[0] for x in leaves])
+            b_new = treedef.unflatten([x[1] for x in leaves])
+            return p_new, {"step": state["step"] + 1, "momentum_buffer": b_new}
+
+        return OptimizerTransform(init, update)

@@ -1,0 +1,3 @@
+from deepspeed_tpu.parallel import groups, topology
+from deepspeed_tpu.parallel.topology import (MESH_AXES, ZERO_AXES, PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology, ProcessTopology, make_mesh_topology)

@@ -1,0 +1,252 @@
+"""Global mesh + "process group" registry.
+
+TPU-native analogue of the reference's ``deepspeed/utils/groups.py``
+(``_get_data_parallel_group`` etc., groups.py:52-572). DeepSpeed lazily
+creates torch process groups for dp/mp/ep/sp; here the single global
+``jax.sharding.Mesh`` is the source of truth and a "group" is a tuple of
+mesh axis names. Sizes/ranks are derived from the mesh shape and the
+process's position in it.
+"""
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, EXPERT_ZERO_AXES, MESH_AXES, ZERO_AXES, make_mesh_topology)
+from deepspeed_tpu.utils.logging import logger
+
+# Global mesh singleton (set by the engine or by initialize_mesh)
+_WORLD_MESH = None
+# Megatron-style external mpu (if the user passed one to initialize())
+mpu = None
+# Expert-parallel group sizes registered per MoE layer group name
+expert_parallel_size_ = {}
+
+
+def initialize_mesh(mesh_shape: Optional[dict] = None, devices=None):
+    """Create and register the global mesh.
+
+    ``mesh_shape`` keys: data_parallel_size / tensor_parallel_size /
+    pipeline_parallel_size / sequence_parallel_size / expert_parallel_size
+    (matching the ``mesh`` config section). Missing data size is inferred.
+    """
+    global _WORLD_MESH
+    mesh_shape = mesh_shape or {}
+    _WORLD_MESH = make_mesh_topology(
+        pipe=int(mesh_shape.get("pipeline_parallel_size", 1)),
+        data=int(mesh_shape.get("data_parallel_size", -1)),
+        expert=int(mesh_shape.get("expert_parallel_size", 1)),
+        sequence=int(mesh_shape.get("sequence_parallel_size", 1)),
+        tensor=int(mesh_shape.get("tensor_parallel_size", 1)),
+        devices=devices,
+    )
+    logger.info(f"Initialized global mesh: {dict(zip(_WORLD_MESH.axis_names, _WORLD_MESH.devices.shape))}")
+    return _WORLD_MESH
+
+
+def set_mesh(mesh):
+    global _WORLD_MESH
+    _WORLD_MESH = mesh
+
+
+def get_mesh(required=True):
+    global _WORLD_MESH
+    if _WORLD_MESH is None and required:
+        # Default: everything data-parallel over all addressable devices.
+        initialize_mesh()
+    return _WORLD_MESH
+
+
+def mesh_is_initialized():
+    return _WORLD_MESH is not None
+
+
+def destroy_mesh():
+    global _WORLD_MESH
+    _WORLD_MESH = None
+
+
+def _axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1))
+
+
+def _axes_size(axes: Tuple[str, ...]) -> int:
+    return int(np.prod([_axis_size(a) for a in axes]))
+
+
+# ----------------------------------------------------------------------------
+# Group handles. A "group" is a tuple of axis names; collectives inside
+# shard_map accept these directly.
+# ----------------------------------------------------------------------------
+
+def _get_data_parallel_group():
+    """Data-parallel group (includes expert axis for non-expert params)."""
+    if mpu is not None:
+        return mpu.get_data_parallel_group()
+    return ("data", "expert")
+
+
+def _get_sequence_parallel_group():
+    return ("sequence",)
+
+
+def _get_sequence_data_parallel_group():
+    """The ZeRO sharding group: seq × dp (reference groups.py:497)."""
+    return ZERO_AXES
+
+
+def _get_model_parallel_group():
+    if mpu is not None:
+        return mpu.get_model_parallel_group()
+    return ("tensor",)
+
+
+def _get_tensor_model_parallel_group():
+    return _get_model_parallel_group()
+
+
+def _get_pipeline_parallel_group():
+    return ("pipe",)
+
+
+def _get_expert_parallel_group(group_name="default"):
+    return ("expert",)
+
+
+def _get_expert_data_parallel_group(group_name="default"):
+    """DP group for expert params: everything data-parallel except the expert axis."""
+    return EXPERT_ZERO_AXES
+
+
+def _get_broadcast_src_rank():
+    return 0
+
+
+# ----------------------------------------------------------------------------
+# Sizes and ranks
+# ----------------------------------------------------------------------------
+
+def get_world_size() -> int:
+    import jax
+    return jax.device_count()
+
+
+def get_data_parallel_world_size() -> int:
+    if mpu is not None:
+        try:
+            return mpu.get_data_parallel_world_size()
+        except Exception:
+            pass
+    return _axes_size(("data", "expert"))
+
+
+def get_zero_data_parallel_world_size() -> int:
+    """Number of shards ZeRO partitions over (seq × dp, reference engine.py:1138)."""
+    return _axes_size(ZERO_AXES)
+
+
+def get_model_parallel_world_size() -> int:
+    if mpu is not None:
+        try:
+            return mpu.get_model_parallel_world_size()
+        except Exception:
+            pass
+    return _axis_size("tensor")
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_model_parallel_world_size()
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _axis_size("sequence")
+
+
+def get_pipeline_parallel_world_size() -> int:
+    return _axis_size("pipe")
+
+
+def get_expert_parallel_world_size(group_name="default") -> int:
+    return _axis_size("expert")
+
+
+def get_expert_data_parallel_world_size(group_name="default") -> int:
+    return _axes_size(EXPERT_ZERO_AXES)
+
+
+def get_batch_shard_size() -> int:
+    """Number of ways the global batch is sharded."""
+    return _axes_size(("data", "expert"))
+
+
+def _process_coords():
+    """Coordinates of this process's first addressable device in the mesh."""
+    import jax
+    mesh = get_mesh()
+    local0 = jax.local_devices()[0]
+    idx = np.argwhere(mesh.devices == local0)
+    if idx.size == 0:
+        return {a: 0 for a in mesh.axis_names}
+    return dict(zip(mesh.axis_names, idx[0]))
+
+
+def get_data_parallel_rank() -> int:
+    coords = _process_coords()
+    return int(coords.get("data", 0) * _axis_size("expert") + coords.get("expert", 0))
+
+
+def get_model_parallel_rank() -> int:
+    return int(_process_coords().get("tensor", 0))
+
+
+def get_tensor_model_parallel_rank() -> int:
+    return get_model_parallel_rank()
+
+
+def get_sequence_parallel_rank() -> int:
+    return int(_process_coords().get("sequence", 0))
+
+
+def get_pipeline_parallel_rank() -> int:
+    return int(_process_coords().get("pipe", 0))
+
+
+def get_expert_parallel_rank(group_name="default") -> int:
+    return int(_process_coords().get("expert", 0))
+
+
+# ----------------------------------------------------------------------------
+# MoE expert group bookkeeping (reference groups.py:114-254)
+# ----------------------------------------------------------------------------
+
+def _ensure_divisibility(numerator, denominator):
+    assert numerator % denominator == 0, f"{numerator} is not divisible by {denominator}"
+
+
+def _create_expert_and_data_parallel(expert_parallel_size_val, use_data_before_expert_parallel_=False):
+    """Register an expert-parallel degree. On TPU the mesh already carries
+    the expert axis, so this validates the request against the mesh."""
+    mesh_ep = _axis_size("expert")
+    if expert_parallel_size_val != mesh_ep:
+        logger.warning(
+            f"Requested expert_parallel_size={expert_parallel_size_val} but mesh expert axis is {mesh_ep}; "
+            f"the mesh axis wins. Configure mesh.expert_parallel_size to change it.")
+    return _get_expert_parallel_group(), _get_expert_data_parallel_group()
+
+
+def _get_max_expert_size():
+    return max(expert_parallel_size_.values()) if expert_parallel_size_ else _axis_size("expert")
+
+
+def _get_max_expert_size_name():
+    return f"ep_size_{_get_max_expert_size()}"
+
+
+# ZeRO param-partition groups (hpZ secondary partitioning) are expressed as
+# mesh sub-axes; see deepspeed_tpu/runtime/zero/partitioning.py.
+def _create_zero_param_parallel_group(group_size):
+    logger.warning("zero_hpz_partition_size is expressed via the mesh on TPU; "
+                   "configure a 'zero' sub-axis through zero config instead.")
+    return None

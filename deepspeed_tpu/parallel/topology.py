@@ -1,0 +1,215 @@
+"""Process/device topology over a JAX device mesh.
+
+TPU-native analogue of the reference's ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology`` at topology.py:12, ``PipelineParallelGrid`` at 251) and
+``deepspeed/utils/groups.py``. Instead of building torch process groups, we
+build one ``jax.sharding.Mesh`` whose named axes stand in for process
+groups; collectives address axes by name inside ``shard_map``/``pjit``.
+
+Canonical axis order (outermost → innermost):
+
+    ('pipe', 'data', 'expert', 'sequence', 'tensor')
+
+- ``pipe``     — pipeline stages (cross-slice/DCN friendly).
+- ``data``     — pure data parallel replicas.
+- ``expert``   — expert parallelism; part of the data-parallel set for
+                 non-expert params (DeepSpeed carves EP groups out of DP,
+                 groups.py:114-254).
+- ``sequence`` — Ulysses sequence parallelism; part of the ZeRO sharding
+                 set (DeepSpeed's ``seq_data_parallel_group``).
+- ``tensor``   — Megatron-style tensor parallelism; innermost so its
+                 heavy collectives ride the fastest ICI dimension.
+"""
+
+from collections import namedtuple
+from itertools import product as cartesian_product
+
+import numpy as np
+
+MESH_AXES = ("pipe", "data", "expert", "sequence", "tensor")
+
+# Axes over which dense (non-expert) model state is sharded by ZeRO.
+ZERO_AXES = ("data", "expert", "sequence")
+# Axes over which the global batch is sharded.
+BATCH_AXES = ("data", "expert", "sequence")
+# Axes over which expert parameters' ZeRO sharding happens.
+EXPERT_ZERO_AXES = ("data", "sequence")
+
+
+class ProcessTopology:
+    """Manages the mapping of n-dimensional Cartesian coordinates to linear
+    indices. This mapping is used to map the rank of processes to the grid
+    for various forms of parallelism.
+
+    Each axis of the tensor is accessed by its name. The provided ordering
+    of the axes defines the layout of the topology.
+    ProcessTopology(axes=['x', 'y'], dims=[2,2]) gives a mapping where
+    (x,y) = (0,0), (0,1), (1,0), (1,1) map to ranks 0, 1, 2, 3 respectively.
+    ``x`` is the fastest-changing... actually the last axis is.
+    """
+
+    def __init__(self, axes, dims):
+        self.axes = list(axes)  # names of each topology axis
+        self.dims = list(dims)  # length of each topology axis
+
+        # This is actually a class that lets us hash {'row':3, 'col':2} mappings
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(cartesian_product(*ranges)):
+            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
+            key = self.ProcessCoord(**key)
+            # for example, {ProcessCoord(row=0, col=1) : 1}
+            self.mapping[key] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        """Return the global rank of a process via its coordinates."""
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError("get_rank() does not support slices. Use filter_match())")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"key {coord_kwargs} invalid"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        """Return a list of the axis names in the ordering of the topology."""
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=["data", "pipe"], inner_sep="_", outer_sep="-"):
+        """Return a string representation of a rank (e.g. for checkpoint names)."""
+        omit_axes = frozenset(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        """Return the number of processes along the given axis."""
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        """Return the coordinate owned by a process rank."""
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology.")
+
+    def get_axis_comm_lists(self, axis):
+        """Construct lists suitable for a communicator group along axis ``axis``."""
+        if axis not in self.axes:
+            return []
+
+        # Grab all axes but `axis`
+        other_axes = [a for a in self.axes if a != axis]
+
+        lists = []
+
+        # Construct all combinations of coords with other_axes
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in cartesian_product(*ranges):
+            other_keys = {a: coord[other_axes.index(a)] for a in other_axes}
+            # now go over all ranks in `axis`.
+            sub_list = []
+            for axis_key in range(self.get_dim(axis)):
+                key = self.ProcessCoord(**other_keys, **{axis: axis_key})
+                sub_list.append(self.mapping[key])
+            lists.append(sub_list)
+
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Return the list of ranks whose coordinates match the provided criteria."""
+
+        def _filter_helper(x):
+            for key, val in filter_kwargs.items():
+                if getattr(x, key) != val:
+                    return False
+            return True
+
+        coords = filter(_filter_helper, self.mapping.keys())
+        return [self.mapping[coord] for coord in coords]
+
+    def get_axis_list(self, axis, idx):
+        """Returns the list of global ranks whose coordinate in an axis is idx."""
+        ranks = [self.mapping[k] for k in self.mapping.keys() if getattr(k, axis) == idx]
+        return sorted(ranks)
+
+    def world_size(self):
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+def _prime_factors(N):
+    """Returns the prime factorization of positive integer N."""
+    if N <= 0:
+        raise ValueError("Values must be greater than 0")
+
+    primes = []
+    while N != 1:
+        for candidate in range(2, N + 1):
+            if N % candidate == 0:
+                primes.append(candidate)
+                N //= candidate
+                break
+    return primes
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """A topology specialization for hybrid data and pipeline parallelism."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """A topology for hybrid pipeline, model, and data parallelism."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+def make_mesh_topology(world_size=None,
+                       pipe=1,
+                       data=-1,
+                       expert=1,
+                       sequence=1,
+                       tensor=1,
+                       devices=None,
+                       allow_split_physical_axes=True):
+    """Build a ``jax.sharding.Mesh`` with the canonical axis layout.
+
+    One axis may be -1 and is inferred from the device count. The device
+    assignment is delegated to ``jax.make_mesh``, which lays axes out so
+    that inner axes map to physically adjacent devices (ICI rings).
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    ndev = len(devices)
+    dims = {"pipe": pipe, "data": data, "expert": expert, "sequence": sequence, "tensor": tensor}
+    unknown = [k for k, v in dims.items() if v == -1]
+    assert len(unknown) <= 1, f"only one mesh axis may be -1, got {dims}"
+    known = int(np.prod([v for v in dims.values() if v != -1]))
+    if unknown:
+        assert ndev % known == 0, f"device count {ndev} not divisible by {known}"
+        dims[unknown[0]] = ndev // known
+    total = int(np.prod(list(dims.values())))
+    assert total == ndev, (f"mesh {dims} requires {total} devices but {ndev} are available")
+
+    shape = tuple(dims[a] for a in MESH_AXES)
+    try:
+        # Auto axis types: classic pjit-style sharding propagation (the
+        # jax 0.9 default of Explicit would demand sharding-typed programs).
+        axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+        return jax.make_mesh(shape, MESH_AXES, axis_types=axis_types, devices=devices)
+    except (TypeError, AttributeError):
+        # Older make_mesh signatures
+        dev_array = np.asarray(devices).reshape(shape)
+        return jax.sharding.Mesh(dev_array, MESH_AXES)

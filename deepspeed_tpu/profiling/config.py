@@ -1,0 +1,20 @@
+"""Flops profiler config (reference ``deepspeed/profiling/config.py``)."""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+def get_flops_profiler_config(param_dict):
+    flops_profiler_dict = param_dict.get("flops_profiler", {})
+    return DeepSpeedFlopsProfilerConfig(**flops_profiler_dict)

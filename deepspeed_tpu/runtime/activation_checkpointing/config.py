@@ -1,0 +1,27 @@
+"""Activation checkpointing config.
+
+Same JSON keys as the reference's
+``deepspeed/runtime/activation_checkpointing/config.py``. On TPU,
+"partition_activations" maps to sharding the remat residuals over the
+tensor axis, and "cpu_checkpointing" maps to host offload of remat
+residuals via ``jax.checkpoint`` policies with host offload.
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+ACTIVATION_CHKPT = "activation_checkpointing"
+
+
+class DeepSpeedActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+def get_activation_checkpointing_config(param_dict):
+    return DeepSpeedActivationCheckpointingConfig(**param_dict.get(ACTIVATION_CHKPT, {}))

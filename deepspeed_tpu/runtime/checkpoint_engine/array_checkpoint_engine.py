@@ -1,0 +1,64 @@
+"""Default checkpoint engine: msgpack-serialized pytrees.
+
+Plays the role of the reference's ``TorchCheckpointEngine``
+(checkpoint_engine/torch_checkpoint_engine.py): synchronous local-disk
+save/load. State dicts are host-ified (``jax.device_get``) and written
+with flax msgpack serialization; arbitrary nesting of arrays, scalars,
+strings, lists and dicts is supported.
+"""
+
+import os
+
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _hostify(tree):
+    """Recursively convert to msgpack-friendly types: device arrays →
+    numpy, tuples → lists, None kept as-is."""
+    import jax
+
+    if isinstance(tree, dict):
+        return {k: _hostify(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_hostify(v) for v in tree]
+    if hasattr(tree, "addressable_shards") or hasattr(tree, "device"):
+        return np.asarray(jax.device_get(tree))
+    return tree
+
+
+class ArrayCheckpointEngine(CheckpointEngine):
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+
+    def create(self, tag):
+        log_dist(f"[DeepSpeedTPU] Saving model checkpoint: {tag}", ranks=[0])
+
+    def save(self, state_dict, path: str):
+        from flax import serialization
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        blob = serialization.msgpack_serialize(_hostify(state_dict), in_place=False)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        logger.debug(f"[DeepSpeedTPU] Saved {path}.")
+
+    def load(self, path: str, map_location=None):
+        from flax import serialization
+        with open(path, "rb") as f:
+            blob = f.read()
+        state = serialization.msgpack_restore(blob)
+        logger.debug(f"[DeepSpeedTPU] Loaded {path}.")
+        return state
+
+    def commit(self, tag):
+        logger.debug(f"[DeepSpeedTPU] Checkpoint {tag} is ready now!")
+        return True
+
+
+# API-parity alias (the reference default engine name)
+TorchCheckpointEngine = ArrayCheckpointEngine

@@ -1,0 +1,36 @@
+"""Checkpoint engine abstraction.
+
+Analogue of the reference's
+``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py``
+(``CheckpointEngine`` ABC at checkpoint_engine.py:9). Engines persist
+arbitrary nested state dicts (pytrees of arrays + python scalars).
+"""
+
+from abc import ABC, abstractmethod
+
+
+class CheckpointEngine(ABC):
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        # create checkpoint on give tag for save/load.
+        pass
+
+    @abstractmethod
+    def save(self, state_dict, path: str):
+        ...
+
+    def makedirs(self, path, exist_ok=False):
+        import os
+        os.makedirs(path, exist_ok=exist_ok)
+
+    @abstractmethod
+    def load(self, path: str, map_location=None):
+        ...
+
+    @abstractmethod
+    def commit(self, tag):
+        # to tell checkpoint services if all files are ready.
+        ...

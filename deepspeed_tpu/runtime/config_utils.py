@@ -1,0 +1,131 @@
+"""Typed config-section base model.
+
+Analogue of the reference's ``deepspeed/runtime/config_utils.py``
+(``DeepSpeedConfigModel``): a pydantic model with support for deprecated
+fields that auto-forward to their replacements, plus dict helpers.
+"""
+
+import collections
+from functools import reduce
+
+from pydantic import BaseModel, ConfigDict
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config sections.
+
+    Supports marking fields deprecated via ``json_schema_extra``:
+
+        my_field: int = Field(0, json_schema_extra={
+            "deprecated": True, "new_param": "better_field"})
+
+    On construction, if a deprecated field was user-set, its value is
+    forwarded to the replacement field (unless that was also user-set)
+    and a warning is logged.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        protected_namespaces=(),
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # This is temporary until we refactor all DS configs, allows HF to load models
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+        self._deprecated_fields_check()
+
+    def _process_deprecated_field(self, dep_field):
+        # Get information about the deprecated field
+        fields_set = self.model_fields_set
+        kwargs = type(self).model_fields[dep_field].json_schema_extra
+        new_param_fn = kwargs.get("new_param_fn", lambda x: x)
+        param_value = new_param_fn(getattr(self, dep_field))
+        new_field = kwargs.get("new_param", "")
+        dep_msg = kwargs.get("deprecated_msg", "")
+        if dep_field in fields_set:
+            logger.warning(f"Config parameter {dep_field} is deprecated" +
+                           (f" use {new_field} instead" if new_field else "") + (f". {dep_msg}" if dep_msg else ""))
+            # Check if there is a new param and if it should be set with a value
+            if new_field and kwargs.get("set_new_param", True):
+                # Remove the deprecate field if there is a replacing field
+                try:
+                    delattr(self, dep_field)
+                except Exception as e:
+                    logger.error(f"Tried removing deprecated '{dep_field}' from config")
+                    raise e
+
+                # Set new param value
+                new_param_nested = new_field.split(".")
+                if len(new_param_nested) > 1:
+                    # If the new param exists in a subconfig, we need to get
+                    # the fields set for that subconfig
+                    pydantic_config = reduce(getattr, new_param_nested[:-1], self)
+                    fields_set = pydantic_config.model_fields_set
+                else:
+                    # If the new param exists in the same level config, we will
+                    # modify the level config
+                    pydantic_config = self
+                new_param_name = new_param_nested[-1]
+                assert (new_param_name in type(pydantic_config).model_fields
+                        ), f"Tried setting value for '{new_field}' but it doesn't exist in the config"
+                # Only set the new param if it was not already set by the user
+                if new_param_name not in fields_set:
+                    setattr(pydantic_config, new_param_name, param_value)
+
+    def _deprecated_fields_check(self):
+        fields = type(self).model_fields
+        for field_name, field_info in fields.items():
+            if isinstance(field_info.json_schema_extra, dict) and field_info.json_schema_extra.get(
+                    "deprecated", False):
+                self._process_deprecated_field(field_name)
+
+
+def get_config_default(config, field_name):
+    assert field_name in type(config).model_fields, f"'{field_name}' is not a field in {config}"
+    assert not type(config).model_fields.get(
+        field_name).is_required(), f"'{field_name}' is a required field and does not have a default value"
+    return type(config).model_fields.get(field_name).get_default()
+
+
+class pp_int(int):
+    """An int with a nicer repr for large power-of-2-ish defaults."""
+
+    def __new__(cls, val, custom_print_str=None):
+        inst = super().__new__(cls, val)
+        inst.custom_print_str = custom_print_str
+        return inst
+
+    def __repr__(self):
+        if self.custom_print_str:
+            return self.custom_print_str
+        return f"{self.real:,}"
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys when parsing the JSON config."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = collections.Counter([pair[0] for pair in ordered_pairs])
+        keys = [key for key, value in counter.items() if value > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d

@@ -1,0 +1,115 @@
+"""Data-efficiency config parsing (reference ``deepspeed/runtime/data_pipeline/config.py``)."""
+
+import copy
+
+from deepspeed_tpu.runtime.data_pipeline.constants import *  # noqa: F401,F403
+
+
+def get_data_efficiency_config(param_dict):
+    output = {}
+    output[DATA_EFFICIENCY_ENABLED] = get_data_efficiency_enabled(param_dict)
+    output[DATA_EFFICIENCY_SEED] = get_data_efficiency_seed(param_dict)
+    if DATA_EFFICIENCY not in param_dict.keys():
+        param_dict[DATA_EFFICIENCY] = {}
+    sub_param_dict = param_dict[DATA_EFFICIENCY]
+    output[DATA_SAMPLING] = get_data_sampling(sub_param_dict)
+    output[DATA_ROUTING] = get_data_routing(sub_param_dict)
+    return output
+
+
+def get_data_efficiency_enabled(param_dict):
+    if DATA_EFFICIENCY in param_dict.keys():
+        return param_dict[DATA_EFFICIENCY].get(DATA_EFFICIENCY_ENABLED, DATA_EFFICIENCY_ENABLED_DEFAULT)
+    return DATA_EFFICIENCY_ENABLED_DEFAULT
+
+
+def get_data_efficiency_seed(param_dict):
+    if DATA_EFFICIENCY in param_dict.keys():
+        return param_dict[DATA_EFFICIENCY].get(DATA_EFFICIENCY_SEED, DATA_EFFICIENCY_SEED_DEFAULT)
+    return DATA_EFFICIENCY_SEED_DEFAULT
+
+
+def get_data_sampling(param_dict):
+    output = {}
+    output[DATA_SAMPLING_ENABLED] = get_data_sampling_enabled(param_dict)
+    output[DATA_SAMPLING_NUM_EPOCHS] = get_data_sampling_num_epochs(param_dict)
+    output[DATA_SAMPLING_NUM_WORKERS] = get_data_sampling_num_workers(param_dict)
+    if DATA_SAMPLING not in param_dict.keys():
+        param_dict[DATA_SAMPLING] = {}
+    sub_param_dict = param_dict[DATA_SAMPLING]
+    output[CURRICULUM_LEARNING] = get_curriculum_learning(sub_param_dict)
+    return output
+
+
+def get_data_sampling_enabled(param_dict):
+    if DATA_SAMPLING in param_dict.keys():
+        return param_dict[DATA_SAMPLING].get(DATA_SAMPLING_ENABLED, DATA_SAMPLING_ENABLED_DEFAULT)
+    return DATA_SAMPLING_ENABLED_DEFAULT
+
+
+def get_data_sampling_num_epochs(param_dict):
+    if DATA_SAMPLING in param_dict.keys():
+        return param_dict[DATA_SAMPLING].get(DATA_SAMPLING_NUM_EPOCHS, DATA_SAMPLING_NUM_EPOCHS_DEFAULT)
+    return DATA_SAMPLING_NUM_EPOCHS_DEFAULT
+
+
+def get_data_sampling_num_workers(param_dict):
+    if DATA_SAMPLING in param_dict.keys():
+        return param_dict[DATA_SAMPLING].get(DATA_SAMPLING_NUM_WORKERS, DATA_SAMPLING_NUM_WORKERS_DEFAULT)
+    return DATA_SAMPLING_NUM_WORKERS_DEFAULT
+
+
+def get_curriculum_learning(param_dict):
+    output = {}
+    output[CURRICULUM_LEARNING_ENABLED] = get_curriculum_learning_enabled(param_dict)
+    if CURRICULUM_LEARNING not in param_dict.keys():
+        param_dict[CURRICULUM_LEARNING] = {}
+    sub_param_dict = param_dict[CURRICULUM_LEARNING]
+    if output[CURRICULUM_LEARNING_ENABLED]:
+        assert CURRICULUM_LEARNING_METRICS in sub_param_dict.keys(
+        ), f"Curriculum learning is enabled, {CURRICULUM_LEARNING_METRICS} must be specified"
+    for key, val in get_curriculum_learning_params(param_dict).items():
+        output[key] = val
+    return output
+
+
+def get_curriculum_learning_enabled(param_dict):
+    if CURRICULUM_LEARNING in param_dict.keys():
+        return param_dict[CURRICULUM_LEARNING].get(CURRICULUM_LEARNING_ENABLED,
+                                                   CURRICULUM_LEARNING_ENABLED_DEFAULT)
+    return CURRICULUM_LEARNING_ENABLED_DEFAULT
+
+
+def get_curriculum_learning_params(param_dict):
+    if CURRICULUM_LEARNING in param_dict.keys():
+        curriculum_learning_params = copy.copy(param_dict[CURRICULUM_LEARNING])
+        curriculum_learning_params.pop(CURRICULUM_LEARNING_ENABLED, None)
+        return curriculum_learning_params
+    return {}
+
+
+def get_data_routing(param_dict):
+    output = {}
+    output[DATA_ROUTING_ENABLED] = get_data_routing_enabled(param_dict)
+    if DATA_ROUTING not in param_dict.keys():
+        param_dict[DATA_ROUTING] = {}
+    sub_param_dict = param_dict[DATA_ROUTING]
+    output[RANDOM_LTD] = get_random_ltd(sub_param_dict)
+    return output
+
+
+def get_data_routing_enabled(param_dict):
+    if DATA_ROUTING in param_dict.keys():
+        return param_dict[DATA_ROUTING].get(DATA_ROUTING_ENABLED, DATA_ROUTING_ENABLED_DEFAULT)
+    return DATA_ROUTING_ENABLED_DEFAULT
+
+
+def get_random_ltd(param_dict):
+    output = {}
+    output[RANDOM_LTD_ENABLED] = RANDOM_LTD_ENABLED_DEFAULT
+    output[RANDOM_LTD_LAYER_TOKEN_LR_SCHEDULE] = {}
+    output[RANDOM_LTD_LAYER_TOKEN_LR_SCHEDULE][
+        RANDOM_LTD_LAYER_TOKEN_LR_ENABLED] = RANDOM_LTD_LAYER_TOKEN_LR_ENABLED_DEFAULT
+    if RANDOM_LTD in param_dict.keys():
+        output.update(param_dict[RANDOM_LTD])
+    return output

@@ -1,0 +1,146 @@
+"""Data loading with data-parallel sharding.
+
+Analogue of the reference's ``deepspeed/runtime/dataloader.py``
+(``DeepSpeedDataLoader``): wraps a dataset into micro-batches, sharding
+samples across data-parallel replicas. Accepts torch Datasets/DataLoaders,
+NumPy/JAX array tuples, or any iterable of batches.
+"""
+
+import math
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart automatically when exhausted
+    (reference ``deepspeed/runtime/pipe/module.py`` helper)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+class DistributedSampler:
+    """Deterministic strided sampler over dataset indices for a dp rank."""
+
+    def __init__(self, num_samples, num_replicas, rank, shuffle=True, seed=0, drop_last=False):
+        self.num_samples_total = num_samples
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        if drop_last:
+            self.num_samples = num_samples // num_replicas
+        else:
+            self.num_samples = math.ceil(num_samples / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            indices = rng.permutation(self.num_samples_total).tolist()
+        else:
+            indices = list(range(self.num_samples_total))
+        if not self.drop_last:
+            padding = self.total_size - len(indices)
+            if padding > 0:
+                indices += indices[:padding]
+        else:
+            indices = indices[:self.total_size]
+        return iter(indices[self.rank:self.total_size:self.num_replicas])
+
+    def __len__(self):
+        return self.num_samples
+
+
+class DeepSpeedDataLoader:
+
+    def __init__(self,
+                 dataset,
+                 batch_size,
+                 local_rank=0,
+                 tput_timer=None,
+                 collate_fn=None,
+                 num_local_io_workers=None,
+                 data_sampler=None,
+                 data_parallel_world_size=None,
+                 data_parallel_rank=None,
+                 dataloader_drop_last=False,
+                 deepspeed_dataloader_config={}):
+        self.tput_timer = tput_timer
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.dataset = dataset
+        self.drop_last = dataloader_drop_last
+        self.dp_world_size = data_parallel_world_size or 1
+        self.dp_rank = data_parallel_rank or 0
+
+        if data_sampler is None:
+            data_sampler = DistributedSampler(
+                num_samples=len(dataset),
+                num_replicas=self.dp_world_size,
+                rank=self.dp_rank,
+                drop_last=dataloader_drop_last,
+            )
+        self.data_sampler = data_sampler
+        self.len = len(self.data_sampler) // self.batch_size if self.drop_last \
+            else math.ceil(len(self.data_sampler) / self.batch_size)
+        self.data = None
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        self._create_dataloader()
+        return self
+
+    def __next__(self):
+        if self.tput_timer:
+            self.tput_timer.start()
+        return next(self.data)
+
+    def _default_collate(self, samples):
+        first = samples[0]
+        if isinstance(first, (tuple, list)):
+            cols = list(zip(*samples))
+            return tuple(np.stack([np.asarray(x) for x in col]) for col in cols)
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+        return np.stack([np.asarray(s) for s in samples])
+
+    def _create_dataloader(self):
+        collate = self.collate_fn or self._default_collate
+
+        def gen():
+            buf = []
+            for idx in iter(self.data_sampler):
+                buf.append(self.dataset[idx])
+                if len(buf) == self.batch_size:
+                    yield collate(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield collate(buf)
+
+        self.data = gen()
+        return self.data

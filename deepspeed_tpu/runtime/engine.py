@@ -1,0 +1,983 @@
+"""The DeepSpeed training engine, TPU-native.
+
+Analogue of the reference's ``deepspeed/runtime/engine.py``
+(``DeepSpeedEngine`` at engine.py:180: ``forward`` 1785, ``backward``
+1924, ``step`` 2123, ``save_checkpoint`` 3056, ``load_checkpoint``
+2710), re-designed for XLA:
+
+- Model state is a pytree of globally-sharded jax.Arrays over one
+  ``jax.sharding.Mesh``; ZeRO stages are sharding policies
+  (see ``runtime/zero/partitioning.py``), not buffer partitioning.
+- ``forward`` computes loss *and* gradients in one fused
+  ``value_and_grad`` dispatch (async — the host does not block);
+  ``backward`` accumulates them; ``step`` runs the jitted
+  unscale/clip/update/re-cast with buffer donation. This preserves the
+  reference's imperative ``forward/backward/step`` surface on a purely
+  functional core.
+- ``train_batch`` additionally offers the fully-fused hot path: one jit
+  containing a ``lax.scan`` over gradient-accumulation micro-batches
+  plus the optimizer update.
+- fp16 loss scaling, bf16 + fp32 master weights, gradient clipping,
+  LR schedules, monitors, timers, and DeepSpeed-layout checkpoints are
+  all wired as in the reference.
+"""
+
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.ops.lion.fused_lion import FusedLion
+from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer
+from deepspeed_tpu.ops.sgd import SGD
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime import lr_schedules
+from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import ArrayCheckpointEngine
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER,
+                                             LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler, has_overflow, scaler_state, update_scale
+from deepspeed_tpu.runtime.zero.partitioning import ZeroShardingPolicy, batch_spec, path_tree_map
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER, FORWARD_GLOBAL_TIMER,
+                                       FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER, STEP_MICRO_TIMER, TRAIN_BATCH_TIMER,
+                                       NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+DeepSpeedOptimizerCallable = object
+DeepSpeedSchedulerCallable = object
+
+
+class EngineTimers:
+    """Wall-clock timers (reference engine.py:148)."""
+
+    def __init__(self, enable_micro_timers, enable_global_timers):
+        self.forward_timers = []
+        self.backward_timers = []
+        self.step_timers = []
+        self.global_timers = []
+        self.micro_timers = []
+
+        if enable_micro_timers:
+            self.forward_timers += [FORWARD_MICRO_TIMER]
+            self.backward_timers += [BACKWARD_MICRO_TIMER]
+            self.step_timers += [STEP_MICRO_TIMER]
+            self.micro_timers += [FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER, STEP_MICRO_TIMER]
+
+        if enable_global_timers:
+            self.forward_timers += [FORWARD_GLOBAL_TIMER]
+            self.backward_timers += [BACKWARD_GLOBAL_TIMER]
+            self.step_timers += [STEP_GLOBAL_TIMER]
+            self.global_timers += [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER]
+
+
+class DeepSpeedEngine:
+    """DeepSpeed engine: wraps a model to expose forward/backward/step."""
+
+    def __init__(self,
+                 args=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 mpu=None,
+                 dist_init_required=None,
+                 collate_fn=None,
+                 config=None,
+                 config_class: Optional[DeepSpeedConfig] = None,
+                 mesh=None,
+                 loss_fn=None,
+                 dont_change_device=False):
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_data = training_data
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+        self.loss_fn = loss_fn
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.gradient_average = True
+        self.warn_unscaled_loss = True
+        self.loaded_checkpoint_mp_world_size = None
+        self.loaded_checkpoint_dp_world_size = None
+        self.losses = None
+        self._is_training = True
+
+        if config_class is None:
+            config_class = DeepSpeedConfig(config, mpu=mpu, mesh_device=mesh)
+        self._config = config_class
+
+        if dist_init_required is None or dist_init_required:
+            if not dist.is_initialized():
+                dist.init_distributed()
+
+        # Mesh: explicit > config['mesh'] > all-data default
+        if mesh is not None:
+            groups.set_mesh(mesh)
+        elif not groups.mesh_is_initialized():
+            groups.initialize_mesh(self._config.mesh_shape)
+        self.mesh = groups.get_mesh()
+        groups.mpu = mpu
+
+        self.module = model
+        self.params = model_parameters if _is_pytree_of_arrays(model_parameters) else None
+        self.master_params = None
+        self.opt_state = None
+        self._initialized = False
+        self._param_rng = jax.random.PRNGKey(int(os.environ.get("DS_SEED", 42)))
+        self._dropout_rng = jax.random.PRNGKey(int(os.environ.get("DS_SEED", 42)) + 1)
+
+        # Precision
+        if self.bfloat16_enabled():
+            self.compute_dtype = jnp.bfloat16
+        elif self.fp16_enabled():
+            self.compute_dtype = jnp.float16
+        else:
+            self.compute_dtype = jnp.float32
+
+        self._grad_accum_dtype = {
+            None: jnp.float32,
+            "fp32": jnp.float32,
+            "fp16": jnp.float16,
+            "bf16": jnp.bfloat16,
+        }.get(self._config.grad_accum_dtype, jnp.float32)
+
+        # Loss scaler (host mirror; device state lives in self.scaler_state)
+        self._build_loss_scaler()
+
+        # Optimizer object (DeepSpeed-shaped; jitted transform drives updates)
+        self.optimizer = self._configure_optimizer()
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+
+        # ZeRO sharding policy
+        zc = self._config.zero_config
+        self.zero_stage = zc.stage
+        self.sharding_policy = ZeroShardingPolicy(
+            mesh=self.mesh,
+            stage=zc.stage,
+            tp_rule=getattr(model, "tp_rule", None),
+            param_persistence_threshold=int(zc.param_persistence_threshold),
+            offload_optimizer=zc.offload_optimizer_device().value != "none",
+            offload_param=zc.offload_param_device().value != "none",
+        )
+
+        # Monitors / timers
+        self.monitor = MonitorMaster(self._config.monitor_config)
+        self.wall_clock_breakdown_enabled = self._config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown_enabled else NoopTimer()
+        self.engine_timers = EngineTimers(enable_micro_timers=self.wall_clock_breakdown_enabled,
+                                          enable_global_timers=self.wall_clock_breakdown_enabled)
+        self.tput_timer = ThroughputTimer(
+            config=self._config.timers_config,
+            batch_size=self.train_batch_size(),
+            steps_per_output=self.steps_per_print(),
+        )
+
+        self.checkpoint_engine = ArrayCheckpointEngine()
+
+        # Data loader
+        self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
+
+        # caches for jitted callables and last-forward microbatch
+        self._jit_cache = {}
+        self._grads_acc = None
+        self._pending = None  # (loss, grads) from the last forward
+        self.global_grad_norm = 0.0
+        self.overflow = False
+
+        self._report_config()
+
+    # ------------------------------------------------------------------
+    # Config accessors (parity with reference engine surface)
+    # ------------------------------------------------------------------
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self._config.bfloat16_enabled
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def dynamic_loss_scale(self):
+        return self._config.loss_scale == 0
+
+    def initial_dynamic_scale(self):
+        return self._config.initial_dynamic_scale
+
+    def dynamic_loss_scale_args(self):
+        return self._config.dynamic_loss_scale_args
+
+    def postscale_gradients(self):
+        return not self._config.prescale_gradients
+
+    def gradient_predivide_factor(self):
+        return self._config.gradient_predivide_factor
+
+    def train(self, mode=True):
+        self._is_training = mode
+
+    def eval(self):
+        self._is_training = False
+
+    def dp_world_size(self):
+        return groups.get_data_parallel_world_size()
+
+    @property
+    def checkpoint_tag_validation_enabled(self):
+        return self._config.checkpoint_tag_validation_enabled
+
+    def _report_config(self):
+        log_dist(
+            f"DeepSpeedTPU engine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
+            f"micro_batch={self.train_micro_batch_size_per_gpu()} gas={self.gradient_accumulation_steps()} "
+            f"train_batch={self.train_batch_size()} mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------
+    # Optimizer / scheduler configuration (reference engine.py:1219/899)
+    # ------------------------------------------------------------------
+    def _configure_optimizer(self):
+        if self.client_optimizer is not None:
+            if isinstance(self.client_optimizer, DeepSpeedOptimizer):
+                return self.client_optimizer
+            if callable(self.client_optimizer):
+                opt = self.client_optimizer(None)
+                assert isinstance(opt, DeepSpeedOptimizer), \
+                    "optimizer callable must return a deepspeed_tpu optimizer"
+                return opt
+            raise ValueError("Unsupported client optimizer type; pass a deepspeed_tpu.ops optimizer "
+                             "or configure one via the 'optimizer' config section")
+        name = self._config.optimizer_name
+        params = dict(self._config.optimizer_params or {})
+        params.pop("torch_adam", None)
+        adam_w_mode = params.pop("adam_w_mode", None)
+        if name is None:
+            # default: Adam
+            return FusedAdam()
+        name = name.lower()
+        if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER):
+            offload = self._config.zero_config.offload_optimizer_device().value == "cpu"
+            if offload:
+                return DeepSpeedCPUAdam(adamw_mode=adam_w_mode if adam_w_mode is not None else True, **params)
+            return FusedAdam(adam_w_mode=adam_w_mode if adam_w_mode is not None else True, **params)
+        if name == ADAMW_OPTIMIZER:
+            return FusedAdam(adam_w_mode=True, **params)
+        if name == LAMB_OPTIMIZER:
+            return FusedLamb(**params)
+        if name == LION_OPTIMIZER:
+            return FusedLion(**params)
+        if name == ADAGRAD_OPTIMIZER:
+            return DeepSpeedCPUAdagrad(**params)
+        if name == SGD_OPTIMIZER:
+            return SGD(**params)
+        raise ValueError(f"Unknown optimizer {name}")
+
+    def _configure_lr_scheduler(self, client_lr_scheduler):
+        if client_lr_scheduler is not None:
+            if callable(client_lr_scheduler):
+                return client_lr_scheduler(self.optimizer)
+            return client_lr_scheduler
+        if self._config.scheduler_name is not None:
+            sched_cls = getattr(lr_schedules, self._config.scheduler_name, None)
+            if sched_cls is None:
+                raise ValueError(f"Unknown lr schedule {self._config.scheduler_name}")
+            return sched_cls(self.optimizer, **(self._config.scheduler_params or {}))
+        return None
+
+    def _build_loss_scaler(self):
+        if self.fp16_enabled():
+            if self.dynamic_loss_scale():
+                args = self.dynamic_loss_scale_args() or {}
+                self.loss_scaler = DynamicLossScaler(init_scale=args.get("init_scale",
+                                                                         self.initial_dynamic_scale()),
+                                                     scale_window=args.get("scale_window", 1000),
+                                                     min_scale=args.get("min_scale", 1),
+                                                     delayed_shift=args.get("delayed_shift", 2),
+                                                     consecutive_hysteresis=args.get("consecutive_hysteresis", False),
+                                                     raise_error_at_min_scale=False)
+                self.scaler_state = self.loss_scaler.device_state()
+                self._scaler_kwargs = dict(scale_window=self.loss_scaler.scale_window,
+                                           min_scale=self.loss_scaler.min_scale,
+                                           delayed_shift=self.loss_scaler.delayed_shift,
+                                           consecutive_hysteresis=self.loss_scaler.consecutive_hysteresis,
+                                           dynamic=True)
+            else:
+                self.loss_scaler = None
+                self.scaler_state = scaler_state(init_scale=self._config.loss_scale)
+                self._scaler_kwargs = dict(dynamic=False)
+        else:
+            self.loss_scaler = None
+            self.scaler_state = scaler_state(init_scale=1.0)
+            self._scaler_kwargs = dict(dynamic=False)
+
+    # ------------------------------------------------------------------
+    # Parameter/optimizer state materialization
+    # ------------------------------------------------------------------
+    def _apply_module(self, params, *args, rngs=None, **kwargs):
+        """Run the wrapped model. Supports flax modules ({'params': p}) and
+        plain callables f(params, *args)."""
+        if hasattr(self.module, "apply"):
+            try:
+                return self.module.apply({"params": params}, *args, rngs=rngs, **kwargs)
+            except TypeError:
+                return self.module.apply({"params": params}, *args, **kwargs)
+        return self.module(params, *args, **kwargs)
+
+    def _init_params(self, *fwd_args, **fwd_kwargs):
+        assert hasattr(self.module, "init"), (
+            "model has no .init(); pass model_parameters (a pytree of arrays) to initialize()")
+        rng = self._param_rng
+
+        def init_fn(rng):
+            variables = self.module.init(rng, *fwd_args, **fwd_kwargs)
+            return variables["params"]
+
+        abstract = jax.eval_shape(init_fn, rng)
+        shardings = path_tree_map(
+            lambda path, x: NamedSharding(self.mesh, self.sharding_policy.param_spec(path, x.shape)), abstract)
+        params = jax.jit(init_fn, out_shardings=shardings)(rng)
+        return jax.tree.map(lambda x: x.astype(self.compute_dtype) if _is_float(x) else x, params)
+
+    def _materialize_state(self, *fwd_args, **fwd_kwargs):
+        if self._initialized:
+            return
+        if self.params is None:
+            self.params = self._init_params(*fwd_args, **fwd_kwargs)
+        else:
+            # Re-place user-provided params with policy shardings + dtype
+            shardings = self.sharding_policy.tree_param_shardings(self.params)
+            self.params = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x.astype(self.compute_dtype) if _is_float(x) else x, s), self.params, shardings)
+
+        self._param_shardings = self.sharding_policy.tree_param_shardings(self.params)
+        self._param_specs = self.sharding_policy.tree_param_specs(self.params)
+        self._opt_shardings = self.sharding_policy.tree_opt_shardings(self.params)
+        self._opt_specs = self.sharding_policy.tree_opt_specs(self.params)
+        self._grad_specs = self.sharding_policy.tree_grad_specs(self.params)
+        self._grad_shardings = self.sharding_policy.tree_grad_shardings(self.params)
+
+        # fp32 master copy sharded like optimizer state (ZeRO-1 partitioning)
+        mixed = self.compute_dtype != jnp.float32
+        if mixed or self.zero_stage >= 1:
+            self.master_params = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(jnp.float32) if _is_float(x) else x, p),
+                out_shardings=self._opt_shardings)(self.params)
+        else:
+            self.master_params = self.params
+
+        # Optimizer state: mirror master sharding for params-shaped subtrees
+        transform = self.optimizer.transform()
+        self._opt_init, self._opt_update = transform.init, transform.update
+        abstract_state = jax.eval_shape(self._opt_init, self.master_params)
+        state_shardings = self._opt_state_shardings(abstract_state)
+        self.opt_state = jax.jit(self._opt_init, out_shardings=state_shardings)(self.master_params)
+        self._opt_state_shards = state_shardings
+
+        self._initialized = True
+
+        # A load_checkpoint() that ran before materialization stashed the
+        # optimizer/master/scaler state; apply it now.
+        pending = getattr(self, "_pending_optim_state", None)
+        if pending is not None:
+            self._restore_optim_state(pending)
+            self._pending_optim_state = None
+
+    def _opt_state_shardings(self, abstract_state):
+        params_treedef = jax.tree.structure(self.params)
+
+        def map_entry(entry):
+            if jax.tree.structure(entry) == params_treedef:
+                return self._opt_shardings
+            return jax.tree.map(lambda x: NamedSharding(self.mesh, P()), entry)
+
+        if isinstance(abstract_state, dict):
+            return {k: map_entry(v) for k, v in abstract_state.items()}
+        return jax.tree.map(lambda x: NamedSharding(self.mesh, P()), abstract_state)
+
+    # ------------------------------------------------------------------
+    # Batch placement
+    # ------------------------------------------------------------------
+    def _shard_batch(self, tree, extra_leading=0):
+        """Place batch arrays with batch (+sequence) sharding."""
+        def place(x):
+            x = np.asarray(x) if not isinstance(x, jax.Array) else x
+            nd = x.ndim - extra_leading
+            spec = batch_spec(self.mesh, extra_leading=extra_leading,
+                              shard_sequence=(nd >= 2))
+            spec = P(*list(spec)[:x.ndim])
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        return jax.tree.map(place, tree)
+
+    # ------------------------------------------------------------------
+    # forward / backward / step (reference engine.py:1785/1924/2123)
+    # ------------------------------------------------------------------
+    def _value_and_grad_fn(self):
+        key = "vag"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        gas = self.gradient_accumulation_steps()
+        acc_dtype = self._grad_accum_dtype
+        grad_specs = self._grad_specs
+
+        def loss_of(params, scale, rng, args, kwargs):
+            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            scaled = (loss.astype(jnp.float32) * scale) / gas
+            return scaled, loss
+
+        def fn(params, scale, rng, args, kwargs):
+            (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(params, scale, rng, args, kwargs)
+            grads = jax.tree.map(
+                lambda g, spec: jax.lax.with_sharding_constraint(g.astype(acc_dtype), NamedSharding(self.mesh, spec)),
+                grads, grad_specs)
+            return loss, grads
+
+        jitted = jax.jit(fn, static_argnames=())
+        self._jit_cache[key] = jitted
+        return jitted
+
+    def forward(self, *args, **kwargs):
+        """Compute loss (and, when training, gradients in the same fused
+        dispatch). Returns the unscaled loss."""
+        self._materialize_state(*args, **kwargs)
+        args = self._shard_batch(args)
+        kwargs = self._shard_batch(kwargs)
+        if not self._is_training:
+            if "eval" not in self._jit_cache:
+                self._jit_cache["eval"] = jax.jit(lambda p, a, k: self._apply_module(p, *a, **k))
+            return self._jit_cache["eval"](self.params, args, kwargs)
+
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        self._dropout_rng, sub = jax.random.split(self._dropout_rng)
+        scale = self.scaler_state["cur_scale"]
+        loss, grads = self._value_and_grad_fn()(self.params, scale, sub, args, kwargs)
+        self._pending = (loss, grads)
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def backward(self, loss=None, retain_graph=False, scale_wrt_gas=True):
+        """Accumulate the gradients computed by the matching forward()."""
+        assert self._pending is not None, "backward() called without a prior forward()"
+        _, grads = self._pending
+        self._pending = None
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if self._grads_acc is None:
+            self._grads_acc = grads
+        else:
+            key = "acc"
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(
+                    lambda a, g: jax.tree.map(jnp.add, a, g), donate_argnums=(0,))
+            self._grads_acc = self._jit_cache[key](self._grads_acc, grads)
+        self.micro_steps += 1
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        return (self.micro_steps % self.gradient_accumulation_steps()) == 0
+
+    def zero_grad(self):
+        self._grads_acc = None
+
+    def allreduce_gradients(self, bucket_size=MEMORY_OPT_ALLREDUCE_SIZE):
+        # Gradient reduction is fused into the sharded update by XLA.
+        pass
+
+    def _apply_update_fn(self):
+        key = "apply"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        clip = float(self.gradient_clipping() or 0.0)
+        fp16 = self.fp16_enabled()
+        scaler_kwargs = dict(self._scaler_kwargs)
+        compute_dtype = self.compute_dtype
+        param_specs = self._param_specs
+        mesh = self.mesh
+        opt_update = self._opt_update
+
+        tied = self.master_params is self.params
+
+        def body(params, master, opt_state, grads, scaler_st, lr):
+            scale = scaler_st["cur_scale"]
+            grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+            overflow = has_overflow(grads32) if fp16 else jnp.zeros((), bool)
+
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
+            if clip > 0.0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads32 = jax.tree.map(lambda g: g * factor, grads32)
+
+            new_master, new_opt = opt_update(grads32, opt_state, master, lr)
+
+            # skip the update on overflow
+            def sel(new, old):
+                return jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
+
+            new_master = sel(new_master, master)
+            new_opt = sel(new_opt, opt_state)
+            new_params = jax.tree.map(
+                lambda m, spec: jax.lax.with_sharding_constraint(
+                    m.astype(compute_dtype) if _is_float(m) else m, NamedSharding(mesh, spec)),
+                new_master, param_specs)
+            new_scaler = update_scale(scaler_st, overflow, **scaler_kwargs)
+            return new_params, new_master, new_opt, new_scaler, gnorm, overflow
+
+        if tied:
+            # master IS params: a single donated buffer (donating it at two
+            # argument positions would be a deleted-array error).
+            def fn(params, opt_state, grads, scaler_st, lr):
+                new_params, _, new_opt, new_scaler, gnorm, overflow = body(
+                    params, params, opt_state, grads, scaler_st, lr)
+                return new_params, new_opt, new_scaler, gnorm, overflow
+
+            jitted = jax.jit(fn, donate_argnums=(0, 1, 2, 3))
+        else:
+            jitted = jax.jit(body, donate_argnums=(0, 1, 2, 3, 4))
+        self._jit_cache[key] = (jitted, tied)
+        return self._jit_cache[key]
+
+    def step(self, lr_kwargs=None):
+        """Optimizer step at gradient-accumulation boundaries."""
+        assert self._grads_acc is not None, "step() called with no accumulated gradients"
+        if not self.is_gradient_accumulation_boundary():
+            return
+        self.timers(STEP_GLOBAL_TIMER).start()
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        fn, tied = self._apply_update_fn()
+        if tied:
+            out = fn(self.params, self.opt_state, self._grads_acc, self.scaler_state, lr)
+            self.params, self.opt_state, self.scaler_state, gnorm, overflow = out
+            self.master_params = self.params
+        else:
+            out = fn(self.params, self.master_params, self.opt_state, self._grads_acc, self.scaler_state, lr)
+            self.params, self.master_params, self.opt_state, self.scaler_state, gnorm, overflow = out
+        self._grads_acc = None
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self.overflow = bool(overflow) if self.fp16_enabled() else False
+        self.global_grad_norm = float(gnorm)
+        if self.overflow:
+            self.skipped_steps += 1
+            log_dist(f"[deepspeed_tpu] OVERFLOW! Skipping step; loss scale -> "
+                     f"{float(self.scaler_state['cur_scale'])}", ranks=[0])
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step(**(lr_kwargs or {}))
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        self._write_monitor()
+        if self.wall_clock_breakdown_enabled and self.global_steps % self.steps_per_print() == 0:
+            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER])
+
+    # ------------------------------------------------------------------
+    # Fused train_batch hot path
+    # ------------------------------------------------------------------
+    def _train_batch_fn(self):
+        key = "train_batch"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        gas = self.gradient_accumulation_steps()
+        acc_dtype = self._grad_accum_dtype
+        grad_specs = self._grad_specs
+        mesh = self.mesh
+        clip = float(self.gradient_clipping() or 0.0)
+        fp16 = self.fp16_enabled()
+        scaler_kwargs = dict(self._scaler_kwargs)
+        compute_dtype = self.compute_dtype
+        param_specs = self._param_specs
+        opt_update = self._opt_update
+
+        def micro_loss(params, scale, rng, batch):
+            args, kwargs = batch
+            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            return (loss.astype(jnp.float32) * scale) / gas, loss
+
+        tied = self.master_params is self.params
+
+        def body(params, master, opt_state, scaler_st, lr, rng, batches):
+            scale = scaler_st["cur_scale"]
+
+            def micro(carry, batch_rng):
+                acc = carry
+                batch, r = batch_rng
+                (_, loss), grads = jax.value_and_grad(micro_loss, has_aux=True)(params, scale, r, batch)
+                grads = jax.tree.map(
+                    lambda g, spec: jax.lax.with_sharding_constraint(
+                        g.astype(acc_dtype), NamedSharding(mesh, spec)), grads, grad_specs)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return acc, loss
+
+            zeros = jax.tree.map(
+                lambda p, spec: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, acc_dtype), NamedSharding(mesh, spec)), params, grad_specs)
+            rngs = jax.random.split(rng, gas)
+            acc, losses = jax.lax.scan(micro, zeros, (batches, rngs))
+
+            grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, acc)
+            overflow = has_overflow(grads32) if fp16 else jnp.zeros((), bool)
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
+            if clip > 0.0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads32 = jax.tree.map(lambda g: g * factor, grads32)
+
+            new_master, new_opt = opt_update(grads32, opt_state, master, lr)
+
+            def sel(new, old):
+                return jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
+
+            new_master = sel(new_master, master)
+            new_opt = sel(new_opt, opt_state)
+            new_params = jax.tree.map(
+                lambda m, spec: jax.lax.with_sharding_constraint(
+                    m.astype(compute_dtype) if _is_float(m) else m, NamedSharding(mesh, spec)),
+                new_master, param_specs)
+            new_scaler = update_scale(scaler_st, overflow, **scaler_kwargs)
+            return new_params, new_master, new_opt, new_scaler, losses.mean(), gnorm, overflow
+
+        if tied:
+            # single donated buffer when master IS params (fp32 stage 0)
+            def fn(params, opt_state, scaler_st, lr, rng, batches):
+                new_params, _, new_opt, new_scaler, mloss, gnorm, overflow = body(
+                    params, params, opt_state, scaler_st, lr, rng, batches)
+                return new_params, new_opt, new_scaler, mloss, gnorm, overflow
+
+            jitted = jax.jit(fn, donate_argnums=(0, 1, 2))
+        else:
+            jitted = jax.jit(body, donate_argnums=(0, 1, 2, 3))
+        self._jit_cache[key] = (jitted, tied)
+        return self._jit_cache[key]
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Run one full training step (gas micro-batches + update) as a
+        single jitted program (reference PipelineEngine.train_batch:326
+        surface, here for the data-parallel engine)."""
+        gas = self.gradient_accumulation_steps()
+        if batch is None:
+            assert data_iter is not None, "provide data_iter or batch"
+            micro = [next(data_iter) for _ in range(gas)]
+            batch = jax.tree.map(lambda *xs: np.stack(xs), *micro)
+        else:
+            lead = jax.tree.leaves(batch)[0].shape[0]
+            if lead != gas:
+                assert lead == gas * self.train_micro_batch_size_per_gpu(), (
+                    f"batch leading dim {lead} != gas*micro")
+                batch = jax.tree.map(
+                    lambda x: x.reshape((gas, self.train_micro_batch_size_per_gpu()) + x.shape[1:]), batch)
+        if not (isinstance(batch, tuple) and len(batch) == 2 and isinstance(batch[1], dict)):
+            batch = ((batch,) if not isinstance(batch, (tuple, list)) else tuple(batch), {})
+        self._materialize_state(*jax.tree.map(lambda x: x[0], batch[0]),
+                                **jax.tree.map(lambda x: x[0], batch[1]))
+        batch = self._shard_batch(batch, extra_leading=1)
+
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        self._dropout_rng, sub = jax.random.split(self._dropout_rng)
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        fn, tied = self._train_batch_fn()
+        if tied:
+            out = fn(self.params, self.opt_state, self.scaler_state, lr, sub, batch)
+            self.params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
+            self.master_params = self.params
+        else:
+            out = fn(self.params, self.master_params, self.opt_state, self.scaler_state, lr, sub, batch)
+            self.params, self.master_params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
+        self.global_steps += 1
+        self.micro_steps += gas
+        self.global_samples += self.train_batch_size()
+        self.overflow = bool(overflow) if self.fp16_enabled() else False
+        self.global_grad_norm = float(gnorm)
+        if not self.overflow and self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        elif self.overflow:
+            self.skipped_steps += 1
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.tput_timer.stop(global_step=True)
+        self.losses = mean_loss
+        self._write_monitor(loss=mean_loss)
+        return mean_loss
+
+    def _write_monitor(self, loss=None):
+        if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
+            events = [("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
+            if loss is not None:
+                events.append(("Train/Samples/train_loss", float(loss), self.global_samples))
+            if self.fp16_enabled():
+                events.append(("Train/Samples/loss_scale", float(self.scaler_state["cur_scale"]),
+                               self.global_samples))
+            self.monitor.write_events(events)
+
+    # ------------------------------------------------------------------
+    # LR / loss-scale accessors
+    # ------------------------------------------------------------------
+    def get_lr(self):
+        return [g["lr"] for g in self.optimizer.param_groups]
+
+    def get_type(self):
+        return type(self.optimizer).__name__
+
+    def get_mom(self):
+        return [g.get("betas", (0.0, 0.0))[0] for g in self.optimizer.param_groups]
+
+    def get_loss_scale(self):
+        return float(self.scaler_state["cur_scale"])
+
+    @property
+    def cur_scale(self):
+        return self.get_loss_scale()
+
+    def get_global_grad_norm(self):
+        return self.global_grad_norm
+
+    # ------------------------------------------------------------------
+    # Data loading (reference engine.py:1690)
+    # ------------------------------------------------------------------
+    def deepspeed_io(self,
+                     dataset,
+                     batch_size=None,
+                     route="train",
+                     pin_memory=True,
+                     data_sampler=None,
+                     collate_fn=None,
+                     num_local_io_workers=None):
+        if batch_size is None:
+            batch_size = self.train_micro_batch_size_per_gpu()
+        return DeepSpeedDataLoader(dataset=dataset,
+                                   batch_size=batch_size,
+                                   collate_fn=collate_fn or self.collate_fn,
+                                   data_parallel_world_size=1,  # one process addresses the full mesh
+                                   data_parallel_rank=0,
+                                   data_sampler=data_sampler)
+
+    # ------------------------------------------------------------------
+    # Checkpointing (reference engine.py:3056/2710)
+    # ------------------------------------------------------------------
+    def _get_ckpt_name(self, checkpoints_path, tag, mp_placeholder=None):
+        if mp_placeholder is not None:
+            mp_rank_str = mp_placeholder
+        else:
+            mp_rank_str = f"{groups.get_model_parallel_rank():02d}"
+        return os.path.join(checkpoints_path, str(tag), f"mp_rank_{mp_rank_str}_model_states.pt")
+
+    def _get_optimizer_ckpt_name(self, checkpoints_path, tag, dp_rank=None):
+        dp_rank = dp_rank if dp_rank is not None else dist.get_rank()
+        mp = groups.get_model_parallel_rank()
+        return os.path.join(checkpoints_path, str(tag),
+                            f"zero_pp_rank_{dp_rank}_mp_rank_{mp:02d}_optim_states.pt")
+
+    def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True, exclude_frozen_parameters=False):
+        assert self._initialized, "cannot save before the first forward/train_batch"
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        tag = str(tag)
+        self._validate_checkpoint_tag(tag)
+        self.checkpoint_engine.create(tag)
+
+        model_state = {
+            "module": _to_serializable(self.params),
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+            "dp_world_size": self.dp_world_size(),
+            "mp_world_size": groups.get_model_parallel_world_size(),
+            "ds_config": self._config._param_dict,
+            "ds_version": _version(),
+            "client_state": client_state,
+        }
+        if self.lr_scheduler is not None:
+            model_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+        if dist.get_rank() == 0:
+            self.checkpoint_engine.save(model_state, self._get_ckpt_name(save_dir, tag))
+
+        optim_state = {
+            "optimizer_state_dict": _to_serializable(self.opt_state),
+            "fp32_master_params": _to_serializable(self.master_params)
+            if self.master_params is not self.params else None,
+            "scaler_state": _to_serializable(self.scaler_state),
+            "optimizer_param_groups": [{k: v for k, v in g.items() if k != "params"}
+                                       for g in self.optimizer.param_groups],
+        }
+        if dist.get_rank() == 0:
+            self.checkpoint_engine.save(optim_state, self._get_optimizer_ckpt_name(save_dir, tag, dp_rank=0))
+
+        if save_latest and dist.get_rank() == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as fd:
+                fd.write(tag)
+        self.checkpoint_engine.commit(tag)
+        return True
+
+    def _validate_checkpoint_tag(self, tag):
+        if not self.checkpoint_tag_validation_enabled:
+            return
+        # all control-plane ranks must agree on the tag
+        digest = np.frombuffer(tag.encode().ljust(64, b"\0")[:64], dtype=np.uint8)
+        gathered = dist.host_all_gather(digest)
+        ok = bool((gathered == gathered[0]).all())
+        msg = f"checkpoint tag '{tag}' differs across ranks"
+        if not ok:
+            if self._config.checkpoint_tag_validation_fail:
+                raise ValueError(msg)
+            logger.warning(msg)
+
+    def load_checkpoint(self,
+                        load_dir,
+                        tag=None,
+                        load_module_strict=True,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True,
+                        load_module_only=False,
+                        custom_load_fn=None):
+        if tag is None:
+            latest_path = os.path.join(load_dir, "latest")
+            if os.path.isfile(latest_path):
+                with open(latest_path, "r") as fd:
+                    tag = fd.read().strip()
+            else:
+                logger.warning(f"Unable to find latest file at {latest_path}, "
+                               f"if trying to load latest checkpoint please pass `tag`")
+                return None, {}
+
+        ckpt_name = self._get_ckpt_name(load_dir, tag)
+        if not os.path.isfile(ckpt_name):
+            logger.warning(f"Client provided checkpoint load path: {ckpt_name} does not exist")
+            return None, {}
+        model_state = self.checkpoint_engine.load(ckpt_name)
+
+        loaded_params = model_state["module"]
+        if self._initialized:
+            # re-place onto existing shardings
+            self.params = jax.tree.map(
+                lambda cur, new, sh: jax.device_put(np.asarray(new).astype(cur.dtype), sh),
+                self.params, _match_tree(loaded_params, self.params), self._param_shardings)
+        else:
+            self.params = jax.tree.map(lambda x: np.asarray(x), loaded_params)
+
+        self.global_steps = int(model_state.get("global_steps", 0))
+        self.global_samples = int(model_state.get("global_samples", 0))
+        self.skipped_steps = int(model_state.get("skipped_steps", 0))
+        self.micro_steps = int(model_state.get("micro_steps", 0))
+        self.loaded_checkpoint_dp_world_size = model_state.get("dp_world_size")
+        self.loaded_checkpoint_mp_world_size = model_state.get("mp_world_size")
+        client_state = model_state.get("client_state", {})
+
+        if load_lr_scheduler_states and self.lr_scheduler is not None and "lr_scheduler" in model_state:
+            self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
+
+        if load_module_only or not load_optimizer_states:
+            return load_dir, client_state
+
+        optim_name = self._get_optimizer_ckpt_name(load_dir, tag, dp_rank=0)
+        if os.path.isfile(optim_name):
+            optim_state = self.checkpoint_engine.load(optim_name)
+            self._pending_optim_state = optim_state
+            if self._initialized:
+                self._restore_optim_state(optim_state)
+        return load_dir, client_state
+
+    def _restore_optim_state(self, optim_state):
+        loaded_opt = _match_tree(optim_state["optimizer_state_dict"], self.opt_state)
+        self.opt_state = jax.tree.map(
+            lambda cur, new: jax.device_put(np.asarray(new).astype(cur.dtype), cur.sharding),
+            self.opt_state, loaded_opt)
+        if optim_state.get("fp32_master_params") is not None and self.master_params is not self.params:
+            loaded_m = _match_tree(optim_state["fp32_master_params"], self.master_params)
+            self.master_params = jax.tree.map(
+                lambda cur, new: jax.device_put(np.asarray(new).astype(cur.dtype), cur.sharding),
+                self.master_params, loaded_m)
+        if "scaler_state" in optim_state and optim_state["scaler_state"] is not None:
+            self.scaler_state = jax.tree.map(jnp.asarray, _match_tree(optim_state["scaler_state"],
+                                                                      self.scaler_state))
+        for g, g_new in zip(self.optimizer.param_groups, optim_state.get("optimizer_param_groups", [])):
+            g.update(g_new)
+
+    # module state dict parity
+    def module_state_dict(self, exclude_frozen_parameters=False):
+        return _to_serializable(self.params)
+
+    def load_module_state_dict(self, state_dict, strict=True, custom_load_fn=None):
+        if self._initialized:
+            self.params = jax.tree.map(
+                lambda cur, new, sh: jax.device_put(np.asarray(new).astype(cur.dtype), sh),
+                self.params, _match_tree(state_dict, self.params), self._param_shardings)
+        else:
+            self.params = state_dict
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
+        """Consolidated compute-dtype weights (reference engine.py:3436)."""
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename.replace(".bin", ".msgpack"))
+        self.checkpoint_engine.save(_to_serializable(self.params), path)
+        return True
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype, jnp.floating)
+
+
+def _is_pytree_of_arrays(x):
+    if x is None:
+        return False
+    leaves = jax.tree.leaves(x)
+    return len(leaves) > 0 and all(hasattr(l, "shape") for l in leaves)
+
+
+def _to_serializable(tree):
+    if tree is None:
+        return None
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, tree)
+
+
+def _match_tree(loaded, reference):
+    """Restructure a msgpack-loaded dict to match the reference treedef."""
+    ref_treedef = jax.tree.structure(reference)
+    loaded_leaves = jax.tree.leaves(loaded)
+    ref_leaves = jax.tree.leaves(reference)
+    assert len(loaded_leaves) == len(ref_leaves), (
+        f"checkpoint has {len(loaded_leaves)} tensors, model expects {len(ref_leaves)}")
+    return jax.tree.unflatten(ref_treedef, loaded_leaves)
+
+
+def _version():
+    from deepspeed_tpu import __version__
+    return __version__

@@ -1,0 +1,173 @@
+"""Static and dynamic loss scaling.
+
+Analogue of the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler`` at loss_scaler.py:67, ``DynamicLossScaler`` at 91), with
+the same knobs (init scale, scale window, hysteresis, min scale). The
+scaler state is a pytree of device scalars so the overflow check and
+scale adjustment run inside the jitted step via ``lax.cond``-free
+``jnp.where`` arithmetic.
+"""
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+def scaler_state(init_scale=2.0**32, scale_window=1000, min_scale=1.0, delayed_shift=1,
+                 consecutive_hysteresis=False, dynamic=True):
+    return {
+        "cur_scale": jnp.asarray(float(init_scale), jnp.float32),
+        "cur_iter": jnp.zeros((), jnp.int32),
+        "last_overflow_iter": jnp.full((), -1, jnp.int32),
+        "cur_hysteresis": jnp.asarray(delayed_shift, jnp.int32),
+    }
+
+
+def has_overflow(grads):
+    """Global inf/nan check over a grad pytree (reference has_overflow_serial)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.zeros((), bool)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(x))) for x in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_scale(state, overflow, *, scale_factor=2.0, scale_window=1000, min_scale=1.0,
+                 delayed_shift=1, consecutive_hysteresis=False, dynamic=True):
+    """Pure update of the scaler state given this step's overflow flag."""
+    if not dynamic:
+        return dict(state, cur_iter=state["cur_iter"] + 1)
+    cur_scale = state["cur_scale"]
+    cur_iter = state["cur_iter"]
+    last_overflow_iter = state["last_overflow_iter"]
+    cur_hysteresis = state["cur_hysteresis"]
+
+    # On overflow: burn hysteresis first, then halve the scale.
+    hysteresis_active = cur_hysteresis > 1
+    new_scale_on_overflow = jnp.where(hysteresis_active, cur_scale,
+                                      jnp.maximum(cur_scale / scale_factor, min_scale))
+    new_hysteresis_on_overflow = jnp.where(hysteresis_active, cur_hysteresis - 1, cur_hysteresis)
+
+    # On a clean window: grow the scale. Matches the reference exactly:
+    # checked before the iteration counter increments
+    # ((cur_iter - last_overflow_iter) % scale_window == 0, loss_scaler.py:91).
+    window_done = ((cur_iter - last_overflow_iter) % scale_window) == 0
+    new_scale_clean = jnp.where(window_done, cur_scale * scale_factor, cur_scale)
+    refill = jnp.asarray(delayed_shift, jnp.int32)
+    if consecutive_hysteresis:
+        # reference: hysteresis refills on every clean step
+        new_hysteresis_clean = refill
+    else:
+        new_hysteresis_clean = jnp.where(window_done, refill, cur_hysteresis)
+
+    return {
+        "cur_scale": jnp.where(overflow, new_scale_on_overflow, new_scale_clean),
+        "cur_iter": cur_iter + 1,
+        "last_overflow_iter": jnp.where(overflow, cur_iter, last_overflow_iter),
+        "cur_hysteresis": jnp.where(overflow, new_hysteresis_on_overflow, new_hysteresis_clean),
+    }
+
+
+class LossScalerBase:
+    """Host-side wrapper for API parity with the reference classes."""
+
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+        self.dynamic = False
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return tuple(self.loss_scale * g for g in grad_in)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss, retain_graph=False):
+        # JAX grads are functional; scaling happens in the engine's loss fn.
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale (reference loss_scaler.py:67)."""
+
+    def __init__(self, scale=1.0):
+        super(LossScaler, self).__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic loss scale (reference loss_scaler.py:91)."""
+
+    def __init__(self,
+                 init_scale=2**32,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False,
+                 raise_error_at_min_scale=True,
+                 dtype=None):
+        super(DynamicLossScaler, self).__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.raise_error_at_min_scale = raise_error_at_min_scale
+        self.dynamic = True
+        self.dtype = dtype
+
+    def device_state(self):
+        return scaler_state(init_scale=self.cur_scale, scale_window=self.scale_window, min_scale=self.min_scale,
+                            delayed_shift=self.delayed_shift,
+                            consecutive_hysteresis=self.consecutive_hysteresis)
+
+    def sync_from_device(self, state):
+        self.cur_scale = float(state["cur_scale"])
+        self.cur_iter = int(state["cur_iter"])
+        self.last_overflow_iter = int(state["last_overflow_iter"])
+        self.cur_hysteresis = int(state["cur_hysteresis"])
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                if (self.cur_scale == self.min_scale) and self.raise_error_at_min_scale:
+                    raise Exception("Current loss scale already at minimum - cannot decrease scale anymore. "
+                                    "Exiting run.")
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args):
+    import jax.numpy as jnp
+    if dtype == jnp.float16 and dynamic_scaling:
+        dynamic_loss_args = dynamic_loss_args or {}
+        return DynamicLossScaler(dtype=dtype, **dynamic_loss_args)
+    loss_scale_value = static_loss_scale if dtype == jnp.float16 else 1.0
+    return LossScaler(scale=loss_scale_value)

@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig, ZERO_OPTIMIZATION
+from deepspeed_tpu.runtime.zero.partitioning import ZeroShardingPolicy
+from deepspeed_tpu.runtime.zero.partition_parameters import GatheredParameters, Init

@@ -1,0 +1,147 @@
+"""ZeRO config section.
+
+Mirrors the reference's ``deepspeed/runtime/zero/config.py``
+(``DeepSpeedZeroConfig``) JSON schema. On TPU, stages map to sharding
+strategies over the mesh's zero/data axis instead of torch-hook
+machinery:
+
+- stage 0: params/grads/optimizer replicated; gradients all-reduced.
+- stage 1: optimizer state sharded over the data axis.
+- stage 2: + gradients reduce-scattered into shards.
+- stage 3: + parameters sharded (gather-before-layer / free-after),
+  i.e. FSDP expressed as pjit shardings; XLA schedules the all-gathers.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel, pp_int
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+
+class OffloadDeviceEnum(str, Enum):
+    """Target device for offloaded tensors."""
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """Where/how ZeRO-3 parameter shards are offloaded."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(pp_int(1e8), ge=0)
+    max_in_cpu: int = Field(pp_int(1e9), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    """Where/how optimizer states (and fp32 master weights) are offloaded."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0)
+
+    @model_validator(mode="after")
+    def set_pipeline(self):
+        pipeline = self.pipeline_read or self.pipeline_write
+        self.__dict__["pipeline"] = pipeline
+        return self
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """``zero_optimization`` section (reference zero/config.py schema)."""
+
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(pp_int(5e8), ge=0)
+    use_multi_rank_bucket_allreduce: bool = True
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(pp_int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+
+    elastic_checkpoint: bool = False
+
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    sub_group_size: int = Field(pp_int(1e9), ge=0)
+
+    cpu_offload_param: Optional[bool] = Field(
+        None,
+        json_schema_extra={
+            "deprecated": True,
+            "new_param": "offload_param",
+            "new_param_fn": (lambda val: DeepSpeedZeroOffloadParamConfig(device=OffloadDeviceEnum.cpu)
+                             if val else None),
+        },
+    )
+    cpu_offload_use_pin_memory: Optional[bool] = Field(
+        None,
+        json_schema_extra={
+            "deprecated": True,
+            "set_new_param": False,
+        },
+    )
+    cpu_offload: Optional[bool] = Field(
+        None,
+        json_schema_extra={
+            "deprecated": True,
+            "new_param": "offload_optimizer",
+            "new_param_fn": (lambda val: DeepSpeedZeroOffloadOptimizerConfig(device=OffloadDeviceEnum.cpu)
+                             if val else None),
+        },
+    )
+
+    prefetch_bucket_size: int = Field(pp_int(5e7), ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(pp_int(1e5), ge=0, alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(pp_int(2**62), ge=0, alias="stage3_model_persistence_threshold")
+    max_live_parameters: int = Field(pp_int(1e9), ge=0, alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(pp_int(1e9), ge=0, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(False, alias="stage3_gather_16bit_weights_on_model_save")
+    use_all_reduce_for_fetch_params: bool = Field(False, alias="stage3_use_all_reduce_for_fetch_params")
+
+    stage3_gather_fp16_weights_on_model_save: bool = Field(
+        False, json_schema_extra={"deprecated": True, "new_param": "gather_16bit_weights_on_model_save"})
+
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+
+    mics_shard_size: int = Field(-1, json_schema_extra={"new_param": "mics_shard_size"})
+    mics_hierarchical_params_gather: bool = False
+
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+
+    @model_validator(mode="after")
+    def overlap_comm_valid(self):
+        if self.overlap_comm is None:
+            self.overlap_comm = self.stage == 3
+        return self
+
+    def offload_optimizer_device(self):
+        if self.offload_optimizer is None:
+            return OffloadDeviceEnum.none
+        return OffloadDeviceEnum(self.offload_optimizer.device)
+
+    def offload_param_device(self):
+        if self.offload_param is None:
+            return OffloadDeviceEnum.none
+        return OffloadDeviceEnum(self.offload_param.device)

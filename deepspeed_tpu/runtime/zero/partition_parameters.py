@@ -1,0 +1,96 @@
+"""zero.Init / GatheredParameters API parity.
+
+The reference (``deepspeed/runtime/zero/partition_parameters.py``)
+patches ``Module.__init__`` so parameters are partitioned at
+construction (``Init`` at partition_parameters.py:808) and offers
+``GatheredParameters`` (2100) to temporarily materialize full values.
+
+On TPU, parameters are *born sharded*: the engine jit-compiles the model
+init with ZeRO-3 output shardings, so each device only ever materializes
+its shard (same memory ceiling as the reference's zero.Init, achieved by
+XLA instead of ctor patching). ``Init`` therefore only records config;
+``GatheredParameters`` performs a real all-gather (resharding to fully
+replicated) for code that needs full values (export, debugging).
+"""
+
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+class ZeroParamStatus:
+    # unavailable: only the local shard is resident
+    NOT_AVAILABLE = 1
+    # in-flight: an all-gather has been dispatched (XLA-internal on TPU)
+    INFLIGHT = 2
+    # available: fully replicated values are resident
+    AVAILABLE = 3
+
+
+class Init:
+    """Context manager for partitioned model construction.
+
+    JAX models built inside this context are unaffected (construction is
+    abstract until ``jit``); the engine reads ``Init.current_config`` to
+    honor ``remote_device``/``pin_memory``-style options.
+    """
+
+    current_config = None
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True, remote_device=None,
+                 pin_memory=False, config_dict_or_path=None, config=None, enabled=True, dtype=None,
+                 mpu=None, zero_param_parallel_group=None, zero_quantized_weights=False,
+                 zero_quantized_nontrainable_weights=False, sequence_data_parallel_group=None, param_swapper=None):
+        self.enabled = enabled
+        self.config = dict(remote_device=remote_device, pin_memory=pin_memory, dtype=dtype,
+                           zero_quantized_weights=zero_quantized_weights)
+
+    def __enter__(self):
+        if self.enabled:
+            Init.current_config = self.config
+        return self
+
+    def __exit__(self, *exc):
+        Init.current_config = None
+        return False
+
+
+class GatheredParameters:
+    """Materialize fully-replicated values for sharded arrays.
+
+    Usage::
+
+        with GatheredParameters(params) as full:
+            ...  # full is the replicated pytree
+
+    ``modifier_rank`` is accepted for API parity; on TPU every process
+    computes the same values, so post-context re-partitioning just
+    re-places modified values with their original shardings.
+    """
+
+    def __init__(self, params, modifier_rank=None, fwd_module=None, enabled=True):
+        self.params = params
+        self.enabled = enabled
+        self.full = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self.params
+
+        def gather(x):
+            if hasattr(x, "sharding") and hasattr(x.sharding, "mesh"):
+                return jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
+            return x
+
+        self.full = jax.tree.map(gather, self.params)
+        return self.full
+
+    def __exit__(self, *exc):
+        return False
+
+
+@contextlib.contextmanager
+def no_init_or_sharding():
+    yield

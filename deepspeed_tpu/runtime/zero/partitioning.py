@@ -1,0 +1,202 @@
+"""ZeRO stages as sharding rules.
+
+This is the TPU-native replacement for the reference's torch-hook ZeRO
+machinery (``stage_1_and_2.py``, ``stage3.py``,
+``partition_parameters.py``): instead of partitioning flattened buffers
+and intercepting module execution, each ZeRO stage is expressed as a
+``PartitionSpec`` policy over the global mesh and XLA schedules the
+collectives:
+
+- stage 0: params/grads/optimizer replicated over the zero axes; grad
+  all-reduce happens implicitly (psum when grads meet replicated
+  optimizer state).
+- stage 1: optimizer state (fp32 master + moments) sharded over the
+  zero axes → XLA emits reduce-scatter(grads) + all-gather(params)
+  around the update, which *is* ZeRO-1/2's communication schedule.
+- stage 2: + gradients constrained to the sharded layout as they are
+  produced (``with_sharding_constraint`` in the engine's grad
+  accumulation), the analogue of IPG bucketing + early reduce-scatter
+  (reference stage_1_and_2.py:931).
+- stage 3: + parameters themselves sharded; with scan-over-layers XLA
+  all-gathers each layer's params just before use and frees them after,
+  which replaces the prefetch coordinator
+  (reference partitioned_param_coordinator.py:62). Small params below
+  ``param_persistence_threshold`` stay replicated, the analogue of
+  persistent params (reference parameter_offload.py:242).
+"""
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import EXPERT_ZERO_AXES, ZERO_AXES
+
+
+def _axis_sizes(mesh: Mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _spec_used_axes(spec):
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def shard_largest_free_dim(shape, base_spec, axes, mesh, allow_partial=True):
+    """Extend ``base_spec`` by sharding the largest unsharded dim over
+    ``axes`` (a tuple of mesh axis names). Falls back to a prefix of the
+    axes when full divisibility fails; returns ``base_spec`` unchanged if
+    nothing divides."""
+    sizes = _axis_sizes(mesh)
+    axes = tuple(a for a in axes if sizes.get(a, 1) > 1)
+    if not axes:
+        return base_spec
+    base = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    used = _spec_used_axes(base)
+    axes = tuple(a for a in axes if a not in used)
+    if not axes:
+        return P(*base)
+    # Candidate dims: unsharded, sorted by size descending
+    cand = sorted([d for d in range(len(shape)) if base[d] is None], key=lambda d: -shape[d])
+    full = int(np.prod([sizes[a] for a in axes]))
+    for d in cand:
+        if shape[d] % full == 0 and shape[d] > 0:
+            base[d] = axes if len(axes) > 1 else axes[0]
+            return P(*base)
+    if allow_partial:
+        # Try shrinking the axis set (drop from the left: outer axes first)
+        for k in range(len(axes) - 1, 0, -1):
+            sub = axes[-k:]
+            subprod = int(np.prod([sizes[a] for a in sub]))
+            for d in cand:
+                if shape[d] % subprod == 0 and shape[d] > 0:
+                    base[d] = sub if len(sub) > 1 else sub[0]
+                    return P(*base)
+    return P(*base)
+
+
+def is_expert_param(path: str) -> bool:
+    return "expert" in path.lower()
+
+
+class ZeroShardingPolicy:
+    """Computes parameter/optimizer/gradient PartitionSpecs for a config.
+
+    ``tp_rule`` is an optional ``(path, shape) -> PartitionSpec`` giving
+    tensor-parallel sharding (from the model or the AutoTP sharder);
+    zero sharding composes on top of it.
+    """
+
+    def __init__(self, mesh: Mesh, stage: int, tp_rule: Optional[Callable] = None,
+                 param_persistence_threshold: int = 0, offload_optimizer: bool = False,
+                 offload_param: bool = False):
+        self.mesh = mesh
+        self.stage = stage
+        self.tp_rule = tp_rule or (lambda path, shape: P())
+        self.param_persistence_threshold = param_persistence_threshold
+        self.offload_optimizer = offload_optimizer
+        self.offload_param = offload_param
+
+    def _zero_axes_for(self, path):
+        return EXPERT_ZERO_AXES if is_expert_param(path) else ZERO_AXES
+
+    def _base_spec(self, path, shape):
+        spec = self.tp_rule(path, shape)
+        if is_expert_param(path) and len(shape) >= 1:
+            # expert-sharded leading dim
+            sizes = _axis_sizes(self.mesh)
+            if sizes.get("expert", 1) > 1 and shape[0] % sizes["expert"] == 0:
+                entries = list(spec) + [None] * (len(shape) - len(spec))
+                if entries[0] is None:
+                    entries[0] = "expert"
+                spec = P(*entries)
+        return spec
+
+    def param_spec(self, path: str, shape) -> P:
+        """Sharding of the compute-dtype parameters."""
+        base = self._base_spec(path, shape)
+        if self.stage < 3:
+            return base
+        if int(np.prod(shape)) < self.param_persistence_threshold:
+            return base
+        return shard_largest_free_dim(shape, base, self._zero_axes_for(path), self.mesh)
+
+    def opt_spec(self, path: str, shape) -> P:
+        """Sharding of fp32 master params and optimizer moments."""
+        base = self._base_spec(path, shape)
+        if self.stage == 0:
+            return base
+        return shard_largest_free_dim(shape, base, self._zero_axes_for(path), self.mesh)
+
+    def grad_spec(self, path: str, shape) -> P:
+        """Layout gradients are constrained to as they are produced.
+
+        Stage ≥2 shards grads like the optimizer state (reduce-scatter as
+        early as possible); stage ≤1 keeps them replicated (all-reduce).
+        """
+        if self.stage >= 2:
+            return self.opt_spec(path, shape)
+        return self._base_spec(path, shape)
+
+    # NamedSharding helpers -------------------------------------------------
+    def _named(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def tree_param_shardings(self, params):
+        return path_tree_map(lambda path, x: self._named(self.param_spec(path, np.shape(x))), params)
+
+    def tree_opt_shardings(self, params):
+        return path_tree_map(lambda path, x: self._named(self.opt_spec(path, np.shape(x))), params)
+
+    def tree_grad_shardings(self, params):
+        return path_tree_map(lambda path, x: self._named(self.grad_spec(path, np.shape(x))), params)
+
+    def tree_param_specs(self, params):
+        return path_tree_map(lambda path, x: self.param_spec(path, np.shape(x)), params)
+
+    def tree_opt_specs(self, params):
+        return path_tree_map(lambda path, x: self.opt_spec(path, np.shape(x)), params)
+
+    def tree_grad_specs(self, params):
+        return path_tree_map(lambda path, x: self.grad_spec(path, np.shape(x)), params)
+
+
+def path_tree_map(fn, tree):
+    """tree_map passing a '/'-joined string path as first argument."""
+
+    def keystr(kp):
+        parts = []
+        for k in kp:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            elif hasattr(k, "name"):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    return jax.tree_util.tree_map_with_path(lambda kp, x: fn(keystr(kp), x), tree)
+
+
+def batch_spec(mesh: Mesh, extra_leading=0, shard_sequence=False):
+    """PartitionSpec for a [batch, seq, ...] array: batch over data+expert,
+    optionally sequence over the sequence axis (Ulysses input layout)."""
+    sizes = _axis_sizes(mesh)
+    b_axes = tuple(a for a in ("data", "expert") if sizes.get(a, 1) > 1)
+    entries = [None] * extra_leading
+    entries.append(b_axes if len(b_axes) > 1 else (b_axes[0] if b_axes else None))
+    if shard_sequence and sizes.get("sequence", 1) > 1:
+        entries.append("sequence")
+    return P(*entries)

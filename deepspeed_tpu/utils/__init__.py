@@ -1,0 +1,6 @@
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+# groups lives in deepspeed_tpu.parallel but is re-exported here for parity
+# with the reference's deepspeed.utils.groups
+from deepspeed_tpu.parallel import groups  # noqa: F401

@@ -1,0 +1,144 @@
+"""Per-op communication logging (reference ``deepspeed/utils/comms_logging.py``).
+
+Records per-collective message sizes/latency and prints a size-binned
+summary. On TPU, in-jit collectives can't be timed individually from the
+host; logged latency for those is dispatch-side wall time and the busbw
+model uses the standard algorithmic factors.
+"""
+
+import math
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def print_rank_0(message):
+    from deepspeed_tpu import comm as dist
+    if dist.get_rank() == 0:
+        print(message)
+
+
+# Helper function to pretty-print message sizes
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB", "EB", "ZB", "YB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return "%s %s" % (s, size_name[i])
+
+
+# Helper function to calculate algbw and busbw.
+# See https://gist.github.com/jeffra/b5e80466b4c86be00ea3b6f130fb7a36
+def calc_bw_log(comm_op, size, duration, n):
+    tput = 0
+    busbw = 0
+    if comm_op == "all_to_all_single" or comm_op == "all_to_all":
+        tput = (size / duration)
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op == "all_gather" or comm_op == "all_gather_into_tensor" or comm_op == "reduce_scatter" or \
+            comm_op == "reduce_scatter_tensor":
+        size *= n
+        tput = (size / duration)
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op == "all_reduce":
+        tput = (size * 2 / duration)
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    elif comm_op == "send" or comm_op == "recv" or comm_op == "isend" or comm_op == "irecv" or \
+            comm_op == "broadcast" or comm_op == "reduce" or comm_op == "gather" or comm_op == "scatter" or \
+            comm_op == "barrier" or comm_op == "ppermute":
+        tput = (size / duration)
+        busbw = tput
+    else:
+        print_rank_0("wrong comm_op specified")  # noqa: F821
+        return 0, 0
+
+    # convert to Gbps
+    tput *= 8
+    busbw *= 8
+
+    tput /= 1e6
+    busbw /= 1e6
+
+    return tput, busbw
+
+
+class CommsLogger:
+    """Records/prints per-collective stats (reference comms_logging.py)."""
+
+    def __init__(self):
+        from deepspeed_tpu.comm.config import CommsLoggerConfig
+        default = CommsLoggerConfig()
+        self.comms_dict = {}
+        self.verbose = default.verbose
+        self.debug = default.debug
+        self.prof_ops = default.prof_ops
+        self.prof_all = default.prof_all
+        self.enabled = default.enabled
+
+    def configure(self, comms_config):
+        self.enabled = comms_config.comms_logger_enabled
+        if self.enabled:
+            self.verbose = comms_config.comms_logger.verbose
+            self.debug = comms_config.comms_logger.debug
+            self.prof_ops = comms_config.comms_logger.prof_ops
+            self.prof_all = comms_config.comms_logger.prof_all
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def start_profiling_op(self, op_name_list):
+        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+
+    def stop_profiling_op(self, op_name_list):
+        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+
+    def append(self, raw_name, record_name, latency, msg_size, world_size):
+        import numpy as np
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, world_size)
+        if record_name in self.comms_dict.keys():
+            # If this comm_op has already been logged with this message size, just add to existing record
+            if msg_size in self.comms_dict[record_name].keys():
+                self.comms_dict[record_name][msg_size][0] += 1
+                self.comms_dict[record_name][msg_size][1].append(latency)
+                self.comms_dict[record_name][msg_size][2].append(algbw)
+                self.comms_dict[record_name][msg_size][3].append(busbw)
+            # If this is a new message size for this comm_op, add new record under existing comm_op
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            # Create entirely new record
+            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+        # If verbose, print every comm op
+        if self.verbose:
+            log_str = f"comm op: {record_name} | time (ms): {latency:.2f} | msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw:.2f} | busbw (Gbps): {busbw:.2f}"
+            log_dist(log_str, [0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from deepspeed_tpu.utils.timer import trim_mean
+        msg = "\n\nComm. Op            Message Size        Count       Total Latency(ms)   Avg Latency(ms)     tput_avg (Gbps)     busbw_avg (Gbps)\n"
+        for record_name in self.comms_dict.keys():
+            msg += record_name + "\n"
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                # vals[0] is the count for each msg size
+                count = vals[0]
+                # vals[1] is a list of latency records for each msg size
+                total_lat = sum(vals[1])
+                # vals[2] and vals[3] are the lists of algbw and busbw, respectively
+                # Get rid of outliers when we print
+                avg_lat = trim_mean(vals[1], 0.1)
+                avg_algbw = trim_mean(vals[2], 0.1)
+                avg_busbw = trim_mean(vals[3], 0.1)
+                msg += "{:<20} {:<20} {:<11} {:<19.2f} {:<19.2f} {:<19.2f} {:<19.2f}\n".format(
+                    record_name, convert_size(msg_size), count, total_lat * 1000, avg_lat * 1000, avg_algbw, avg_busbw)
+        if print_log:
+            print_rank_0(msg)
+        return self.comms_dict

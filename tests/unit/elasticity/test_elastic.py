@@ -1,0 +1,83 @@
+"""Elasticity tests (analogue of reference tests/unit/elasticity/test_elastic.py)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
+from deepspeed_tpu.elasticity.config import ElasticityConfigError, ElasticityIncompatibleWorldSize
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    final_batch_size, valid_gpus = compute_elastic_config(ds_config=base_ds_config,
+                                                          target_deepspeed_version="0.1.0")
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0, f"Batch {final_batch_size} is not divisible by GPU count {gpu_num}"
+        batch_per_gpu = final_batch_size // gpu_num
+        found_valid_mbsize = False
+        for mb in base_ds_config["elasticity"]["micro_batch_sizes"]:
+            if batch_per_gpu % mb == 0:
+                found_valid_mbsize = True
+                break
+        assert found_valid_mbsize, f"No valid mb size for batch per gpu {batch_per_gpu}"
+
+
+def test_world_size_in_valid_gpus():
+    final_batch_size, valid_gpus, mbsize = compute_elastic_config(ds_config=base_ds_config,
+                                                                  target_deepspeed_version="0.1.0",
+                                                                  world_size=64)
+    assert 64 in valid_gpus
+    assert final_batch_size % 64 == 0
+    assert (final_batch_size // 64) % mbsize == 0
+
+
+def test_invalid_world_size():
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=base_ds_config, target_deepspeed_version="0.1.0", world_size=7)
+
+
+def test_disabled_raises():
+    ds_config = {"elasticity": {"enabled": False, "max_train_batch_size": 100, "micro_batch_sizes": [2]}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.1.0")
+
+
+def test_missing_config_raises():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config={}, target_deepspeed_version="0.1.0")
+
+
+def test_get_compatible_gpus_v1():
+    final, valid = get_compatible_gpus(micro_batches=[2, 4], max_acceptable_batch_size=100,
+                                       min_gpus=1, max_gpus=16, version=0.1)
+    assert valid
+    for g in valid:
+        assert final % g == 0
+
+
+def test_v2_with_mp():
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "version": 0.2,
+            "model_parallel_size": 2,
+            "num_gpus_per_node": 8,
+        }
+    }
+    final, valid, micro = compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.1.0",
+                                                 world_size=16)
+    assert micro in [2, 4]

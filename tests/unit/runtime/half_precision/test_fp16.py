@@ -1,0 +1,141 @@
+"""fp16 loss-scaling tests (analogue of reference tests/unit/runtime/half_precision/test_fp16.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler, scaler_state, update_scale
+from unit.simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 32
+
+
+def make_engine(fp16_cfg, lr=1e-3):
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": lr}},
+        "fp16": fp16_cfg,
+        "mesh": {"data_parallel_size": 8},
+    }
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def test_fp16_trains():
+    engine = make_engine({"enabled": True, "initial_scale_power": 8})
+    losses = []
+    for x, y in random_dataloader(None, 48, HIDDEN, batch_size=8):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_overflow_skips_step_and_halves_scale():
+    engine = make_engine({"enabled": True, "initial_scale_power": 8, "hysteresis": 2})
+    x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    params_before = engine.module_state_dict()
+    scale_before = engine.get_loss_scale()
+
+    # Poison a batch to force inf grads
+    x_bad = x.copy()
+    x_bad[0, 0] = np.inf
+
+    # first overflow: hysteresis=2 absorbs it (reference loss_scaler.py
+    # semantics), scale unchanged, step skipped
+    loss = engine(x_bad, y)
+    engine.backward(loss)
+    engine.step()
+    assert engine.overflow, "overflow was not detected"
+    assert engine.skipped_steps == 1
+    assert engine.get_loss_scale() == scale_before
+
+    # second overflow: scale halves
+    loss = engine(x_bad, y)
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 2
+    assert engine.get_loss_scale() == scale_before / 2
+
+    params_after = engine.module_state_dict()
+    import jax
+    for a, b in zip(jax.tree.leaves(params_before), jax.tree.leaves(params_after)):
+        assert np.array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32)), \
+            "params changed despite overflow"
+
+
+def test_static_loss_scale():
+    engine = make_engine({"enabled": True, "loss_scale": 128.0})
+    assert engine.get_loss_scale() == 128.0
+    x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert engine.get_loss_scale() == 128.0  # static: never changes
+
+
+class TestDynamicScalerUnit:
+    """Pure-function scaler semantics (window growth, hysteresis)."""
+
+    def test_grow_after_window(self):
+        st = scaler_state(init_scale=256.0)
+        kw = dict(scale_window=4, min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False, dynamic=True)
+        for _ in range(4):
+            st = update_scale(st, jnp.zeros((), bool), **kw)
+        assert float(st["cur_scale"]) == 512.0
+
+    def test_shrink_on_overflow(self):
+        st = scaler_state(init_scale=256.0)
+        kw = dict(scale_window=1000, min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False, dynamic=True)
+        st = update_scale(st, jnp.ones((), bool), **kw)
+        assert float(st["cur_scale"]) == 128.0
+
+    def test_hysteresis_delays_shrink(self):
+        st = scaler_state(init_scale=256.0, delayed_shift=2)
+        kw = dict(scale_window=1000, min_scale=1.0, delayed_shift=2, consecutive_hysteresis=False, dynamic=True)
+        st = update_scale(st, jnp.ones((), bool), **kw)
+        assert float(st["cur_scale"]) == 256.0  # first overflow burns hysteresis
+        st = update_scale(st, jnp.ones((), bool), **kw)
+        assert float(st["cur_scale"]) == 128.0
+
+    def test_min_scale_floor(self):
+        st = scaler_state(init_scale=2.0)
+        kw = dict(scale_window=1000, min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False, dynamic=True)
+        st = update_scale(st, jnp.ones((), bool), **kw)
+        st = update_scale(st, jnp.ones((), bool), **kw)
+        assert float(st["cur_scale"]) == 1.0
+
+    def test_host_mirror_matches(self):
+        host = DynamicLossScaler(init_scale=256.0, scale_window=4, delayed_shift=1)
+        st = scaler_state(init_scale=256.0)
+        kw = dict(scale_window=4, min_scale=1, delayed_shift=1, consecutive_hysteresis=False, dynamic=True)
+        pattern = [False, False, True, False, False, False, False, True]
+        for ov in pattern:
+            host.update_scale(ov)
+            st = update_scale(st, jnp.asarray(ov), **kw)
+        assert float(st["cur_scale"]) == host.cur_scale
+
+
+def test_bf16_no_loss_scaling():
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "mesh": {"data_parallel_size": 8},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN), config=config)
+    assert engine.get_loss_scale() == 1.0

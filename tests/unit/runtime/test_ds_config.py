@@ -1,0 +1,132 @@
+"""Config tests (analogue of reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+class TestBatchConfig:
+
+    def test_all_given(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 8,
+        })
+        assert cfg.train_batch_size == 32
+        assert cfg.train_micro_batch_size_per_gpu == 4
+        assert cfg.gradient_accumulation_steps == 8
+
+    def test_infer_gas(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 8})
+        assert cfg.gradient_accumulation_steps == 4
+
+    def test_infer_micro(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 4})
+        assert cfg.train_micro_batch_size_per_gpu == 8
+
+    def test_infer_train(self):
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 8, "gradient_accumulation_steps": 4})
+        assert cfg.train_batch_size == 32
+
+    def test_only_train(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 32})
+        assert cfg.train_micro_batch_size_per_gpu == 32
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_mismatch_raises(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedConfig({
+                "train_batch_size": 33,
+                "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 8,
+            })
+
+    def test_none_raises(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedConfig({"gradient_accumulation_steps": 4})
+
+    def test_world_size_triangulation(self):
+        class FakeMpu:
+            def get_data_parallel_world_size(self):
+                return 4
+
+        cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, mpu=FakeMpu())
+        assert cfg.gradient_accumulation_steps == 2
+
+
+class TestPrecisionConfig:
+
+    def test_bf16(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 1, "bf16": {"enabled": True}})
+        assert cfg.bfloat16_enabled and not cfg.fp16_enabled
+
+    def test_fp16(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 1,
+            "fp16": {"enabled": True, "initial_scale_power": 8, "loss_scale_window": 500},
+        })
+        assert cfg.fp16_enabled
+        assert cfg.initial_dynamic_scale == 2**8
+        assert cfg.dynamic_loss_scale_args["scale_window"] == 500
+
+    def test_both_raises(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedConfig({
+                "train_batch_size": 1,
+                "fp16": {"enabled": True},
+                "bf16": {"enabled": True},
+            })
+
+
+class TestZeroConfig:
+
+    def test_stage(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 1, "zero_optimization": {"stage": 3}})
+        assert cfg.zero_enabled
+        assert cfg.zero_optimization_stage == 3
+
+    def test_offload(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 1,
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu", "pin_memory": True},
+                "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+            },
+        })
+        assert cfg.zero_config.offload_optimizer_device().value == "cpu"
+        assert cfg.zero_config.offload_param_device().value == "nvme"
+
+    def test_deprecated_cpu_offload(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 1,
+            "zero_optimization": {"stage": 2, "cpu_offload": True},
+        })
+        assert cfg.zero_config.offload_optimizer_device().value == "cpu"
+
+    def test_aliases(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 1,
+            "zero_optimization": {"stage": 3, "stage3_max_live_parameters": 12345},
+        })
+        assert cfg.zero_config.max_live_parameters == 12345
+
+
+class TestConfigFromFile:
+
+    def test_json_file(self, tmp_path):
+        path = tmp_path / "ds_config.json"
+        path.write_text(json.dumps({"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 0.1}}}))
+        cfg = DeepSpeedConfig(str(path))
+        assert cfg.train_batch_size == 8
+        assert cfg.optimizer_name == "adam"
+        assert cfg.optimizer_params["lr"] == 0.1
+
+    def test_dup_keys_raise(self, tmp_path):
+        path = tmp_path / "dup.json"
+        path.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+        with pytest.raises(ValueError):
+            DeepSpeedConfig(str(path))

@@ -1,0 +1,112 @@
+"""LR schedule tests (analogue of reference tests/unit/runtime/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupCosineLR, WarmupDecayLR, WarmupLR)
+
+
+def opt(lr=0.01):
+    return FusedAdam(lr=lr)
+
+
+class TestWarmupLR:
+
+    def test_reaches_max(self):
+        o = opt()
+        s = WarmupLR(o, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+        for _ in range(15):
+            s.step()
+        assert o.param_groups[0]["lr"] == pytest.approx(0.1)
+
+    def test_linear_midpoint(self):
+        o = opt()
+        s = WarmupLR(o, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+        # after construction, step() was called once (iteration 0)
+        for _ in range(5):
+            s.step()
+        assert o.param_groups[0]["lr"] == pytest.approx(0.05)
+
+    def test_log_shape(self):
+        o = opt()
+        s = WarmupLR(o, warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100, warmup_type="log")
+        s.step(50)
+        expected = math.log(51) / math.log(100)
+        assert o.param_groups[0]["lr"] == pytest.approx(expected)
+
+
+class TestWarmupDecayLR:
+
+    def test_decays_to_zero(self):
+        o = opt()
+        s = WarmupDecayLR(o, total_num_steps=20, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+        for _ in range(25):
+            s.step()
+        assert o.param_groups[0]["lr"] == pytest.approx(0.0)
+
+    def test_peak_at_warmup_end(self):
+        o = opt()
+        s = WarmupDecayLR(o, total_num_steps=20, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                          warmup_type="linear")
+        s.step(10)
+        assert o.param_groups[0]["lr"] == pytest.approx(0.1)
+
+
+class TestWarmupCosineLR:
+
+    def test_cosine_tail(self):
+        o = opt(lr=0.1)
+        s = WarmupCosineLR(o, total_num_steps=100, warmup_num_steps=10, cos_min_ratio=0.1)
+        s.step(100)
+        assert o.param_groups[0]["lr"] == pytest.approx(0.1 * 0.1, rel=1e-2)
+
+
+class TestLRRangeTest:
+
+    def test_continuous_growth(self):
+        o = opt()
+        s = LRRangeTest(o, lr_range_test_min_lr=0.01, lr_range_test_step_size=10, lr_range_test_step_rate=1.0)
+        lrs = []
+        for _ in range(30):
+            s.step()
+            lrs.append(o.param_groups[0]["lr"])
+        assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+        assert lrs[-1] == pytest.approx(0.01 * (1 + 3.0))
+
+    def test_staircase(self):
+        o = opt()
+        s = LRRangeTest(o, lr_range_test_min_lr=0.01, lr_range_test_step_size=10, lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+        seen = set()
+        for _ in range(30):
+            s.step()
+            seen.add(round(o.param_groups[0]["lr"], 8))
+        assert len(seen) <= 4  # discrete stairs
+
+
+class TestOneCycle:
+
+    def test_cycle_peak_and_return(self):
+        o = opt()
+        s = OneCycle(o, cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10, cycle_momentum=False)
+        lrs = []
+        for _ in range(20):
+            s.step()
+            lrs.append(o.param_groups[0]["lr"])
+        assert max(lrs) == pytest.approx(0.1, rel=1e-6)
+        assert lrs[-1] == pytest.approx(0.01, rel=1e-2)
+
+    def test_state_dict_roundtrip(self):
+        o = opt()
+        s = OneCycle(o, cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10, cycle_momentum=False)
+        for _ in range(7):
+            s.step()
+        sd = s.state_dict()
+        o2 = opt()
+        s2 = OneCycle(o2, cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10, cycle_momentum=False)
+        s2.load_state_dict(sd)
+        s.step()
+        s2.step()
+        assert o.param_groups[0]["lr"] == o2.param_groups[0]["lr"]

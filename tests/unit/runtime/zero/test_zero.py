@@ -1,0 +1,157 @@
+"""ZeRO stage tests (analogue of reference tests/unit/runtime/zero/test_zero.py).
+
+The central correctness property: every ZeRO stage is numerically
+equivalent to plain data-parallel training (stage 0), and the optimizer
+math matches an unsharded reference implementation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import groups
+from unit.simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 64
+
+
+def run_engine(stage, dtype_cfg, steps=6, gas=1, hidden=HIDDEN, seed=42, lr=1e-2, extra_zero=None, opt="Adam"):
+    groups.destroy_mesh()
+    zero_cfg = {"stage": stage}
+    zero_cfg.update(extra_zero or {})
+    config = {
+        "train_batch_size": 16 * gas,
+        "train_micro_batch_size_per_gpu": 16,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": opt, "params": {"lr": lr}},
+        "zero_optimization": zero_cfg,
+        "mesh": {"data_parallel_size": 8},
+    }
+    config.update(dtype_cfg)
+    model = SimpleModel(hidden_dim=hidden, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    batches = random_dataloader(None, 16 * gas * steps, hidden, batch_size=16)
+    losses = []
+    for x, y in batches:
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses, engine
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_stage_matches_dp_baseline(stage):
+    """ZeRO-n loss trajectory == plain DP (stage 0) trajectory."""
+    base, _ = run_engine(0, {"bf16": {"enabled": True}})
+    test, _ = run_engine(stage, {"bf16": {"enabled": True}})
+    assert np.allclose(base, test, rtol=1e-5, atol=1e-5), f"stage {stage}: {base} vs {test}"
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_stage_fp32_matches_reference_adam(stage):
+    """fp32 engine result == hand-rolled Adam on the same data."""
+    losses, engine = run_engine(stage, {}, steps=4)
+
+    # Hand-rolled reference: same init (same rng), same data, plain Adam.
+    model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+    params = model.init(jax.random.PRNGKey(42), np.zeros((16, HIDDEN), np.float32),
+                        np.zeros((16,), np.int64))["params"]
+    m = jax.tree.map(jnp.zeros_like, params)
+    v = jax.tree.map(jnp.zeros_like, params)
+    lr, b1, b2, eps = 1e-2, 0.9, 0.999, 1e-8
+    batches = random_dataloader(None, 16 * 4, HIDDEN, batch_size=16)
+    ref_losses = []
+
+    @jax.jit
+    def step(params, m, v, t, x, y):
+        def loss_fn(p):
+            return model.apply({"params": p}, x, y)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        m = jax.tree.map(lambda mm, gg: b1 * mm + (1 - b1) * gg, m, g)
+        v = jax.tree.map(lambda vv, gg: b2 * vv + (1 - b2) * gg**2, v, g)
+        bc1 = 1 - b1**t
+        bc2 = 1 - b2**t
+        params = jax.tree.map(lambda p, mm, vv: p - lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + eps), params, m, v)
+        return params, m, v, loss
+
+    for t, (x, y) in enumerate(batches, start=1):
+        params, m, v, loss = step(params, m, v, float(t), x, y)
+        ref_losses.append(float(loss))
+
+    assert np.allclose(losses, ref_losses, rtol=2e-4, atol=2e-4), f"{losses} vs {ref_losses}"
+
+
+def test_stage3_params_are_sharded():
+    _, engine = run_engine(3, {"bf16": {"enabled": True}}, steps=1, extra_zero={
+        "stage3_param_persistence_threshold": 0})
+    mesh_size = 8
+    sharded = 0
+    for leaf in jax.tree.leaves(engine.params):
+        n_shards = len({s.index for s in leaf.addressable_shards})
+        if leaf.ndim > 0 and leaf.shape[0] * leaf.size >= 0 and n_shards > 1:
+            sharded += 1
+    assert sharded > 0, "no parameter was actually sharded under stage 3"
+
+
+def test_stage1_opt_state_sharded_params_replicated():
+    _, engine = run_engine(1, {"bf16": {"enabled": True}}, steps=1)
+    for leaf in jax.tree.leaves(engine.params):
+        assert len({s.index for s in leaf.addressable_shards}) == 1, "stage1 params must be replicated"
+    any_sharded = any(
+        len({s.index for s in leaf.addressable_shards}) > 1
+        for leaf in jax.tree.leaves(engine.opt_state["exp_avg"]))
+    assert any_sharded, "stage1 optimizer state must be sharded"
+
+
+def test_persistence_threshold_keeps_small_replicated():
+    _, engine = run_engine(3, {"bf16": {"enabled": True}}, steps=1,
+                           extra_zero={"stage3_param_persistence_threshold": 10**9})
+    for leaf in jax.tree.leaves(engine.params):
+        assert len({s.index for s in leaf.addressable_shards}) == 1
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=2 with half-size micro-batches == gas=1 full batch."""
+    l1, _ = run_engine(0, {}, steps=4, gas=1)
+    # same total batch via 2 micro steps: feed the same data
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data_parallel_size": 8},
+    }
+    model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    batches = random_dataloader(None, 16 * 4, HIDDEN, batch_size=16)
+    l2 = []
+    for x, y in batches:
+        halves = [(x[:8], y[:8]), (x[8:], y[8:])]
+        step_losses = []
+        for hx, hy in halves:
+            loss = engine(hx, hy)
+            engine.backward(loss)
+            step_losses.append(float(loss))
+        engine.step()
+        l2.append(float(np.mean(step_losses)))
+    assert np.allclose(l1, l2, rtol=1e-4, atol=1e-4), f"{l1} vs {l2}"
+
+
+@pytest.mark.parametrize("opt", ["Lamb", "Lion", "Adagrad", "SGD"])
+def test_other_optimizers_train(opt):
+    losses, _ = run_engine(2, {"bf16": {"enabled": True}}, steps=5, opt=opt, lr=1e-3)
+    assert losses[-1] < losses[0], f"{opt} failed to reduce loss: {losses}"
+
+
+def test_fp32_stage0_tied_buffers():
+    """fp32 + stage 0: master IS params (one donated buffer) — must not crash."""
+    losses, engine = run_engine(0, {}, steps=3)
+    assert engine.master_params is engine.params
+    assert losses[-1] < losses[0]

@@ -1,0 +1,60 @@
+"""Fixture models (analogue of reference tests/unit/simple_model.py)."""
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SimpleModel(nn.Module):
+    """Linear stack returning cross-entropy loss (reference SimpleModel)."""
+    hidden_dim: int
+    nlayers: int = 1
+    empty_grad: bool = False
+
+    @nn.compact
+    def __call__(self, x, y):
+        for i in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim, name=f"linear_{i}")(x)
+        logits = nn.Dense(self.hidden_dim, name="classifier")(x)
+        labels = y.astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+        return loss
+
+
+class SimpleMLPModel(nn.Module):
+    """MLP with named projections that AutoTP recognizes."""
+    hidden_dim: int
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y):
+        for i in range(self.nlayers):
+            h = nn.Dense(self.hidden_dim * 4, name=f"layer{i}_up_proj")(x)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.hidden_dim, name=f"layer{i}_down_proj")(h)
+        logits = nn.Dense(self.hidden_dim, name="classifier")(x)
+        labels = y.astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+        return loss
+
+
+def random_dataset(total_samples, hidden_dim, seed=123, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(total_samples, hidden_dim).astype(dtype)
+    y = rng.randint(0, hidden_dim, size=(total_samples,)).astype(np.int64)
+    return list(zip(x, y))
+
+
+def random_dataloader(model_unused, total_samples, hidden_dim, device_unused=None, dtype=np.float32, batch_size=8):
+    data = random_dataset(total_samples, hidden_dim, dtype=dtype)
+    batches = []
+    for i in range(0, total_samples, batch_size):
+        chunk = data[i:i + batch_size]
+        xs = np.stack([c[0] for c in chunk])
+        ys = np.stack([c[1] for c in chunk])
+        batches.append((xs, ys))
+    return batches
