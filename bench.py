@@ -1,0 +1,105 @@
+"""Benchmark: tokens/sec/chip + MFU for a Llama-style train step.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The north-star from BASELINE.json is ZeRO-3 Llama ≥45% MFU on v5e;
+``vs_baseline`` reports measured MFU / 0.45.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOPs/s per chip
+PEAK_FLOPS = {
+    "tpu v5 lite": 197e12,  # v5e
+    "tpu v5": 459e12,       # v5p
+    "tpu v4": 275e12,
+    "tpu v6 lite": 918e12,  # v6e (Trillium)
+    "cpu": 1e12,            # nominal, for local smoke runs
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def _param_count(params) -> int:
+    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # ~550M params: fits one v5e chip with fp32 optimizer states
+        model = build_llama("160m", hidden_size=1536, intermediate_size=4096,
+                            num_hidden_layers=16, num_attention_heads=16,
+                            num_key_value_heads=16, max_position_embeddings=2048)
+        B, S, steps, warmup = 4, 2048, 10, 3
+    else:
+        model = build_llama("debug")
+        B, S, steps, warmup = 4, 64, 3, 1
+
+    config = {
+        "train_batch_size": B,
+        "train_micro_batch_size_per_gpu": B,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, model.config.vocab_size, size=(B, S)).astype(np.int32))
+
+    for _ in range(warmup):
+        engine.train_batch(batch=(ids, ids))
+    jax.block_until_ready(engine.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=(ids, ids))
+    jax.block_until_ready(engine.params)
+    dt = (time.perf_counter() - t0) / steps
+
+    n_chips = jax.device_count()
+    tokens_per_sec_chip = B * S / dt / n_chips
+    n_params = _param_count(engine.params)
+    model_flops = 6.0 * n_params * B * S  # fwd+bwd, ignoring attention quadratic term
+    mfu = model_flops / dt / (n_chips * _peak_flops(jax.devices()[0]))
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "batch": B,
+            "seq": S,
+            "step_ms": round(dt * 1e3, 2),
+            "loss": round(float(loss), 4),
+            "backend": jax.default_backend(),
+            "device": jax.devices()[0].device_kind,
+            "n_chips": n_chips,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from deepspeed_tpu.models.llama import (LLAMA_CONFIGS, LlamaConfig, LlamaForCausalLM, build_llama,
+                                        causal_lm_loss, llama_tp_rule)  # noqa: F401

@@ -1,0 +1,2 @@
+from deepspeed_tpu.moe.layer import MoE  # noqa: F401
+from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate, top1gating, top2gating, topkgating  # noqa: F401

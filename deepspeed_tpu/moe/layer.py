@@ -1,0 +1,56 @@
+"""Public MoE module (reference ``deepspeed/moe/layer.py`` ``MoE`` at
+layer.py:17).
+
+The reference wraps a user-supplied expert ``nn.Module`` and replicates
+it ``num_local_experts`` times; here the experts are a stacked parameter
+tensor inside :class:`deepspeed_tpu.moe.sharded_moe.MOELayer`, sharded
+over the 'expert' mesh axis (the TPU-native form of expert parallelism —
+``groups.py:114-254`` expert/expert-data groups become mesh axes).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate, top1gating, top2gating, topkgating  # noqa: F401
+from deepspeed_tpu.parallel import groups
+
+
+class MoE(nn.Module):
+    """Mixture-of-Experts FFN layer.
+
+    Returns ``(output, aux_loss)``; the caller adds
+    ``aux_loss * coefficient`` to the training loss (the reference
+    engine aggregates the same way via ``MoE.get_moe_loss``).
+    """
+    hidden_size: int
+    num_experts: int = 1
+    intermediate_size: int = 0
+    ep_size: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False
+    noisy_gate_policy: str = ""
+
+    @nn.compact
+    def __call__(self, hidden_states, train: bool = True):
+        inter = self.intermediate_size or 4 * self.hidden_size
+        out, aux_loss = MOELayer(num_experts=self.num_experts,
+                                 hidden_size=self.hidden_size,
+                                 intermediate_size=inter,
+                                 k=self.k,
+                                 capacity_factor=self.capacity_factor,
+                                 eval_capacity_factor=self.eval_capacity_factor,
+                                 min_capacity=self.min_capacity,
+                                 noisy_gate_policy=self.noisy_gate_policy or None,
+                                 name="deepspeed_moe")(hidden_states, train=train)
+        if self.use_residual:
+            # residual MoE (DeepSpeed-MoE): dense MLP branch + learned mixer
+            res = nn.Dense(inter, use_bias=False, name="residual_up")(hidden_states)
+            res = nn.silu(res)
+            res = nn.Dense(self.hidden_size, use_bias=False, name="residual_down")(res)
+            coef = nn.Dense(2, name="coefficient")(hidden_states)
+            coef = nn.softmax(coef.astype(jnp.float32), axis=-1).astype(out.dtype)
+            out = out * coef[..., 0:1] + res * coef[..., 1:2]
+        return out, aux_loss

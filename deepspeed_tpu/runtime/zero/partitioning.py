@@ -112,8 +112,9 @@ class ZeroShardingPolicy:
 
     def _base_spec(self, path, shape):
         spec = self.tp_rule(path, shape)
-        if is_expert_param(path) and len(shape) >= 1:
-            # expert-sharded leading dim
+        if is_expert_param(path) and len(shape) >= 1 and "expert" not in _spec_used_axes(spec):
+            # No explicit expert placement from the tp_rule: assume the
+            # expert dim leads (standalone MOELayer params are (E, ...)).
             sizes = _axis_sizes(self.mesh)
             if sizes.get("expert", 1) > 1 and shape[0] % sizes["expert"] == 0:
                 entries = list(spec) + [None] * (len(shape) - len(spec))

@@ -1,0 +1,2 @@
+from deepspeed_tpu.sequence.layer import (DistributedAttention, constrain, constrain_hidden,
+                                          head_to_seq_shard, seq_to_head_shard)  # noqa: F401

@@ -1,0 +1,121 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Capability match for the reference's ``deepspeed/sequence/layer.py``
+(``single_all_to_all`` at layer.py:15, ``_SeqAllToAll`` at 44,
+``DistributedAttention`` at 60). The reference wraps any local attention
+with two explicit ``all_to_all`` collectives that trade the sequence
+shard for a head shard before attention and back after.
+
+On TPU the same exchange is expressed as a sharding re-layout: inputs
+arrive sharded ``[B, S/'sequence', H, D]``; constraining them to
+``[B, S, H/'sequence', D]`` makes XLA insert exactly the Ulysses
+all-to-all over the ICI ring, fused with neighbouring ops where
+possible. The head axis keeps any Megatron 'tensor' sharding, so
+Ulysses composes with TP (heads sharded over ('tensor','sequence')).
+"""
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import groups
+
+# Canonical activation layouts.
+BATCH_AXES_SPEC = ("data", "expert")
+
+
+def _mesh_axis_sizes(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _batch_entry(mesh):
+    sizes = _mesh_axis_sizes(mesh)
+    axes = tuple(a for a in BATCH_AXES_SPEC if sizes.get(a, 1) > 1)
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
+def constrain(x, spec_entries, mesh=None):
+    """with_sharding_constraint with graceful no-mesh fallback.
+
+    ``spec_entries`` is a tuple of PartitionSpec entries (axis name,
+    tuple of names, or None) — entries naming axes of size 1 (or absent
+    from the mesh) are dropped so the same model code runs on any mesh.
+    """
+    mesh = mesh if mesh is not None else groups.get_mesh(required=False)
+    if mesh is None:
+        return x
+    sizes = _mesh_axis_sizes(mesh)
+
+    def live(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if sizes.get(a, 1) > 1)
+            if not kept:
+                return None
+            return kept if len(kept) > 1 else kept[0]
+        return entry if sizes.get(entry, 1) > 1 else None
+
+    spec = P(*[live(e) for e in spec_entries])
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def constrain_hidden(x, mesh=None):
+    """[B, S, D] activations: batch over data axes, seq over 'sequence'."""
+    mesh = mesh if mesh is not None else groups.get_mesh(required=False)
+    if mesh is None:
+        return x
+    return constrain(x, (_batch_entry(mesh), "sequence", None), mesh)
+
+
+def seq_to_head_shard(x, mesh=None):
+    """Ulysses forward exchange on [B, S, H, D]: sequence-sharded →
+    head-sharded (reference ``single_all_to_all`` scatter_idx=2)."""
+    mesh = mesh if mesh is not None else groups.get_mesh(required=False)
+    if mesh is None:
+        return x
+    return constrain(x, (_batch_entry(mesh), None, ("tensor", "sequence"), None), mesh)
+
+
+def head_to_seq_shard(x, mesh=None):
+    """Ulysses reverse exchange on [B, S, H, D]: head-sharded →
+    sequence-sharded (reference ``single_all_to_all`` scatter_idx=1)."""
+    mesh = mesh if mesh is not None else groups.get_mesh(required=False)
+    if mesh is None:
+        return x
+    return constrain(x, (_batch_entry(mesh), "sequence", "tensor", None), mesh)
+
+
+class DistributedAttention:
+    """Ulysses wrapper around any local attention callable
+    (reference ``DistributedAttention``, sequence/layer.py:60).
+
+    ``local_attn(q, k, v, *args, **kwargs)`` operates on
+    ``[B, S, H, D]`` tensors that hold the **full** sequence and a head
+    shard; this wrapper accepts sequence-sharded inputs, performs the
+    seq↔head all-to-all exchange on both sides, and returns
+    sequence-sharded output.
+    """
+
+    def __init__(self, local_attention, sequence_process_group=None,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        if (scatter_idx, gather_idx) != (2, 1):
+            raise NotImplementedError(
+                "only the [B, S, H, D] layout (scatter_idx=2, gather_idx=1) is supported; "
+                "transpose to batch-seq-head-dim before wrapping")
+        self.local_attn = local_attention
+        self.spg = sequence_process_group
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, mesh=None, **kwargs):
+        mesh = mesh if mesh is not None else groups.get_mesh(required=False)
+        q = seq_to_head_shard(query, mesh)
+        k = seq_to_head_shard(key, mesh)
+        v = seq_to_head_shard(value, mesh)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        return head_to_seq_shard(out, mesh)

@@ -1,0 +1,111 @@
+"""Flagship model tests: forward, loss, TP/SP sharding, engine training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import LLAMA_CONFIGS, build_llama, causal_lm_loss
+from deepspeed_tpu.parallel import groups
+
+
+def _batch(cfg, B=2, S=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    return ids
+
+
+class TestLlamaForward:
+
+    def test_logits_shape_and_loss(self):
+        model = build_llama("debug")
+        cfg = model.config
+        ids = _batch(cfg)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        logits = model.apply(variables, ids)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        loss, logits2 = model.apply(variables, ids, ids)
+        assert np.isfinite(float(loss))
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2), rtol=1e-5)
+
+    def test_scanned_params_have_layer_dim(self):
+        model = build_llama("debug")
+        ids = _batch(model.config)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        k = variables["params"]["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
+        assert k.shape[0] == model.config.num_hidden_layers
+
+    def test_loss_ignore_index(self):
+        logits = jnp.zeros((1, 4, 8))
+        labels = jnp.array([[1, 2, -100, 3]])
+        loss = causal_lm_loss(logits, labels)
+        # uniform logits -> loss == log(8) over the 2 unmasked targets
+        np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+    def test_gqa_kv_heads(self):
+        model = build_llama("debug", num_key_value_heads=2, num_attention_heads=4)
+        ids = _batch(model.config)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        k = variables["params"]["model"]["layers"]["self_attn"]["k_proj"]["kernel"]
+        assert k.shape[-1] == 2 * model.config.head_dim
+
+
+class TestLlamaSharded:
+
+    def test_tp_sp_engine_train(self):
+        """Train on a tp=2, sp=2, dp=2 mesh end-to-end through the engine."""
+        model = build_llama("debug")
+        config = {
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"tensor_parallel_size": 2, "sequence_parallel_size": 2},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        ids = _batch(model.config, B=4, S=16)
+        losses = []
+        for step in range(3):
+            loss = engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+    def test_zero3_param_sharding(self):
+        model = build_llama("debug")
+        config = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        ids = _batch(model.config, B=8, S=16)
+        loss = engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+        assert np.isfinite(float(loss))
+        # q_proj kernel must actually be sharded over the zero axes
+        k = engine.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
+        assert not k.sharding.is_fully_replicated
+
+
+class TestLlamaMoE:
+
+    def test_moe_forward_and_train(self):
+        model = build_llama("debug", moe_num_experts=4, moe_top_k=2)
+        config = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"expert_parallel_size": 4},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        ids = _batch(model.config, B=8, S=16)
+        loss = engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+        assert np.isfinite(float(loss))
+        w1 = engine.params["model"]["layers"]["moe_mlp"]["deepspeed_moe"]["experts_w1"]
+        assert w1.shape[1] == 4  # (L, E, D, I)
+        # expert dim (axis 1) genuinely sharded over the 4-way expert axis
+        assert w1.sharding.shard_shape(w1.shape)[1] == 1
