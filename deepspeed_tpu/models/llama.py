@@ -45,7 +45,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
-    attention_impl: str = "einsum"  # "einsum" | "flash"
+    attention_impl: str = "auto"  # "auto" | "einsum" | "flash"
     remat: bool = True
     # MoE (0 = dense)
     moe_num_experts: int = 0
@@ -119,6 +119,11 @@ def einsum_attention(q, k, v, causal=True, bias=None):
 
 
 def _local_attention(q, k, v, impl: str, causal=True):
+    if impl == "auto":
+        from deepspeed_tpu.ops.pallas import use_pallas
+        # The Pallas kernel wins once the [S, S] score matrix dominates;
+        # tiny test shapes stay on the fused-by-XLA einsum path.
+        impl = "flash" if use_pallas() and q.shape[1] >= 256 else "einsum"
     if impl == "flash":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal)

@@ -1,0 +1,32 @@
+"""Pallas TPU kernels — the framework's native-kernel layer.
+
+TPU-native replacement for the reference's CUDA device code under
+``csrc/`` (training transformer kernels ``csrc/transformer/``, inference
+kernels ``csrc/transformer/inference/csrc/``, quantization
+``csrc/quantization/``): instead of hand-written CUDA bound via
+pybind11, the hot ops are Pallas kernels launched from jitted XLA
+programs. Everything else (bias-add, gelu chains, residual adds, …) is
+left to the XLA fuser on purpose — re-implementing those would only
+defeat the compiler.
+
+Dispatch policy: each op has a reference XLA implementation and a
+Pallas kernel; ``use_pallas()`` selects the kernel on TPU backends
+(override with ``DS_PALLAS=0/1``). Tests exercise the kernels in
+interpreter mode on CPU against the XLA references.
+"""
+
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    env = os.environ.get("DS_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "False")
+    return jax.default_backend() == "tpu"
+
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402,F401
+from deepspeed_tpu.ops.pallas.fused_norms import fused_layer_norm, fused_rms_norm  # noqa: E402,F401
+from deepspeed_tpu.ops.pallas.quantization import dequantize_int8, quantize_int8  # noqa: E402,F401

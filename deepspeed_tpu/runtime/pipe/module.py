@@ -1,0 +1,255 @@
+"""Pipeline module: a model expressed as a sequence of layers.
+
+Capability match for the reference's ``deepspeed/runtime/pipe/module.py``
+(``LayerSpec`` at module.py:49, ``PipelineModule`` at 370 with
+uniform/parameter/regex partitioning). The execution model is different
+by design: instead of per-stage processes exchanging tensors over P2P,
+the whole pipeline runs as ONE jitted SPMD program where the 'pipe'
+mesh axis carries the stages (see ``pipe/engine.py``) — so this class
+is pure structure: build the layers, partition them into stages, and
+expose a ``stage_step`` that executes one stage's chunk under
+``jax.lax.switch`` on the stage index.
+
+Layers may be flax modules (params via ``.init``/``.apply``) or plain
+callables (no params). Tied layers (``TiedLayerSpec``) share one param
+subtree; gradient summation across their uses is automatic under
+autodiff (the reference needs an explicit tied-grad all-reduce,
+pipe/engine.py:265 — XLA inserts the psum for us).
+"""
+
+import re
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class LayerSpec:
+    """Lazily-built layer: stores the class and ctor args so the module
+    can be described cheaply and built once per process."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not isinstance(typename, type):
+            raise RuntimeError("LayerSpec only supports classes")
+
+    def build(self, log=False):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose params are shared with every other TiedLayerSpec of
+    the same ``key`` (e.g. input embedding reused as the LM head)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def _is_flax_module(obj):
+    return hasattr(obj, "init") and hasattr(obj, "apply")
+
+
+class PipelineModule:
+    """A sequence of layers partitioned into pipeline stages.
+
+    Args:
+        layers: list of LayerSpec / flax modules / callables.
+        num_stages: pipeline depth; defaults to the mesh 'pipe' axis.
+        loss_fn: ``loss_fn(last_layer_output, labels) -> scalar``;
+            executed inside the final stage so only the scalar crosses
+            stage boundaries.
+        partition_method: 'uniform' (equal layer counts),
+            'parameters' (balance by parameter count), or
+            'type:<regex>' (balance layers whose class name matches).
+        activation_checkpoint_interval: >0 enables remat of the stage
+            body (the engine always remats the pipeline tick; this adds
+            per-layer granularity).
+    """
+
+    def __init__(self,
+                 layers,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False,
+                 checkpointable_layers=None):
+        self.specs = list(layers)
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._num_stages = num_stages
+        self._topology = topology
+
+        self.layer_objs: List[Any] = []
+        self.tied_keys: List[Optional[str]] = []
+        self.tied_forward: List[Optional[Callable]] = []
+        tied_built = {}
+        for spec in self.specs:
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied_built:
+                    tied_built[spec.key] = spec.build()
+                self.layer_objs.append(tied_built[spec.key])
+                self.tied_keys.append(spec.key)
+                self.tied_forward.append(spec.forward_fn)
+            elif isinstance(spec, LayerSpec):
+                self.layer_objs.append(spec.build())
+                self.tied_keys.append(None)
+                self.tied_forward.append(None)
+            else:
+                self.layer_objs.append(spec)
+                self.tied_keys.append(None)
+                self.tied_forward.append(None)
+        self.parts = None  # stage boundaries, computed in plan_partition
+        self._parts_provisional = False
+
+    # ------------------------------------------------------------------
+    @property
+    def num_stages(self):
+        if self._num_stages is not None:
+            return self._num_stages
+        from deepspeed_tpu.parallel import groups
+        return groups.get_pipeline_parallel_world_size()
+
+    def num_layers(self):
+        return len(self.layer_objs)
+
+    def _param_name(self, idx):
+        key = self.tied_keys[idx]
+        return f"tied_{key}" if key is not None else f"layer_{idx:02d}"
+
+    # ------------------------------------------------------------------
+    # Initialization: thread a sample input through the layers.
+    # ------------------------------------------------------------------
+    def init(self, rng, *first_stage_args):
+        """Returns (params, activation_struct): params is a dict keyed by
+        layer name; activation_struct is the inter-stage h ShapeDtype.
+        Also finalizes the stage partition (param counts become known here,
+        so 'parameters' balancing takes effect)."""
+        params = {}
+        x = first_stage_args if len(first_stage_args) > 1 else first_stage_args[0]
+        structs = []
+        counts = []
+        for idx, layer in enumerate(self.layer_objs):
+            name = self._param_name(idx)
+            rng, sub = jax.random.split(rng)
+            if _is_flax_module(layer):
+                if name not in params:
+                    variables = layer.init(sub, x)
+                    params[name] = variables.get("params", {})
+                x = self._apply_one(idx, params[name], x)
+                counts.append(sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params[name])))
+            else:
+                x = layer(x)
+                counts.append(0)
+            structs.append(jax.eval_shape(lambda v: v, x))
+        parts = self.plan_partition(param_counts=counts)
+        # Activation crossing the first stage boundary (uniform across
+        # boundaries for a well-formed pipeline).
+        boundary_struct = structs[parts[1] - 1] if len(parts) > 2 else None
+        return params, boundary_struct
+
+    def _apply_one(self, idx, layer_params, x):
+        layer = self.layer_objs[idx]
+        fwd = self.tied_forward[idx]
+        if fwd is not None:
+            return fwd(layer, layer_params, x)
+        if _is_flax_module(layer):
+            return layer.apply({"params": layer_params}, x)
+        return layer(x)
+
+    # ------------------------------------------------------------------
+    # Partitioning
+    # ------------------------------------------------------------------
+    def plan_partition(self, param_counts=None):
+        """Compute stage boundaries ``parts`` (len = num_stages + 1).
+
+        With method='parameters' the boundaries are provisional (uniform)
+        until the first call that supplies ``param_counts`` — ``init``
+        does — after which they are fixed."""
+        if self.parts is not None and not (self._parts_provisional and param_counts is not None):
+            return self.parts
+        n, stages = self.num_layers(), self.num_stages
+        method = (self.partition_method or "uniform").lower()
+        self._parts_provisional = method == "parameters" and param_counts is None
+        if method == "uniform" or (method == "parameters" and param_counts is None):
+            weights = [1.0] * n
+        elif method == "parameters":
+            weights = [max(float(c), 1.0) for c in param_counts]
+        elif method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            weights = [1.0 if re.search(pat, type(l).__name__, re.IGNORECASE) else 0.0
+                       for l in self.layer_objs]
+            if sum(weights) == 0:
+                weights = [1.0] * n
+        else:
+            raise NotImplementedError(f"Partitioning method {method} not implemented")
+        self.parts = _balance_prefix(weights, stages)
+        return self.parts
+
+    def stage_layers(self, stage_id):
+        parts = self.plan_partition()
+        return list(range(parts[stage_id], parts[stage_id + 1]))
+
+    # ------------------------------------------------------------------
+    # Execution of one stage under a traced stage index
+    # ------------------------------------------------------------------
+    def stage_step(self, params, stage_idx, first_input, labels, h):
+        """Run the layers of stage ``stage_idx`` (traced int32).
+
+        Stage 0 consumes ``first_input`` (e.g. token ids); later stages
+        consume ``h``. The final stage applies ``loss_fn(out, labels)``
+        and returns it as the scalar; other stages return 0. Returns
+        ``(h_out, loss)`` with ``h_out`` of the inter-stage activation
+        shape (the final stage passes ``h`` through unchanged).
+        """
+        parts = self.plan_partition()
+        stages = self.num_stages
+
+        def make_branch(s):
+            lo, hi = parts[s], parts[s + 1]
+            last = s == stages - 1
+
+            def branch(params, first_input, labels, h):
+                x = first_input if s == 0 else h
+                for i in range(lo, hi):
+                    x = self._apply_one(i, params.get(self._param_name(i), {}), x)
+                if last:
+                    loss = (self.loss_fn(x, labels) if self.loss_fn is not None
+                            else jnp.zeros((), jnp.float32))
+                    return h, loss.astype(jnp.float32)
+                return x, jnp.zeros((), jnp.float32)
+
+            return branch
+
+        branches = [make_branch(s) for s in range(stages)]
+        return jax.lax.switch(stage_idx, branches, params, first_input, labels, h)
+
+
+def _balance_prefix(weights, parts_n):
+    """Split ``weights`` into ``parts_n`` contiguous chunks with roughly
+    equal weight sums (greedy prefix walk against the ideal quantiles)."""
+    n = len(weights)
+    assert n >= parts_n, f"cannot split {n} layers into {parts_n} stages"
+    total = float(sum(weights))
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+    bounds = [0]
+    for s in range(1, parts_n):
+        target = total * s / parts_n
+        # first index whose prefix weight reaches the target, but leave
+        # at least one layer for each remaining stage
+        lo, hi = bounds[-1] + 1, n - (parts_n - s)
+        idx = int(np.searchsorted(prefix, target, side="left"))
+        bounds.append(int(np.clip(idx, lo, hi)))
+    bounds.append(n)
+    return bounds

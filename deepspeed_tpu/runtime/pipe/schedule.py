@@ -1,0 +1,200 @@
+"""Pipeline schedules — instruction streams for pipeline execution.
+
+Capability match for the reference's ``deepspeed/runtime/pipe/schedule.py``
+(instruction classes at schedule.py:327-489, ``TrainSchedule`` at 189,
+``InferenceSchedule`` at 135). On TPU the hot path does NOT dispatch
+these instructions one by one: ``PipelineEngine`` fuses the whole
+schedule into a single jitted scan+ppermute program and XLA overlaps
+the stage compute with the ICI transfers. The schedule objects remain
+the source of truth for *what* that fused program computes — tests and
+tooling can enumerate them — and drive the (unfused) interpreter in
+``PipelineEngine.exec_schedule_host`` used for debugging.
+
+A schedule yields, per virtual clock tick, the list of instructions a
+given stage executes. The train schedule is 1F1B: warmup forwards
+(stages-stage_id-1 deep), steady-state alternating fwd/bwd, then drain.
+"""
+
+
+class PipeInstruction:
+    """One unit of work in a pipeline schedule."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return isinstance(other, PipeInstruction) and repr(self) == repr(other)
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer update (all stages, end of batch)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce gradients of tied layers across the stages sharing them."""
+
+
+class LoadMicroBatch(PipeInstruction):
+    """Fetch micro-batch ``buffer_id`` from the data iterator."""
+
+
+class ForwardPass(PipeInstruction):
+    """Run this stage's layers forward on buffer ``buffer_id``."""
+
+
+class BackwardPass(PipeInstruction):
+    """Run this stage's layers backward on buffer ``buffer_id``."""
+
+
+class SendActivation(PipeInstruction):
+    """Send activations of buffer ``buffer_id`` to the next stage."""
+
+
+class RecvActivation(PipeInstruction):
+    """Receive activations for buffer ``buffer_id`` from the previous stage."""
+
+
+class SendGrad(PipeInstruction):
+    """Send input-activation grads of buffer ``buffer_id`` to the previous stage."""
+
+
+class RecvGrad(PipeInstruction):
+    """Receive output grads for buffer ``buffer_id`` from the next stage."""
+
+
+class PipeSchedule:
+    """Base: enumerate instructions for one stage of one batch.
+
+    Args:
+        micro_batches: number of micro-batches in the batch
+        stages: number of pipeline stages
+        stage_id: which stage this schedule is for
+    """
+
+    def __init__(self, micro_batches, stages, stage_id):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    @property
+    def num_pipe_buffers(self):
+        """Upper bound on simultaneously-live activation buffers."""
+        return self.micro_batches
+
+    def steps(self):
+        """Yield a list of :class:`PipeInstruction` per clock tick."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain pipeline (reference schedule.py:135)."""
+
+    @property
+    def num_pipe_buffers(self):
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for tick in range(total):
+            cmds = []
+            mb = tick - self.stage_id  # micro-batch this stage works on now
+            if 0 <= mb < self.micro_batches:
+                buf = mb % self.num_pipe_buffers
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: each stage runs ``stages - stage_id - 1`` warmup forwards,
+    then alternates one-forward-one-backward, then drains backwards.
+    Peak live activations per stage = warmup depth + 1 (the 1F1B memory
+    bound), vs ``micro_batches`` for plain GPipe."""
+
+    @property
+    def num_pipe_buffers(self):
+        return max(1, min(self.micro_batches, self.stages - self.stage_id))
+
+    def _sequence(self):
+        """Per-stage (kind, micro_batch) work list in execution order."""
+        warmup = min(self.micro_batches, self.stages - self.stage_id - 1)
+        seq = [("fwd", m) for m in range(warmup)]
+        next_fwd, next_bwd = warmup, 0
+        while next_bwd < self.micro_batches:
+            if next_fwd < self.micro_batches:
+                seq.append(("fwd", next_fwd))
+                next_fwd += 1
+            seq.append(("bwd", next_bwd))
+            next_bwd += 1
+        return seq
+
+    def steps(self):
+        # Per-stage ordered work list, one work item per yield. Send/Recv
+        # instructions are blocking rendezvous with the neighbour stage
+        # (as in the reference, whose P2P ops block): steps are NOT
+        # globally clock-aligned across stages, so an executor must
+        # process each stage's stream concurrently and let the sends and
+        # recvs pair up by (kind, micro-batch) order.
+        seq = self._sequence()
+        for kind, mb in seq:
+            buf = mb % self.num_pipe_buffers
+            cmds = []
+            if kind == "fwd":
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            else:
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buffer_id=buf))
+                cmds.append(BackwardPass(buffer_id=buf))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buffer_id=buf))
+            yield cmds
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference schedule.py:469)."""
+
+    @property
+    def num_pipe_buffers(self):
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0), BackwardPass(buffer_id=0)]
+        yield [ReduceGrads(), OptimizerStep()]
