@@ -80,11 +80,13 @@ class RMSNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        dtype = x.dtype
-        x32 = x.astype(jnp.float32)
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
-        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-        return (x32 * jax.lax.rsqrt(var + self.eps) * scale.astype(jnp.float32)).astype(dtype)
+        from deepspeed_tpu.ops.pallas import fused_rms_norm
+        # Pallas kernel on TPU, identical-math XLA elsewhere. (Multi-chip
+        # note: pallas_call under GSPMD runs per-shard once activations
+        # are only sequence/batch-sharded, which holds at every call site
+        # here — the norm axis is never sharded.)
+        return fused_rms_norm(x, scale, self.eps)
 
 
 def rope_frequencies(head_dim: int, max_len: int, theta: float):

@@ -1,0 +1,87 @@
+"""Llama as a PipelineModule (embed / blocks / head layer stack).
+
+The pipeline counterpart of ``models/llama.py`` — the role the
+reference fills with Megatron-style ``PipelineModule`` layer lists
+(e.g. its GPT examples feeding ``deepspeed/runtime/pipe/module.py``).
+Each block is one pipeline layer; the head applies the final norm and
+vocab projection; the loss runs in-pipeline on the last stage.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import (LLAMA_CONFIGS, LlamaBlock, LlamaConfig, RMSNorm, causal_lm_loss)
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.sequence.layer import constrain_hidden
+
+
+class LlamaEmbed(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size))
+        h = jnp.take(embed, input_ids, axis=0)
+        return constrain_hidden(h)
+
+
+class LlamaPipeBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h):
+        positions = jnp.arange(h.shape[1])[None, :]
+        (h_out, _), _ = LlamaBlock(self.config, name="block")((h, jnp.zeros((), jnp.float32)),
+                                                              positions)
+        return h_out
+
+
+class LlamaFinalNorm(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h):
+        return RMSNorm(eps=self.config.rms_norm_eps, name="norm")(h)
+
+
+class LlamaHead(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        h = RMSNorm(eps=cfg.rms_norm_eps, name="norm")(h)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
+
+
+def _tied_logits(layer, layer_params, h):
+    """Head forward for the tied-embedding layer: h @ embed.T
+    (grad summation into the shared embedding is automatic)."""
+    embed = layer_params["embed_tokens"]
+    return jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
+
+
+def build_llama_pipeline(preset_or_config="debug", num_stages=None,
+                         partition_method="parameters", **overrides) -> PipelineModule:
+    if isinstance(preset_or_config, LlamaConfig):
+        cfg = preset_or_config
+    else:
+        cfg = LLAMA_CONFIGS[preset_or_config]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    assert cfg.moe_num_experts == 0, \
+        "MoE blocks carry an aux loss through the scan carry; use the scanned " \
+        "LlamaForCausalLM (models/llama.py) for MoE training"
+    blocks = [LayerSpec(LlamaPipeBlock, cfg) for _ in range(cfg.num_hidden_layers)]
+    if cfg.tie_word_embeddings:
+        layers = ([TiedLayerSpec("embed", LlamaEmbed, cfg)] + blocks
+                  + [LayerSpec(LlamaFinalNorm, cfg),
+                     TiedLayerSpec("embed", LlamaEmbed, cfg, forward_fn=_tied_logits)])
+    else:
+        layers = [LayerSpec(LlamaEmbed, cfg)] + blocks + [LayerSpec(LlamaHead, cfg)]
+    return PipelineModule(layers, num_stages=num_stages, loss_fn=causal_lm_loss,
+                          partition_method=partition_method)

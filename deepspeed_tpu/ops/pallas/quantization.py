@@ -11,6 +11,10 @@ Layout: the tensor is flattened and viewed as [num_groups, group_size];
 each group gets one scale = absmax/127. On TPU a Pallas kernel does the
 absmax + scale + round in one VMEM pass (optionally with hardware
 stochastic rounding); the XLA fallback is the same math.
+
+Consumers: ZeRO++-style compressed collectives (qwZ/qgZ) and 1-bit
+optimizers wire these in as those subsystems land; until then the ops
+stand alone behind the kernel registry.
 """
 
 import functools
@@ -93,7 +97,14 @@ def quantize_int8(x, group_size=2048, stochastic=False, seed=0, interpret=None):
         x32 = groups.astype(jnp.float32)
         absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
         scales = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
-        values = jnp.clip(jnp.round(x32 / scales), -127, 127).astype(jnp.int8)
+        scaled = x32 / scales
+        if stochastic:
+            frac = jax.random.uniform(jax.random.PRNGKey(seed), scaled.shape)
+            low = jnp.floor(scaled)
+            scaled = low + (frac < (scaled - low)).astype(jnp.float32)
+        else:
+            scaled = jnp.round(scaled)
+        values = jnp.clip(scaled, -127, 127).astype(jnp.int8)
         scales = scales[:, 0]
     return values, scales, x.shape
 

@@ -525,44 +525,42 @@ class DeepSpeedEngine:
         # Gradient reduction is fused into the sharded update by XLA.
         pass
 
+    def _update_math(self, params, master, opt_state, grads, scaler_st, lr):
+        """Shared traced update body: unscale, overflow check, clip,
+        optimizer update, skip-on-overflow select, compute-dtype re-cast,
+        loss-scale update. ``grads`` still carry the loss scale."""
+        clip = float(self.gradient_clipping() or 0.0)
+        fp16 = self.fp16_enabled()
+        scale = scaler_st["cur_scale"]
+        grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+        overflow = has_overflow(grads32) if fp16 else jnp.zeros((), bool)
+
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
+        if clip > 0.0:
+            factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            grads32 = jax.tree.map(lambda g: g * factor, grads32)
+
+        new_master, new_opt = self._opt_update(grads32, opt_state, master, lr)
+
+        # skip the update on overflow
+        def sel(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
+
+        new_master = sel(new_master, master)
+        new_opt = sel(new_opt, opt_state)
+        new_params = jax.tree.map(
+            lambda m, spec: jax.lax.with_sharding_constraint(
+                m.astype(self.compute_dtype) if _is_float(m) else m, NamedSharding(self.mesh, spec)),
+            new_master, self._param_specs)
+        new_scaler = update_scale(scaler_st, overflow, **dict(self._scaler_kwargs))
+        return new_params, new_master, new_opt, new_scaler, gnorm, overflow
+
     def _apply_update_fn(self):
         key = "apply"
         if key in self._jit_cache:
             return self._jit_cache[key]
-        clip = float(self.gradient_clipping() or 0.0)
-        fp16 = self.fp16_enabled()
-        scaler_kwargs = dict(self._scaler_kwargs)
-        compute_dtype = self.compute_dtype
-        param_specs = self._param_specs
-        mesh = self.mesh
-        opt_update = self._opt_update
-
         tied = self.master_params is self.params
-
-        def body(params, master, opt_state, grads, scaler_st, lr):
-            scale = scaler_st["cur_scale"]
-            grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
-            overflow = has_overflow(grads32) if fp16 else jnp.zeros((), bool)
-
-            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
-            if clip > 0.0:
-                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads32 = jax.tree.map(lambda g: g * factor, grads32)
-
-            new_master, new_opt = opt_update(grads32, opt_state, master, lr)
-
-            # skip the update on overflow
-            def sel(new, old):
-                return jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
-
-            new_master = sel(new_master, master)
-            new_opt = sel(new_opt, opt_state)
-            new_params = jax.tree.map(
-                lambda m, spec: jax.lax.with_sharding_constraint(
-                    m.astype(compute_dtype) if _is_float(m) else m, NamedSharding(mesh, spec)),
-                new_master, param_specs)
-            new_scaler = update_scale(scaler_st, overflow, **scaler_kwargs)
-            return new_params, new_master, new_opt, new_scaler, gnorm, overflow
+        body = self._update_math
 
         if tied:
             # master IS params: a single donated buffer (donating it at two
@@ -620,12 +618,6 @@ class DeepSpeedEngine:
         acc_dtype = self._grad_accum_dtype
         grad_specs = self._grad_specs
         mesh = self.mesh
-        clip = float(self.gradient_clipping() or 0.0)
-        fp16 = self.fp16_enabled()
-        scaler_kwargs = dict(self._scaler_kwargs)
-        compute_dtype = self.compute_dtype
-        param_specs = self._param_specs
-        opt_update = self._opt_update
 
         def micro_loss(params, scale, rng, batch):
             args, kwargs = batch
@@ -654,25 +646,8 @@ class DeepSpeedEngine:
             rngs = jax.random.split(rng, gas)
             acc, losses = jax.lax.scan(micro, zeros, (batches, rngs))
 
-            grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, acc)
-            overflow = has_overflow(grads32) if fp16 else jnp.zeros((), bool)
-            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
-            if clip > 0.0:
-                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads32 = jax.tree.map(lambda g: g * factor, grads32)
-
-            new_master, new_opt = opt_update(grads32, opt_state, master, lr)
-
-            def sel(new, old):
-                return jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
-
-            new_master = sel(new_master, master)
-            new_opt = sel(new_opt, opt_state)
-            new_params = jax.tree.map(
-                lambda m, spec: jax.lax.with_sharding_constraint(
-                    m.astype(compute_dtype) if _is_float(m) else m, NamedSharding(mesh, spec)),
-                new_master, param_specs)
-            new_scaler = update_scale(scaler_st, overflow, **scaler_kwargs)
+            new_params, new_master, new_opt, new_scaler, gnorm, overflow = self._update_math(
+                params, master, opt_state, acc, scaler_st, lr)
             return new_params, new_master, new_opt, new_scaler, losses.mean(), gnorm, overflow
 
         if tied:

@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from deepspeed_tpu.runtime.pipe.schedule import (DataParallelSchedule, InferenceSchedule,  # noqa: F401
+                                                 PipeSchedule, TrainSchedule)
+from deepspeed_tpu.parallel.topology import PipeDataParallelTopology, ProcessTopology  # noqa: F401

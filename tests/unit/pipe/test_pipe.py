@@ -1,0 +1,148 @@
+"""Pipeline parallelism tests (virtual 8-device CPU mesh).
+
+Mirrors the reference's tests/unit/pipe: schedule enumeration sanity,
+module partitioning, end-to-end pipelined training, and equivalence of
+the pipelined forward against a sequential layer-by-layer reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama_pipe import build_llama_pipeline
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import make_mesh_topology
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, _balance_prefix
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass, InferenceSchedule,
+                                                 TrainSchedule)
+
+
+class TestSchedules:
+
+    @pytest.mark.parametrize("stages,micro", [(2, 4), (4, 8), (4, 2), (1, 3)])
+    def test_train_schedule_covers_all_microbatches(self, stages, micro):
+        for sid in range(stages):
+            sched = TrainSchedule(micro_batches=micro, stages=stages, stage_id=sid)
+            fwd = [c.buffer_id for step in sched for c in step if isinstance(c, ForwardPass)]
+            bwd = [c.buffer_id for step in sched for c in step if isinstance(c, BackwardPass)]
+            assert len(fwd) == micro
+            assert len(bwd) == micro
+
+    def test_train_schedule_1f1b_warmup_depth(self):
+        # 1F1B: stage s runs (stages - s - 1) warmup forwards plus the
+        # first steady-state forward before its first backward.
+        for sid, expect in ((0, 4), (2, 2), (3, 1)):
+            sched = TrainSchedule(micro_batches=8, stages=4, stage_id=sid)
+            kinds = []
+            for step in sched:
+                for cmd in step:
+                    if isinstance(cmd, (ForwardPass, BackwardPass)):
+                        kinds.append(type(cmd).__name__)
+            first_bwd = kinds.index("BackwardPass")
+            assert kinds[:first_bwd].count("ForwardPass") == expect
+
+    def test_inference_schedule(self):
+        sched = InferenceSchedule(micro_batches=3, stages=2, stage_id=1)
+        fwd = [c for step in sched for c in step if isinstance(c, ForwardPass)]
+        assert len(fwd) == 3
+
+
+class TestPartitioning:
+
+    def test_balance_prefix_uniform(self):
+        assert _balance_prefix([1.0] * 8, 4) == [0, 2, 4, 6, 8]
+
+    def test_balance_prefix_weighted(self):
+        # One huge layer should sit alone on its stage
+        parts = _balance_prefix([100, 1, 1, 1], 2)
+        assert parts == [0, 1, 4]
+
+    def test_parameter_partitioning_applied_at_init(self):
+        import flax.linen as nn
+
+        class Big(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(64)(x)
+
+        class Small(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(x.shape[-1])(x) * 0 + x
+
+        mesh = make_mesh_topology(pipe=2, data=4)
+        groups.set_mesh(mesh)
+        mod = PipelineModule([LayerSpec(Big), LayerSpec(Small), LayerSpec(Small),
+                              LayerSpec(Small)], partition_method="parameters")
+        mod.init(jax.random.PRNGKey(0), jnp.zeros((2, 64)))
+        # Big (64*64) dominates the three Smalls; it gets its own stage.
+        assert mod.parts[1] in (1, 2)
+
+
+class TestPipelineEngineE2E:
+
+    def _build(self, stages=2, gas=4, mbs=4, zero_stage=1):
+        dp = 8 // stages
+        mesh = make_mesh_topology(pipe=stages, data=dp)
+        groups.set_mesh(mesh)
+        model = build_llama_pipeline("debug", num_stages=stages)
+        config = {
+            "train_batch_size": mbs * gas * dp,
+            "train_micro_batch_size_per_gpu": mbs,
+            "gradient_accumulation_steps": gas,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": zero_stage},
+            "mesh": {"pipeline_parallel_size": stages},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, mesh=mesh)
+        return engine, model
+
+    def test_train_batch_runs_and_learns(self):
+        engine, _ = self._build()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 256, size=(16, 32)).astype(np.int32)
+        losses = [float(engine.train_batch(batch=(ids, ids))) for _ in range(8)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], f"no learning: {losses}"
+
+    def test_pipelined_forward_matches_sequential(self):
+        engine, model = self._build(stages=2, gas=2, mbs=4)
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 256, size=(8, 32)).astype(np.int32)
+        # run one eval to materialize params
+        pipe_loss = float(engine.eval_batch(batch=(ids, ids)))
+
+        # sequential reference with the SAME params
+        params = jax.device_get(engine.params)
+        x = jnp.asarray(ids.reshape(2, 4, 32))
+
+        def seq_loss(params, ids_m, labels_m):
+            total = 0.0
+            for m in range(2):
+                h = ids_m[m]
+                for i in range(model.num_layers()):
+                    h = model._apply_one(i, params.get(model._param_name(i), {}), h)
+                total = total + model.loss_fn(h, labels_m[m])
+            return total / 2
+
+        ref = float(seq_loss(jax.tree.map(jnp.asarray, params), x, x))
+        assert abs(pipe_loss - ref) < 5e-2, (pipe_loss, ref)
+
+    def test_single_stage_degenerate(self):
+        # pipe=1 → all 8 devices on data; micro batch must divide by 8
+        engine, _ = self._build(stages=1, gas=2, mbs=8)
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 256, size=(16, 32)).astype(np.int32)
+        loss = engine.train_batch(batch=(ids, ids))
+        assert np.isfinite(float(loss))
+
+    def test_forward_backward_forbidden(self):
+        engine, _ = self._build()
+        with pytest.raises(RuntimeError):
+            engine.forward(np.zeros((2, 8), np.int32))
+        with pytest.raises(RuntimeError):
+            engine.backward(None)
