@@ -1,0 +1,2 @@
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig  # noqa: F401
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401

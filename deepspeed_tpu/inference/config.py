@@ -1,0 +1,119 @@
+"""Inference engine config.
+
+Capability match for the reference's ``deepspeed/inference/config.py``
+(``DeepSpeedInferenceConfig``, 304 LoC): same section names and field
+surface where meaningful on TPU. CUDA-specific toggles
+(``enable_cuda_graph`` — jit IS the captured graph on TPU;
+``use_triton``; kernel injection flags) are accepted and ignored so
+reference configs load unchanged.
+"""
+
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+DTYPES = {
+    "fp32": jnp.float32, "float32": jnp.float32, "float": jnp.float32,
+    "fp16": jnp.float16, "float16": jnp.float16, "half": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Tensor-parallel section (reference config.py DeepSpeedTPConfig)."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1], alias="num_experts")
+    type: str = "standard"
+
+
+class QuantTypeEnum:
+    asym = "asymmetric"
+    sym = "symmetric"
+
+
+class BaseQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    q_type: str = QuantTypeEnum.sym
+    q_groups: int = 1
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+    quantized_initialization: Dict = Field(default_factory=dict)
+    post_init_quant: Dict = Field(default_factory=dict)
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    activation: ActivationQuantConfig = Field(default_factory=ActivationQuantConfig)
+    weight: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Arguments to ``deepspeed_tpu.init_inference`` (reference
+    inference/config.py:DeepSpeedInferenceConfig)."""
+
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: Union[str, Any] = "bf16"
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    enable_cuda_graph: bool = False  # accepted; jit compilation plays this role
+    use_triton: bool = False
+    triton_autotune: bool = False
+    zero: Dict = Field(default_factory=dict)
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: Union[bool, DeepSpeedMoEConfig] = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Union[str, Dict]] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: Optional[Dict] = Field(None, alias="ckpt_config")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_method: str = Field("auto", json_schema_extra={"deprecated": True})
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = None
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    transposed_mode: bool = False
+    mp_size: int = Field(1, json_schema_extra={"deprecated": True, "new_param": "tensor_parallel.tp_size"})
+    mpu: Optional[Any] = None
+    ep_size: int = 1
+    ep_group: Optional[Any] = Field(None, alias="expert_group")
+    ep_mp_group: Optional[Any] = Field(None, alias="expert_mp_group")
+    moe_experts: list = Field(default_factory=lambda: [1])
+    moe_type: str = "standard"
+
+    # TPU-specific extras
+    model_parameters: Optional[Any] = None  # pre-loaded param pytree
+    seed: int = 0
+
+    @property
+    def jax_dtype(self):
+        if isinstance(self.dtype, str):
+            return DTYPES[self.dtype.lower().replace("torch.", "")]
+        return self.dtype
+
+    def __init__(self, **data):
+        if "mp_size" in data and "tensor_parallel" not in data and "tp" not in data:
+            data["tensor_parallel"] = {"tp_size": data["mp_size"]}
+        super().__init__(**data)

@@ -1,0 +1,221 @@
+"""Inference engine (v1): tensor-parallel serving with KV-cache decode.
+
+Capability match for the reference's ``deepspeed/inference/engine.py``
+(``InferenceEngine`` at engine.py:39): wraps a model for latency-
+oriented inference with tensor parallelism and a greedy/sampling
+``generate``. The mechanism is TPU-native:
+
+- the reference performs module surgery (kernel injection,
+  ``replace_transformer_layer``) or AutoTP weight slicing; here the
+  model is already a functional flax module and "injection" is a
+  sharding decision — params are placed with the model's ``tp_rule``
+  (or the AutoTP pattern rule) over the 'tensor' mesh axis and XLA
+  inserts the Megatron-style collectives;
+- CUDA-graph capture/replay (engine.py:524) is jit compilation;
+- the KV cache is a static-shape [L, B, S_max, Hkv, D] buffer updated
+  in place via donation (the reference's inference-context workspace);
+- prefill and the full decode loop (with sampling) each compile once;
+  the decode loop is a ``lax.scan`` over new tokens.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import make_mesh_topology
+from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class InferenceEngine:
+
+    def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None):
+        self._config = config or DeepSpeedInferenceConfig()
+        self.module = model
+        self.dtype = self._config.jax_dtype
+        if self.dtype == jnp.int8:
+            # int8 engine dtype = weight-only quantized storage; compute in bf16
+            self.dtype = jnp.bfloat16
+
+        tp = int(self._config.tensor_parallel.tp_size)
+        self.mp_world_size = tp
+        if groups.mesh_is_initialized() and groups.get_model_parallel_world_size() == tp:
+            self.mesh = groups.get_mesh()
+        else:
+            # The inference world IS the TP group (reference
+            # _create_model_parallel_group, engine.py:254): the mesh spans
+            # exactly tp devices so batch size carries no sharding
+            # constraint; extra local devices serve other replicas.
+            assert tp <= len(jax.devices()), f"tp_size {tp} > visible devices"
+            self.mesh = make_mesh_topology(tensor=tp, data=1, devices=jax.devices()[:tp])
+            groups.set_mesh(self.mesh)
+
+        rule = getattr(model, "tp_rule", None) or AutoTP()
+        self._tp_rule = rule
+        self.params = None
+        self._jit_cache = {}
+        self._rng = jax.random.PRNGKey(int(self._config.seed))
+
+        if self._config.model_parameters is not None:
+            self._set_params(self._config.model_parameters)
+        elif self._config.checkpoint is not None:
+            self._load_checkpoint(self._config.checkpoint)
+        log_dist(f"InferenceEngine: tp={tp} dtype={self.dtype.__name__}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _param_sharding(self, path, x):
+        spec = self._tp_rule(path, np.shape(x))
+        # drop axes the mesh doesn't have >1 of
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+        def live(e):
+            if e is None:
+                return None
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if sizes.get(a, 1) > 1)
+                return kept if len(kept) > 1 else (kept[0] if kept else None)
+            return e if sizes.get(e, 1) > 1 else None
+
+        entries = [live(e) for e in spec]
+        # divisibility guard: fall back to replicated when a dim doesn't divide
+        for d, e in enumerate(entries):
+            if e is None:
+                continue
+            size = int(np.prod([sizes[a] for a in (e if isinstance(e, tuple) else (e,))]))
+            if np.shape(x)[d] % size != 0:
+                entries[d] = None
+        return NamedSharding(self.mesh, P(*entries))
+
+    def _set_params(self, params):
+        """Cast to engine dtype and TP-shard over the mesh."""
+        def place(path, x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(self.dtype)
+            return jax.device_put(x, self._param_sharding(path, x))
+
+        self.params = path_tree_map(place, params)
+
+    def _load_checkpoint(self, path):
+        from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import ArrayCheckpointEngine
+        state = ArrayCheckpointEngine().load(path)
+        params = state.get("module", state)
+        self._set_params(params)
+
+    def _materialize(self, input_ids):
+        if self.params is not None:
+            return
+        variables = self.module.init(self._rng, input_ids)
+        self._set_params(variables["params"])
+
+    # ------------------------------------------------------------------
+    def forward(self, input_ids, *args, **kwargs):
+        """Logits for a batch of token ids (jit-compiled once per shape)."""
+        input_ids = jnp.asarray(input_ids)
+        self._materialize(input_ids[:1])
+        key = ("fwd", input_ids.shape)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                lambda p, ids: self.module.apply({"params": p}, ids))
+        return self._jit_cache[key](self.params, input_ids)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def _decode_fn(self, max_new_tokens, do_sample, temperature, top_k, top_p):
+        """One jitted program: scan over new tokens with KV-cache donation."""
+        module = self.module
+
+        def sample_token(logits, rng):
+            logits = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if temperature != 1.0:
+                logits = logits / max(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p and top_p < 1.0:
+                sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_l, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # smallest set with cumulative prob >= top_p
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+                cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+        def fn(params, input_ids, cache, rng, eos_id):
+            B, S = input_ids.shape
+            # Prefill writes the prompt KV and yields the first new token
+            logits, cache = module.apply({"params": params}, input_ids, cache=cache, start_pos=0)
+            rng, sub = jax.random.split(rng)
+            tok = sample_token(logits[:, -1], sub)
+            done = (tok == eos_id)
+
+            def step(carry, _):
+                cache, tok, pos, rng, done = carry
+                logits, cache = module.apply({"params": params}, tok[:, None],
+                                             cache=cache, start_pos=pos)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_token(logits[:, 0], sub)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = jnp.logical_or(done, nxt == eos_id)
+                return (cache, nxt, pos + 1, rng, done), nxt
+
+            (_, _, _, _, _), rest = jax.lax.scan(
+                step, (cache, tok, jnp.asarray(S, jnp.int32), rng, done),
+                None, length=max_new_tokens - 1)
+            return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+        return jax.jit(fn, donate_argnums=(2,))
+
+    def generate(self, input_ids, max_new_tokens=32, max_length=None, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,
+                 **kwargs):
+        """Autoregressive generation (reference engine.generate surface;
+        greedy or temperature/top-k/top-p sampling). Returns
+        [B, S + max_new_tokens] token ids including the prompt."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, S = input_ids.shape
+        if max_length is not None:
+            max_new_tokens = max(int(max_length) - S, 1)
+        self._materialize(input_ids[:1])
+
+        cfg = getattr(self.module, "config", None)
+        assert cfg is not None and hasattr(self.module, "apply"), \
+            "generate() needs a deepspeed_tpu model with KV-cache support"
+        from deepspeed_tpu.models.llama import init_cache
+        s_max = S + max_new_tokens
+        cache = init_cache(cfg, B, s_max, self.dtype)
+
+        key = ("gen", B, S, max_new_tokens, do_sample, temperature, top_k, top_p)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._decode_fn(max_new_tokens, do_sample,
+                                                   temperature, top_k, top_p)
+        rng = jax.random.PRNGKey(seed) if seed is not None else self._rng
+        self._rng, rng = jax.random.split(rng if seed is not None else self._rng)
+        new_tokens = self._jit_cache[key](self.params, input_ids, cache, rng,
+                                          jnp.asarray(eos_token_id, jnp.int32))
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+    # ------------------------------------------------------------------
+    # Parity surface
+    # ------------------------------------------------------------------
+    def profile_model_time(self, use_cuda_events=True):
+        pass
+
+    def _create_model_parallel_group(self, config=None):
+        return ("tensor",)
+
+    def destroy(self):
+        self._jit_cache.clear()
+        self.params = None

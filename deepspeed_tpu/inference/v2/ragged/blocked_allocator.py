@@ -1,0 +1,44 @@
+"""KV-cache block allocator.
+
+Capability match for the reference's block allocator backing
+``BlockedKVCache`` (``deepspeed/inference/v2/ragged/blocked_allocator.py``):
+a free-list over a fixed pool of KV blocks. Pure host-side bookkeeping
+(numpy); the device never sees this structure, only the block tables
+the scheduler builds from it.
+"""
+
+import numpy as np
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        if num_blocks > len(self._free):
+            raise ValueError(
+                f"requested {num_blocks} blocks but only {len(self._free)} free")
+        out = self._free[:num_blocks]
+        self._free = self._free[num_blocks:]
+        return np.asarray(out, dtype=np.int32)
+
+    def free(self, blocks) -> None:
+        blocks = [int(b) for b in np.atleast_1d(blocks)]
+        for b in blocks:
+            if b < 0 or b >= self._num_blocks:
+                raise ValueError(f"invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)

@@ -105,17 +105,23 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
-def einsum_attention(q, k, v, causal=True, bias=None):
-    """Reference attention: [B, S, H, D] → [B, S, H, D]; softmax in fp32."""
+def einsum_attention(q, k, v, causal=True, bias=None, mask=None):
+    """Reference attention: [B, S, H, D] → [B, S, H, D]; softmax in fp32.
+
+    ``mask``: optional [.., Sq, Sk] bool (True = attend), e.g. the
+    KV-cache validity mask during decode; overrides ``causal``.
+    """
     dtype = q.dtype
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if bias is not None:
         scores = scores + bias
-    if causal:
-        sq, sk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+    if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    elif causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cmask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -136,7 +142,13 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, h, positions):
+    def __call__(self, h, positions, layer_cache=None):
+        """Training: ``layer_cache=None`` → causal self-attention with the
+        Ulysses seq↔head exchange. Decode: ``layer_cache`` is this
+        layer's ``{'k','v'}`` [B, S_max, Hkv, D] KV cache and
+        ``positions`` [1 or B, T] the absolute write positions; returns
+        ``(out, new_layer_cache)`` (equivalent of the reference's
+        softmax_context KV-cache kernels, csrc/transformer/inference)."""
         cfg = self.config
         B, S, D = h.shape
         H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -148,6 +160,26 @@ class LlamaAttention(nn.Module):
         cos, sin = rope_frequencies(Dh, cfg.max_position_embeddings, cfg.rope_theta)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+
+        if layer_cache is not None:
+            start = positions[0, 0]
+            k_full = jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype),
+                                                  (0, start, 0, 0))
+            v_full = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype),
+                                                  (0, start, 0, 0))
+            new_cache = {"k": k_full, "v": v_full}
+            kx, vx = k_full, v_full
+            if Hkv != H:
+                kx = jnp.repeat(kx, H // Hkv, axis=2)
+                vx = jnp.repeat(vx, H // Hkv, axis=2)
+            # token t may attend to cache positions <= start + t
+            s_max = kx.shape[1]
+            k_idx = jnp.arange(s_max)[None, :]
+            q_pos = (start + jnp.arange(S))[:, None]
+            mask = (k_idx <= q_pos)[None, None, :, :]  # [1, 1, T, S_max]
+            out = einsum_attention(q, kx, vx, mask=mask)
+            out = out.reshape(B, S, H * Dh)
+            return nn.Dense(D, use_bias=False, name="o_proj")(out), new_cache
 
         # GQA: expand kv heads to match q heads
         if Hkv != H:
@@ -162,7 +194,7 @@ class LlamaAttention(nn.Module):
         out = head_to_seq_shard(out)
 
         out = out.reshape(B, S, H * Dh)
-        return nn.Dense(D, use_bias=False, name="o_proj")(out)
+        return nn.Dense(D, use_bias=False, name="o_proj")(out), None
 
 
 class LlamaMLP(nn.Module):
@@ -182,12 +214,15 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, carry, positions):
+    def __call__(self, carry, positions, layer_cache=None):
         h, aux_loss = carry
         cfg = self.config
+        decode = layer_cache is not None
         attn_in = RMSNorm(eps=cfg.rms_norm_eps, name="input_layernorm")(h)
-        h = h + LlamaAttention(cfg, name="self_attn")(attn_in, positions)
-        h = constrain_hidden(h)
+        attn_out, new_cache = LlamaAttention(cfg, name="self_attn")(attn_in, positions, layer_cache)
+        h = h + attn_out
+        if not decode:
+            h = constrain_hidden(h)
         mlp_in = RMSNorm(eps=cfg.rms_norm_eps, name="post_attention_layernorm")(h)
         if cfg.moe_num_experts > 0:
             from deepspeed_tpu.moe.layer import MoE
@@ -201,7 +236,9 @@ class LlamaBlock(nn.Module):
             aux_loss = aux_loss + layer_aux
         else:
             h = h + LlamaMLP(cfg, name="mlp")(mlp_in)
-        return (constrain_hidden(h), aux_loss), None
+        if not decode:
+            h = constrain_hidden(h)
+        return (h, aux_loss), new_cache
 
 
 class LlamaModel(nn.Module):
@@ -209,27 +246,42 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, cache=None, start_pos=0):
         cfg = self.config
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size))
         h = jnp.take(embed, input_ids, axis=0)
-        h = constrain_hidden(h)
-        positions = jnp.arange(input_ids.shape[1])[None, :]
+        decode = cache is not None
+        if not decode:
+            h = constrain_hidden(h)
+        positions = (start_pos + jnp.arange(input_ids.shape[1]))[None, :]
 
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and not decode:
             block = nn.remat(block, prevent_cse=False,
                              policy=jax.checkpoint_policies.nothing_saveable)
-        ScanBlocks = nn.scan(block,
-                             variable_axes={"params": 0},
-                             split_rngs={"params": True, "dropout": True},
-                             in_axes=nn.broadcast,
-                             length=cfg.num_hidden_layers,
-                             metadata_params={nn.PARTITION_NAME: "layers"})
-        (h, aux_loss), _ = ScanBlocks(cfg, name="layers")((h, jnp.zeros((), jnp.float32)), positions)
+        carry0 = (h, jnp.zeros((), jnp.float32))
+        if decode:
+            # cache leaves carry a leading L dim and scan over layers
+            # threads each layer's slice through as scanned input/output.
+            ScanBlocks = nn.scan(block,
+                                 variable_axes={"params": 0},
+                                 split_rngs={"params": True, "dropout": True},
+                                 in_axes=(nn.broadcast, 0),
+                                 out_axes=0,
+                                 length=cfg.num_hidden_layers,
+                                 metadata_params={nn.PARTITION_NAME: "layers"})
+            (h, aux_loss), new_cache = ScanBlocks(cfg, name="layers")(carry0, positions, cache)
+        else:
+            ScanBlocks = nn.scan(block,
+                                 variable_axes={"params": 0},
+                                 split_rngs={"params": True, "dropout": True},
+                                 in_axes=nn.broadcast,
+                                 length=cfg.num_hidden_layers,
+                                 metadata_params={nn.PARTITION_NAME: "layers"})
+            (h, aux_loss), new_cache = ScanBlocks(cfg, name="layers")(carry0, positions)
         h = RMSNorm(eps=cfg.rms_norm_eps, name="norm")(h)
-        return h, embed, aux_loss
+        return h, embed, aux_loss, new_cache
 
 
 class LlamaForCausalLM(nn.Module):
@@ -242,13 +294,17 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, labels=None):
+    def __call__(self, input_ids, labels=None, cache=None, start_pos=0):
         cfg = self.config
-        h, embed, aux_loss = LlamaModel(cfg, name="model")(input_ids)
+        decode = cache is not None
+        h, embed, aux_loss, new_cache = LlamaModel(cfg, name="model")(input_ids, cache=cache,
+                                                                      start_pos=start_pos)
         if cfg.tie_word_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
+        if decode:
+            return logits, new_cache
         logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))
         if labels is None:
             return logits
@@ -297,6 +353,15 @@ def causal_lm_loss(logits, labels):
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(mask.sum(), 1)
     return jnp.where(mask, nll, 0.0).sum() / denom
+
+
+def init_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    """Allocate the static-shape KV cache: leaves [L, B, S_max, Hkv, D]
+    (the TPU analogue of the reference's inference-context workspace,
+    csrc/includes/inference_context.h)."""
+    shape = (config.num_hidden_layers, batch_size, max_len,
+             config.num_key_value_heads, config.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def build_llama(preset_or_config="debug", **overrides) -> LlamaForCausalLM:
