@@ -130,15 +130,36 @@ def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None,
 
 
 # ---------------------------------------------------------------------------
-# Rank / size queries. "rank" is the JAX process index; world size counts
-# devices to preserve the reference's one-rank-per-accelerator arithmetic.
+# Rank / size queries. The reference's contract is one rank per
+# accelerator; under JAX one process drives several devices. The facade
+# keeps the device-plane arithmetic coherent — ``get_rank()`` is the
+# device-plane rank of this process's lead device and pairs with
+# ``get_world_size()`` = device count — and exposes the process plane
+# explicitly via ``get_process_rank()`` / ``get_process_count()``.
 # ---------------------------------------------------------------------------
 
 def get_rank(group=None):
+    """Device-plane rank of this process's first addressable device
+    (process 0 → 0, so rank-0 gating behaves as in the reference)."""
+    if not is_initialized():
+        return int(os.environ.get("RANK", 0))
+    import jax
+    return jax.process_index() * jax.local_device_count()
+
+
+def get_process_rank():
+    """Host-plane rank (the JAX process index)."""
     if not is_initialized():
         return int(os.environ.get("RANK", 0))
     import jax
     return jax.process_index()
+
+
+def get_process_count():
+    if not is_initialized():
+        return int(os.environ.get("WORLD_SIZE", 1))
+    import jax
+    return jax.process_count()
 
 
 def get_world_size(group=None):
@@ -354,7 +375,8 @@ def host_broadcast(array, src=0):
     if cdb.single_process:
         return array
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.broadcast_one_to_all(array, is_source=get_rank() == src))
+    return np.asarray(multihost_utils.broadcast_one_to_all(array,
+                                                           is_source=get_process_rank() == src))
 
 
 def host_all_gather(array):

@@ -1,20 +1,29 @@
-"""Elasticity config (reference ``deepspeed/elasticity/config.py``)."""
+"""Elasticity configuration.
+
+Parses the ``"elasticity"`` section of the ds_config (same JSON schema
+as the reference, ``deepspeed/elasticity/config.py``) into a typed
+object. The schema keys are product surface; the implementation is a
+plain dataclass with explicit validation.
+"""
+
 
 import json
 
 
+
 class ElasticityError(Exception):
-    """Base exception for elasticity problems."""
+    """Any failure inside the elasticity subsystem."""
 
 
 class ElasticityConfigError(ElasticityError):
-    """Elasticity configuration error."""
+    """The 'elasticity' config section is malformed or unusable."""
 
 
 class ElasticityIncompatibleWorldSize(ElasticityError):
-    """Attempting to run a world size that is incompatible with a given elastic config."""
+    """The requested world size cannot run the solved elastic batch."""
 
 
+# ds_config schema keys (parity with the reference's section layout)
 ELASTICITY = "elasticity"
 ENABLED = "enabled"
 ENABLED_DEFAULT = False
@@ -42,82 +51,47 @@ IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
 
 
 class ElasticityConfig:
-    """Elastic config object, constructed from a param dictionary that only
-    contains the contents of the 'elasticity' entry within the deepspeed config.
-
-    {
-      "elasticity": {
-        "enabled": true,
-        "max_train_batch_size": 2000,
-        "micro_batch_sizes": [2,4,6],
-        "min_gpus": 1,
-        "max_gpus" : 10000,
-        "min_time": 20,
-        "ignore_non_elastic_batch_info": false,
-        "version": 0.1
-      }
-    }
-    """
+    """Typed view of one 'elasticity' section."""
 
     def __init__(self, param_dict):
-        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        d = dict(param_dict or {})
+        self.enabled = bool(d.get(ENABLED, ENABLED_DEFAULT))
         if self.enabled:
-            if MAX_ACCEPTABLE_BATCH_SIZE in param_dict:
-                self.max_acceptable_batch_size = param_dict[MAX_ACCEPTABLE_BATCH_SIZE]
-            else:
-                raise ElasticityConfigError(f"Elasticity config missing {MAX_ACCEPTABLE_BATCH_SIZE}")
-            if MICRO_BATCHES in param_dict:
-                self.micro_batches = param_dict[MICRO_BATCHES]
-            else:
-                raise ElasticityConfigError(f"Elasticity config missing {MICRO_BATCHES}")
-        else:
-            self.max_acceptable_batch_size = param_dict.get(MAX_ACCEPTABLE_BATCH_SIZE,
-                                                            MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
-            self.micro_batches = param_dict.get(MICRO_BATCHES, MICRO_BATCHES_DEFAULT)
+            for key in (MAX_ACCEPTABLE_BATCH_SIZE, MICRO_BATCHES):
+                if key not in d:
+                    raise ElasticityConfigError(f"elasticity section requires '{key}' when enabled")
+        self.max_acceptable_batch_size = d.get(MAX_ACCEPTABLE_BATCH_SIZE,
+                                               MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+        self.micro_batches = d.get(MICRO_BATCHES, list(MICRO_BATCHES_DEFAULT))
+        self.min_gpus = d.get(MIN_GPUS, MIN_GPUS_DEFAULT)
+        self.max_gpus = d.get(MAX_GPUS, MAX_GPUS_DEFAULT)
+        self.model_parallel_size = d.get(MODEL_PARALLEL_SIZE, MODEL_PARALLEL_SIZE_DEFAULT)
+        self.num_gpus_per_node = d.get(NUM_GPUS_PER_NODE, NUM_GPUS_PER_NODE_DEFAULT)
+        self.min_time = d.get(MIN_TIME, MIN_TIME_DEFAULT)
+        self.version = d.get(VERSION, VERSION_DEFAULT)
+        self.prefer_larger_batch_size = d.get(PREFER_LARGER_BATCH, PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = d.get(IGNORE_NON_ELASTIC_BATCH_INFO,
+                                                   IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+        self._validate()
 
-        if not isinstance(self.micro_batches, list):
+    def _validate(self):
+        mbs = self.micro_batches
+        if not isinstance(mbs, (list, tuple)) or not mbs:
+            raise ElasticityConfigError(f"'{MICRO_BATCHES}' must be a non-empty list, got {mbs!r}")
+        if any(not isinstance(m, int) or m <= 0 for m in mbs):
+            raise ElasticityConfigError(f"'{MICRO_BATCHES}' must be positive ints, got {mbs!r}")
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
             raise ElasticityConfigError(
-                f"Elasticity expected value of {MICRO_BATCHES} to be a "
-                f"list of micro batches, instead is: {type(self.micro_batches)}, containing: {self.micro_batches}")
-
-        if not all(map(lambda m: isinstance(m, int), self.micro_batches)):
-            raise ElasticityConfigError(f"Elasticity expected {MICRO_BATCHES} to only contain a list of integers, "
-                                        f"instead contains: f{self.micro_batches}")
-
-        if not all(map(lambda m: m > 0, self.micro_batches)):
-            raise ElasticityConfigError(f"Elasticity expected {MICRO_BATCHES} to only contain positive integers, "
-                                        f"instead contains: f{self.micro_batches}")
-
-        self.min_gpus = param_dict.get(MIN_GPUS, MIN_GPUS_DEFAULT)
-        self.max_gpus = param_dict.get(MAX_GPUS, MAX_GPUS_DEFAULT)
-        if self.min_gpus < 1 or self.max_gpus < 1:
-            raise ElasticityConfigError("Elasticity min/max gpus must be > 0, "
-                                        f"given min_gpus: {self.min_gpus}, max_gpus: {self.max_gpus}")
-        if self.max_gpus < self.min_gpus:
-            raise ElasticityConfigError("Elasticity min_gpus cannot be greater than max_gpus, "
-                                        f"given min_gpus: {self.min_gpus}, max_gpus: {self.max_gpus}")
-
-        self.model_parallel_size = param_dict.get(MODEL_PARALLEL_SIZE, MODEL_PARALLEL_SIZE_DEFAULT)
-        if self.model_parallel_size < 1:
-            raise ElasticityConfigError("Model-Parallel size cannot be less than 1, "
-                                        f"given model-parallel size: {self.model_parallel_size}")
-
-        self.num_gpus_per_node = param_dict.get(NUM_GPUS_PER_NODE, NUM_GPUS_PER_NODE_DEFAULT)
-        if self.num_gpus_per_node < 1:
-            raise ElasticityConfigError("Number of GPUs per node cannot be less than 1, "
-                                        f"given number of GPUs per node: {self.num_gpus_per_node}")
-
-        self.min_time = param_dict.get(MIN_TIME, MIN_TIME_DEFAULT)
+                f"need 1 <= min_gpus <= max_gpus, got [{self.min_gpus}, {self.max_gpus}]")
+        if self.model_parallel_size < 1 or self.num_gpus_per_node < 1:
+            raise ElasticityConfigError(
+                f"model_parallel_size ({self.model_parallel_size}) and num_gpus_per_node "
+                f"({self.num_gpus_per_node}) must be >= 1")
         if self.min_time < 0:
-            raise ElasticityConfigError(f"Elasticity min time needs to be >= 0: given {self.min_time}")
-
-        self.version = param_dict.get(VERSION, VERSION_DEFAULT)
-        self.prefer_larger_batch_size = param_dict.get(PREFER_LARGER_BATCH, PREFER_LARGER_BATCH_DEFAULT)
-        self.ignore_non_elastic_batch_info = param_dict.get(IGNORE_NON_ELASTIC_BATCH_INFO,
-                                                            IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+            raise ElasticityConfigError(f"'{MIN_TIME}' must be >= 0, got {self.min_time}")
 
     def repr(self):
-        return self.__dict__
+        return dict(self.__dict__)
 
     def __repr__(self):
-        return json.dumps(self.__dict__, sort_keys=True, indent=4)
+        return json.dumps({k: v for k, v in self.__dict__.items()}, sort_keys=True, indent=4)

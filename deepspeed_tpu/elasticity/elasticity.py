@@ -1,24 +1,30 @@
-"""Elastic batch-size / chip-count compatibility solver.
+"""Elastic batch solver: pick one global batch size that stays valid
+across many chip counts.
 
-Same algorithm family as the reference's
-``deepspeed/elasticity/elasticity.py`` (``compute_elastic_config`` at
-elasticity.py:233, ``get_compatible_gpus`` v0.1/v0.2 at 83/126):
-pre-compute a global batch size highly composite over candidate chip
-counts, so that any world size in range resumes with identical math.
+Same capability as the reference's ``deepspeed/elasticity/elasticity.py``
+(``compute_elastic_config`` at elasticity.py:233, v0.1/v0.2 solvers at
+83/126), re-derived for TPU topologies:
+
+A chip count ``g`` can run global batch ``B`` with micro-batch ``m``
+iff ``g * m`` divides ``B`` (the quotient is the grad-accumulation
+step count). The solver therefore wants a ``B`` under the cap with as
+many divisors of the form ``g * m`` as possible. TPU slice sizes are
+powers of two (×3 for some pod shapes), so instead of a hardcoded
+highly-composite-number table we generate 5-smooth numbers
+(``2^a · 3^b · 5^c``) — divisor-rich by construction and aligned with
+real slice shapes — and score candidates by enumerating divisors in
+O(√B) rather than scanning every count.
 """
 
 import json
 import math
 import os
-from math import gcd
 
 from deepspeed_tpu.elasticity.config import (
     ELASTICITY,
     ENABLED,
     ENABLED_DEFAULT,
     LATEST_ELASTICITY_VERSION,
-    MAX_ACCEPTABLE_BATCH_SIZE,
-    MICRO_BATCHES,
     ElasticityConfig,
     ElasticityConfigError,
     ElasticityError,
@@ -26,314 +32,238 @@ from deepspeed_tpu.elasticity.config import (
 )
 from deepspeed_tpu.utils.logging import logger
 
-# Thirty eight smallest highly composite numbers. The list should be enough
-# to support up to 720K batch size.
-HCN_LIST = [
-    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160,
-    25200, 27720, 45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280, 720720
-]
+
+def _smooth_numbers(limit, primes=(2, 3, 5)):
+    """All primes-smooth integers in [1, limit], ascending."""
+    vals = [1]
+    for p in primes:
+        grown = []
+        for v in vals:
+            x = v * p
+            while x <= limit:
+                grown.append(x)
+                x *= p
+        vals.extend(grown)
+    return sorted(vals)
 
 
-def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
-    candidate_batch_size = []
-    for base in base_list:
-        if base >= max_acceptable_batch_size:
-            candidate_batch_size.append(base)
-        else:
-            value = max_acceptable_batch_size // base
-            index = next((i for i, n in enumerate(HCN_LIST) if n > value), len(HCN_LIST) - 1)
-            candidate_batch_size.append(HCN_LIST[index - 1] * base)
-    candidate_batch_size = list(set(candidate_batch_size))
-    logger.info(f"Candidate batch size: {candidate_batch_size}")
-    return candidate_batch_size
+def _n_divisors(n):
+    count, i = 1, 2
+    while i * i <= n:
+        e = 0
+        while n % i == 0:
+            n //= i
+            e += 1
+        count *= e + 1
+        i += 1
+    return count * (2 if n > 1 else 1)
 
 
-def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
-    valid_gpus = []
-    for micro_batch in micro_batches:
-        if batch_size % micro_batch == 0:
-            max_gpus = batch_size // micro_batch
-            if min_valid_gpus <= max_gpus <= max_valid_gpus:
-                valid_gpus.append(max_gpus)
-
-            # find all factors less than max_gpus / 2
-            for i in range(1, max_gpus // 2 + 1):
-                if i > max_valid_gpus:
-                    break
-                if i < min_valid_gpus:
-                    continue
-                if max_gpus % i == 0:
-                    valid_gpus.append(i)
-    valid_gpus = set(valid_gpus)
-    valid_gpus = sorted(list(valid_gpus))
-    return valid_gpus
+def _richest_smooth(limit):
+    """The 5-smooth number <= limit with the most divisors (ties break
+    toward the larger value) — a computed stand-in for a
+    highly-composite-number table."""
+    best = max(_smooth_numbers(limit), key=lambda v: (_n_divisors(v), v))
+    return best
 
 
-def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus, prefer_larger):
-    max_valid_gpus = 0
-    valid_gpus = None
-    final_batch_size = int(min(micro_batches))
-
-    for batch_size in candidate_batch_sizes:
-        current_valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
-        if (len(current_valid_gpus) > max_valid_gpus or (len(current_valid_gpus) == max_valid_gpus and
-                                                         ((prefer_larger and batch_size > final_batch_size) or
-                                                          (not prefer_larger and batch_size < final_batch_size)))):
-            max_valid_gpus = len(current_valid_gpus)
-            valid_gpus = current_valid_gpus
-            final_batch_size = batch_size
-
-    return final_batch_size, valid_gpus
+def _divisors(n):
+    """All divisors of n, via trial division to √n."""
+    small, large = [], []
+    i = 1
+    while i * i <= n:
+        if n % i == 0:
+            small.append(i)
+            if i != n // i:
+                large.append(n // i)
+        i += 1
+    return small + large[::-1]
 
 
-def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=None, max_gpus=None,
-                             prefer_larger=True):
-    """We use two heuristics to compute the batch size
-        1. We use the Lowest Common Multiple of the micro-batches
-    as the base batch size and scale it by a HCN such that the result is
-    the largest batch size less than the max_acceptable batch size
-        2. We use each of the micro batches as a base and scale it
-    by a HCN such that the result is the largest batch size less than the
-    max_acceptable batch size.
+def _chip_counts_for(batch, micro_batches, lo, hi):
+    """Sorted chip counts g in [lo, hi] such that some micro-batch m has
+    g*m | batch."""
+    counts = set()
+    for m in micro_batches:
+        if batch % m:
+            continue
+        for g in _divisors(batch // m):
+            if lo <= g <= hi:
+                counts.add(g)
+    return sorted(counts)
 
-    We then use brute force to count the number of compatible GPU count for
-    each of the aforementioned cases, and return the batch size with the most number of
-    compatible GPU counts in the min-max GPU range if provided, other wise
-    we return the batch size with the most number of total compatible GPU counts.
 
-    Returns:
-        final_batch_size
-        valid_gpus
+def _solve_v01(micro_batches, batch_cap, min_chips=None, max_chips=None, prefer_larger=True):
+    """Pick (global_batch, valid_chip_counts) for homogeneous chips.
+
+    Candidates: for each base in {each micro-batch, lcm of all}, the
+    largest smooth multiple of the base under the cap. The winner is the
+    candidate compatible with the most chip counts in range; ties break
+    toward the larger (or smaller) batch per ``prefer_larger``.
     """
-    min_gpus = min_gpus or 1
-    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    min_chips = min_chips or 1
+    max_chips = max_chips or batch_cap // min(micro_batches)
+    if max(micro_batches) > batch_cap:
+        raise ElasticityError(
+            f"micro batch {max(micro_batches)} exceeds max_train_batch_size {batch_cap}")
 
-    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
-        raise ValueError(f"All micro batches must be less than \
-            or equal to max_acceptable_batch_size: {max_acceptable_batch_size}")
+    bases = set(micro_batches)
+    bases.add(math.lcm(*micro_batches))
+    candidates = set()
+    for base in bases:
+        if base >= batch_cap:
+            candidates.add(base)
+            continue
+        candidates.add(base * _richest_smooth(batch_cap // base))
+    logger.info(f"elasticity: candidate global batches {sorted(candidates)}")
 
-    lcm = micro_batches[0]
-    for mb in micro_batches[1:]:
-        lcm = lcm * mb // gcd(lcm, mb)
-
-    base_list = []
-    base_list.extend(micro_batches)
-    base_list.append(lcm)
-
-    candidate_batch_sizes = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
-
-    final_batch_size, valid_gpus = get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus,
-                                                       prefer_larger)
-
-    return final_batch_size, valid_gpus
+    best = None  # (n_valid, signed_batch, batch, valid)
+    for batch in candidates:
+        valid = _chip_counts_for(batch, micro_batches, min_chips, max_chips)
+        key = (len(valid), batch if prefer_larger else -batch)
+        if best is None or key > best[0]:
+            best = (key, batch, valid)
+    _, batch, valid = best
+    return batch, valid
 
 
-def _get_compatible_gpus_v02(micro_batches,
-                             max_acceptable_batch_size,
-                             current_num_gpus,
-                             min_gpus=None,
-                             max_gpus=None,
-                             prefer_larger=True,
-                             num_gpus_per_node=1,
-                             model_parallel_size=1):
-    """Computes a compatible batch size in the presence of model parallelism:
-    the effective data-parallel unit becomes ``dp_size_per_node`` groups.
+def _solve_v02(micro_batches, batch_cap, current_chips, min_chips=None, max_chips=None,
+               prefer_larger=True, chips_per_node=1, model_parallel_size=1):
+    """v0.2: model-parallel aware, node-granular. The schedulable unit is
+    a node contributing ``chips_per_node // mp`` data-parallel ranks, so
+    the v0.1 solver runs at node granularity and results scale back up.
+    Returns (global_batch, valid_dp_sizes, micro_batch)."""
+    if chips_per_node % model_parallel_size:
+        raise ElasticityError(
+            f"v0.2 needs chips_per_node ({chips_per_node}) divisible by "
+            f"model_parallel_size ({model_parallel_size})")
+    dp_per_node = chips_per_node // model_parallel_size
 
-    Returns:
-        final_batch_size
-        valid_gpus
-        micro-batch size
-    """
-    if num_gpus_per_node % model_parallel_size != 0:
-        raise ElasticityError(f"In Elasticity v0.2, number of GPUs per node:"
-                              f"{num_gpus_per_node} should be divisible by "
-                              f"model parallel size {model_parallel_size}")
-
-    def get_microbatch(final_batch_size):
-        candidate_microbatch = None
-
-        for micro_batch in micro_batches:
-            if final_batch_size // current_num_gpus % micro_batch == 0:
-                if candidate_microbatch is None:
-                    candidate_microbatch = micro_batch
-                if prefer_larger and candidate_microbatch < micro_batch:
-                    candidate_microbatch = micro_batch
-        return candidate_microbatch
-
-    dp_size_per_node = num_gpus_per_node // model_parallel_size
-
-    final_batch_size, valid_world_size = _get_compatible_gpus_v01(
+    node_batch, valid_nodes = _solve_v01(
         micro_batches,
-        int(max_acceptable_batch_size / dp_size_per_node),
-        int(min_gpus / num_gpus_per_node),
-        int(max_gpus / num_gpus_per_node),  # Passing number of max nodes as Elasticity v2 works at node level
+        batch_cap // dp_per_node,
+        max(1, (min_chips or 1) // chips_per_node) if min_chips else None,
+        max(1, (max_chips or 0) // chips_per_node) if max_chips else None,
         prefer_larger=prefer_larger)
+    batch = node_batch * dp_per_node
+    valid_dp = [n * dp_per_node for n in valid_nodes]
 
-    final_batch_size = int(final_batch_size) * dp_size_per_node
-    valid_dp_world_size = [i * dp_size_per_node for i in valid_world_size]
+    def pick_micro(b):
+        fits = [m for m in micro_batches if (b // current_chips) % m == 0]
+        if not fits:
+            return None
+        return max(fits) if prefer_larger else min(fits)
 
-    if current_num_gpus // model_parallel_size in valid_dp_world_size:
-        candidate_microbatch = get_microbatch(final_batch_size)
-        return final_batch_size, valid_dp_world_size, candidate_microbatch
+    if current_chips // model_parallel_size in valid_dp:
+        return batch, valid_dp, pick_micro(batch)
 
-    current_dp_size = (current_num_gpus / num_gpus_per_node) * dp_size_per_node
-    candidate_batch_sizes = []
-    for micro_batch in micro_batches:
-        min_batch_size = micro_batch * current_dp_size
-
-        factor = math.floor(max_acceptable_batch_size / float(min_batch_size))
-        candidate_batch_sizes.append(factor * min_batch_size)
-
-    used_microbatch = None
-    if prefer_larger:
-        candidate_batch_size = max(candidate_batch_sizes)
-    else:
-        candidate_batch_size = min(candidate_batch_sizes)
-
-    candidate_microbatch = get_microbatch(candidate_batch_size)
-
-    return candidate_batch_size, [int(current_dp_size)], candidate_microbatch
+    # Current world size is off-grid: fall back to the largest batch
+    # under the cap that this exact dp size can run. Below one full node,
+    # the dp size is just whatever the chips give after model parallelism.
+    dp_now = ((current_chips // chips_per_node) * dp_per_node
+              or max(1, current_chips // model_parallel_size))
+    fallbacks = [m * dp_now * (batch_cap // (m * dp_now)) for m in micro_batches]
+    batch = max(fallbacks) if prefer_larger else min(b for b in fallbacks if b > 0)
+    return batch, [dp_now], pick_micro(batch)
 
 
-def get_compatible_gpus(micro_batches,
-                        max_acceptable_batch_size,
-                        min_gpus=None,
-                        max_gpus=None,
-                        prefer_larger=True,
-                        num_gpus_per_node=1,
-                        model_parallel_size=1,
-                        current_num_gpus=None,
-                        version=0.1):
+def get_compatible_gpus(micro_batches, max_acceptable_batch_size, min_gpus=None, max_gpus=None,
+                        prefer_larger=True, num_gpus_per_node=1, model_parallel_size=1,
+                        current_num_gpus=None, version=0.1):
+    """Version-dispatching public solver (reference API surface)."""
     if version == 0.1:
-        return _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus, max_gpus, prefer_larger)
-    elif version == 0.2:
-        return _get_compatible_gpus_v02(micro_batches,
-                                        max_acceptable_batch_size,
-                                        current_num_gpus,
-                                        min_gpus=min_gpus,
-                                        max_gpus=max_gpus,
-                                        prefer_larger=prefer_larger,
-                                        num_gpus_per_node=num_gpus_per_node,
-                                        model_parallel_size=model_parallel_size)
+        return _solve_v01(micro_batches, max_acceptable_batch_size, min_gpus, max_gpus,
+                          prefer_larger)
+    if version == 0.2:
+        return _solve_v02(micro_batches, max_acceptable_batch_size, current_num_gpus,
+                          min_chips=min_gpus, max_chips=max_gpus, prefer_larger=prefer_larger,
+                          chips_per_node=num_gpus_per_node,
+                          model_parallel_size=model_parallel_size)
     raise ElasticityError(f"Unknown elasticity version: {version}")
 
 
 def elasticity_enabled(ds_config: dict):
-    if ELASTICITY not in ds_config:
-        return False
-    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+    return ds_config.get(ELASTICITY, {}).get(ENABLED, ENABLED_DEFAULT)
 
 
 def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
-    """Ensure the resource scheduler saw the same elastic config we are using at runtime."""
-    if "DEEPSPEED_ELASTICITY_CONFIG" in os.environ:
-        scheduler_elastic_config_dict = json.loads(os.environ["DEEPSPEED_ELASTICITY_CONFIG"])
-        scheduler_elastic_config = ElasticityConfig(scheduler_elastic_config_dict)
-        runtime_elastic_config = ElasticityConfig(runtime_elastic_config_dict)
-        err_str = "Elastic config '{}={}' seen by resource scheduler does not match config passed to runtime {}={}"
-        if runtime_elastic_config.max_acceptable_batch_size != scheduler_elastic_config.max_acceptable_batch_size:
+    """The launcher records the elastic config it scheduled against in
+    ``DEEPSPEED_ELASTICITY_CONFIG``; the runtime must not deviate from
+    it, or resumed jobs would train with different math."""
+    frozen = os.environ.get("DEEPSPEED_ELASTICITY_CONFIG")
+    if frozen is None:
+        return
+    sched = ElasticityConfig(json.loads(frozen))
+    run = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        a, b = getattr(sched, field), getattr(run, field)
+        if a != b:
             raise ElasticityConfigError(
-                err_str.format("max_acceptable_batch_size", scheduler_elastic_config.max_acceptable_batch_size,
-                               "max_acceptable_batch_size", runtime_elastic_config.max_acceptable_batch_size))
-        if runtime_elastic_config.micro_batches != scheduler_elastic_config.micro_batches:
-            raise ElasticityConfigError(
-                err_str.format("micro_batches", scheduler_elastic_config.micro_batches, "micro_batches",
-                               runtime_elastic_config.micro_batches))
-        if runtime_elastic_config.version != scheduler_elastic_config.version:
-            raise ElasticityConfigError(
-                err_str.format("version", scheduler_elastic_config.version, "version",
-                               runtime_elastic_config.version))
+                f"elastic config drift on '{field}': scheduler saw {a}, runtime has {b}")
 
 
-def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size=0, return_microbatch=False):
-    """Core deepspeed elasticity API.
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size=0,
+                           return_microbatch=False):
+    """Solve the elastic batch for a ds_config (reference
+    ``compute_elastic_config``, elasticity.py:233).
 
-    Args:
-        ds_config (dict): DeepSpeed config dictionary/json
-        target_deepspeed_version (str): When called from scheduling
-            infrastructure we want to ensure the user is on a deepspeed version that
-            supports elasticity.
-        world_size (int, optional): Intended/current DP world size, will do some sanity
-            checks to ensure world size is actually valid with the config.
-        return_microbatch (bool, optional): whether to return micro batch size or not.
+    Returns ``(batch, valid_counts)``, plus the chosen micro-batch when
+    ``world_size`` is given (or ``return_microbatch`` under v0.2).
     """
     if not isinstance(ds_config, dict):
-        raise ValueError("Expected ds_config to be a dictionary but received " f"a {type(ds_config)}, containing: {ds_config}")
-
+        raise ValueError(f"expected ds_config dict, got {type(ds_config)}")
     if ELASTICITY not in ds_config:
-        raise ElasticityConfigError(f"'{ELASTICITY}' is missing from config json,"
-                                    " please add it if running an elastic training job.")
+        raise ElasticityConfigError(
+            f"'{ELASTICITY}' section missing from config json — add it to run elastic jobs")
+    section = ds_config[ELASTICITY]
+    if not section.get(ENABLED, ENABLED_DEFAULT):
+        raise ElasticityConfigError("elasticity is present but not enabled in the config")
+    ensure_immutable_elastic_config(section)
 
-    elastic_config_dict = ds_config[ELASTICITY]
-    if not elastic_config_dict.get(ENABLED, ENABLED_DEFAULT):
-        raise ElasticityConfigError("Elasticity is not enabled, please enable it "
-                                    "in the config json or don't call this function.")
+    cfg = ElasticityConfig(section)
+    version = float(cfg.version)
+    if version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity v{version} requested; runtime supports up to v{LATEST_ELASTICITY_VERSION}")
+    if cfg.model_parallel_size > 1 and version != 0.2:
+        raise ElasticityConfigError(
+            f"model parallelism (size {cfg.model_parallel_size}) requires elasticity v0.2")
 
-    ensure_immutable_elastic_config(runtime_elastic_config_dict=elastic_config_dict)
+    if version not in (0.1, 0.2):
+        raise ElasticityConfigError(f"Unknown elasticity version: {version}")
 
-    elastic_config = ElasticityConfig(elastic_config_dict)
-    model_parallel_size = elastic_config.model_parallel_size
-    num_gpus_per_node = elastic_config.num_gpus_per_node
-
-    if model_parallel_size > 1 and float(elastic_config.version) != 0.2:
-        raise ElasticityConfigError("Elasticity V{} " "does not support model-parallel training. Given model-parallel size: "
-                                    "{}".format(elastic_config.version, model_parallel_size))
-
-    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
-        raise ElasticityConfigError("Attempting to run elasticity version " f"{elastic_config.version} but runtime only supports up "
-                                    f"to {LATEST_ELASTICITY_VERSION}")
-
-    if float(elastic_config.version) == 0.1:
-        final_batch_size, valid_gpus = get_compatible_gpus(
-            micro_batches=elastic_config.micro_batches,
-            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
-            min_gpus=elastic_config.min_gpus,
-            max_gpus=elastic_config.max_gpus,
-            prefer_larger=elastic_config.prefer_larger_batch_size,
-            version=0.1)
-    elif float(elastic_config.version) == 0.2:
-        if world_size != 0:
-            current_num_gpus = world_size
-        else:
-            if "WORLD_SIZE" in os.environ and os.getenv("WORLD_SIZE").isdigit():
-                current_num_gpus = int(os.getenv("WORLD_SIZE"))
-            else:
-                WORLD_SIZE = os.getenv("WORLD_SIZE")
-                raise ElasticityConfigError("Elasticity V 0.2 needs WORLD_SIZE to compute valid batch size. "
-                                            f"Either give it as argument to function compute_elastic_config "
-                                            f"or set it as an environment variable. Value of WORLD_SIZE as environment variable is {WORLD_SIZE}")
-
-        final_batch_size, valid_gpus, candidate_microbatch_size = get_compatible_gpus(
-            micro_batches=elastic_config.micro_batches,
-            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
-            current_num_gpus=current_num_gpus,
-            min_gpus=elastic_config.min_gpus,
-            max_gpus=elastic_config.max_gpus,
-            prefer_larger=elastic_config.prefer_larger_batch_size,
-            num_gpus_per_node=num_gpus_per_node,
-            model_parallel_size=model_parallel_size,
-            version=0.2)
+    micro_choice = None
+    if version == 0.2:
+        chips = world_size or int(os.environ.get("WORLD_SIZE") or 0)
+        if not chips:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs the world size: pass world_size= or set WORLD_SIZE")
+        batch, valid, micro_choice = _solve_v02(
+            cfg.micro_batches, cfg.max_acceptable_batch_size, chips,
+            min_chips=cfg.min_gpus, max_chips=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size,
+            chips_per_node=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
     else:
-        raise ElasticityConfigError(f"Unknown elasticity version: {elastic_config.version}")
-
-    logger.info(f"Valid World Size (GPUs / Model Parallel Size): {valid_gpus}")
+        batch, valid = _solve_v01(cfg.micro_batches, cfg.max_acceptable_batch_size,
+                                  cfg.min_gpus, cfg.max_gpus,
+                                  prefer_larger=cfg.prefer_larger_batch_size)
+    logger.info(f"elasticity: batch {batch}, valid dp sizes {valid}")
 
     if world_size > 0:
-        if world_size not in valid_gpus:
-            raise ElasticityIncompatibleWorldSize(f"World size ({world_size}) is not valid " f"with the current list of valid GPU counts: {valid_gpus}")
-
-        # Pick largest valid micro batch size
-        micro_batch_size = None
-        for mbsz in sorted(list(set(elastic_config.micro_batches)), reverse=True):
-            if final_batch_size // world_size % mbsz == 0:
-                micro_batch_size = mbsz
-                break
-        assert micro_batch_size is not None, "Unable to find divisible micro batch size" \
-            f" world_size={world_size} final_batch_size={final_batch_size} and  micro_batches={elastic_config.micro_batches}"
-        return final_batch_size, valid_gpus, micro_batch_size
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not among valid counts {valid}")
+        per_rank = batch // world_size
+        fits = [m for m in sorted(set(cfg.micro_batches), reverse=True) if per_rank % m == 0]
+        if not fits:
+            raise ElasticityError(
+                f"no micro batch in {cfg.micro_batches} divides per-rank batch {per_rank}")
+        return batch, valid, fits[0]
 
     if return_microbatch:
-        assert float(elastic_config.version) == 0.2, "Microbatch return is only supported for elasticity v0.2"
-        return final_batch_size, valid_gpus, candidate_microbatch_size
+        if version != 0.2:
+            raise ElasticityConfigError("return_microbatch requires elasticity v0.2")
+        return batch, valid, micro_choice
 
-    return final_batch_size, valid_gpus
+    return batch, valid

@@ -201,8 +201,12 @@ class InferenceEngine:
         if key not in self._jit_cache:
             self._jit_cache[key] = self._decode_fn(max_new_tokens, do_sample,
                                                    temperature, top_k, top_p)
-        rng = jax.random.PRNGKey(seed) if seed is not None else self._rng
-        self._rng, rng = jax.random.split(rng if seed is not None else self._rng)
+        # Only advance the engine's persistent stream on unseeded calls;
+        # an explicit seed must not clobber it.
+        if seed is not None:
+            rng = jax.random.PRNGKey(seed)
+        else:
+            self._rng, rng = jax.random.split(self._rng)
         new_tokens = self._jit_cache[key](self.params, input_ids, cache, rng,
                                           jnp.asarray(eos_token_id, jnp.int32))
         return jnp.concatenate([input_ids, new_tokens], axis=1)

@@ -30,7 +30,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.sequence.layer import (constrain, constrain_hidden, head_to_seq_shard, seq_to_head_shard)
+from deepspeed_tpu.ops.pallas import spec_divides as _spec_divides
+from deepspeed_tpu.sequence.layer import (constrain, constrain_hidden, head_to_seq_shard, heads_spec,
+                                          hidden_spec, seq_to_head_shard)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,11 +83,19 @@ class RMSNorm(nn.Module):
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
-        from deepspeed_tpu.ops.pallas import fused_rms_norm
-        # Pallas kernel on TPU, identical-math XLA elsewhere. (Multi-chip
-        # note: pallas_call under GSPMD runs per-shard once activations
-        # are only sequence/batch-sharded, which holds at every call site
-        # here — the norm axis is never sharded.)
+        from deepspeed_tpu.ops.pallas import fused_rms_norm, kernel_dispatch, shard_map_kernel
+        from deepspeed_tpu.parallel import groups
+        mesh = groups.get_mesh(required=False)
+        # Pallas kernel on TPU, identical-math XLA elsewhere. Under a
+        # multi-device mesh the kernel must run per-shard (pallas_call
+        # has no GSPMD rule), so wrap it in shard_map on the canonical
+        # [B, S, D] layout — the norm axis is never sharded.
+        if kernel_dispatch(mesh) == "shard_map" and x.ndim == 3 \
+                and _spec_divides(mesh, hidden_spec(mesh), x.shape):
+            spec = hidden_spec(mesh)
+            eps = self.eps
+            return shard_map_kernel(lambda xs, sc: fused_rms_norm(xs, sc, eps),
+                                    mesh, (spec, P(None)), spec)(x, scale)
         return fused_rms_norm(x, scale, self.eps)
 
 
@@ -127,13 +137,24 @@ def einsum_attention(q, k, v, causal=True, bias=None, mask=None):
 
 
 def _local_attention(q, k, v, impl: str, causal=True):
+    from deepspeed_tpu.ops.pallas import kernel_dispatch, shard_map_kernel
+    from deepspeed_tpu.parallel import groups
+    mesh = groups.get_mesh(required=False)
+    mode = kernel_dispatch(mesh)
+    if mode == "shard_map" and not _spec_divides(mesh, heads_spec(mesh), q.shape):
+        mode = "xla"
     if impl == "auto":
-        from deepspeed_tpu.ops.pallas import use_pallas
         # The Pallas kernel wins once the [S, S] score matrix dominates;
         # tiny test shapes stay on the fused-by-XLA einsum path.
-        impl = "flash" if use_pallas() and q.shape[1] >= 256 else "einsum"
+        impl = "flash" if mode != "xla" and q.shape[1] >= 256 else "einsum"
     if impl == "flash":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        if mode == "shard_map":
+            # Run the kernel per-shard on the post-Ulysses layout (full
+            # sequence, head-sharded) — causal masking is shard-local.
+            spec = heads_spec(mesh)
+            return shard_map_kernel(lambda a, b, c: flash_attention(a, b, c, causal=causal),
+                                    mesh, (spec, spec, spec), spec)(q, k, v)
         return flash_attention(q, k, v, causal=causal)
     return einsum_attention(q, k, v, causal=causal)
 

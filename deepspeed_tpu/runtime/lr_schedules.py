@@ -1,11 +1,16 @@
 """Learning-rate schedules.
 
-Same schedule family and JSON parameters as the reference's
-``deepspeed/runtime/lr_schedules.py`` (LRRangeTest:267, OneCycle:370,
-WarmupLR:634, WarmupDecayLR:723, WarmupCosineLR:774). Schedulers are
-host-side stateful objects driving the engine optimizer's ``lr`` field;
-each also exposes ``as_schedule_fn()`` returning a pure
-``step -> lr`` callable for fully-jitted training loops.
+Same schedule family and JSON parameter schema as the reference
+(``deepspeed/runtime/lr_schedules.py``: LRRangeTest:267, OneCycle:370,
+WarmupLR:634, WarmupDecayLR:723, WarmupCosineLR:774), rebuilt around a
+pure functional core: every schedule is a stateless ``step -> value``
+curve; the scheduler classes are thin stateful drivers that write the
+curve's value into the optimizer's param groups. The pure curve is also
+exposed directly (``as_schedule_fn``) for fully-jitted training loops —
+the natural TPU shape, where the LR is a traced scalar input.
+
+CLI plumbing is generated from one declarative parameter table instead
+of per-schedule helper functions.
 """
 
 import argparse
@@ -56,172 +61,168 @@ COS_MIN_RATIO = "cos_min_ratio"
 TOTAL_NUM_STEPS = "total_num_steps"
 
 
+# ---------------------------------------------------------------------------
+# Declarative CLI parameter table: family -> [(key, type, default, help)].
+# argparse setup and config overrides are both generated from it.
+# ---------------------------------------------------------------------------
+
+_CLI_TABLE = {
+    LR_RANGE_TEST: [
+        (LR_RANGE_TEST_MIN_LR, float, 0.001, "starting LR for the range test"),
+        (LR_RANGE_TEST_STEP_RATE, float, 1.0, "LR scaling rate per interval"),
+        (LR_RANGE_TEST_STEP_SIZE, int, 1000, "steps per LR interval"),
+        (LR_RANGE_TEST_STAIRCASE, bool, False, "discrete (staircase) intervals"),
+    ],
+    ONE_CYCLE: [
+        (CYCLE_FIRST_STEP_SIZE, int, 1000, "steps in the rising half-cycle"),
+        (CYCLE_FIRST_STAIR_COUNT, int, -1, "stairs in the rising half-cycle"),
+        (CYCLE_SECOND_STEP_SIZE, int, -1, "steps in the falling half-cycle"),
+        (CYCLE_SECOND_STAIR_COUNT, int, -1, "stairs in the falling half-cycle"),
+        (DECAY_STEP_SIZE, int, 1000, "steps per post-cycle decay interval"),
+        (CYCLE_MIN_LR, float, 0.01, "cycle LR floor"),
+        (CYCLE_MAX_LR, float, 0.1, "cycle LR peak"),
+        (DECAY_LR_RATE, float, 0.0, "post-cycle LR decay rate"),
+        (CYCLE_MIN_MOM, float, 0.8, "cycle momentum floor"),
+        (CYCLE_MAX_MOM, float, 0.9, "cycle momentum peak"),
+        (DECAY_MOM_RATE, float, 0.0, "post-cycle momentum decay rate"),
+    ],
+    WARMUP_LR: [
+        (WARMUP_MIN_LR, float, 0.0, "initial LR before warmup"),
+        (WARMUP_MAX_LR, float, 0.001, "LR after warmup"),
+        (WARMUP_NUM_STEPS, int, 1000, "warmup step count"),
+        (WARMUP_TYPE, str, WARMUP_LOG_RATE, "warmup curve: log | linear"),
+    ],
+}
+
+
 def add_tuning_arguments(parser):
     group = parser.add_argument_group("Convergence Tuning", "Convergence tuning configurations")
-
-    # LR scheduler
-    group.add_argument("--lr_schedule", type=str, default=None, help="LR schedule for training.")
-
-    # Learning rate range test
-    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001, help="Starting lr value.")
-    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0, help="scaling rate for LR range test.")
-    group.add_argument("--lr_range_test_step_size", type=int, default=1000, help="training steps per LR change.")
-    group.add_argument("--lr_range_test_staircase", type=bool, default=False,
-                       help="use staircase scaling for LR range test.")
-
-    # OneCycle schedule
-    group.add_argument("--cycle_first_step_size", type=int, default=1000,
-                       help="size of first step of 1Cycle schedule (training steps).")
-    group.add_argument("--cycle_first_stair_count", type=int, default=-1,
-                       help="first stair count for 1Cycle schedule.")
-    group.add_argument("--cycle_second_step_size", type=int, default=-1,
-                       help="size of second step of 1Cycle schedule (default first_step_size).")
-    group.add_argument("--cycle_second_stair_count", type=int, default=-1,
-                       help="second stair count for 1Cycle schedule.")
-    group.add_argument("--decay_step_size", type=int, default=1000,
-                       help="size of intervals for applying post cycle decay (training steps).")
-
-    # 1Cycle LR
-    group.add_argument("--cycle_min_lr", type=float, default=0.01, help="1Cycle LR lower bound.")
-    group.add_argument("--cycle_max_lr", type=float, default=0.1, help="1Cycle LR upper bound.")
-    group.add_argument("--decay_lr_rate", type=float, default=0.0, help="post cycle LR decay rate.")
-
-    # 1Cycle Momentum
-    group.add_argument("--cycle_momentum", default=False, action="store_true", help="enable 1Cycle momentum schedule.")
-    group.add_argument("--cycle_min_mom", type=float, default=0.8, help="1Cycle momentum lower bound.")
-    group.add_argument("--cycle_max_mom", type=float, default=0.9, help="1Cycle momentum upper bound.")
-    group.add_argument("--decay_mom_rate", type=float, default=0.0, help="post cycle momentum decay rate.")
-
-    # Warmup LR
-    group.add_argument("--warmup_min_lr", type=float, default=0, help="WarmupLR minimum/initial LR value.")
-    group.add_argument("--warmup_max_lr", type=float, default=0.001, help="WarmupLR maximum LR value.")
-    group.add_argument("--warmup_num_steps", type=int, default=1000, help="WarmupLR step count for LR warmup.")
-    group.add_argument("--warmup_type", type=str, default=WARMUP_LOG_RATE,
-                       help="WarmupLR increasing function during warmup.")
+    group.add_argument(f"--{LR_SCHEDULE}", type=str, default=None, help="LR schedule for training.")
+    for rows in _CLI_TABLE.values():
+        for key, typ, default, help_text in rows:
+            group.add_argument(f"--{key}", type=typ, default=default, help=help_text)
+    group.add_argument("--cycle_momentum", default=False, action="store_true",
+                       help="enable the OneCycle momentum schedule")
     return parser
 
 
 def parse_arguments():
-    parser = argparse.ArgumentParser()
-    parser = add_tuning_arguments(parser)
-    lr_sched_args, unknown_args = parser.parse_known_args()
-    return lr_sched_args, unknown_args
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    return parser.parse_known_args()
+
+
+def _apply_cli_overrides(family, args, params):
+    for key, _, _, _ in _CLI_TABLE[family]:
+        value = getattr(args, key, None)
+        if value is not None:
+            params[key] = value
 
 
 def override_lr_range_test_params(args, params):
-    if hasattr(args, LR_RANGE_TEST_MIN_LR) and args.lr_range_test_min_lr is not None:
-        params[LR_RANGE_TEST_MIN_LR] = args.lr_range_test_min_lr
-    if hasattr(args, LR_RANGE_TEST_STEP_RATE) and args.lr_range_test_step_rate is not None:
-        params[LR_RANGE_TEST_STEP_RATE] = args.lr_range_test_step_rate
-    if hasattr(args, LR_RANGE_TEST_STEP_SIZE) and args.lr_range_test_step_size is not None:
-        params[LR_RANGE_TEST_STEP_SIZE] = args.lr_range_test_step_size
-    if hasattr(args, LR_RANGE_TEST_STAIRCASE) and args.lr_range_test_staircase is not None:
-        params[LR_RANGE_TEST_STAIRCASE] = args.lr_range_test_staircase
+    _apply_cli_overrides(LR_RANGE_TEST, args, params)
 
 
 def override_1cycle_params(args, params):
-    if hasattr(args, CYCLE_FIRST_STEP_SIZE) and args.cycle_first_step_size is not None:
-        params[CYCLE_FIRST_STEP_SIZE] = args.cycle_first_step_size
-    if hasattr(args, CYCLE_FIRST_STAIR_COUNT) and args.cycle_first_stair_count is not None:
-        params[CYCLE_FIRST_STAIR_COUNT] = args.cycle_first_stair_count
-    if hasattr(args, CYCLE_SECOND_STEP_SIZE) and args.cycle_second_step_size is not None:
-        params[CYCLE_SECOND_STEP_SIZE] = args.cycle_second_step_size
-    if hasattr(args, CYCLE_SECOND_STAIR_COUNT) and args.cycle_second_stair_count is not None:
-        params[CYCLE_SECOND_STAIR_COUNT] = args.cycle_second_stair_count
-    if hasattr(args, DECAY_STEP_SIZE) and args.decay_step_size is not None:
-        params[DECAY_STEP_SIZE] = args.decay_step_size
-    if hasattr(args, CYCLE_MIN_LR) and args.cycle_min_lr is not None:
-        params[CYCLE_MIN_LR] = args.cycle_min_lr
-    if hasattr(args, CYCLE_MAX_LR) and args.cycle_max_lr is not None:
-        params[CYCLE_MAX_LR] = args.cycle_max_lr
-    if hasattr(args, DECAY_LR_RATE) and args.decay_lr_rate is not None:
-        params[DECAY_LR_RATE] = args.decay_lr_rate
-    if hasattr(args, CYCLE_MIN_MOM) and args.cycle_min_mom is not None:
-        params[CYCLE_MIN_MOM] = args.cycle_min_mom
-    if hasattr(args, CYCLE_MAX_MOM) and args.cycle_max_mom is not None:
-        params[CYCLE_MAX_MOM] = args.cycle_max_mom
-    if hasattr(args, DECAY_MOM_RATE) and args.decay_mom_rate is not None:
-        params[DECAY_MOM_RATE] = args.decay_mom_rate
+    _apply_cli_overrides(ONE_CYCLE, args, params)
 
 
 def override_warmupLR_params(args, params):
-    if hasattr(args, WARMUP_MIN_LR) and args.warmup_min_lr is not None:
-        params[WARMUP_MIN_LR] = args.warmup_min_lr
-    if hasattr(args, WARMUP_MAX_LR) and args.warmup_max_lr is not None:
-        params[WARMUP_MAX_LR] = args.warmup_max_lr
-    if hasattr(args, WARMUP_NUM_STEPS) and args.warmup_num_steps is not None:
-        params[WARMUP_NUM_STEPS] = args.warmup_num_steps
-    if hasattr(args, WARMUP_TYPE) and args.warmup_type is not None:
-        params[WARMUP_TYPE] = args.warmup_type
+    _apply_cli_overrides(WARMUP_LR, args, params)
 
 
 def override_params(args, params):
-    # LR range test params
-    override_lr_range_test_params(args, params)
-    # 1Cycle params
-    override_1cycle_params(args, params)
-    # WarmupLR params
-    override_warmupLR_params(args, params)
+    for family in _CLI_TABLE:
+        _apply_cli_overrides(family, args, params)
 
 
 def get_config_from_args(args):
-    if not hasattr(args, LR_SCHEDULE) or args.lr_schedule is None:
-        return None, "--{} not specified on command line".format(LR_SCHEDULE)
-    if args.lr_schedule not in VALID_LR_SCHEDULES:
-        return None, "{} is not supported LR schedule".format(args.lr_schedule)
-
-    config = {"type": args.lr_schedule, "params": {}}
-    if args.lr_schedule == LR_RANGE_TEST:
-        override_lr_range_test_params(args, config["params"])
-    elif args.lr_schedule == ONE_CYCLE:
-        override_1cycle_params(args, config["params"])
-    else:
-        override_warmupLR_params(args, config["params"])
+    """Build a scheduler config dict from parsed CLI args; returns
+    (config, None) or (None, reason)."""
+    name = getattr(args, LR_SCHEDULE, None)
+    if name is None:
+        return None, f"--{LR_SCHEDULE} not specified on command line"
+    if name not in VALID_LR_SCHEDULES:
+        return None, f"{name} is not supported LR schedule"
+    family = name if name in _CLI_TABLE else WARMUP_LR  # warmup variants share params
+    config = {"type": name, "params": {}}
+    _apply_cli_overrides(family, args, config["params"])
     return config, None
 
 
 def get_lr_from_config(config):
-    if "type" not in config:
-        return None, "LR schedule type not defined in config"
-    if "params" not in config:
-        return None, "LR schedule params not defined in config"
+    """The schedule's nominal peak LR; returns (lr, '') or (None, reason)."""
+    for key in ("type", "params"):
+        if key not in config:
+            return None, f"LR schedule {key} not defined in config"
+    name, params = config["type"], config["params"]
+    if name not in VALID_LR_SCHEDULES:
+        return None, f"{name} is not a valid LR schedule"
+    peak_key = {LR_RANGE_TEST: LR_RANGE_TEST_MIN_LR, ONE_CYCLE: CYCLE_MAX_LR}.get(name, WARMUP_MAX_LR)
+    return params[peak_key], ""
 
-    lr_schedule = config["type"]
-    lr_params = config["params"]
 
-    if lr_schedule not in VALID_LR_SCHEDULES:
-        return None, "{} is not a valid LR schedule".format(lr_schedule)
+# ---------------------------------------------------------------------------
+# Pure curves (step -> scalar). The scheduler classes drive these.
+# ---------------------------------------------------------------------------
 
-    if lr_schedule == LR_RANGE_TEST:
-        return lr_params[LR_RANGE_TEST_MIN_LR], ""
-    if lr_schedule == ONE_CYCLE:
-        return lr_params[CYCLE_MAX_LR], ""
-    # Warmup LR
-    return lr_params[WARMUP_MAX_LR], ""
+def _warmup_fraction(step, num_steps, warmup_type):
+    """Warmup progress in [0, 1]; log or linear ramp over ``num_steps``."""
+    if step >= num_steps:
+        return 1.0
+    if warmup_type == WARMUP_LINEAR_RATE:
+        return step / num_steps
+    return math.log(step + 1) / math.log(num_steps)
+
+
+def _triangle(step, up_steps, down_steps):
+    """Periodic triangular wave in [0, 1]: up over ``up_steps``, down
+    over ``down_steps``."""
+    period = up_steps + down_steps
+    t = step % period
+    if t < up_steps:
+        return t / up_steps
+    return 1.0 - (t - up_steps) / down_steps
 
 
 class _LRScheduler:
-    """Common scaffolding: an optimizer-like object exposing
-    ``param_groups`` (list of dicts with at least 'lr')."""
+    """Stateful driver over a pure ``_lr_at(step) -> [lr per group]``
+    curve. ``step()`` advances the counter and writes the new LRs into
+    ``optimizer.param_groups``."""
 
     def __init__(self, optimizer, last_batch_iteration=-1):
         self.optimizer = optimizer
         self.last_batch_iteration = last_batch_iteration
 
-    def get_lr(self):
+    # subclasses implement the pure curve
+    def _lr_at(self, step):
         raise NotImplementedError
+
+    def get_lr(self):
+        return self._lr_at(self.last_batch_iteration)
 
     def get_last_lr(self):
         assert getattr(self, "_last_lr", None) is not None, "need to call step() first"
         return self._last_lr
 
     def step(self, last_batch_iteration=None):
-        if last_batch_iteration is None:
-            last_batch_iteration = self.last_batch_iteration + 1
-        self.last_batch_iteration = last_batch_iteration
-        for param_group, lr in zip(self.optimizer.param_groups, self.get_lr()):
-            param_group["lr"] = lr
-        self._last_lr = self.get_lr()
+        self.last_batch_iteration = (self.last_batch_iteration + 1
+                                     if last_batch_iteration is None else last_batch_iteration)
+        lrs = self.get_lr()
+        self._write_lrs(lrs)
+        self._last_lr = lrs
+
+    def _write_lrs(self, lrs):
+        for group, lr in zip(self.optimizer.param_groups, lrs):
+            group["lr"] = lr
+
+    def _per_group(self, value, name="value"):
+        """Broadcast a scalar (or check a list) across param groups."""
+        n = len(self.optimizer.param_groups)
+        if isinstance(value, (list, tuple)):
+            if len(value) != n:
+                raise ValueError(f"expected {n} values for {name}, got {len(value)}")
+            return list(value)
+        return [value] * n
 
     def state_dict(self):
         return {"last_batch_iteration": self.last_batch_iteration}
@@ -230,361 +231,199 @@ class _LRScheduler:
         self.last_batch_iteration = sd["last_batch_iteration"]
 
     def as_schedule_fn(self):
-        """Pure ``step -> lr`` function for jitted loops."""
-
-        def fn(step):
-            saved = self.last_batch_iteration
-            self.last_batch_iteration = int(step)
-            lr = self.get_lr()[0]
-            self.last_batch_iteration = saved
-            return lr
-
-        return fn
+        """Pure ``step -> lr`` (first param group) for jitted loops."""
+        return lambda step: self._lr_at(int(step))[0]
 
 
 class LRRangeTest(_LRScheduler):
-    """Linearly (or staircase) increases LR from min over step intervals
-    (Smith's LR range test; reference lr_schedules.py:267)."""
+    """Smith's LR range test: grow LR from the floor by ``step_rate``
+    per interval, continuously or in stairs (reference lr_schedules.py:267)."""
 
-    def __init__(self,
-                 optimizer,
-                 lr_range_test_min_lr: float = 1e-3,
-                 lr_range_test_step_size: int = 2000,
-                 lr_range_test_step_rate: float = 1.0,
-                 lr_range_test_staircase: bool = False,
-                 last_batch_iteration: int = -1):
+    def __init__(self, optimizer, lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                 lr_range_test_step_rate=1.0, lr_range_test_staircase=False,
+                 last_batch_iteration=-1):
         super().__init__(optimizer, last_batch_iteration)
-        if isinstance(lr_range_test_min_lr, (list, tuple)):
-            self.min_lr = list(lr_range_test_min_lr)
-        else:
-            self.min_lr = [lr_range_test_min_lr] * len(optimizer.param_groups)
+        self.min_lr = self._per_group(lr_range_test_min_lr, LR_RANGE_TEST_MIN_LR)
         self.step_size = lr_range_test_step_size
         self.step_rate = lr_range_test_step_rate
         self.staircase = lr_range_test_staircase
-        self.interval_fn = self._staircase_interval if lr_range_test_staircase else self._continuous_interval
         if last_batch_iteration == -1:
-            self._update_optimizer(self.min_lr)
+            self._write_lrs(self.min_lr)
 
-    def _staircase_interval(self):
-        return math.floor(float(self.last_batch_iteration + 1) / self.step_size)
-
-    def _continuous_interval(self):
-        return float(self.last_batch_iteration + 1) / self.step_size
-
-    def _get_increase(self):
-        return 1 + self.step_rate * self.interval_fn()
-
-    def get_lr(self):
-        lr_increase = self._get_increase()
-        return [lr_range_test_min_lr * lr_increase for lr_range_test_min_lr in self.min_lr]
-
-    def _update_optimizer(self, group_lrs):
-        for param_group, lr in zip(self.optimizer.param_groups, group_lrs):
-            param_group["lr"] = lr
+    def _lr_at(self, step):
+        interval = (step + 1) / self.step_size
+        if self.staircase:
+            interval = math.floor(interval)
+        gain = 1 + self.step_rate * interval
+        return [lr * gain for lr in self.min_lr]
 
 
 class OneCycle(_LRScheduler):
-    """1Cycle LR (and optional momentum) schedule
-    (reference lr_schedules.py:370)."""
+    """1Cycle policy: triangular LR (and inverse momentum) cycle, then
+    optional decay (reference lr_schedules.py:370)."""
 
-    def __init__(self,
-                 optimizer,
-                 cycle_min_lr,
-                 cycle_max_lr,
-                 decay_lr_rate=0.0,
-                 cycle_first_step_size=2000,
-                 cycle_second_step_size=None,
-                 cycle_first_stair_count=0,
-                 cycle_second_stair_count=None,
-                 decay_step_size=0,
-                 cycle_momentum=True,
-                 cycle_min_mom=0.8,
-                 cycle_max_mom=0.9,
-                 decay_mom_rate=0.0,
-                 last_batch_iteration=-1):
+    def __init__(self, optimizer, cycle_min_lr, cycle_max_lr, decay_lr_rate=0.0,
+                 cycle_first_step_size=2000, cycle_second_step_size=None,
+                 cycle_first_stair_count=0, cycle_second_stair_count=None,
+                 decay_step_size=0, cycle_momentum=True, cycle_min_mom=0.8,
+                 cycle_max_mom=0.9, decay_mom_rate=0.0, last_batch_iteration=-1):
         super().__init__(optimizer, last_batch_iteration)
-        # Initialize cycle shape
-        self._initialize_cycle(cycle_first_step_size, cycle_second_step_size, cycle_first_stair_count,
-                               cycle_second_stair_count, decay_step_size)
-        # Initialize cycle lr
-        self._initialize_lr(optimizer, cycle_min_lr, cycle_max_lr, decay_lr_rate, last_batch_iteration)
-        # Initialize cyclic momentum
-        self.cycle_momentum = cycle_momentum
-        if cycle_momentum:
-            self._initialize_momentum(optimizer, cycle_min_mom, cycle_max_mom, decay_mom_rate, last_batch_iteration)
-
-    def _initialize_cycle(self, cycle_first_step_size, cycle_second_step_size, cycle_first_stair_count,
-                          cycle_second_stair_count, decay_step_size):
-        cycle_first_step_size = float(cycle_first_step_size)
-        cycle_second_step_size = float(
-            cycle_second_step_size) if cycle_second_step_size is not None else cycle_first_step_size
-
-        self.total_size = cycle_first_step_size + cycle_second_step_size
-        self.step_ratio = cycle_first_step_size / self.total_size
+        self.up_steps = float(cycle_first_step_size)
+        self.down_steps = float(cycle_second_step_size
+                                if cycle_second_step_size is not None else cycle_first_step_size)
+        self.total_size = self.up_steps + self.down_steps
+        self.step_ratio = self.up_steps / self.total_size
         self.first_stair_count = cycle_first_stair_count
-        self.second_stair_count = cycle_first_stair_count if cycle_second_stair_count is None else \
-            cycle_second_stair_count
+        self.second_stair_count = (cycle_first_stair_count if cycle_second_stair_count is None
+                                   else cycle_second_stair_count)
         self.decay_step_size = decay_step_size
 
-        if math.isclose(self.decay_step_size, 0):
-            self.skip_lr_decay = True
-            self.skip_mom_decay = True
-        else:
-            self.skip_lr_decay = False
-            self.skip_mom_decay = False
-
-    def _initialize_lr(self, optimizer, cycle_min_lr, cycle_max_lr, decay_lr_rate, last_batch_iteration):
-        self.min_lrs = [cycle_min_lr] * len(optimizer.param_groups)
-        if last_batch_iteration == -1:
-            for lr, group in zip(self.min_lrs, optimizer.param_groups):
-                group["lr"] = lr
-
-        self.max_lrs = [cycle_max_lr] * len(optimizer.param_groups)
+        self.min_lrs = self._per_group(cycle_min_lr, CYCLE_MIN_LR)
+        self.max_lrs = self._per_group(cycle_max_lr, CYCLE_MAX_LR)
         self.decay_lr_rate = decay_lr_rate
-        if math.isclose(self.decay_lr_rate, 0):
-            self.skip_lr_decay = True
-
-    def _initialize_momentum(self, optimizer, cycle_min_mom, cycle_max_mom, decay_mom_rate, last_batch_iteration):
-        if "betas" not in optimizer.defaults:
-            optimizer_name = type(optimizer).__name__
-            logger.warning(
-                f"cycle_momentum is disabled because optimizer {optimizer_name} does not support momentum, "
-                f"no betas attribute in defaults")
-            self.cycle_momentum = False
-            return
-
-        self.decay_mom_rate = decay_mom_rate
-        self.min_moms = [(cycle_min_mom, 0.99)] * len(optimizer.param_groups)
-        self.max_moms = [(cycle_max_mom, 0.99)] * len(optimizer.param_groups)
-
         if last_batch_iteration == -1:
-            for momentum, group in zip(self.min_moms, optimizer.param_groups):
-                group["betas"] = momentum
+            self._write_lrs(self.min_lrs)
 
-        if math.isclose(self.decay_mom_rate, 0):
-            self.skip_mom_decay = True
+        self.cycle_momentum = cycle_momentum
+        if cycle_momentum:
+            if "betas" not in getattr(optimizer, "defaults", {}):
+                logger.warning(f"cycle_momentum disabled: optimizer {type(optimizer).__name__} "
+                               "has no 'betas' default")
+                self.cycle_momentum = False
+            else:
+                self.min_moms = self._per_group((cycle_min_mom, 0.99), CYCLE_MIN_MOM)
+                self.max_moms = self._per_group((cycle_max_mom, 0.99), CYCLE_MAX_MOM)
+                self.decay_mom_rate = decay_mom_rate
+                if last_batch_iteration == -1:
+                    for group, betas in zip(optimizer.param_groups, self.min_moms):
+                        group["betas"] = betas
 
-    def _get_scale_factor(self):
-        batch_iteration = (self.last_batch_iteration + 1)
-        cycle = math.floor(1 + batch_iteration / self.total_size)
-        x = 1.0 + batch_iteration / self.total_size - cycle
-        if x <= self.step_ratio:
-            scale_factor = x / self.step_ratio
-        else:
-            scale_factor = (x - 1) / (self.step_ratio - 1)
-        return scale_factor
+    def _cycle_fraction(self, step):
+        return _triangle(step + 1, self.up_steps, self.down_steps)
 
-    def _get_cycle_mom(self):
-        scale_factor = self._get_scale_factor()
-        momentums = []
-        for base_betas, max_betas in zip(self.min_moms, self.max_moms):
-            cycle_min_mom = base_betas[0]
-            cycle_max_mom = max_betas[0]
-            base_height = (cycle_max_mom - cycle_min_mom) * scale_factor
-            momentum = cycle_max_mom - base_height
-            momentums.append((momentum, base_betas[1]))
-        return momentums
+    def _decay_gain(self, step, rate):
+        if not rate or not self.decay_step_size:
+            return None
+        past = step - self.total_size + 1
+        return 1 + rate * past / self.decay_step_size
 
-    def _get_cycle_lr(self):
-        scale_factor = self._get_scale_factor()
-        lrs = []
-        for cycle_min_lr, cycle_max_lr in zip(self.min_lrs, self.max_lrs):
-            base_height = (cycle_max_lr - cycle_min_lr) * scale_factor
-            lr = cycle_min_lr + base_height
-            lrs.append(lr)
-        return lrs
-
-    def _get_decay_mom(self, decay_batch_iteration):
-        if self.skip_mom_decay:
-            return self.max_moms
-        decay_interval = decay_batch_iteration / self.decay_step_size
-        mom_decay_factor = (1 + self.decay_mom_rate * decay_interval)
-        return [(beta0 * mom_decay_factor, beta1) for beta0, beta1 in self.max_moms]
-
-    def _get_decay_lr(self, decay_batch_iteration):
-        """Calculates the learning rate at batch index, post cycle."""
-        if self.skip_lr_decay:
-            return self.min_lrs
-        decay_interval = decay_batch_iteration / self.decay_step_size
-        lr_decay_factor = (1 + self.decay_lr_rate * decay_interval)
-        return [cycle_min_lr / lr_decay_factor for cycle_min_lr in self.min_lrs]
-
-    def get_lr(self):
-        if self.last_batch_iteration < self.total_size:
-            return self._get_cycle_lr()
-        return self._get_decay_lr(self.last_batch_iteration - self.total_size + 1)
+    def _lr_at(self, step):
+        if step < self.total_size:
+            frac = self._cycle_fraction(step)
+            return [lo + (hi - lo) * frac for lo, hi in zip(self.min_lrs, self.max_lrs)]
+        gain = self._decay_gain(step, self.decay_lr_rate)
+        if gain is None:
+            return list(self.min_lrs)
+        return [lo / gain for lo in self.min_lrs]
 
     def get_mom(self):
         if not self.cycle_momentum:
             return None
-        if self.last_batch_iteration < self.total_size:
-            return self._get_cycle_mom()
-        return self._get_decay_mom(self.last_batch_iteration - self.total_size + 1)
+        step = self.last_batch_iteration
+        if step < self.total_size:
+            # momentum runs counter to LR: high when LR is low
+            frac = self._cycle_fraction(step)
+            return [(hi[0] - (hi[0] - lo[0]) * frac, lo[1])
+                    for lo, hi in zip(self.min_moms, self.max_moms)]
+        gain = self._decay_gain(step, self.decay_mom_rate)
+        if gain is None:
+            return list(self.max_moms)
+        return [(hi[0] * gain, hi[1]) for hi in self.max_moms]
 
     def step(self, batch_iteration=None):
-        if batch_iteration is None:
-            batch_iteration = self.last_batch_iteration + 1
-        self.last_batch_iteration = batch_iteration
-
-        lrs = self.get_lr()
-        for param_group, lr in zip(self.optimizer.param_groups, lrs):
-            param_group["lr"] = lr
-        self._last_lr = lrs
-
+        super().step(batch_iteration)
         if self.cycle_momentum:
-            momentums = self.get_mom()
-            for param_group, momentum in zip(self.optimizer.param_groups, momentums):
-                param_group["betas"] = momentum
+            for group, betas in zip(self.optimizer.param_groups, self.get_mom()):
+                group["betas"] = betas
 
 
 class WarmupLR(_LRScheduler):
-    """Warmup from min to max LR, then hold (reference lr_schedules.py:634)."""
+    """Ramp from min to max LR over warmup, then hold
+    (reference lr_schedules.py:634)."""
 
-    def __init__(self,
-                 optimizer,
-                 warmup_min_lr: float = 0.0,
-                 warmup_max_lr: float = 0.001,
-                 warmup_num_steps: int = 1000,
-                 warmup_type: str = WARMUP_LOG_RATE,
-                 last_batch_iteration: int = -1):
-        self.optimizer = optimizer
-
-        self.min_lrs = self._format_param(optimizer, warmup_min_lr, "min_lr")
-        self.max_lrs = self._format_param(optimizer, warmup_max_lr, "max_lr")
-        self.delta_lrs = [big - small for big, small in zip(self.max_lrs, self.min_lrs)]
+    def __init__(self, optimizer, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, warmup_type=WARMUP_LOG_RATE, last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.min_lrs = self._per_group(warmup_min_lr, WARMUP_MIN_LR)
+        self.max_lrs = self._per_group(warmup_max_lr, WARMUP_MAX_LR)
+        self.delta_lrs = [hi - lo for lo, hi in zip(self.min_lrs, self.max_lrs)]
         self.warmup_num_steps = max(2, warmup_num_steps)
-        # Currently only support linear and log function
-        if warmup_type not in {WARMUP_LOG_RATE, WARMUP_LINEAR_RATE}:
-            logger.warning(f"Using unknown warmup_type: {warmup_type}. The increasing function "
-                           f"is set to default (log)")
+        if warmup_type not in (WARMUP_LOG_RATE, WARMUP_LINEAR_RATE):
+            logger.warning(f"unknown warmup_type {warmup_type!r}; using '{WARMUP_LOG_RATE}'")
             warmup_type = WARMUP_LOG_RATE
         self.warmup_type = warmup_type
         self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
-        self.last_batch_iteration = last_batch_iteration
-        # Initialize lr in optimizer
         if last_batch_iteration == -1:
-            self._last_lr = [group["lr"] for group in self.optimizer.param_groups]
+            self._last_lr = [g["lr"] for g in self.optimizer.param_groups]
             self.step()
 
-    def get_lr(self):
-        if self.last_batch_iteration < 0:
-            logger.warning("Attempting to get learning rate from scheduler before it has started")
-            return [0.0]
-        gamma = self._get_gamma()
-        return [min_lr + (delta_lr * gamma) for min_lr, delta_lr in zip(self.min_lrs, self.delta_lrs)]
-
-    def _get_gamma(self):
-        if self.last_batch_iteration < self.warmup_num_steps:
-            if self.warmup_type == WARMUP_LOG_RATE:
-                return self.inverse_log_warm_up * math.log(self.last_batch_iteration + 1)
-            elif self.warmup_type == WARMUP_LINEAR_RATE:
-                return self.last_batch_iteration / self.warmup_num_steps
+    def _post_warmup(self, step):
         return 1.0
 
-    def _format_param(self, optimizer, param_value, param_name):
-        if isinstance(param_value, list) or isinstance(param_value, tuple):
-            if len(param_value) != len(optimizer.param_groups):
-                raise ValueError(f"expected {len(optimizer.param_groups)} value for {param_name}, "
-                                 f"got {len(param_value)}")
-            return list(param_value)
-        return [param_value] * len(optimizer.param_groups)
+    def _lr_at(self, step):
+        if step < 0:
+            logger.warning("LR requested before the scheduler's first step()")
+            return [0.0]
+        if step < self.warmup_num_steps:
+            gamma = _warmup_fraction(step, self.warmup_num_steps, self.warmup_type)
+        else:
+            gamma = self._post_warmup(step)
+        return [lo + d * gamma for lo, d in zip(self.min_lrs, self.delta_lrs)]
 
 
 class WarmupDecayLR(WarmupLR):
-    """Warmup then linear decay to zero over total steps
+    """Warmup then linear decay to zero by ``total_num_steps``
     (reference lr_schedules.py:723)."""
 
-    def __init__(self,
-                 optimizer,
-                 total_num_steps: int,
-                 warmup_min_lr: float = 0.0,
-                 warmup_max_lr: float = 0.001,
-                 warmup_num_steps: int = 1000,
-                 warmup_type: str = WARMUP_LOG_RATE,
-                 last_batch_iteration: int = -1):
+    def __init__(self, optimizer, total_num_steps, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, warmup_type=WARMUP_LOG_RATE, last_batch_iteration=-1):
         self.total_num_steps = total_num_steps
-        super(WarmupDecayLR, self).__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type,
-                                            last_batch_iteration)
-        if self.total_num_steps < self.warmup_num_steps:
-            logger.warning("total_num_step {} is less than warmup_num_steps {}".format(
-                total_num_steps, warmup_num_steps))
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         warmup_type, last_batch_iteration)
+        if total_num_steps < self.warmup_num_steps:
+            logger.warning(f"total_num_steps {total_num_steps} < warmup_num_steps "
+                           f"{self.warmup_num_steps}")
 
-    def _get_gamma(self):
-        if self.last_batch_iteration < self.warmup_num_steps:
-            if self.warmup_type == WARMUP_LOG_RATE:
-                return self.inverse_log_warm_up * math.log(self.last_batch_iteration + 1)
-            elif self.warmup_type == WARMUP_LINEAR_RATE:
-                return self.last_batch_iteration / self.warmup_num_steps
-        return max(
-            0.0,
-            float(self.total_num_steps - self.last_batch_iteration) /
-            float(max(1.0, self.total_num_steps - self.warmup_num_steps)))
+    def _post_warmup(self, step):
+        decay_span = max(1.0, self.total_num_steps - self.warmup_num_steps)
+        return max(0.0, (self.total_num_steps - step) / decay_span)
 
 
 class WarmupCosineLR(_LRScheduler):
-    """Warmup then cosine decay (reference lr_schedules.py:774)."""
+    """Warmup then cosine decay toward ``cos_min_ratio`` of the base LR
+    (reference lr_schedules.py:774)."""
 
-    def __init__(self,
-                 optimizer,
-                 total_num_steps: int,
-                 warmup_min_ratio: float = 0.0,
-                 warmup_num_steps: int = 1000,
-                 cos_min_ratio: float = 0.0001,
-                 warmup_type: str = WARMUP_LOG_RATE,
-                 last_batch_iteration: int = -1):
-        self.optimizer = optimizer
-
+    def __init__(self, optimizer, total_num_steps, warmup_min_ratio=0.0,
+                 warmup_num_steps=1000, cos_min_ratio=0.0001, warmup_type=WARMUP_LOG_RATE,
+                 last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
         self.total_num_steps = total_num_steps
-        self.last_batch_iteration = last_batch_iteration
-        self.cos_min_ratio = cos_min_ratio
-
-        self.warmup_type = warmup_type
         self.warmup_min_ratio = warmup_min_ratio
         self.warmup_num_steps = max(2, warmup_num_steps)
-        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
-
-        if self.total_num_steps < self.warmup_num_steps:
-            logger.warning("total_num_step {} is less than warmup_num_steps {}".format(
-                total_num_steps, warmup_num_steps))
-        self.org_lrs = [group["lr"] for group in self.optimizer.param_groups]
+        self.cos_min_ratio = cos_min_ratio
+        self.warmup_type = warmup_type
+        if total_num_steps < self.warmup_num_steps:
+            logger.warning(f"total_num_steps {total_num_steps} < warmup_num_steps "
+                           f"{self.warmup_num_steps}")
+        self.org_lrs = [g["lr"] for g in self.optimizer.param_groups]
         if last_batch_iteration == -1:
-            self._last_lr = [group["lr"] for group in self.optimizer.param_groups]
+            self._last_lr = list(self.org_lrs)
             self.step()
 
     def get_lr_ratio(self):
-        if self.last_batch_iteration < 0:
-            logger.warning("Attempting to get learning rate from scheduler before it has started")
+        return self._ratio_at(self.last_batch_iteration)
+
+    def _ratio_at(self, step):
+        if step < self.warmup_num_steps:
+            ramp = _warmup_fraction(step, self.warmup_num_steps, self.warmup_type)
+            return self.warmup_min_ratio + (1.0 - self.warmup_min_ratio) * ramp
+        progress = (step - self.warmup_num_steps + 1) / (self.total_num_steps - self.warmup_num_steps)
+        cos = (1 + math.cos(math.pi * progress)) / 2
+        return max(0.0, self.cos_min_ratio + (1.0 - self.cos_min_ratio) * cos)
+
+    def _lr_at(self, step):
+        if step < 0:
+            logger.warning("LR requested before the scheduler's first step()")
             return [0.0]
-
-        if self.last_batch_iteration < self.warmup_num_steps:
-            if self.warmup_type == WARMUP_LOG_RATE:
-                ratio = self.inverse_log_warm_up * math.log(self.last_batch_iteration + 1)
-            elif self.warmup_type == WARMUP_LINEAR_RATE:
-                ratio = self.last_batch_iteration / self.warmup_num_steps
-            ratio_delta = 1.0 - self.warmup_min_ratio
-            ratio = self.warmup_min_ratio + ratio * ratio_delta
-            return ratio
-
-        real_last_step = self.last_batch_iteration - self.warmup_num_steps + 1
-        real_total_steps = self.total_num_steps - self.warmup_num_steps
-        ratio_delta = 1.0 - self.cos_min_ratio
-        ratio = (1 + math.cos(math.pi * real_last_step / real_total_steps)) / 2
-        ratio = max(0.0, self.cos_min_ratio + ratio_delta * ratio)
-        return ratio
-
-    def step(self, last_batch_iteration=None):
-        if last_batch_iteration is None:
-            last_batch_iteration = self.last_batch_iteration + 1
-        self.last_batch_iteration = last_batch_iteration
-
-        lrs = self.get_lr()
-        for param_group, lr in zip(self.optimizer.param_groups, lrs):
-            param_group["lr"] = lr
-        self._last_lr = lrs
-
-    def get_lr(self):
-        if self.last_batch_iteration < 0:
-            logger.warning("Attempting to get learning rate from scheduler before it has started")
-            return [0.0]
-        lr_ratio = self.get_lr_ratio()
-        return [org_lr * lr_ratio for org_lr in self.org_lrs]
+        ratio = self._ratio_at(step)
+        return [lr * ratio for lr in self.org_lrs]

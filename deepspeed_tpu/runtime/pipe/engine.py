@@ -138,6 +138,14 @@ class PipelineEngine(DeepSpeedEngine):
         compute_dtype = self.compute_dtype
 
         def inner(params, inputs, labels, scale):
+            # Declare the manual 'pipe' axis while tracing so Pallas
+            # call sites inside the stages fall back to XLA instead of
+            # opening a nested full-mesh shard_map.
+            from deepspeed_tpu.ops.pallas import manual_axes
+            with manual_axes({"pipe"}):
+                return _inner_body(params, inputs, labels, scale)
+
+        def _inner_body(params, inputs, labels, scale):
             params = jax.tree.map(
                 lambda x: x.astype(compute_dtype) if _is_float(x) else x, params)
             p = jax.lax.axis_index("pipe") if n_stages > 1 else jnp.zeros((), jnp.int32)
@@ -214,12 +222,20 @@ class PipelineEngine(DeepSpeedEngine):
         else:
             inputs, labels = batch
             lead = jax.tree.leaves(inputs)[0].shape[0]
-            if lead != M:
-                assert lead == M * self.micro_batch_size, \
-                    f"batch leading dim {lead} != micro_batches*micro_batch_size"
+            flat = M * self.micro_batch_size
+            if lead == flat:
+                # Flat [M*mb, ...] batch (the dataloader layout). When
+                # mb == 1 this is indistinguishable from an already
+                # stacked [M, ...] batch; flat wins — callers with
+                # pre-stacked micro-batches at mb == 1 must add the
+                # explicit batch dim themselves.
                 reshape = lambda x: x.reshape((M, self.micro_batch_size) + x.shape[1:])
                 inputs = jax.tree.map(reshape, inputs)
                 labels = jax.tree.map(reshape, labels)
+            elif lead != M:
+                raise ValueError(
+                    f"batch leading dim {lead} is neither micro_batches*micro_batch_size"
+                    f"={flat} (flat) nor micro_batches={M} (stacked)")
         return inputs, labels
 
     def _place_batch(self, tree):

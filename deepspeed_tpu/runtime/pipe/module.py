@@ -144,11 +144,16 @@ class PipelineModule:
             name = self._param_name(idx)
             rng, sub = jax.random.split(rng)
             if _is_flax_module(layer):
-                if name not in params:
+                first_use = name not in params
+                if first_use:
                     variables = layer.init(sub, x)
                     params[name] = variables.get("params", {})
                 x = self._apply_one(idx, params[name], x)
-                counts.append(sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params[name])))
+                # Tied params are attributed to their first (owning)
+                # occurrence only, so stage balancing doesn't double
+                # count the shared subtree.
+                counts.append(sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params[name]))
+                              if first_use else 0)
             else:
                 x = layer(x)
                 counts.append(0)

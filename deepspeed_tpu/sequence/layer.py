@@ -38,16 +38,12 @@ def _batch_entry(mesh):
     return axes if len(axes) > 1 else axes[0]
 
 
-def constrain(x, spec_entries, mesh=None):
-    """with_sharding_constraint with graceful no-mesh fallback.
+def live_spec(mesh, spec_entries) -> P:
+    """PartitionSpec from ``spec_entries`` with dead axes dropped.
 
-    ``spec_entries`` is a tuple of PartitionSpec entries (axis name,
-    tuple of names, or None) — entries naming axes of size 1 (or absent
-    from the mesh) are dropped so the same model code runs on any mesh.
+    Entries naming axes of size 1 (or absent from the mesh) are dropped
+    so the same model code runs on any mesh.
     """
-    mesh = mesh if mesh is not None else groups.get_mesh(required=False)
-    if mesh is None:
-        return x
     sizes = _mesh_axis_sizes(mesh)
 
     def live(entry):
@@ -60,8 +56,16 @@ def constrain(x, spec_entries, mesh=None):
             return kept if len(kept) > 1 else kept[0]
         return entry if sizes.get(entry, 1) > 1 else None
 
-    spec = P(*[live(e) for e in spec_entries])
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return P(*[live(e) for e in spec_entries])
+
+
+def constrain(x, spec_entries, mesh=None):
+    """with_sharding_constraint with graceful no-mesh fallback."""
+    mesh = mesh if mesh is not None else groups.get_mesh(required=False)
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, live_spec(mesh, spec_entries)))
 
 
 def constrain_hidden(x, mesh=None):
@@ -70,6 +74,17 @@ def constrain_hidden(x, mesh=None):
     if mesh is None:
         return x
     return constrain(x, (_batch_entry(mesh), "sequence", None), mesh)
+
+
+def hidden_spec(mesh) -> P:
+    """Canonical [B, S, D] layout: batch over data axes, seq over 'sequence'."""
+    return live_spec(mesh, (_batch_entry(mesh), "sequence", None))
+
+
+def heads_spec(mesh) -> P:
+    """Canonical post-Ulysses [B, S, H, D] layout: full sequence, heads
+    over ('tensor', 'sequence')."""
+    return live_spec(mesh, (_batch_entry(mesh), None, ("tensor", "sequence"), None))
 
 
 def seq_to_head_shard(x, mesh=None):

@@ -1,18 +1,26 @@
-"""Per-op communication logging (reference ``deepspeed/utils/comms_logging.py``).
+"""Per-collective communication statistics.
 
-Records per-collective message sizes/latency and prints a size-binned
-summary. On TPU, in-jit collectives can't be timed individually from the
-host; logged latency for those is dispatch-side wall time and the busbw
-model uses the standard algorithmic factors.
+Capability match for the reference's comms logger
+(``deepspeed/utils/comms_logging.py`` + ``comm/comm.py:422
+log_summary``): every profiled collective records message size and
+latency, and ``log_all`` prints a per-op, per-size table with
+algorithmic and bus bandwidth estimates.
+
+TPU caveat: in-jit collectives are fused into the XLA program, so the
+host-side latency recorded here is dispatch+sync wall time, not the
+isolated collective — treat busbw numbers as lower bounds. (The
+reference has the same blind spot inside CUDA graphs.)
 """
 
 import math
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List
 
 from deepspeed_tpu.utils.logging import log_dist
 
 
 def get_caller_func(frame=3):
-    import sys
     return sys._getframe(frame).f_code.co_name
 
 
@@ -22,73 +30,93 @@ def print_rank_0(message):
         print(message)
 
 
-# Helper function to pretty-print message sizes
 def convert_size(size_bytes):
-    if size_bytes == 0:
+    """Human-readable byte count ('1.5 MB')."""
+    if size_bytes <= 0:
         return "0B"
-    size_name = ("B", "KB", "MB", "GB", "TB", "PB", "EB", "ZB", "YB")
-    i = int(math.floor(math.log(size_bytes, 1024)))
-    p = math.pow(1024, i)
-    s = round(size_bytes / p, 2)
-    return "%s %s" % (s, size_name[i])
+    units = ("B", "KB", "MB", "GB", "TB", "PB", "EB", "ZB", "YB")
+    exp = min(int(math.log(size_bytes, 1024)), len(units) - 1)
+    return f"{round(size_bytes / 1024 ** exp, 2)} {units[exp]}"
 
 
-# Helper function to calculate algbw and busbw.
-# See https://gist.github.com/jeffra/b5e80466b4c86be00ea3b6f130fb7a36
+# Bandwidth model per collective: (wire_mult, bus_frac) where
+#   algbw = wire_mult * size / t
+#   busbw = algbw * bus_frac(n)
+# Standard ring-algorithm accounting: an all-reduce moves 2(n-1)/n of
+# the buffer per link; gather/scatter ops move (n-1)/n of the *global*
+# buffer (size is the local shard, so wire volume is size*n).
+_RING_FRAC = lambda n: (n - 1) / n if n > 0 else 1.0
+_UNIT_FRAC = lambda n: 1.0
+_BW_MODEL = {
+    "all_reduce": (2.0, _RING_FRAC),
+    "all_gather": ("global", _RING_FRAC),
+    "all_gather_into_tensor": ("global", _RING_FRAC),
+    "reduce_scatter": ("global", _RING_FRAC),
+    "reduce_scatter_tensor": ("global", _RING_FRAC),
+    "all_to_all": (1.0, _RING_FRAC),
+    "all_to_all_single": (1.0, _RING_FRAC),
+}
+# Point-to-point-ish ops: volume = size, bus = alg.
+_P2P_OPS = ("send", "recv", "isend", "irecv", "broadcast", "reduce", "gather",
+            "scatter", "barrier", "ppermute")
+
+
 def calc_bw_log(comm_op, size, duration, n):
-    tput = 0
-    busbw = 0
-    if comm_op == "all_to_all_single" or comm_op == "all_to_all":
-        tput = (size / duration)
-        busbw = (size / duration) * ((n - 1) / n)
-    elif comm_op == "all_gather" or comm_op == "all_gather_into_tensor" or comm_op == "reduce_scatter" or \
-            comm_op == "reduce_scatter_tensor":
-        size *= n
-        tput = (size / duration)
-        busbw = (size / duration) * ((n - 1) / n)
-    elif comm_op == "all_reduce":
-        tput = (size * 2 / duration)
-        busbw = (size / duration) * (2 * (n - 1) / n)
-    elif comm_op == "send" or comm_op == "recv" or comm_op == "isend" or comm_op == "irecv" or \
-            comm_op == "broadcast" or comm_op == "reduce" or comm_op == "gather" or comm_op == "scatter" or \
-            comm_op == "barrier" or comm_op == "ppermute":
-        tput = (size / duration)
-        busbw = tput
+    """(algbw, busbw) in Gbps for one op instance."""
+    if duration <= 0:
+        return 0.0, 0.0
+    if comm_op in _BW_MODEL:
+        mult, frac = _BW_MODEL[comm_op]
+        volume = size * n if mult == "global" else size * mult
+        alg = volume / duration
+        bus = alg * frac(n)
+    elif comm_op in _P2P_OPS:
+        alg = bus = size / duration
     else:
-        print_rank_0("wrong comm_op specified")  # noqa: F821
-        return 0, 0
+        print_rank_0(f"comms logger: unknown op '{comm_op}'")
+        return 0.0, 0.0
+    to_gbps = 8 / 1e9
+    return alg * to_gbps, bus * to_gbps
 
-    # convert to Gbps
-    tput *= 8
-    busbw *= 8
 
-    tput /= 1e6
-    busbw /= 1e6
+@dataclass
+class _SizeRecord:
+    count: int = 0
+    latencies: List[float] = field(default_factory=list)
+    algbws: List[float] = field(default_factory=list)
+    busbws: List[float] = field(default_factory=list)
 
-    return tput, busbw
+    def add(self, latency, algbw, busbw):
+        self.count += 1
+        self.latencies.append(latency)
+        self.algbws.append(algbw)
+        self.busbws.append(busbw)
 
 
 class CommsLogger:
-    """Records/prints per-collective stats (reference comms_logging.py)."""
+    """Accumulates per-op/per-size records; see module docstring."""
 
     def __init__(self):
         from deepspeed_tpu.comm.config import CommsLoggerConfig
-        default = CommsLoggerConfig()
-        self.comms_dict = {}
-        self.verbose = default.verbose
-        self.debug = default.debug
-        self.prof_ops = default.prof_ops
-        self.prof_all = default.prof_all
-        self.enabled = default.enabled
+        defaults = CommsLoggerConfig()
+        self.comms_dict: Dict[str, Dict[int, list]] = {}
+        self._records: Dict[str, Dict[int, _SizeRecord]] = {}
+        self.enabled = defaults.enabled
+        self.prof_all = defaults.prof_all
+        self.prof_ops = defaults.prof_ops
+        self.verbose = defaults.verbose
+        self.debug = defaults.debug
 
     def configure(self, comms_config):
         self.enabled = comms_config.comms_logger_enabled
         if self.enabled:
-            self.verbose = comms_config.comms_logger.verbose
-            self.debug = comms_config.comms_logger.debug
-            self.prof_ops = comms_config.comms_logger.prof_ops
-            self.prof_all = comms_config.comms_logger.prof_all
+            section = comms_config.comms_logger
+            self.prof_all = section.prof_all
+            self.prof_ops = section.prof_ops
+            self.verbose = section.verbose
+            self.debug = section.debug
 
+    # -- runtime toggles (reference API surface) --
     def start_profiling_comms(self):
         self.prof_all = True
 
@@ -96,49 +124,41 @@ class CommsLogger:
         self.prof_all = False
 
     def start_profiling_op(self, op_name_list):
-        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+        self.prof_ops = sorted(set(self.prof_ops) | set(op_name_list))
 
     def stop_profiling_op(self, op_name_list):
-        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+        self.prof_ops = [op for op in self.prof_ops if op not in set(op_name_list)]
 
+    # -- recording --
     def append(self, raw_name, record_name, latency, msg_size, world_size):
-        import numpy as np
         algbw, busbw = calc_bw_log(raw_name, msg_size, latency, world_size)
-        if record_name in self.comms_dict.keys():
-            # If this comm_op has already been logged with this message size, just add to existing record
-            if msg_size in self.comms_dict[record_name].keys():
-                self.comms_dict[record_name][msg_size][0] += 1
-                self.comms_dict[record_name][msg_size][1].append(latency)
-                self.comms_dict[record_name][msg_size][2].append(algbw)
-                self.comms_dict[record_name][msg_size][3].append(busbw)
-            # If this is a new message size for this comm_op, add new record under existing comm_op
-            else:
-                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
-        else:
-            # Create entirely new record
-            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
-        # If verbose, print every comm op
+        rec = self._records.setdefault(record_name, {}).setdefault(msg_size, _SizeRecord())
+        rec.add(latency, algbw, busbw)
+        # legacy dict view kept in sync (the reference returns this shape
+        # from log_all and tools consume it)
+        self.comms_dict.setdefault(record_name, {})[msg_size] = [
+            rec.count, rec.latencies, rec.algbws, rec.busbws]
         if self.verbose:
-            log_str = f"comm op: {record_name} | time (ms): {latency:.2f} | msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw:.2f} | busbw (Gbps): {busbw:.2f}"
-            log_dist(log_str, [0])
+            log_dist(f"comm op: {record_name} | time (ms): {latency * 1e3:.2f} | "
+                     f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw:.2f} | "
+                     f"busbw (Gbps): {busbw:.2f}", [0])
 
+    # -- reporting --
     def log_all(self, print_log=True, show_straggler=False):
         from deepspeed_tpu.utils.timer import trim_mean
-        msg = "\n\nComm. Op            Message Size        Count       Total Latency(ms)   Avg Latency(ms)     tput_avg (Gbps)     busbw_avg (Gbps)\n"
-        for record_name in self.comms_dict.keys():
-            msg += record_name + "\n"
-            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
-                # vals[0] is the count for each msg size
-                count = vals[0]
-                # vals[1] is a list of latency records for each msg size
-                total_lat = sum(vals[1])
-                # vals[2] and vals[3] are the lists of algbw and busbw, respectively
-                # Get rid of outliers when we print
-                avg_lat = trim_mean(vals[1], 0.1)
-                avg_algbw = trim_mean(vals[2], 0.1)
-                avg_busbw = trim_mean(vals[3], 0.1)
-                msg += "{:<20} {:<20} {:<11} {:<19.2f} {:<19.2f} {:<19.2f} {:<19.2f}\n".format(
-                    record_name, convert_size(msg_size), count, total_lat * 1000, avg_lat * 1000, avg_algbw, avg_busbw)
+        cols = ("Comm. Op", "Message Size", "Count", "Total Latency(ms)",
+                "Avg Latency(ms)", "tput_avg (Gbps)", "busbw_avg (Gbps)")
+        lines = ["", "", "".join(f"{c:<20}" for c in cols)]
+        for op_name, by_size in self._records.items():
+            lines.append(op_name)
+            for size in sorted(by_size):
+                rec = by_size[size]
+                row = (op_name, convert_size(size), str(rec.count),
+                       f"{sum(rec.latencies) * 1e3:.2f}",
+                       f"{trim_mean(rec.latencies, 0.1) * 1e3:.2f}",
+                       f"{trim_mean(rec.algbws, 0.1):.2f}",
+                       f"{trim_mean(rec.busbws, 0.1):.2f}")
+                lines.append("".join(f"{c:<20}" for c in row))
         if print_log:
-            print_rank_0(msg)
+            print_rank_0("\n".join(lines) + "\n")
         return self.comms_dict

@@ -81,3 +81,28 @@ def test_v2_with_mp():
     final, valid, micro = compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.1.0",
                                                  world_size=16)
     assert micro in [2, 4]
+
+
+def test_v2_below_one_node_no_crash():
+    # world smaller than one node: must raise incompatible (not ZeroDivisionError)
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "version": 0.2,
+            "model_parallel_size": 2,
+            "num_gpus_per_node": 8,
+        }
+    }
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.1.0", world_size=4)
+
+
+def test_unknown_version_raises():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                                "micro_batch_sizes": [2], "version": 0.15}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.1.0")
