@@ -1,0 +1,65 @@
+// TPU-host SIMD Adagrad for ZeRO-Offload.
+// Capability match for the reference's csrc/adagrad/cpu_adagrad.cpp; same
+// vector-tile + OpenMP structure as csrc/adam/cpu_adam.cpp.
+
+#include "../includes/ds_simd.h"
+
+#include <cmath>
+#include <cstdint>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+void adagrad_tile(float* p, const float* g, float* sq, int64_t begin, int64_t end,
+                  float lr, float eps, float wd) {
+    const ds::vec veps = ds::vec::bcast(eps);
+    const ds::vec vwd = ds::vec::bcast(wd);
+    const ds::vec vnlr = ds::vec::bcast(-lr);
+    int64_t i = begin;
+    for (; i + DS_SIMD_WIDTH <= end; i += DS_SIMD_WIDTH) {
+        ds::vec gv = ds::vec::load(g + i);
+        ds::vec pv = ds::vec::load(p + i);
+        if (wd != 0.0f) gv = ds::vec::fma(vwd, pv, gv);
+        ds::vec sv = ds::vec::fma(gv, gv, ds::vec::load(sq + i));
+        sv.store(sq + i);
+        ds::vec upd = gv / (ds::vec::sqrt(sv) + veps);
+        pv = ds::vec::fma(vnlr, upd, pv);
+        pv.store(p + i);
+    }
+    for (; i < end; ++i) {
+        float gv = g[i];
+        if (wd != 0.0f) gv += wd * p[i];
+        sq[i] += gv * gv;
+        p[i] -= lr * gv / (std::sqrt(sq[i]) + eps);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int ds_adagrad_update(int opt_id, int64_t step, float lr, float eps, float weight_decay,
+                      float* params, const float* grads, float* exp_avg_sq, int64_t n) {
+    (void)opt_id;
+    (void)step;
+#if defined(_OPENMP)
+#pragma omp parallel
+    {
+        const int nt = omp_get_num_threads();
+        const int tid = omp_get_thread_num();
+        int64_t chunk = (n + nt - 1) / nt;
+        chunk = ((chunk + DS_SIMD_WIDTH - 1) / DS_SIMD_WIDTH) * DS_SIMD_WIDTH;
+        const int64_t begin = static_cast<int64_t>(tid) * chunk;
+        const int64_t end = begin + chunk < n ? begin + chunk : n;
+        if (begin < end) adagrad_tile(params, grads, exp_avg_sq, begin, end, lr, eps, weight_decay);
+    }
+#else
+    adagrad_tile(params, grads, exp_avg_sq, 0, n, lr, eps, weight_decay);
+#endif
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,105 @@
+// SIMD abstraction for TPU-host optimizer kernels (ZeRO-Offload hot path).
+//
+// Capability match for the reference's csrc/includes/simd.h (AVX256/AVX512
+// macros); re-designed as a minimal vector wrapper with AVX512, AVX2, NEON
+// and scalar backends so the same kernel body compiles on x86 TPU-VMs and
+// ARM hosts. All kernels operate on fp32 host buffers; bf16 conversion for
+// the device-bound copy is done with round-to-nearest-even bit arithmetic.
+
+#pragma once
+
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX512F__)
+#include <immintrin.h>
+#define DS_SIMD_WIDTH 16
+namespace ds {
+struct vec {
+    __m512 v;
+    static vec load(const float* p) { return {_mm512_loadu_ps(p)}; }
+    void store(float* p) const { _mm512_storeu_ps(p, v); }
+    static vec bcast(float x) { return {_mm512_set1_ps(x)}; }
+    vec operator+(vec o) const { return {_mm512_add_ps(v, o.v)}; }
+    vec operator-(vec o) const { return {_mm512_sub_ps(v, o.v)}; }
+    vec operator*(vec o) const { return {_mm512_mul_ps(v, o.v)}; }
+    vec operator/(vec o) const { return {_mm512_div_ps(v, o.v)}; }
+    static vec fma(vec a, vec b, vec c) { return {_mm512_fmadd_ps(a.v, b.v, c.v)}; }
+    static vec sqrt(vec a) { return {_mm512_sqrt_ps(a.v)}; }
+};
+}  // namespace ds
+#elif defined(__AVX2__)
+#include <immintrin.h>
+#define DS_SIMD_WIDTH 8
+namespace ds {
+struct vec {
+    __m256 v;
+    static vec load(const float* p) { return {_mm256_loadu_ps(p)}; }
+    void store(float* p) const { _mm256_storeu_ps(p, v); }
+    static vec bcast(float x) { return {_mm256_set1_ps(x)}; }
+    vec operator+(vec o) const { return {_mm256_add_ps(v, o.v)}; }
+    vec operator-(vec o) const { return {_mm256_sub_ps(v, o.v)}; }
+    vec operator*(vec o) const { return {_mm256_mul_ps(v, o.v)}; }
+    vec operator/(vec o) const { return {_mm256_div_ps(v, o.v)}; }
+    static vec fma(vec a, vec b, vec c) { return {_mm256_fmadd_ps(a.v, b.v, c.v)}; }
+    static vec sqrt(vec a) { return {_mm256_sqrt_ps(a.v)}; }
+};
+}  // namespace ds
+#elif defined(__ARM_NEON)
+#include <arm_neon.h>
+#define DS_SIMD_WIDTH 4
+namespace ds {
+struct vec {
+    float32x4_t v;
+    static vec load(const float* p) { return {vld1q_f32(p)}; }
+    void store(float* p) const { vst1q_f32(p, v); }
+    static vec bcast(float x) { return {vdupq_n_f32(x)}; }
+    vec operator+(vec o) const { return {vaddq_f32(v, o.v)}; }
+    vec operator-(vec o) const { return {vsubq_f32(v, o.v)}; }
+    vec operator*(vec o) const { return {vmulq_f32(v, o.v)}; }
+    vec operator/(vec o) const { return {vdivq_f32(v, o.v)}; }
+    static vec fma(vec a, vec b, vec c) { return {vfmaq_f32(c.v, a.v, b.v)}; }
+    static vec sqrt(vec a) { return {vsqrtq_f32(a.v)}; }
+};
+}  // namespace ds
+#else
+#define DS_SIMD_WIDTH 1
+namespace ds {
+struct vec {
+    float v;
+    static vec load(const float* p) { return {*p}; }
+    void store(float* p) const { *p = v; }
+    static vec bcast(float x) { return {x}; }
+    vec operator+(vec o) const { return {v + o.v}; }
+    vec operator-(vec o) const { return {v - o.v}; }
+    vec operator*(vec o) const { return {v * o.v}; }
+    vec operator/(vec o) const { return {v / o.v}; }
+    static vec fma(vec a, vec b, vec c) { return {a.v * b.v + c.v}; }
+    static vec sqrt(vec a) { return {std::sqrt(a.v)}; }
+};
+}  // namespace ds
+#endif
+
+namespace ds {
+
+// fp32 -> bf16 with round-to-nearest-even (matches jnp.astype(bfloat16)).
+inline uint16_t to_bf16(float x) {
+    uint32_t bits;
+    std::memcpy(&bits, &x, sizeof(bits));
+    if ((bits & 0x7FFFFFFFu) > 0x7F800000u) {  // NaN: quiet, truncate
+        return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+    }
+    const uint32_t rounding_bias = 0x7FFFu + ((bits >> 16) & 1u);
+    return static_cast<uint16_t>((bits + rounding_bias) >> 16);
+}
+
+inline float from_bf16(uint16_t x) {
+    uint32_t bits = static_cast<uint32_t>(x) << 16;
+    float out;
+    std::memcpy(&out, &bits, sizeof(out));
+    return out;
+}
+
+}  // namespace ds

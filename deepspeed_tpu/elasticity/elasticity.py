@@ -163,7 +163,13 @@ def _solve_v02(micro_batches, batch_cap, current_chips, min_chips=None, max_chip
     dp_now = ((current_chips // chips_per_node) * dp_per_node
               or max(1, current_chips // model_parallel_size))
     fallbacks = [m * dp_now * (batch_cap // (m * dp_now)) for m in micro_batches]
-    batch = max(fallbacks) if prefer_larger else min(b for b in fallbacks if b > 0)
+    positive = [b for b in fallbacks if b > 0]
+    if not positive:
+        from deepspeed_tpu.elasticity.config import ElasticityIncompatibleWorldSize
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro-batch from {list(micro_batches)} fits under max batch {batch_cap} "
+            f"at data-parallel size {dp_now}")
+    batch = max(positive) if prefer_larger else min(positive)
     return batch, [dp_now], pick_micro(batch)
 
 
@@ -234,7 +240,8 @@ def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world
 
     micro_choice = None
     if version == 0.2:
-        chips = world_size or int(os.environ.get("WORLD_SIZE") or 0)
+        env_ws = os.environ.get("WORLD_SIZE", "")
+        chips = world_size or (int(env_ws) if env_ws.isdigit() else 0)
         if not chips:
             raise ElasticityConfigError(
                 "elasticity v0.2 needs the world size: pass world_size= or set WORLD_SIZE")

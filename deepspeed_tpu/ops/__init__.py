@@ -1,2 +1,12 @@
+import os
+import sys
+
+# The op build system lives as a top-level package next to deepspeed_tpu
+# (reference layout: op_builder/ beside deepspeed/). Make it importable when
+# the framework was imported from a checkout without installation.
+_repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if os.path.isdir(os.path.join(_repo_root, "op_builder")) and _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
 from deepspeed_tpu.ops import adagrad, adam, lamb, lion
 from deepspeed_tpu.ops.sgd import SGD

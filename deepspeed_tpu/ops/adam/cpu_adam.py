@@ -34,9 +34,11 @@ class DeepSpeedCPUAdam(DeepSpeedOptimizer):
         self.fp32_optimizer_states = fp32_optimizer_states
         self._native = None
         try:
+            import deepspeed_tpu.ops  # noqa: F401  (op_builder path shim)
             from op_builder.tpu import CPUAdamBuilder
             self._native = CPUAdamBuilder().load()
             self._native.create_adam(self.opt_id, lr, betas[0], betas[1], eps, weight_decay, adamw_mode, True)
+            self._native.set_adamw_mode(adamw_mode)
         except Exception as e:
             logger.warning(f"CPUAdam native kernel unavailable ({e}); using NumPy fallback")
 

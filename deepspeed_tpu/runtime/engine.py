@@ -199,6 +199,7 @@ class DeepSpeedEngine:
         # caches for jitted callables and last-forward microbatch
         self._jit_cache = {}
         self._grads_acc = None
+        self._host_offload = None  # set by _materialize_state when offloading
         self._pending = None  # (loss, grads) from the last forward
         self.global_grad_norm = 0.0
         self.overflow = False
@@ -394,22 +395,40 @@ class DeepSpeedEngine:
         self._grad_specs = self.sharding_policy.tree_grad_specs(self.params)
         self._grad_shardings = self.sharding_policy.tree_grad_shardings(self.params)
 
-        # fp32 master copy sharded like optimizer state (ZeRO-1 partitioning)
-        mixed = self.compute_dtype != jnp.float32
-        if mixed or self.zero_stage >= 1:
-            self.master_params = jax.jit(
-                lambda p: jax.tree.map(lambda x: x.astype(jnp.float32) if _is_float(x) else x, p),
-                out_shardings=self._opt_shardings)(self.params)
+        offload_device = self._config.zero_config.offload_optimizer_device().value
+        if offload_device != "none":
+            # ZeRO-Offload: fp32 master + moments on host (RAM or NVMe),
+            # update on host SIMD (runtime/zero/offload.py). The device
+            # keeps only compute-dtype params.
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            nvme_path = None
+            if offload_device == "nvme":
+                nvme_path = self._config.zero_config.offload_optimizer.nvme_path
+                assert nvme_path, "offload_optimizer.device=nvme requires nvme_path"
+            self._host_offload = HostOffloadOptimizer(
+                self.optimizer, self.params, self._param_shardings, self.compute_dtype,
+                nvme_path=nvme_path,
+                aio_threads=int(self._config.zero_config.offload_optimizer.buffer_count or 4))
+            self.master_params = None
+            self.opt_state = None
         else:
-            self.master_params = self.params
+            self._host_offload = None
+            # fp32 master copy sharded like optimizer state (ZeRO-1 partitioning)
+            mixed = self.compute_dtype != jnp.float32
+            if mixed or self.zero_stage >= 1:
+                self.master_params = jax.jit(
+                    lambda p: jax.tree.map(lambda x: x.astype(jnp.float32) if _is_float(x) else x, p),
+                    out_shardings=self._opt_shardings)(self.params)
+            else:
+                self.master_params = self.params
 
-        # Optimizer state: mirror master sharding for params-shaped subtrees
-        transform = self.optimizer.transform()
-        self._opt_init, self._opt_update = transform.init, transform.update
-        abstract_state = jax.eval_shape(self._opt_init, self.master_params)
-        state_shardings = self._opt_state_shardings(abstract_state)
-        self.opt_state = jax.jit(self._opt_init, out_shardings=state_shardings)(self.master_params)
-        self._opt_state_shards = state_shardings
+            # Optimizer state: mirror master sharding for params-shaped subtrees
+            transform = self.optimizer.transform()
+            self._opt_init, self._opt_update = transform.init, transform.update
+            abstract_state = jax.eval_shape(self._opt_init, self.master_params)
+            state_shardings = self._opt_state_shardings(abstract_state)
+            self.opt_state = jax.jit(self._opt_init, out_shardings=state_shardings)(self.master_params)
+            self._opt_state_shards = state_shardings
 
         self._initialized = True
 
@@ -555,6 +574,33 @@ class DeepSpeedEngine:
         new_scaler = update_scale(scaler_st, overflow, **dict(self._scaler_kwargs))
         return new_params, new_master, new_opt, new_scaler, gnorm, overflow
 
+    def _unscale_clip_math(self, grads, scaler_st):
+        """Device half of the offload step: unscale, overflow check, clip.
+        The optimizer update itself runs on host SIMD."""
+        clip = float(self.gradient_clipping() or 0.0)
+        scale = scaler_st["cur_scale"]
+        grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+        overflow = has_overflow(grads32) if self.fp16_enabled() else jnp.zeros((), bool)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
+        if clip > 0.0:
+            factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            grads32 = jax.tree.map(lambda g: g * factor, grads32)
+        return grads32, gnorm, overflow
+
+    def _offload_prep_fn(self):
+        key = "offload_prep"
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(self._unscale_clip_math, donate_argnums=(0,))
+        return self._jit_cache[key]
+
+    def _offload_apply(self, grads32, gnorm, overflow):
+        """Host half of the offload step + shared bookkeeping."""
+        self.overflow = bool(overflow) if self.fp16_enabled() else False
+        if not self.overflow:
+            self.params = self._host_offload.step(grads32)
+        self.scaler_state = update_scale(self.scaler_state, overflow, **dict(self._scaler_kwargs))
+        self.global_grad_norm = float(gnorm)
+
     def _apply_update_fn(self):
         key = "apply"
         if key in self._jit_cache:
@@ -582,20 +628,24 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(STEP_GLOBAL_TIMER).start()
-        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
-        fn, tied = self._apply_update_fn()
-        if tied:
-            out = fn(self.params, self.opt_state, self._grads_acc, self.scaler_state, lr)
-            self.params, self.opt_state, self.scaler_state, gnorm, overflow = out
-            self.master_params = self.params
+        if self._host_offload is not None:
+            grads32, gnorm, overflow = self._offload_prep_fn()(self._grads_acc, self.scaler_state)
+            self._offload_apply(grads32, gnorm, overflow)
         else:
-            out = fn(self.params, self.master_params, self.opt_state, self._grads_acc, self.scaler_state, lr)
-            self.params, self.master_params, self.opt_state, self.scaler_state, gnorm, overflow = out
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            fn, tied = self._apply_update_fn()
+            if tied:
+                out = fn(self.params, self.opt_state, self._grads_acc, self.scaler_state, lr)
+                self.params, self.opt_state, self.scaler_state, gnorm, overflow = out
+                self.master_params = self.params
+            else:
+                out = fn(self.params, self.master_params, self.opt_state, self._grads_acc, self.scaler_state, lr)
+                self.params, self.master_params, self.opt_state, self.scaler_state, gnorm, overflow = out
+            self.overflow = bool(overflow) if self.fp16_enabled() else False
+            self.global_grad_norm = float(gnorm)
         self._grads_acc = None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        self.overflow = bool(overflow) if self.fp16_enabled() else False
-        self.global_grad_norm = float(gnorm)
         if self.overflow:
             self.skipped_steps += 1
             log_dist(f"[deepspeed_tpu] OVERFLOW! Skipping step; loss scale -> "
@@ -663,6 +713,46 @@ class DeepSpeedEngine:
         self._jit_cache[key] = (jitted, tied)
         return self._jit_cache[key]
 
+    def _train_batch_grads_fn(self):
+        """Offload variant of the fused step: scan over micro-batches and
+        return clipped fp32 grads for the host-side optimizer update."""
+        key = "train_batch_grads"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        gas = self.gradient_accumulation_steps()
+        acc_dtype = self._grad_accum_dtype
+        grad_specs = self._grad_specs
+        mesh = self.mesh
+
+        def micro_loss(params, scale, rng, batch):
+            args, kwargs = batch
+            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            return (loss.astype(jnp.float32) * scale) / gas, loss
+
+        def fn(params, scaler_st, rng, batches):
+            scale = scaler_st["cur_scale"]
+
+            def micro(carry, batch_rng):
+                acc = carry
+                batch, r = batch_rng
+                (_, loss), grads = jax.value_and_grad(micro_loss, has_aux=True)(params, scale, r, batch)
+                grads = jax.tree.map(
+                    lambda g, spec: jax.lax.with_sharding_constraint(
+                        g.astype(acc_dtype), NamedSharding(mesh, spec)), grads, grad_specs)
+                return jax.tree.map(jnp.add, acc, grads), loss
+
+            zeros = jax.tree.map(
+                lambda p, spec: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, acc_dtype), NamedSharding(mesh, spec)), params, grad_specs)
+            rngs = jax.random.split(rng, gas)
+            acc, losses = jax.lax.scan(micro, zeros, (batches, rngs))
+            grads32, gnorm, overflow = self._unscale_clip_math(acc, scaler_st)
+            return grads32, losses.mean(), gnorm, overflow
+
+        self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
     def train_batch(self, data_iter=None, batch=None):
         """Run one full training step (gas micro-batches + update) as a
         single jitted program (reference PipelineEngine.train_batch:326
@@ -688,15 +778,20 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         self._dropout_rng, sub = jax.random.split(self._dropout_rng)
-        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
-        fn, tied = self._train_batch_fn()
-        if tied:
-            out = fn(self.params, self.opt_state, self.scaler_state, lr, sub, batch)
-            self.params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
-            self.master_params = self.params
+        if self._host_offload is not None:
+            grads32, mean_loss, gnorm, overflow = self._train_batch_grads_fn()(
+                self.params, self.scaler_state, sub, batch)
+            self._offload_apply(grads32, gnorm, overflow)
         else:
-            out = fn(self.params, self.master_params, self.opt_state, self.scaler_state, lr, sub, batch)
-            self.params, self.master_params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            fn, tied = self._train_batch_fn()
+            if tied:
+                out = fn(self.params, self.opt_state, self.scaler_state, lr, sub, batch)
+                self.params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
+                self.master_params = self.params
+            else:
+                out = fn(self.params, self.master_params, self.opt_state, self.scaler_state, lr, sub, batch)
+                self.params, self.master_params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
@@ -805,10 +900,16 @@ class DeepSpeedEngine:
         if dist.get_rank() == 0:
             self.checkpoint_engine.save(model_state, self._get_ckpt_name(save_dir, tag))
 
+        if self._host_offload is not None:
+            opt_sd = self._host_offload.export_state()
+            master_sd = self._host_offload.export_master()
+        else:
+            opt_sd = _to_serializable(self.opt_state)
+            master_sd = (_to_serializable(self.master_params)
+                         if self.master_params is not self.params else None)
         optim_state = {
-            "optimizer_state_dict": _to_serializable(self.opt_state),
-            "fp32_master_params": _to_serializable(self.master_params)
-            if self.master_params is not self.params else None,
+            "optimizer_state_dict": opt_sd,
+            "fp32_master_params": master_sd,
             "scaler_state": _to_serializable(self.scaler_state),
             "optimizer_param_groups": [{k: v for k, v in g.items() if k != "params"}
                                        for g in self.optimizer.param_groups],
@@ -891,6 +992,17 @@ class DeepSpeedEngine:
         return load_dir, client_state
 
     def _restore_optim_state(self, optim_state):
+        if self._host_offload is not None:
+            self._host_offload.load_state(optim_state["optimizer_state_dict"])
+            if optim_state.get("fp32_master_params") is not None:
+                self._host_offload.load_master(optim_state["fp32_master_params"])
+                self.params = self._host_offload.current_params()
+            if optim_state.get("scaler_state") is not None:
+                self.scaler_state = jax.tree.map(jnp.asarray, _match_tree(optim_state["scaler_state"],
+                                                                          self.scaler_state))
+            for g, g_new in zip(self.optimizer.param_groups, optim_state.get("optimizer_param_groups", [])):
+                g.update(g_new)
+            return
         loaded_opt = _match_tree(optim_state["optimizer_state_dict"], self.opt_state)
         self.opt_state = jax.tree.map(
             lambda cur, new: jax.device_put(np.asarray(new).astype(cur.dtype), cur.sharding),

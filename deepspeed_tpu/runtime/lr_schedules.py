@@ -291,8 +291,9 @@ class OneCycle(_LRScheduler):
                                "has no 'betas' default")
                 self.cycle_momentum = False
             else:
-                self.min_moms = self._per_group((cycle_min_mom, 0.99), CYCLE_MIN_MOM)
-                self.max_moms = self._per_group((cycle_max_mom, 0.99), CYCLE_MAX_MOM)
+                n_groups = len(self.optimizer.param_groups)
+                self.min_moms = [(cycle_min_mom, 0.99)] * n_groups
+                self.max_moms = [(cycle_max_mom, 0.99)] * n_groups
                 self.decay_mom_rate = decay_mom_rate
                 if last_batch_iteration == -1:
                     for group, betas in zip(optimizer.param_groups, self.min_moms):

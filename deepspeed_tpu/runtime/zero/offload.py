@@ -1,0 +1,286 @@
+"""ZeRO-Offload: optimizer state in host RAM (or NVMe), update on host SIMD.
+
+Capability match for the reference's offload step path
+(``deepspeed/runtime/zero/stage_1_and_2.py:1820`` with
+``DeepSpeedCPUAdam``, moments pinned in host RAM; NVMe variant via
+``runtime/swap_tensor/partitioned_optimizer_swapper.py``). TPU-native
+design:
+
+- The device keeps only the compute-dtype (bf16/fp16) parameters; fp32
+  master weights and optimizer moments live in flat host NumPy buffers.
+  HBM cost per param drops from 14 bytes (bf16 param + fp32 master+m+v)
+  to 2 bytes + transient fp32 gradients.
+- ``step(grads)`` pipelines per-leaf: async D2H of all gradient leaves is
+  kicked off at once (XLA transfers overlap the host SIMD updates of
+  earlier leaves), each leaf region is updated in place by the native
+  C++ kernel (csrc/adam/cpu_adam.cpp), and the new bf16 params are
+  produced by the kernel's fused fp32->bf16 copy and uploaded with an
+  async ``device_put`` that overlaps the next leaf's update.
+- With ``device: nvme`` the moments additionally swap through
+  ``OptimizerStateSwapper`` (double-buffered async file I/O) so host RAM
+  holds only master weights + two leaf-sized bounce buffers.
+
+Multi-host note: this path operates on the process-addressable value of
+each gradient leaf; on a multi-host mesh the zero axis must be chosen so
+each process addresses its own shard (one process per host over ICI).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _leaf_paths_and_shapes(params):
+    from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+    acc = []
+    path_tree_map(lambda path, x: acc.append((path, tuple(np.shape(x)))) or x, params)
+    return acc
+
+
+class HostOffloadOptimizer:
+    """Host-resident optimizer state + SIMD update for the offload path.
+
+    Supports the Adam/Adagrad/Lion families (the same set the reference
+    ships CPU-SIMD kernels for). ``kind`` is inferred from the engine's
+    configured DeepSpeed optimizer object, whose ``param_groups`` remain
+    the source of hyperparameters (LR schedules mutate them in place).
+    """
+
+    STATE_NAMES = {
+        "adam": ("exp_avg", "exp_avg_sq"),
+        "adagrad": ("sum_sq",),
+        "lion": ("exp_avg",),
+    }
+
+    def __init__(self, optimizer, params, param_shardings, compute_dtype,
+                 nvme_path: Optional[str] = None, aio_threads: int = 4):
+        self.optimizer = optimizer
+        self.kind = self._infer_kind(optimizer)
+        self.compute_dtype = compute_dtype
+        self._param_shardings = param_shardings
+        self._treedef = jax.tree.structure(params)
+        self._shardings_flat = jax.tree.leaves(param_shardings)
+
+        leaves = jax.tree.leaves(params)
+        meta = _leaf_paths_and_shapes(params)
+        self.paths = [m[0] for m in meta]
+        self.shapes = [m[1] for m in meta]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = np.concatenate([[0], np.cumsum(self.sizes)]).astype(np.int64)
+        self.numel = int(self.offsets[-1])
+        self.step_count = 0
+
+        # fp32 master weights (always host RAM, even for NVMe moments).
+        self.master_flat = np.empty(self.numel, np.float32)
+        for i, leaf in enumerate(leaves):
+            self.master_flat[self.offsets[i]:self.offsets[i + 1]] = (
+                np.asarray(jax.device_get(leaf)).astype(np.float32).ravel())
+
+        # Moments: RAM buffers, or NVMe-swapped.
+        self.state_names = self.STATE_NAMES[self.kind]
+        self.swapper = None
+        if nvme_path is not None:
+            from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import OptimizerStateSwapper
+            self.swapper = OptimizerStateSwapper(nvme_path, self.state_names, self.sizes,
+                                                 buffer_count=aio_threads)
+            self.swapper.initialize_zeros()
+            self.state_flat = None
+        else:
+            self.state_flat = {name: np.zeros(self.numel, np.float32) for name in self.state_names}
+
+        # Native SIMD kernels (NumPy fallback inside the ops if unavailable).
+        self._native = self._load_native()
+        # Reusable conversion buffers (largest leaf).
+        max_size = max(self.sizes) if self.sizes else 0
+        self._bf16_out = np.empty(max_size, np.uint16) if compute_dtype == jnp.bfloat16 else None
+        self._grad_f32 = np.empty(max_size, np.float32)
+
+        where = "nvme" if self.swapper else "cpu"
+        logger.info(f"[zero-offload] {self.kind} state on {where}: {self.numel / 1e6:.1f}M params, "
+                    f"host RAM {(self.numel * 4 * (1 + (0 if self.swapper else len(self.state_names)))) / 1e9:.2f} GB")
+
+    @staticmethod
+    def _infer_kind(optimizer):
+        name = type(optimizer).__name__.lower()
+        if "adagrad" in name:
+            return "adagrad"
+        if "lion" in name:
+            return "lion"
+        if "adam" in name:
+            return "adam"
+        raise ValueError(
+            f"offload_optimizer supports Adam/Adagrad/Lion families; got {type(optimizer).__name__} "
+            f"(the reference similarly requires a DeepSpeedCPUOptimizer for offload)")
+
+    def _load_native(self):
+        try:
+            if self.kind == "adam":
+                from op_builder.tpu import CPUAdamBuilder
+                mod = CPUAdamBuilder().load()
+                mod.set_adamw_mode(self.optimizer.param_groups[0].get("adam_w_mode", True))
+                return mod
+            if self.kind == "adagrad":
+                from op_builder.tpu import CPUAdagradBuilder
+                return CPUAdagradBuilder().load()
+            from op_builder.tpu import CPULionBuilder
+            return CPULionBuilder().load()
+        except Exception as e:  # pragma: no cover - toolchain-dependent
+            logger.warning(f"[zero-offload] native SIMD kernel unavailable ({e}); NumPy fallback")
+            return None
+
+    # ------------------------------------------------------------------
+    # The hot path
+    # ------------------------------------------------------------------
+    def _grad_to_fp32(self, g_np, size):
+        """Return an fp32 view/copy of a fetched gradient leaf."""
+        if g_np.dtype == np.float32:
+            return np.ascontiguousarray(g_np.ravel())
+        if g_np.dtype == ml_dtypes.bfloat16 and self._native is not None and self.kind == "adam":
+            out = self._grad_f32[:size]
+            self._native.bf16_to_fp32(np.ascontiguousarray(g_np.ravel()).view(np.uint16), out)
+            return out
+        return g_np.astype(np.float32).ravel()
+
+    def _update_region(self, i, grad_f32, want_bf16_out):
+        """Run the optimizer update on leaf i's region of the flat buffers.
+        Returns the updated params in compute dtype (np array, flat)."""
+        o, size = int(self.offsets[i]), self.sizes[i]
+        p = self.master_flat[o:o + size]
+        group = self.optimizer.param_groups[0]
+        lr = float(group["lr"])
+        wd = float(group.get("weight_decay", 0.0))
+
+        if self.swapper is not None:
+            state = self.swapper.fetch(i)
+            self.swapper.prefetch(i + 1)
+        else:
+            state = {name: self.state_flat[name][o:o + size] for name in self.state_names}
+
+        if self.kind == "adam":
+            b1, b2 = group["betas"]
+            eps = float(group["eps"])
+            bc = bool(group.get("bias_correction", True))
+            if self._native is not None and want_bf16_out:
+                out16 = self._bf16_out[:size]
+                self._native.adam_update_copy_bf16(0, self.step_count, lr, float(b1), float(b2), eps, wd, bc,
+                                                   p, grad_f32, state["exp_avg"], state["exp_avg_sq"], out16)
+                new_p = out16.view(ml_dtypes.bfloat16)
+            elif self._native is not None:
+                self._native.adam_update(0, self.step_count, lr, float(b1), float(b2), eps, wd, bc,
+                                         p, grad_f32, state["exp_avg"], state["exp_avg_sq"])
+                new_p = p
+            else:
+                self.optimizer.step_flat(self.step_count, p, grad_f32, state["exp_avg"], state["exp_avg_sq"], lr=lr)
+                new_p = p
+        elif self.kind == "adagrad":
+            eps = float(group["eps"])
+            if self._native is not None:
+                self._native.adagrad_update(0, self.step_count, lr, eps, wd, p, grad_f32, state["sum_sq"])
+            else:
+                g = grad_f32 + wd * p if wd else grad_f32
+                state["sum_sq"] += np.square(g)
+                p -= lr * g / (np.sqrt(state["sum_sq"]) + eps)
+            new_p = p
+        else:  # lion
+            b1, b2 = group["betas"]
+            if self._native is not None:
+                self._native.lion_update(0, self.step_count, lr, float(b1), float(b2), wd,
+                                         p, grad_f32, state["exp_avg"])
+            else:
+                c = b1 * state["exp_avg"] + (1 - b1) * grad_f32
+                p -= lr * (np.sign(c) + wd * p)
+                state["exp_avg"] *= b2
+                state["exp_avg"] += (1 - b2) * grad_f32
+            new_p = p
+
+        if self.swapper is not None:
+            self.swapper.commit(i, state)
+        return new_p
+
+    def step(self, grads_tree):
+        """One optimizer step. ``grads_tree`` are unscaled, clipped fp32 (or
+        bf16) device gradients. Returns the new compute-dtype param tree,
+        placed with the engine's parameter shardings."""
+        self.step_count += 1
+        grads_flat = jax.tree.leaves(grads_tree)
+        # Kick off ALL device->host copies up front; jax overlaps them with
+        # the host-side SIMD work below.
+        for g in grads_flat:
+            try:
+                g.copy_to_host_async()
+            except Exception:
+                pass
+
+        want_bf16 = self.compute_dtype == jnp.bfloat16
+        new_leaves = []
+        for i, g in enumerate(grads_flat):
+            size = self.sizes[i]
+            g_np = np.asarray(jax.device_get(g))
+            grad_f32 = self._grad_to_fp32(g_np, size)
+            new_p = self._update_region(i, grad_f32, want_bf16)
+            if want_bf16:
+                # new_p views the shared conversion buffer; device_put may be
+                # zero-copy (CPU backend), so snapshot before the next leaf
+                # overwrites it.
+                host_val = new_p.reshape(self.shapes[i]).copy()
+            else:
+                host_val = new_p.reshape(self.shapes[i]).astype(
+                    ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else
+                    np.dtype(self.compute_dtype.__name__))
+            # async upload; placement overlaps the next leaf's SIMD update
+            new_leaves.append(jax.device_put(host_val, self._shardings_flat[i]))
+        if self.swapper is not None:
+            self.swapper.flush()
+        return jax.tree.unflatten(self._treedef, new_leaves)
+
+    # ------------------------------------------------------------------
+    # Checkpoint surface (engine save/load parity with the device path)
+    # ------------------------------------------------------------------
+    def _region_tree(self, flat):
+        views = [flat[self.offsets[i]:self.offsets[i + 1]].reshape(self.shapes[i])
+                 for i in range(len(self.sizes))]
+        return jax.tree.unflatten(self._treedef, views)
+
+    def export_state(self):
+        state = {"step": np.asarray(self.step_count, np.int32)}
+        for name in self.state_names:
+            flat = self.swapper.read_full(name) if self.swapper else self.state_flat[name]
+            state[name] = self._region_tree(flat)
+        return state
+
+    def export_master(self):
+        return self._region_tree(self.master_flat)
+
+    def load_state(self, state):
+        self.step_count = int(np.asarray(state.get("step", self.step_count)))
+        for name in self.state_names:
+            if name not in state:
+                continue
+            flat = np.concatenate([np.asarray(x, np.float32).ravel() for x in jax.tree.leaves(state[name])])
+            assert flat.size == self.numel
+            if self.swapper:
+                self.swapper.write_full(name, flat)
+            else:
+                self.state_flat[name][:] = flat
+
+    def load_master(self, master_tree):
+        flat = np.concatenate([np.asarray(x, np.float32).ravel() for x in jax.tree.leaves(master_tree)])
+        assert flat.size == self.numel
+        self.master_flat[:] = flat
+
+    def current_params(self):
+        """Compute-dtype device params rebuilt from the host master copy."""
+        leaves = []
+        np_dtype = ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else np.dtype(
+            self.compute_dtype.__name__)
+        for i in range(len(self.sizes)):
+            o, size = int(self.offsets[i]), self.sizes[i]
+            host_val = self.master_flat[o:o + size].reshape(self.shapes[i]).astype(np_dtype)
+            leaves.append(jax.device_put(host_val, self._shardings_flat[i]))
+        return jax.tree.unflatten(self._treedef, leaves)

@@ -1,0 +1,18 @@
+"""Top-level op build system (reference layout: op_builder/ next to the
+framework package). ``op_builder.tpu`` carries the TPU-host builders; the
+accelerator abstraction resolves them via ``create_op_builder()``."""
+
+from op_builder.builder import OpBuilder, OpBuilderError  # noqa: F401
+from op_builder.tpu import (AsyncIOBuilder, CPUAdagradBuilder, CPUAdamBuilder,  # noqa: F401
+                            CPULionBuilder)
+
+ALL_OPS = {
+    "cpu_adam": CPUAdamBuilder,
+    "cpu_adagrad": CPUAdagradBuilder,
+    "cpu_lion": CPULionBuilder,
+    "async_io": AsyncIOBuilder,
+}
+
+
+def get_op_builder(name):
+    return ALL_OPS[name]

@@ -1,0 +1,207 @@
+"""TPU-host op builders.
+
+Counterpart of the reference's per-accelerator ``op_builder/{cpu,npu,...}``
+packages: every native component the TPU build needs on the *host* side —
+SIMD optimizers for ZeRO-Offload and async NVMe I/O — with ctypes bindings
+exposing the same method surface the reference's pybind modules expose
+(``create_adam``/``adam_update``/... from csrc/adam/fused_adam_frontend.cpp,
+``aio_handle`` from csrc/aio/py_lib/py_ds_aio.cpp).
+"""
+
+import ctypes
+from ctypes import POINTER, c_char_p, c_float, c_int, c_int64, c_uint16, c_void_p
+
+import numpy as np
+
+from op_builder.builder import OpBuilder, OpBuilderError
+
+__all__ = [
+    "CPUAdamBuilder",
+    "CPUAdagradBuilder",
+    "CPULionBuilder",
+    "AsyncIOBuilder",
+    "OpBuilderError",
+]
+
+_f32p = POINTER(c_float)
+_u16p = POINTER(c_uint16)
+
+
+def _fp(arr, dtype=np.float32):
+    assert arr.dtype == dtype and arr.flags["C_CONTIGUOUS"], (arr.dtype, arr.flags)
+    return arr.ctypes.data_as(_f32p if dtype == np.float32 else _u16p)
+
+
+class _CPUAdamModule:
+    """Python face of libds_cpu_adam (reference DeepSpeedCPUAdam surface)."""
+
+    def __init__(self, cdll):
+        self._c = cdll
+        c = self._c
+        c.ds_adam_create.argtypes = [c_int, c_float, c_float, c_float, c_float, c_float, c_int, c_int]
+        c.ds_adam_destroy.argtypes = [c_int]
+        c.ds_adam_update.argtypes = [c_int, c_int64, c_float, c_float, c_float, c_float, c_float,
+                                     c_int, c_int, _f32p, _f32p, _f32p, _f32p, c_int64]
+        c.ds_adam_update_copy_bf16.argtypes = [c_int, c_int64, c_float, c_float, c_float, c_float, c_float,
+                                               c_int, c_int, _f32p, _f32p, _f32p, _f32p, _u16p, c_int64]
+        c.ds_bf16_to_fp32.argtypes = [_u16p, _f32p, c_int64]
+        c.ds_fp32_to_bf16.argtypes = [_f32p, _u16p, c_int64]
+        c.ds_simd_width.restype = c_int
+
+    def create_adam(self, opt_id, lr, beta1, beta2, eps, weight_decay, adamw_mode, should_log=False):
+        return self._c.ds_adam_create(opt_id, lr, beta1, beta2, eps, weight_decay, int(adamw_mode), 1)
+
+    def destroy_adam(self, opt_id):
+        return self._c.ds_adam_destroy(opt_id)
+
+    def adam_update(self, opt_id, step, lr, beta1, beta2, eps, weight_decay, bias_correction,
+                    params, grads, exp_avg, exp_avg_sq):
+        n = params.size
+        assert grads.size == n and exp_avg.size == n and exp_avg_sq.size == n
+        return self._c.ds_adam_update(opt_id, step, lr, beta1, beta2, eps, weight_decay,
+                                      int(bias_correction), self._adamw_flag,
+                                      _fp(params), _fp(grads), _fp(exp_avg), _fp(exp_avg_sq), n)
+
+    # adamw flag travels with the bound module: set by DeepSpeedCPUAdam
+    _adamw_flag = 1
+
+    def set_adamw_mode(self, adamw):
+        self._adamw_flag = int(adamw)
+
+    def adam_update_copy_bf16(self, opt_id, step, lr, beta1, beta2, eps, weight_decay, bias_correction,
+                              params, grads, exp_avg, exp_avg_sq, params_bf16):
+        n = params.size
+        assert params_bf16.size == n and params_bf16.dtype == np.uint16
+        return self._c.ds_adam_update_copy_bf16(opt_id, step, lr, beta1, beta2, eps, weight_decay,
+                                                int(bias_correction), self._adamw_flag,
+                                                _fp(params), _fp(grads), _fp(exp_avg), _fp(exp_avg_sq),
+                                                _fp(params_bf16, np.uint16), n)
+
+    def bf16_to_fp32(self, src_u16, dst_f32):
+        self._c.ds_bf16_to_fp32(_fp(src_u16, np.uint16), _fp(dst_f32), src_u16.size)
+
+    def fp32_to_bf16(self, src_f32, dst_u16):
+        self._c.ds_fp32_to_bf16(_fp(src_f32), _fp(dst_u16, np.uint16), src_f32.size)
+
+    def simd_width(self):
+        return self._c.ds_simd_width()
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["csrc/adam/cpu_adam.cpp"]
+
+    def bind(self, cdll):
+        return _CPUAdamModule(cdll)
+
+
+class _CPUAdagradModule:
+    def __init__(self, cdll):
+        self._c = cdll
+        cdll.ds_adagrad_update.argtypes = [c_int, c_int64, c_float, c_float, c_float,
+                                           _f32p, _f32p, _f32p, c_int64]
+
+    def adagrad_update(self, opt_id, step, lr, eps, weight_decay, params, grads, exp_avg_sq):
+        return self._c.ds_adagrad_update(opt_id, step, lr, eps, weight_decay,
+                                         _fp(params), _fp(grads), _fp(exp_avg_sq), params.size)
+
+
+class CPUAdagradBuilder(OpBuilder):
+    NAME = "cpu_adagrad"
+
+    def sources(self):
+        return ["csrc/adagrad/cpu_adagrad.cpp"]
+
+    def bind(self, cdll):
+        return _CPUAdagradModule(cdll)
+
+
+class _CPULionModule:
+    def __init__(self, cdll):
+        self._c = cdll
+        cdll.ds_lion_update.argtypes = [c_int, c_int64, c_float, c_float, c_float, c_float,
+                                        _f32p, _f32p, _f32p, c_int64]
+
+    def lion_update(self, opt_id, step, lr, beta1, beta2, weight_decay, params, grads, exp_avg):
+        return self._c.ds_lion_update(opt_id, step, lr, beta1, beta2, weight_decay,
+                                      _fp(params), _fp(grads), _fp(exp_avg), params.size)
+
+
+class CPULionBuilder(OpBuilder):
+    NAME = "cpu_lion"
+
+    def sources(self):
+        return ["csrc/lion/cpu_lion.cpp"]
+
+    def bind(self, cdll):
+        return _CPULionModule(cdll)
+
+
+class AioHandle:
+    """aio_handle parity object (reference py_ds_aio.cpp)."""
+
+    def __init__(self, cdll, num_threads=8):
+        self._c = cdll
+        cdll.ds_aio_create.restype = c_void_p
+        cdll.ds_aio_create.argtypes = [c_int]
+        cdll.ds_aio_destroy.argtypes = [c_void_p]
+        for fn in ("ds_aio_submit_read", "ds_aio_submit_write", "ds_aio_pread", "ds_aio_pwrite"):
+            getattr(cdll, fn).argtypes = [c_void_p, c_char_p, c_void_p, c_int64, c_int64]
+        cdll.ds_aio_wait.argtypes = [c_void_p]
+        self._h = cdll.ds_aio_create(num_threads)
+
+    def close(self):
+        if self._h is not None:
+            self._c.ds_aio_destroy(self._h)
+            self._h = None
+
+    __del__ = close
+
+    @staticmethod
+    def _buf(arr):
+        assert arr.flags["C_CONTIGUOUS"]
+        return arr.ctypes.data_as(c_void_p), arr.nbytes
+
+    def async_pread(self, arr, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        return self._c.ds_aio_submit_read(self._h, str(path).encode(), ptr, nbytes, offset)
+
+    def async_pwrite(self, arr, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        return self._c.ds_aio_submit_write(self._h, str(path).encode(), ptr, nbytes, offset)
+
+    def wait(self):
+        errors = self._c.ds_aio_wait(self._h)
+        if errors:
+            raise IOError(f"aio: {errors} I/O job(s) failed")
+        return 0
+
+    def read(self, arr, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        if self._c.ds_aio_pread(self._h, str(path).encode(), ptr, nbytes, offset):
+            raise IOError(f"aio read failed: {path}")
+
+    def write(self, arr, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        if self._c.ds_aio_pwrite(self._h, str(path).encode(), ptr, nbytes, offset):
+            raise IOError(f"aio write failed: {path}")
+
+
+class _AioModule:
+    def __init__(self, cdll):
+        self._cdll = cdll
+
+    def aio_handle(self, num_threads=8, **_compat_kwargs):
+        return AioHandle(self._cdll, num_threads=num_threads)
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "aio"
+
+    def sources(self):
+        return ["csrc/aio/ds_aio.cpp"]
+
+    def bind(self, cdll):
+        return _AioModule(cdll)

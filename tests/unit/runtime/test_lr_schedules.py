@@ -110,3 +110,19 @@ class TestOneCycle:
         s.step()
         s2.step()
         assert o.param_groups[0]["lr"] == o2.param_groups[0]["lr"]
+
+
+def test_onecycle_cycle_momentum():
+    """Regression: (mom, 0.99) beta tuples must broadcast per group, not be
+    misread as a per-group list."""
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+    from deepspeed_tpu.runtime.lr_schedules import OneCycle
+    opt = FusedAdam(lr=1e-3)
+    sched = OneCycle(opt, cycle_min_lr=1e-4, cycle_max_lr=1e-3, cycle_momentum=True,
+                     cycle_min_mom=0.85, cycle_max_mom=0.95)
+    assert sched.cycle_momentum
+    assert opt.param_groups[0]["betas"] == (0.85, 0.99)
+    for _ in range(3):
+        sched.step()
+    b1, b2 = opt.param_groups[0]["betas"]
+    assert 0.84 <= b1 <= 0.96 and b2 == 0.99
