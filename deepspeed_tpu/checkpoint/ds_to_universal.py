@@ -1,0 +1,30 @@
+"""CLI: convert a saved checkpoint tag into the universal fp32 layout.
+
+Usage parity with the reference script
+(``deepspeed/checkpoint/ds_to_universal.py:main``)::
+
+    python -m deepspeed_tpu.checkpoint.ds_to_universal \
+        --input_folder ./ckpts --output_folder ./ckpts_universal [--tag global_step10]
+"""
+
+import argparse
+
+from deepspeed_tpu.checkpoint.universal import ds_to_universal
+
+
+def parse_arguments(args=None):
+    parser = argparse.ArgumentParser(description="Convert a DeepSpeedTPU checkpoint to universal format")
+    parser.add_argument("--input_folder", required=True, help="checkpoint save_dir (contains tag dirs)")
+    parser.add_argument("--output_folder", required=True, help="destination universal dir")
+    parser.add_argument("--tag", default=None, help="tag to convert (default: the 'latest' tag)")
+    return parser.parse_args(args)
+
+
+def main(args=None):
+    opts = parse_arguments(args)
+    out = ds_to_universal(opts.input_folder, opts.output_folder, tag=opts.tag)
+    print(f"wrote universal checkpoint: {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Universal checkpoint: mesh-agnostic consolidated fp32 format.
+
+Capability match for the reference's universal checkpointing
+(``deepspeed/checkpoint/ds_to_universal.py``: extract → merge → save one
+consolidated fp32 file set per parameter;
+``deepspeed/checkpoint/universal_checkpoint.py``:
+``load_hp_checkpoint_state`` re-slices per target rank). The TPU design
+is simpler because saved chunks already carry global coordinates: a
+universal checkpoint is just the per-parameter consolidation of a tag
+directory, written one parameter at a time.
+
+Layout of a universal dir:
+
+- ``universal_metadata.json``  steps/version/scalars/param index
+- ``zero/<param_path>/fp32.npy``       consolidated fp32 master weights
+- ``zero/<param_path>/<moment>.npy``   consolidated optimizer moments
+                                       (e.g. exp_avg, exp_avg_sq)
+"""
+
+import json
+import os
+
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import ArrayCheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import (ShardedCheckpointEngine,
+                                                                              ShardedReader, flatten_named,
+                                                                              load_skeleton)
+
+UNIVERSAL_METADATA = "universal_metadata.json"
+ZERO_FP32 = "fp32"
+
+
+def resolve_tag(checkpoint_dir, tag=None):
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if not os.path.isfile(latest):
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag=")
+        with open(latest) as f:
+            tag = f.read().strip()
+    return tag
+
+
+class TagReader:
+    """Uniform per-key reader over a saved tag dir, both formats
+    (sharded chunk store or consolidated msgpack)."""
+
+    def __init__(self, checkpoint_dir, tag=None):
+        self.tag = resolve_tag(checkpoint_dir, tag)
+        tag_dir = os.path.join(checkpoint_dir, self.tag)
+        self.model_path = os.path.join(tag_dir, "mp_rank_00_model_states.pt")
+        self.optim_path = os.path.join(tag_dir, "zero_pp_rank_0_mp_rank_00_optim_states.pt")
+        self._files = {}
+        self._named_cache = {}
+        for name, path in (("model", self.model_path), ("optim", self.optim_path)):
+            if not os.path.isfile(path):
+                continue
+            if ShardedCheckpointEngine.is_sharded(path):
+                self._files[name] = ("sharded", load_skeleton(path),
+                                     ShardedReader(ShardedCheckpointEngine.shard_dir(path)))
+            else:
+                self._files[name] = ("eager", ArrayCheckpointEngine().load(path), None)
+
+    def _named(self, which):
+        if which in self._named_cache:
+            return self._named_cache[which]
+        kind, tree_or_skel, reader = self._files[which]
+        if kind == "sharded":
+            out = ({k: ("sharded", reader, k) for k in reader.keys()}, tree_or_skel)
+        else:
+            flat = {}
+            for path, leaf in flatten_named(tree_or_skel):
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    flat[path] = ("eager", leaf, None)
+            out = (flat, tree_or_skel)
+        self._named_cache[which] = out
+        return out
+
+    def array_keys(self, which):
+        return sorted(self._named(which)[0].keys())
+
+    def read(self, which, key):
+        """Read one full array (host memory bound: this one array)."""
+        entry = self._named(which)[0].get(key)
+        if entry is None:
+            raise KeyError(f"{key} not in {which} states of tag {self.tag}")
+        kind, obj, k = entry
+        if kind == "sharded":
+            return obj.read_full(k)
+        return np.asarray(obj)
+
+    def metadata(self, which="model"):
+        """Non-array part of the state (skeleton scalars/strings)."""
+        kind, tree_or_skel, _ = self._files[which]
+        return _strip_arrays(tree_or_skel)
+
+    def has(self, which):
+        return which in self._files
+
+    def close(self):
+        for kind, _, reader in self._files.values():
+            if reader is not None:
+                reader.close()
+
+
+def _strip_arrays(node):
+    if isinstance(node, dict):
+        if set(node.keys()) == {"__ds_sharded__"}:
+            return None
+        return {k: _strip_arrays(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_strip_arrays(v) for v in node]
+    if hasattr(node, "shape") and hasattr(node, "dtype") and getattr(node, "ndim", 1) > 0:
+        return None
+    if isinstance(node, (np.integer, np.floating, np.bool_)):
+        return node.item()
+    if hasattr(node, "item") and getattr(node, "ndim", None) == 0:
+        return node.item()
+    return node
+
+
+def _param_dir(out_dir, param_path):
+    # param paths are filesystem-safe already ("/"-joined identifiers)
+    return os.path.join(out_dir, "zero", param_path)
+
+
+def ds_to_universal(checkpoint_dir, output_dir, tag=None):
+    """Consolidate a saved tag into the universal fp32 layout, one
+    parameter at a time (peak host memory = largest single parameter).
+
+    Mirrors the extract/merge pipeline of the reference's
+    ``ds_to_universal.py:main`` — the chunk index plays the role of the
+    per-rank fragment files, so no merge workers are needed."""
+    reader = TagReader(checkpoint_dir, tag)
+    os.makedirs(output_dir, exist_ok=True)
+
+    module_prefix = "module/"
+    master_prefix = "fp32_master_params/"
+    opt_prefix = "optimizer_state_dict/"
+
+    model_keys = reader.array_keys("model")
+    param_paths = [k[len(module_prefix):] for k in model_keys if k.startswith(module_prefix)]
+
+    optim_keys = reader.array_keys("optim") if reader.has("optim") else []
+    masters = {k[len(master_prefix):]: k for k in optim_keys if k.startswith(master_prefix)}
+    moments = {}  # param_path -> {moment_name: key}
+    scalars = {}
+    for k in optim_keys:
+        if not k.startswith(opt_prefix):
+            continue
+        rest = k[len(opt_prefix):]
+        head, _, sub = rest.partition("/")
+        if sub and sub in set(param_paths):
+            moments.setdefault(sub, {})[head] = k
+        elif not sub:
+            arr = reader.read("optim", k)
+            if arr.ndim == 0:
+                scalars[head] = arr.item()
+
+    index = {}
+    for p in param_paths:
+        pdir = _param_dir(output_dir, p)
+        os.makedirs(pdir, exist_ok=True)
+        if p in masters:
+            fp32 = reader.read("optim", masters[p]).astype(np.float32)
+        else:
+            fp32 = reader.read("model", module_prefix + p).astype(np.float32)
+        np.save(os.path.join(pdir, f"{ZERO_FP32}.npy"), fp32)
+        entry = {"shape": list(fp32.shape), "moments": []}
+        for mname, mkey in moments.get(p, {}).items():
+            np.save(os.path.join(pdir, f"{mname}.npy"), reader.read("optim", mkey))
+            entry["moments"].append(mname)
+        index[p] = entry
+        del fp32
+
+    meta = reader.metadata("model")
+    universal = {
+        "universal_format_version": 1,
+        "source_tag": reader.tag,
+        "ds_version": meta.get("ds_version"),
+        "global_steps": meta.get("global_steps", 0),
+        "global_samples": meta.get("global_samples", 0),
+        "skipped_steps": meta.get("skipped_steps", 0),
+        "micro_steps": meta.get("micro_steps", 0),
+        "lr_scheduler": meta.get("lr_scheduler"),
+        "client_state": meta.get("client_state", {}),
+        "optimizer_scalars": scalars,
+        "params": index,
+    }
+    reader.close()
+    with open(os.path.join(output_dir, UNIVERSAL_METADATA), "w") as f:
+        json.dump(universal, f, indent=1)
+    return output_dir
+
+
+def is_universal_dir(path):
+    return os.path.isfile(os.path.join(path, UNIVERSAL_METADATA))
+
+
+def load_universal_metadata(udir):
+    with open(os.path.join(udir, UNIVERSAL_METADATA)) as f:
+        return json.load(f)
+
+
+def read_universal_param(udir, param_path, name=ZERO_FP32, mmap=True):
+    path = os.path.join(_param_dir(udir, param_path), f"{name}.npy")
+    return np.load(path, mmap_mode="r" if mmap else None)

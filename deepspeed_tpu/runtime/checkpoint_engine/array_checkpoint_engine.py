@@ -45,6 +45,12 @@ class ArrayCheckpointEngine(CheckpointEngine):
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, path)
+        # a stale sharded payload at the same path would shadow this file
+        # on load (readers prefer the chunk store)
+        stale_shards = path + ".shards"
+        if os.path.isdir(stale_shards):
+            import shutil
+            shutil.rmtree(stale_shards, ignore_errors=True)
         logger.debug(f"[DeepSpeedTPU] Saved {path}.")
 
     def load(self, path: str, map_location=None):

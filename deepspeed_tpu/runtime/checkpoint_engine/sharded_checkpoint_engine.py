@@ -1,0 +1,372 @@
+"""Sharded checkpoint engine: chunked per-shard save with a global index.
+
+TPU-native replacement for the reference's ZeRO checkpoint layout
+(``deepspeed/runtime/engine.py:3056`` saves per-mp-rank model states and
+per-dp-rank zero shards; ``deepspeed/runtime/zero/stage3.py`` gathers
+partitions on load). Instead of rank-sliced flat buffers, every array is
+stored as *global-coordinate chunks*: each process writes exactly the
+shards it addresses (replica 0 only), and an index maps byte ranges to
+global slices. Loading assembles any target sharding from the chunk
+intersections, so a checkpoint written on one mesh (dp×tp×pp×sp) loads
+onto any other — mesh resize and even ZeRO-stage changes come for free,
+without ever materializing a full array per host beyond one leaf's
+target-shard slice.
+
+Layout (``<path>`` is the metadata file, e.g. ``mp_rank_00_model_states.pt``):
+
+- ``<path>``                 msgpack skeleton: tree structure, scalars,
+                             strings; array leaves replaced by
+                             ``{"__ds_sharded__": <key>}`` markers
+- ``<path>.shards/index.json``        per-key shape/dtype (written by rank 0)
+- ``<path>.shards/chunks_p{N}.json``  chunk records of process N
+- ``<path>.shards/data_p{N}.bin``     raw chunk payloads of process N
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+_MARKER = "__ds_sharded__"
+
+
+# ----------------------------------------------------------------------
+# Path-keyed flattening (shared with name-keyed tree matching)
+# ----------------------------------------------------------------------
+def _is_array(x):
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def flatten_named(tree, prefix=""):
+    """Flatten a nested dict/list/tuple tree into ``[(path, leaf)]`` with
+    deterministic, structure-independent path strings: dict keys joined
+    with ``/``, sequence positions as ``#i``. Sorting is by path so two
+    trees with different dict insertion orders align identically."""
+    out = []
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            for k in sorted(node.keys(), key=str):
+                rec(node[k], f"{path}/{k}" if path else str(k))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                rec(v, f"{path}/#{i}" if path else f"#{i}")
+        else:
+            out.append((path, node))
+
+    rec(tree, prefix)
+    return out
+
+
+def match_named_tree(loaded, reference, strict=True):
+    """Rebuild ``loaded`` in the structure of ``reference``, pairing
+    leaves by *path name* rather than flat order (the reference pairs by
+    name via state-dict keys; order-pairing silently mis-assigns when a
+    treedef changes). ``strict=False`` keeps the reference leaf where the
+    checkpoint has no matching path."""
+    loaded_map = dict(flatten_named(loaded))
+    ref_named = flatten_named(reference)
+    missing = [p for p, _ in ref_named if p not in loaded_map]
+    if missing and strict:
+        extra = [p for p in loaded_map if p not in {q for q, _ in ref_named}]
+        raise KeyError(f"checkpoint is missing {len(missing)} keys (e.g. {missing[:5]}); "
+                       f"has {len(extra)} unexpected keys (e.g. {extra[:5]})")
+
+    def rec(ref_node, path):
+        if isinstance(ref_node, dict):
+            return {k: rec(v, f"{path}/{k}" if path else str(k)) for k, v in ref_node.items()}
+        if isinstance(ref_node, (list, tuple)):
+            vals = [rec(v, f"{path}/#{i}" if path else f"#{i}") for i, v in enumerate(ref_node)]
+            return type(ref_node)(vals) if isinstance(ref_node, tuple) else vals
+        return loaded_map.get(path, ref_node)
+
+    return rec(reference, "")
+
+
+def _skeletonize(tree):
+    """Split a tree into a JSON/msgpack-able skeleton (arrays replaced by
+    markers) and the list of ``(key, array)`` payloads."""
+    arrays = []
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            return {k: rec(v, f"{path}/{k}" if path else str(k)) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return [rec(v, f"{path}/#{i}" if path else f"#{i}") for i, v in enumerate(node)]
+        if _is_array(node):
+            arrays.append((path, node))
+            return {_MARKER: path}
+        if isinstance(node, (np.integer, np.floating, np.bool_)):
+            return node.item()
+        return node
+
+    return rec(tree, ""), arrays
+
+
+def _normalize_index(idx, shape):
+    """Global slice tuple → [[start, stop], ...] (rank-0 arrays → [])."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start, stop, step = sl.indices(dim)
+        assert step == 1, "strided shards are not supported"
+        out.append([start, stop])
+    return out
+
+
+class _ChunkWriter:
+    """Appends raw array bytes to this process's data file."""
+
+    def __init__(self, shard_dir, proc_index):
+        os.makedirs(shard_dir, exist_ok=True)
+        self.proc = proc_index
+        self.data_path = os.path.join(shard_dir, f"data_p{proc_index}.bin")
+        self.chunks_path = os.path.join(shard_dir, f"chunks_p{proc_index}.json")
+        self._f = open(self.data_path + ".tmp", "wb")
+        self._offset = 0
+        self.records = []
+        self.meta = {}  # key -> {shape, dtype}
+
+    def add(self, key, arr):
+        if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+            self.meta[key] = {"shape": list(arr.shape), "dtype": arr.dtype.name}
+            seen = set()
+            for shard in arr.addressable_shards:
+                if shard.replica_id != 0:
+                    continue  # another device holds the same global slice
+                coords = tuple(tuple(se) for se in _normalize_index(shard.index, arr.shape))
+                if coords in seen:
+                    continue
+                seen.add(coords)
+                self._write(key, np.asarray(shard.data), [list(se) for se in coords])
+        else:
+            npa = np.asarray(arr)
+            self.meta[key] = {"shape": list(npa.shape), "dtype": npa.dtype.name}
+            if self.proc == 0:  # host-replicated value: rank 0 owns it
+                self._write(key, npa, [[0, d] for d in npa.shape])
+
+    def _write(self, key, data, index):
+        data = np.ascontiguousarray(data)
+        self.records.append({
+            "key": key,
+            "index": index,
+            "offset": self._offset,
+            "nbytes": int(data.nbytes),
+            "dtype": data.dtype.name,
+        })
+        self._f.write(data.tobytes())
+        self._offset += data.nbytes
+
+    def finish(self):
+        self._f.close()
+        os.replace(self.data_path + ".tmp", self.data_path)
+        with open(self.chunks_path + ".tmp", "w") as f:
+            json.dump(self.records, f)
+        os.replace(self.chunks_path + ".tmp", self.chunks_path)
+
+
+class ShardedReader:
+    """Reads any global slice of any key from a chunked checkpoint dir."""
+
+    def __init__(self, shard_dir):
+        self.dir = shard_dir
+        with open(os.path.join(shard_dir, "index.json")) as f:
+            self.meta = json.load(f)["arrays"]
+        self._chunks = {}  # key -> [record+file]
+        for cpath in sorted(glob.glob(os.path.join(shard_dir, "chunks_p*.json"))):
+            proc = os.path.basename(cpath)[len("chunks_p"):-len(".json")]
+            dfile = os.path.join(shard_dir, f"data_p{proc}.bin")
+            with open(cpath) as f:
+                for rec in json.load(f):
+                    rec["file"] = dfile
+                    self._chunks.setdefault(rec["key"], []).append(rec)
+        self._mmaps = {}
+
+    def keys(self):
+        return list(self.meta.keys())
+
+    def shape_dtype(self, key):
+        m = self.meta[key]
+        return tuple(m["shape"]), np.dtype(m["dtype"])
+
+    def _mmap(self, path):
+        if path not in self._mmaps:
+            self._mmaps[path] = np.memmap(path, dtype=np.uint8, mode="r")
+        return self._mmaps[path]
+
+    def read_slice(self, key, index):
+        """Assemble the global slice ``index`` ([[start, stop], ...]) of
+        ``key`` from the chunks that intersect it."""
+        shape, dtype = self.shape_dtype(key)
+        tgt = [(int(s), int(e)) for s, e in index]
+        out_shape = tuple(e - s for s, e in tgt)
+        out = np.empty(out_shape, dtype=dtype)
+        filled = 0
+        for rec in self._chunks.get(key, ()):
+            src = [(int(s), int(e)) for s, e in rec["index"]]
+            inter = [(max(ts, ss), min(te, se)) for (ts, te), (ss, se) in zip(tgt, src)]
+            if any(s >= e for s, e in inter):
+                continue
+            chunk_shape = tuple(e - s for s, e in src)
+            raw = self._mmap(rec["file"])[rec["offset"]:rec["offset"] + rec["nbytes"]]
+            chunk = raw.view(np.dtype(rec["dtype"])).reshape(chunk_shape)
+            src_sel = tuple(slice(s - ss, e - ss) for (s, e), (ss, _) in zip(inter, src))
+            dst_sel = tuple(slice(s - ts, e - ts) for (s, e), (ts, _) in zip(inter, tgt))
+            out[dst_sel] = chunk[src_sel]
+            filled += int(np.prod([e - s for s, e in inter]))
+        want = int(np.prod(out_shape))
+        if filled < want:
+            raise ValueError(f"checkpoint chunks cover only {filled}/{want} elements of "
+                             f"'{key}' slice {tgt} — missing shard files?")
+        return out
+
+    def read_full(self, key):
+        shape, _ = self.shape_dtype(key)
+        return self.read_slice(key, [[0, d] for d in shape])
+
+    def place(self, key, like):
+        """Build a jax.Array for ``key`` with ``like``'s sharding/dtype,
+        reading only the slices this process addresses."""
+        shape, _ = self.shape_dtype(key)
+        sharding = like.sharding
+        target_dtype = like.dtype
+        idx_map = sharding.addressable_devices_indices_map(tuple(shape))
+        cache = {}
+        bufs = []
+        for dev, idx in idx_map.items():
+            coords = tuple(tuple(se) for se in _normalize_index(idx, shape))
+            if coords not in cache:
+                cache[coords] = self.read_slice(key, [list(se) for se in coords]).astype(target_dtype)
+            bufs.append(jax.device_put(cache[coords], dev))
+        return jax.make_array_from_single_device_arrays(tuple(shape), sharding, bufs)
+
+    def close(self):
+        self._mmaps.clear()
+
+
+def _resolve_markers(skeleton, resolve):
+    """Walk a skeleton, replacing ``{_MARKER: key}`` via ``resolve(key)``."""
+    if isinstance(skeleton, dict):
+        if set(skeleton.keys()) == {_MARKER}:
+            return resolve(skeleton[_MARKER])
+        return {k: _resolve_markers(v, resolve) for k, v in skeleton.items()}
+    if isinstance(skeleton, (list, tuple)):
+        return [_resolve_markers(v, resolve) for v in skeleton]
+    return skeleton
+
+
+class ShardedCheckpointEngine(CheckpointEngine):
+    """Collective save: every process calls ``save``; each writes only its
+    addressable (replica-0) shards. Rank 0 additionally writes the
+    skeleton + index."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+
+    @staticmethod
+    def shard_dir(path):
+        return path + ".shards"
+
+    @staticmethod
+    def is_sharded(path):
+        return os.path.isdir(ShardedCheckpointEngine.shard_dir(path)) or (
+            os.path.isfile(path) and _peek_is_sharded(path))
+
+    def create(self, tag):
+        log_dist(f"[DeepSpeedTPU] Saving sharded checkpoint: {tag}", ranks=[0])
+
+    def save(self, state_dict, path: str):
+        from deepspeed_tpu import comm as dist
+        proc = dist.get_process_rank() if dist.is_initialized() else 0
+        skeleton, arrays = _skeletonize(state_dict)
+        sdir = self.shard_dir(path)
+        # Stale chunks from a previous save with more processes (or a
+        # different layout) would merge into future reads: clear first.
+        if proc == 0 and os.path.isdir(sdir):
+            for f in os.listdir(sdir):
+                os.unlink(os.path.join(sdir, f))
+        _host_sync()  # writes must not start before the clean finishes
+        writer = _ChunkWriter(sdir, proc)
+        for key, arr in arrays:
+            writer.add(key, arr)
+        writer.finish()
+        if proc == 0:
+            with open(os.path.join(sdir, "index.json") + ".tmp", "w") as f:
+                json.dump({"version": 1, "arrays": writer.meta}, f)
+            os.replace(os.path.join(sdir, "index.json") + ".tmp", os.path.join(sdir, "index.json"))
+            from flax import serialization
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            blob = serialization.msgpack_serialize({"__ds_sharded_skeleton__": skeleton}, in_place=False)
+            with open(path + ".tmp", "wb") as f:
+                f.write(blob)
+            os.replace(path + ".tmp", path)
+        # save() returning on any process implies every process's shard
+        # files are durable — callers may then advance 'latest'
+        _host_sync()
+        logger.debug(f"[DeepSpeedTPU] Saved sharded {path}.")
+
+    def load(self, path: str, map_location=None):
+        """Eager load: assemble every array in full (host memory bound =
+        one leaf at a time + the resulting tree)."""
+        skeleton = load_skeleton(path)
+        reader = ShardedReader(self.shard_dir(path))
+        try:
+            return _resolve_markers(skeleton, reader.read_full)
+        finally:
+            reader.close()
+
+    def load_onto(self, path: str, target_tree):
+        """Shard-aware load: array leaves matched by name are placed
+        directly onto the target leaves' shardings; non-array leaves are
+        returned eagerly. Bound: one target shard slice per leaf."""
+        skeleton = load_skeleton(path)
+        reader = ShardedReader(self.shard_dir(path))
+        targets = {p: l for p, l in flatten_named(target_tree) if isinstance(l, jax.Array)}
+
+        def resolve(key):
+            like = targets.get(key)
+            if like is not None and hasattr(like, "sharding"):
+                return reader.place(key, like)
+            return reader.read_full(key)
+
+        try:
+            return _resolve_markers(skeleton, resolve)
+        finally:
+            reader.close()
+
+    def commit(self, tag):
+        logger.debug(f"[DeepSpeedTPU] Sharded checkpoint {tag} ready.")
+        return True
+
+
+def _host_sync():
+    """Host-plane barrier across processes (no-op single-process)."""
+    from deepspeed_tpu import comm as dist
+    if dist.is_initialized() and dist.get_process_count() > 1:
+        dist.host_all_gather(np.zeros(1, np.float32))
+
+
+def _peek_is_sharded(path):
+    try:
+        from flax import serialization
+        with open(path, "rb") as f:
+            blob = f.read(4096)
+        # cheap structural probe: the skeleton key appears verbatim in msgpack
+        return b"__ds_sharded_skeleton__" in blob
+    except OSError:
+        return False
+
+
+def load_skeleton(path):
+    from flax import serialization
+    with open(path, "rb") as f:
+        blob = f.read()
+    state = serialization.msgpack_restore(blob)
+    if "__ds_sharded_skeleton__" not in state:
+        raise ValueError(f"{path} is not a sharded checkpoint")
+    return state["__ds_sharded_skeleton__"]

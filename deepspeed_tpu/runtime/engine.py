@@ -46,6 +46,8 @@ from deepspeed_tpu.ops.sgd import SGD
 from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.runtime import lr_schedules
 from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import ArrayCheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import (ShardedCheckpointEngine,
+                                                                              flatten_named, match_named_tree)
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER,
                                              LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
@@ -191,7 +193,12 @@ class DeepSpeedEngine:
             steps_per_output=self.steps_per_print(),
         )
 
-        self.checkpoint_engine = ArrayCheckpointEngine()
+        # Sharded (chunk-indexed, mesh-resizable) checkpoints by default;
+        # `"checkpoint": {"sharded": false}` selects consolidated msgpack.
+        if self._config.checkpoint_config.get("sharded", True):
+            self.checkpoint_engine = ShardedCheckpointEngine()
+        else:
+            self.checkpoint_engine = ArrayCheckpointEngine()
 
         # Data loader
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
@@ -293,12 +300,14 @@ class DeepSpeedEngine:
             # default: Adam
             return FusedAdam()
         name = name.lower()
+        offload = self._config.zero_config.offload_optimizer_device().value != "none"
         if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER):
-            offload = self._config.zero_config.offload_optimizer_device().value == "cpu"
             if offload:
                 return DeepSpeedCPUAdam(adamw_mode=adam_w_mode if adam_w_mode is not None else True, **params)
             return FusedAdam(adam_w_mode=adam_w_mode if adam_w_mode is not None else True, **params)
         if name == ADAMW_OPTIMIZER:
+            if offload:
+                return DeepSpeedCPUAdam(adamw_mode=True, **params)
             return FusedAdam(adam_w_mode=True, **params)
         if name == LAMB_OPTIMIZER:
             return FusedLamb(**params)
@@ -438,6 +447,10 @@ class DeepSpeedEngine:
         if pending is not None:
             self._restore_optim_state(pending)
             self._pending_optim_state = None
+        pending_u = getattr(self, "_pending_universal", None)
+        if pending_u is not None:
+            self._apply_universal(pending_u)
+            self._pending_universal = None
 
     def _opt_state_shardings(self, abstract_state):
         params_treedef = jax.tree.structure(self.params)
@@ -882,9 +895,13 @@ class DeepSpeedEngine:
         tag = str(tag)
         self._validate_checkpoint_tag(tag)
         self.checkpoint_engine.create(tag)
+        sharded = isinstance(self.checkpoint_engine, ShardedCheckpointEngine)
+        # sharded save: leave leaves on device, every process writes its
+        # own shards; consolidated save: host-ify on rank 0 only.
+        ser = (lambda t: t) if sharded else _to_serializable
 
         model_state = {
-            "module": _to_serializable(self.params),
+            "module": ser(self.params),
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
@@ -897,27 +914,27 @@ class DeepSpeedEngine:
         }
         if self.lr_scheduler is not None:
             model_state["lr_scheduler"] = self.lr_scheduler.state_dict()
-        if dist.get_rank() == 0:
+        if sharded or dist.get_process_rank() == 0:
             self.checkpoint_engine.save(model_state, self._get_ckpt_name(save_dir, tag))
 
         if self._host_offload is not None:
             opt_sd = self._host_offload.export_state()
             master_sd = self._host_offload.export_master()
         else:
-            opt_sd = _to_serializable(self.opt_state)
-            master_sd = (_to_serializable(self.master_params)
+            opt_sd = ser(self.opt_state)
+            master_sd = (ser(self.master_params)
                          if self.master_params is not self.params else None)
         optim_state = {
             "optimizer_state_dict": opt_sd,
             "fp32_master_params": master_sd,
-            "scaler_state": _to_serializable(self.scaler_state),
+            "scaler_state": ser(self.scaler_state),
             "optimizer_param_groups": [{k: v for k, v in g.items() if k != "params"}
                                        for g in self.optimizer.param_groups],
         }
-        if dist.get_rank() == 0:
+        if sharded or dist.get_process_rank() == 0:
             self.checkpoint_engine.save(optim_state, self._get_optimizer_ckpt_name(save_dir, tag, dp_rank=0))
 
-        if save_latest and dist.get_rank() == 0:
+        if save_latest and dist.get_process_rank() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as fd:
                 fd.write(tag)
         self.checkpoint_engine.commit(tag)
@@ -944,6 +961,8 @@ class DeepSpeedEngine:
                         load_lr_scheduler_states=True,
                         load_module_only=False,
                         custom_load_fn=None):
+        if self._config.load_universal_checkpoint:
+            return self.load_universal_checkpoint(load_dir, tag)
         if tag is None:
             latest_path = os.path.join(load_dir, "latest")
             if os.path.isfile(latest_path):
@@ -958,16 +977,24 @@ class DeepSpeedEngine:
         if not os.path.isfile(ckpt_name):
             logger.warning(f"Client provided checkpoint load path: {ckpt_name} does not exist")
             return None, {}
-        model_state = self.checkpoint_engine.load(ckpt_name)
-
-        loaded_params = model_state["module"]
-        if self._initialized:
-            # re-place onto existing shardings
-            self.params = jax.tree.map(
-                lambda cur, new, sh: jax.device_put(np.asarray(new).astype(cur.dtype), sh),
-                self.params, _match_tree(loaded_params, self.params), self._param_shardings)
+        reader = self._reader_engine(ckpt_name)
+        if isinstance(reader, ShardedCheckpointEngine) and self._initialized:
+            # place each leaf straight onto its current sharding: reads
+            # only this process's slices, reshards across mesh changes
+            model_state = reader.load_onto(ckpt_name, {"module": self.params})
+            self.params = match_named_tree(model_state["module"], self.params,
+                                           strict=load_module_strict)
         else:
-            self.params = jax.tree.map(lambda x: np.asarray(x), loaded_params)
+            model_state = reader.load(ckpt_name)
+            loaded_params = match_named_tree(model_state["module"], self.params,
+                                            strict=load_module_strict) \
+                if self.params is not None else model_state["module"]
+            if self._initialized:
+                self.params = jax.tree.map(
+                    lambda cur, new, sh: _place_leaf(new, cur.dtype, sh),
+                    self.params, loaded_params, self._param_shardings)
+            else:
+                self.params = jax.tree.map(lambda x: np.asarray(x), loaded_params)
 
         self.global_steps = int(model_state.get("global_steps", 0))
         self.global_samples = int(model_state.get("global_samples", 0))
@@ -985,38 +1012,146 @@ class DeepSpeedEngine:
 
         optim_name = self._get_optimizer_ckpt_name(load_dir, tag, dp_rank=0)
         if os.path.isfile(optim_name):
-            optim_state = self.checkpoint_engine.load(optim_name)
-            self._pending_optim_state = optim_state
             if self._initialized:
-                self._restore_optim_state(optim_state)
+                self._restore_optim_state(self._load_optim_state(optim_name))
+            else:
+                # defer to _materialize_state: shardings don't exist yet,
+                # so a sharded read can't place leaves (and an eager read
+                # would gather the world) — stash the path instead
+                self._pending_optim_state = ("__ckpt_path__", optim_name)
         return load_dir, client_state
 
+    def _reader_engine(self, path):
+        """Pick the engine matching the on-disk format (a sharded write is
+        readable regardless of the configured save engine, and vice versa)."""
+        if ShardedCheckpointEngine.is_sharded(path):
+            return self.checkpoint_engine if isinstance(self.checkpoint_engine, ShardedCheckpointEngine) \
+                else ShardedCheckpointEngine()
+        return self.checkpoint_engine if isinstance(self.checkpoint_engine, ArrayCheckpointEngine) \
+            else ArrayCheckpointEngine()
+
+    def _load_optim_state(self, optim_name):
+        reader = self._reader_engine(optim_name)
+        if isinstance(reader, ShardedCheckpointEngine) and self._initialized and self._host_offload is None:
+            # scaler_state is deliberately absent: its leaves are plain
+            # uncommitted scalars, not mesh-sharded arrays — they load
+            # eagerly via the skeleton fallback
+            target = {
+                "optimizer_state_dict": self.opt_state,
+                "fp32_master_params": (self.master_params
+                                       if self.master_params is not self.params else None),
+            }
+            return reader.load_onto(optim_name, target)
+        return reader.load(optim_name)
+
     def _restore_optim_state(self, optim_state):
+        if isinstance(optim_state, tuple) and optim_state and optim_state[0] == "__ckpt_path__":
+            optim_state = self._load_optim_state(optim_state[1])
         if self._host_offload is not None:
             self._host_offload.load_state(optim_state["optimizer_state_dict"])
             if optim_state.get("fp32_master_params") is not None:
                 self._host_offload.load_master(optim_state["fp32_master_params"])
                 self.params = self._host_offload.current_params()
             if optim_state.get("scaler_state") is not None:
-                self.scaler_state = jax.tree.map(jnp.asarray, _match_tree(optim_state["scaler_state"],
-                                                                          self.scaler_state))
+                self.scaler_state = jax.tree.map(jnp.asarray, match_named_tree(optim_state["scaler_state"],
+                                                                               self.scaler_state))
             for g, g_new in zip(self.optimizer.param_groups, optim_state.get("optimizer_param_groups", [])):
                 g.update(g_new)
             return
-        loaded_opt = _match_tree(optim_state["optimizer_state_dict"], self.opt_state)
+        loaded_opt = match_named_tree(optim_state["optimizer_state_dict"], self.opt_state)
         self.opt_state = jax.tree.map(
-            lambda cur, new: jax.device_put(np.asarray(new).astype(cur.dtype), cur.sharding),
+            lambda cur, new: _place_leaf(new, cur.dtype, cur.sharding),
             self.opt_state, loaded_opt)
         if optim_state.get("fp32_master_params") is not None and self.master_params is not self.params:
-            loaded_m = _match_tree(optim_state["fp32_master_params"], self.master_params)
+            loaded_m = match_named_tree(optim_state["fp32_master_params"], self.master_params)
             self.master_params = jax.tree.map(
-                lambda cur, new: jax.device_put(np.asarray(new).astype(cur.dtype), cur.sharding),
+                lambda cur, new: _place_leaf(new, cur.dtype, cur.sharding),
                 self.master_params, loaded_m)
         if "scaler_state" in optim_state and optim_state["scaler_state"] is not None:
-            self.scaler_state = jax.tree.map(jnp.asarray, _match_tree(optim_state["scaler_state"],
-                                                                      self.scaler_state))
+            self.scaler_state = jax.tree.map(jnp.asarray, match_named_tree(optim_state["scaler_state"],
+                                                                           self.scaler_state))
         for g, g_new in zip(self.optimizer.param_groups, optim_state.get("optimizer_param_groups", [])):
             g.update(g_new)
+
+    # ------------------------------------------------------------------
+    # Universal checkpoint load (reference universal_checkpoint.py:
+    # load_hp_checkpoint_state re-slices consolidated fp32 per rank)
+    # ------------------------------------------------------------------
+    def load_universal_checkpoint(self, load_dir, tag=None):
+        from deepspeed_tpu.checkpoint.universal import is_universal_dir, load_universal_metadata
+        udir = load_dir
+        if not is_universal_dir(udir) and tag is not None:
+            cand = os.path.join(load_dir, str(tag))
+            if is_universal_dir(cand):
+                udir = cand
+        if not is_universal_dir(udir):
+            raise FileNotFoundError(f"{load_dir} is not a universal checkpoint "
+                                    f"(run deepspeed_tpu.checkpoint.ds_to_universal first)")
+        meta = load_universal_metadata(udir)
+        if self._initialized:
+            self._apply_universal(udir)
+        else:
+            self._apply_universal_metadata(meta)
+            self._pending_universal = udir
+        return udir, meta.get("client_state", {})
+
+    def _apply_universal_metadata(self, meta):
+        self.global_steps = int(meta.get("global_steps", 0))
+        self.global_samples = int(meta.get("global_samples", 0))
+        self.skipped_steps = int(meta.get("skipped_steps", 0))
+        self.micro_steps = int(meta.get("micro_steps", 0))
+        if self.lr_scheduler is not None and meta.get("lr_scheduler") is not None:
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+
+    def _apply_universal(self, udir):
+        from deepspeed_tpu.checkpoint.universal import load_universal_metadata, read_universal_param
+        if self._host_offload is not None:
+            raise NotImplementedError("universal checkpoint load with offload_optimizer is not "
+                                      "supported yet; load the sharded checkpoint directly")
+        meta = load_universal_metadata(udir)
+        self._apply_universal_metadata(meta)
+        index = meta.get("params", {})
+        named = dict(flatten_named(self.params))
+        missing = [p for p in named if p not in index]
+        if missing:
+            raise KeyError(f"universal checkpoint missing {len(missing)} params (e.g. {missing[:5]})")
+
+        mixed = self.master_params is not self.params
+        params_treedef = jax.tree.structure(self.params)
+        moment_keys = [k for k, v in self.opt_state.items()
+                       if jax.tree.structure(v) == params_treedef] if isinstance(self.opt_state, dict) else []
+
+        named_master = dict(flatten_named(self.master_params)) if mixed else {}
+        named_moments = {mk: dict(flatten_named(self.opt_state[mk])) for mk in moment_keys}
+        new_params, new_master = {}, {}
+        new_moments = {k: {} for k in moment_keys}
+        for p, cur in named.items():
+            fp32 = read_universal_param(udir, p)  # mmap'd; sliced per shard
+            shape = tuple(fp32.shape)
+            new_params[p] = _place_np(fp32, cur.dtype, cur.sharding, shape)
+            if mixed:
+                mleaf = named_master[p]
+                new_master[p] = _place_np(fp32, mleaf.dtype, mleaf.sharding, shape)
+            for mk in moment_keys:
+                oleaf = named_moments[mk][p]
+                if mk in index[p].get("moments", []):
+                    mom = read_universal_param(udir, p, name=mk)
+                    new_moments[mk][p] = _place_np(mom, oleaf.dtype, oleaf.sharding, shape)
+                else:
+                    new_moments[mk][p] = jnp.zeros_like(oleaf)
+
+        self.params = match_named_tree(new_params, self.params)
+        if mixed:
+            self.master_params = match_named_tree(new_master, self.master_params)
+        scalars = meta.get("optimizer_scalars", {})
+        if isinstance(self.opt_state, dict):
+            for k in list(self.opt_state.keys()):
+                if k in moment_keys:
+                    self.opt_state[k] = match_named_tree(new_moments[k], self.opt_state[k])
+                elif k in scalars:
+                    cur = self.opt_state[k]
+                    self.opt_state[k] = jax.device_put(
+                        np.asarray(scalars[k]).astype(cur.dtype), cur.sharding)
 
     # module state dict parity
     def module_state_dict(self, exclude_frozen_parameters=False):
@@ -1025,8 +1160,9 @@ class DeepSpeedEngine:
     def load_module_state_dict(self, state_dict, strict=True, custom_load_fn=None):
         if self._initialized:
             self.params = jax.tree.map(
-                lambda cur, new, sh: jax.device_put(np.asarray(new).astype(cur.dtype), sh),
-                self.params, _match_tree(state_dict, self.params), self._param_shardings)
+                lambda cur, new, sh: _place_leaf(new, cur.dtype, sh),
+                self.params, match_named_tree(state_dict, self.params, strict=strict),
+                self._param_shardings)
         else:
             self.params = state_dict
 
@@ -1055,14 +1191,28 @@ def _to_serializable(tree):
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, tree)
 
 
-def _match_tree(loaded, reference):
-    """Restructure a msgpack-loaded dict to match the reference treedef."""
-    ref_treedef = jax.tree.structure(reference)
-    loaded_leaves = jax.tree.leaves(loaded)
-    ref_leaves = jax.tree.leaves(reference)
-    assert len(loaded_leaves) == len(ref_leaves), (
-        f"checkpoint has {len(loaded_leaves)} tensors, model expects {len(ref_leaves)}")
-    return jax.tree.unflatten(ref_treedef, loaded_leaves)
+def _place_np(arr, dtype, sharding, shape):
+    """Place a host (possibly mem-mapped) array onto ``sharding``,
+    reading only the slices the addressable devices need."""
+    idx_map = sharding.addressable_devices_indices_map(tuple(shape))
+    cache = {}
+    bufs = []
+    for dev, idx in idx_map.items():
+        key = tuple(sl.indices(d)[:2] for sl, d in zip(idx, shape))
+        if key not in cache:
+            cache[key] = np.ascontiguousarray(np.asarray(arr[idx])).astype(dtype)
+        bufs.append(jax.device_put(cache[key], dev))
+    return jax.make_array_from_single_device_arrays(tuple(shape), sharding, bufs)
+
+
+def _place_leaf(new, dtype, sharding):
+    """Place a loaded leaf on ``sharding`` without a host round-trip when
+    it is already a correctly-placed jax.Array (the sharded-read path)."""
+    if isinstance(new, jax.Array) and getattr(new, "sharding", None) == sharding and new.dtype == dtype:
+        return new
+    if isinstance(new, jax.Array):
+        return jax.device_put(new.astype(dtype), sharding)
+    return jax.device_put(np.asarray(new).astype(dtype), sharding)
 
 
 def _version():

@@ -117,6 +117,10 @@ class PipelineEngine(DeepSpeedEngine):
         if pending is not None:
             self._restore_optim_state(pending)
             self._pending_optim_state = None
+        pending_u = getattr(self, "_pending_universal", None)
+        if pending_u is not None:
+            self._apply_universal(pending_u)
+            self._pending_universal = None
 
     # ------------------------------------------------------------------
     # The fused pipeline program

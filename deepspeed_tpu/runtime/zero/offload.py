@@ -176,7 +176,28 @@ class HostOffloadOptimizer:
                                          p, grad_f32, state["exp_avg"], state["exp_avg_sq"])
                 new_p = p
             else:
-                self.optimizer.step_flat(self.step_count, p, grad_f32, state["exp_avg"], state["exp_avg_sq"], lr=lr)
+                step_fn = getattr(self.optimizer, "step_flat", None)
+                if step_fn is not None:
+                    step_fn(self.step_count, p, grad_f32, state["exp_avg"], state["exp_avg_sq"], lr=lr)
+                else:
+                    # optimizer without a host path (e.g. client FusedAdam):
+                    # in-place NumPy Adam matching DeepSpeedCPUAdam.step_flat
+                    g = grad_f32.astype(np.float32)
+                    adam_w = bool(group.get("adam_w_mode", True))
+                    if wd != 0.0 and not adam_w:
+                        g = g + wd * p
+                    m, v = state["exp_avg"], state["exp_avg_sq"]
+                    np.multiply(m, b1, out=m)
+                    m += (1 - b1) * g
+                    np.multiply(v, b2, out=v)
+                    v += (1 - b2) * np.square(g)
+                    bc1 = 1.0 - b1**self.step_count if bc else 1.0
+                    bc2 = 1.0 - b2**self.step_count if bc else 1.0
+                    denom = np.sqrt(v / bc2) + eps
+                    upd = (m / bc1) / denom
+                    if wd != 0.0 and adam_w:
+                        upd += wd * p
+                    p -= lr * upd
                 new_p = p
         elif self.kind == "adagrad":
             eps = float(group["eps"])
@@ -224,15 +245,16 @@ class HostOffloadOptimizer:
             g_np = np.asarray(jax.device_get(g))
             grad_f32 = self._grad_to_fp32(g_np, size)
             new_p = self._update_region(i, grad_f32, want_bf16)
-            if want_bf16:
-                # new_p views the shared conversion buffer; device_put may be
-                # zero-copy (CPU backend), so snapshot before the next leaf
-                # overwrites it.
+            target_dtype = (ml_dtypes.bfloat16 if want_bf16
+                            else np.dtype(jnp.dtype(self.compute_dtype).name))
+            if new_p.dtype == target_dtype:
+                # new_p views a shared buffer (conversion scratch or the
+                # master region); device_put may be zero-copy (CPU
+                # backend), so snapshot before the next leaf overwrites it
                 host_val = new_p.reshape(self.shapes[i]).copy()
             else:
-                host_val = new_p.reshape(self.shapes[i]).astype(
-                    ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else
-                    np.dtype(self.compute_dtype.__name__))
+                # non-native / non-adam paths return the fp32 master view
+                host_val = new_p.reshape(self.shapes[i]).astype(target_dtype)
             # async upload; placement overlaps the next leaf's SIMD update
             new_leaves.append(jax.device_put(host_val, self._shardings_flat[i]))
         if self.swapper is not None:

@@ -1,0 +1,92 @@
+"""Reconstruct consolidated fp32 weights from a ZeRO checkpoint.
+
+Capability match for the reference's ``deepspeed/utils/zero_to_fp32.py``
+(``get_fp32_state_dict_from_zero_checkpoint``,
+``convert_zero_checkpoint_to_fp32_state_dict``, CLI ``main``). There the
+script merges per-dp-rank flat partitions; here the chunk index already
+carries global coordinates, so reconstruction is a per-parameter
+assembly — fp32 master values when the optimizer saved them, otherwise
+the model weights upcast.
+
+Runnable standalone::
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 ./ckpts pytorch_model.msgpack [--tag t]
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.universal import TagReader
+
+
+def _nest(flat):
+    """{'a/b/#0': v} → nested dicts/lists."""
+    root = {}
+    for path, value in flat.items():
+        parts = path.split("/")
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+
+    def listify(node):
+        if isinstance(node, dict):
+            if node and all(k.startswith("#") for k in node):
+                return [listify(node[f"#{i}"]) for i in range(len(node))]
+            return {k: listify(v) for k, v in node.items()}
+        return node
+
+    return listify(root)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None, lazy_mode=False):
+    """Nested fp32 state dict of the model weights. ``lazy_mode`` returns
+    per-leaf callables so callers can stream one parameter at a time
+    (reference zero_to_fp32.py offers the same escape hatch)."""
+    reader = TagReader(checkpoint_dir, tag)
+    module_prefix = "module/"
+    master_prefix = "fp32_master_params/"
+    masters = set()
+    if reader.has("optim"):
+        masters = {k[len(master_prefix):] for k in reader.array_keys("optim") if k.startswith(master_prefix)}
+
+    def fetch(p):
+        if p in masters:
+            return reader.read("optim", master_prefix + p).astype(np.float32)
+        return reader.read("model", module_prefix + p).astype(np.float32)
+
+    flat = {}
+    for k in reader.array_keys("model"):
+        if not k.startswith(module_prefix):
+            continue
+        p = k[len(module_prefix):]
+        flat[p] = (lambda p=p: fetch(p)) if lazy_mode else fetch(p)
+    return _nest(flat)
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file, tag=None):
+    """Write the consolidated fp32 state dict as flax msgpack."""
+    from flax import serialization
+    state = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+    blob = serialization.msgpack_serialize(state, in_place=False)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    with open(output_file, "wb") as f:
+        f.write(blob)
+    return output_file
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(
+        description="Extract consolidated fp32 weights from a DeepSpeedTPU ZeRO checkpoint")
+    parser.add_argument("checkpoint_dir", help="save_dir containing tag dirs and 'latest'")
+    parser.add_argument("output_file", help="destination msgpack file")
+    parser.add_argument("--tag", default=None)
+    opts = parser.parse_args(args)
+    out = convert_zero_checkpoint_to_fp32_state_dict(opts.checkpoint_dir, opts.output_file, tag=opts.tag)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -166,3 +166,14 @@ def test_offload_lion_and_adagrad():
         base, _ = run_engine(offload=None, steps=3, opt=opt)
         off, _ = run_engine(offload={"device": "cpu"}, steps=3, opt=opt)
         assert np.allclose(base, off, rtol=1e-4, atol=1e-5), f"{opt}: {base} vs {off}"
+
+
+@pytest.mark.parametrize("opt", ["Lion", "Adagrad", "AdamW"])
+def test_offload_bf16_keeps_compute_dtype(opt):
+    """Regression: non-native update paths return the fp32 master view —
+    the uploaded params must still be cast to the compute dtype, or HBM
+    use doubles and every jitted fn retraces."""
+    _, engine = run_engine(offload={"device": "cpu"}, steps=2, opt=opt,
+                           dtype_cfg={"bf16": {"enabled": True}})
+    for leaf in jax.tree.leaves(engine.params):
+        assert leaf.dtype == jnp.bfloat16, f"{opt} offload leaked {leaf.dtype} params"
