@@ -143,6 +143,7 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
 
     optim_keys = reader.array_keys("optim") if reader.has("optim") else []
     masters = {k[len(master_prefix):]: k for k in optim_keys if k.startswith(master_prefix)}
+    param_set = set(param_paths)
     moments = {}  # param_path -> {moment_name: key}
     scalars = {}
     for k in optim_keys:
@@ -150,12 +151,19 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
             continue
         rest = k[len(opt_prefix):]
         head, _, sub = rest.partition("/")
-        if sub and sub in set(param_paths):
+        if sub and sub in param_set:
             moments.setdefault(sub, {})[head] = k
         elif not sub:
             arr = reader.read("optim", k)
             if arr.ndim == 0:
                 scalars[head] = arr.item()
+    scaler_prefix = "scaler_state/"
+    scaler = {}
+    for k in optim_keys:
+        if k.startswith(scaler_prefix):
+            arr = reader.read("optim", k)
+            if arr.ndim == 0:
+                scaler[k[len(scaler_prefix):]] = arr.item()
 
     index = {}
     for p in param_paths:
@@ -174,6 +182,7 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
         del fp32
 
     meta = reader.metadata("model")
+    ometa = reader.metadata("optim") if reader.has("optim") else {}
     universal = {
         "universal_format_version": 1,
         "source_tag": reader.tag,
@@ -185,6 +194,8 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
         "lr_scheduler": meta.get("lr_scheduler"),
         "client_state": meta.get("client_state", {}),
         "optimizer_scalars": scalars,
+        "optimizer_param_groups": ometa.get("optimizer_param_groups"),
+        "scaler_state": scaler or None,
         "params": index,
     }
     reader.close()

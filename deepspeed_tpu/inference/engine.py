@@ -106,7 +106,11 @@ class InferenceEngine:
 
     def _load_checkpoint(self, path):
         from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import ArrayCheckpointEngine
-        state = ArrayCheckpointEngine().load(path)
+        from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import ShardedCheckpointEngine
+        if ShardedCheckpointEngine.is_sharded(path):
+            state = ShardedCheckpointEngine().load(path)
+        else:
+            state = ArrayCheckpointEngine().load(path)
         params = state.get("module", state)
         self._set_params(params)
 

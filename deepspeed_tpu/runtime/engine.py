@@ -888,6 +888,11 @@ class DeepSpeedEngine:
         return os.path.join(checkpoints_path, str(tag),
                             f"zero_pp_rank_{dp_rank}_mp_rank_{mp:02d}_optim_states.pt")
 
+    def _get_optimizer_ckpt_name_sharded(self, checkpoints_path, tag):
+        # canonical rank-0 name: the chunk store spans all dp/mp ranks
+        return os.path.join(checkpoints_path, str(tag),
+                            "zero_pp_rank_0_mp_rank_00_optim_states.pt")
+
     def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True, exclude_frozen_parameters=False):
         assert self._initialized, "cannot save before the first forward/train_batch"
         if tag is None:
@@ -914,8 +919,13 @@ class DeepSpeedEngine:
         }
         if self.lr_scheduler is not None:
             model_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+        # A sharded save is ONE logical chunk store for the whole mesh:
+        # every process must target the same path (global coordinates make
+        # per-mp-rank files meaningless), so pin the mp placeholder.
+        ckpt_name = (self._get_ckpt_name(save_dir, tag, mp_placeholder="00") if sharded
+                     else self._get_ckpt_name(save_dir, tag))
         if sharded or dist.get_process_rank() == 0:
-            self.checkpoint_engine.save(model_state, self._get_ckpt_name(save_dir, tag))
+            self.checkpoint_engine.save(model_state, ckpt_name)
 
         if self._host_offload is not None:
             opt_sd = self._host_offload.export_state()
@@ -931,8 +941,10 @@ class DeepSpeedEngine:
             "optimizer_param_groups": [{k: v for k, v in g.items() if k != "params"}
                                        for g in self.optimizer.param_groups],
         }
+        optim_name = (self._get_optimizer_ckpt_name_sharded(save_dir, tag) if sharded
+                      else self._get_optimizer_ckpt_name(save_dir, tag, dp_rank=0))
         if sharded or dist.get_process_rank() == 0:
-            self.checkpoint_engine.save(optim_state, self._get_optimizer_ckpt_name(save_dir, tag, dp_rank=0))
+            self.checkpoint_engine.save(optim_state, optim_name)
 
         if save_latest and dist.get_process_rank() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as fd:
@@ -975,8 +987,13 @@ class DeepSpeedEngine:
 
         ckpt_name = self._get_ckpt_name(load_dir, tag)
         if not os.path.isfile(ckpt_name):
-            logger.warning(f"Client provided checkpoint load path: {ckpt_name} does not exist")
-            return None, {}
+            # sharded saves are written once under the canonical mp rank
+            canonical = self._get_ckpt_name(load_dir, tag, mp_placeholder="00")
+            if os.path.isfile(canonical):
+                ckpt_name = canonical
+            else:
+                logger.warning(f"Client provided checkpoint load path: {ckpt_name} does not exist")
+                return None, {}
         reader = self._reader_engine(ckpt_name)
         if isinstance(reader, ShardedCheckpointEngine) and self._initialized:
             # place each leaf straight onto its current sharding: reads
@@ -1011,6 +1028,8 @@ class DeepSpeedEngine:
             return load_dir, client_state
 
         optim_name = self._get_optimizer_ckpt_name(load_dir, tag, dp_rank=0)
+        if not os.path.isfile(optim_name):
+            optim_name = self._get_optimizer_ckpt_name_sharded(load_dir, tag)
         if os.path.isfile(optim_name):
             if self._initialized:
                 self._restore_optim_state(self._load_optim_state(optim_name))
@@ -1102,6 +1121,13 @@ class DeepSpeedEngine:
         self.micro_steps = int(meta.get("micro_steps", 0))
         if self.lr_scheduler is not None and meta.get("lr_scheduler") is not None:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        for g, g_new in zip(self.optimizer.param_groups, meta.get("optimizer_param_groups") or []):
+            g.update(g_new)
+        if meta.get("scaler_state"):
+            for k, v in meta["scaler_state"].items():
+                if k in self.scaler_state:
+                    cur = self.scaler_state[k]
+                    self.scaler_state[k] = jnp.asarray(v, getattr(cur, "dtype", jnp.float32))
 
     def _apply_universal(self, udir):
         from deepspeed_tpu.checkpoint.universal import load_universal_metadata, read_universal_param

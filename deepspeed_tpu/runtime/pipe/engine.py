@@ -42,7 +42,7 @@ from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _is_float
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.schedule import InferenceSchedule, TrainSchedule
-from deepspeed_tpu.runtime.zero.partitioning import batch_spec
+from deepspeed_tpu.runtime.zero.partitioning import batch_spec, path_tree_map
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.utils.timer import TRAIN_BATCH_TIMER
 
@@ -87,13 +87,15 @@ class PipelineEngine(DeepSpeedEngine):
             _, self._act_struct = jax.eval_shape(
                 lambda r: self.module.init(r, sample_inputs), self._param_rng)
 
-        # Shardings: params replicated over 'pipe' (each stage reads only
-        # its layers); ZeRO/TP placement over the other axes comes from
-        # the sharding policy exactly as in the base engine.
-        self._param_shardings = self.sharding_policy.tree_param_shardings(self.params)
-        self._param_specs = self.sharding_policy.tree_param_specs(self.params)
-        self._opt_shardings = self.sharding_policy.tree_opt_shardings(self.params)
-        self._grad_specs = self.sharding_policy.tree_grad_specs(self.params)
+        # Shardings: stacked body params carry their stage dim on 'pipe'
+        # (each device materializes ONLY its own stage's layers — the
+        # parameter-memory half of pipeline parallelism); prologue/
+        # epilogue params are pipe-replicated. ZeRO placement over the
+        # other axes composes on the inner dims via the sharding policy.
+        self._param_shardings = self._pipe_tree_shardings(self.params, self.sharding_policy.param_spec)
+        self._param_specs = self._pipe_tree_specs(self.params, self.sharding_policy.param_spec)
+        self._opt_shardings = self._pipe_tree_shardings(self.params, self.sharding_policy.opt_spec)
+        self._grad_specs = self._pipe_tree_specs(self.params, self.sharding_policy.grad_spec)
         self.params = jax.tree.map(lambda x, s: jax.device_put(x, s),
                                    self.params, self._param_shardings)
 
@@ -121,6 +123,25 @@ class PipelineEngine(DeepSpeedEngine):
         if pending_u is not None:
             self._apply_universal(pending_u)
             self._pending_universal = None
+
+    # ------------------------------------------------------------------
+    # Sharding-spec composition for the stacked layout
+    # ------------------------------------------------------------------
+    def _pipe_spec(self, path, leaf_shape, base_fn):
+        """P('pipe', None, *policy-spec-of-inner-dims) for stacked body
+        leaves; the plain policy spec (pipe-replicated) otherwise."""
+        if self.module.is_stacked and path.startswith("blocks/"):
+            inner = tuple(leaf_shape[2:])
+            base = tuple(base_fn(path, inner))
+            return P("pipe", None, *base)
+        return base_fn(path, leaf_shape)
+
+    def _pipe_tree_specs(self, params, base_fn):
+        return path_tree_map(lambda path, x: self._pipe_spec(path, x.shape, base_fn), params)
+
+    def _pipe_tree_shardings(self, params, base_fn):
+        return path_tree_map(
+            lambda path, x: NamedSharding(self.mesh, self._pipe_spec(path, x.shape, base_fn)), params)
 
     # ------------------------------------------------------------------
     # The fused pipeline program
@@ -157,12 +178,37 @@ class PipelineEngine(DeepSpeedEngine):
             h0 = jnp.zeros(act_struct.shape, compute_dtype) if act_struct is not None \
                 else jnp.zeros((), compute_dtype)
 
+            stacked = module.is_stacked and n_stages > 1
+            if stacked:
+                # local view of the stage dim is size 1 (split over 'pipe')
+                blocks_local = jax.tree.map(lambda x: x[0], params["blocks"])
+                other = {k: v for k, v in params.items() if k != "blocks"}
+
             def tick(h, t):
                 mb = jnp.clip(t - p, 0, M - 1)
                 valid = jnp.logical_and(t - p >= 0, t - p < M)
                 x_mb = jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(a, mb, 0, False), inputs)
                 l_mb = jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(a, mb, 0, False), labels)
-                h_out, loss_c = module.stage_step(params, p, x_mb, l_mb, h)
+                if stacked:
+                    # Stage 0 embeds its micro-batch; later stages consume
+                    # the permuted boundary activation. All pipe ranks then
+                    # run the SAME scan over their local blocks, so GSPMD
+                    # collectives over the auto axes stay uniform.
+                    x = jax.lax.cond(p == 0,
+                                     lambda op: module.prologue_apply(other, op[0]),
+                                     lambda op: op[1], (x_mb, h))
+
+                    def body(c, bp):
+                        return module.block_apply(bp, c), None
+
+                    x, _ = jax.lax.scan(body, x, blocks_local)
+                    loss_c = jax.lax.cond(
+                        p == n_stages - 1,
+                        lambda xx: module.epilogue_loss(other, xx, l_mb),
+                        lambda xx: jnp.zeros((), jnp.float32), x)
+                    h_out = x
+                else:
+                    h_out, loss_c = module.stage_step(params, p, x_mb, l_mb, h)
                 loss_c = jnp.where(valid, loss_c, 0.0)
                 if n_stages > 1:
                     h_next = jax.lax.ppermute(h_out, "pipe",
@@ -180,7 +226,9 @@ class PipelineEngine(DeepSpeedEngine):
             return total
 
         if n_stages > 1:
-            param_specs = jax.tree.map(lambda _: P(), self.master_params)
+            param_specs = path_tree_map(
+                lambda path, _: P("pipe") if (module.is_stacked and path.startswith("blocks/")) else P(),
+                self.master_params)
             return jax.shard_map(inner, mesh=mesh,
                                  in_specs=(param_specs, P(), P(), P()),
                                  out_specs=P(), axis_names={"pipe"}, check_vma=False)
@@ -193,10 +241,23 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn = self._pipeline_loss_fn()
         tied = self.master_params is self.params
 
+        param_shardings = self._param_shardings
+
+        def gathered_loss(master, inputs, labels, scale):
+            # Re-place the (ZeRO-sharded) fp32 master onto the PARAM
+            # shardings before the pipeline shard_map: GSPMD emits the
+            # ZeRO-1 pre-forward all-gather in auto mode, and the manual
+            # 'pipe' boundary sees operands already in its layout (a
+            # mismatched reshard at that boundary aborts XLA's SPMD
+            # partitioner: spmd_partitioner_util.cc CHECK).
+            master = jax.tree.map(
+                lambda x, s: jax.lax.with_sharding_constraint(x, s), master, param_shardings)
+            return loss_fn(master, inputs, labels, scale)
+
         def body(params, master, opt_state, scaler_st, lr, inputs, labels):
             scale = scaler_st["cur_scale"]
             # Differentiate w.r.t. the fp32 master copy (see _pipeline_loss_fn)
-            scaled_loss, grads = jax.value_and_grad(loss_fn)(master, inputs, labels, scale)
+            scaled_loss, grads = jax.value_and_grad(gathered_loss)(master, inputs, labels, scale)
             new_params, new_master, new_opt, new_scaler, gnorm, overflow = self._update_math(
                 params, master, opt_state, grads, scaler_st, lr)
             mean_loss = scaled_loss / scale

@@ -58,6 +58,8 @@ def _is_flax_module(obj):
     return hasattr(obj, "init") and hasattr(obj, "apply")
 
 
+
+
 class PipelineModule:
     """A sequence of layers partitioned into pipeline stages.
 
@@ -112,6 +114,11 @@ class PipelineModule:
                 self.tied_forward.append(None)
         self.parts = None  # stage boundaries, computed in plan_partition
         self._parts_provisional = False
+        # Stacked-body pipeline (set by init when a homogeneous run of
+        # layers is found): {"start", "n_body", "bps"}. Stage-local
+        # parameter memory comes from stacking those layers' params as
+        # [num_stages, bps, ...] sharded over the 'pipe' mesh axis.
+        self.stack = None
 
     # ------------------------------------------------------------------
     @property
@@ -135,7 +142,8 @@ class PipelineModule:
         """Returns (params, activation_struct): params is a dict keyed by
         layer name; activation_struct is the inter-stage h ShapeDtype.
         Also finalizes the stage partition (param counts become known here,
-        so 'parameters' balancing takes effect)."""
+        so 'parameters' balancing takes effect), and detects a stackable
+        homogeneous layer run (see :meth:`_detect_stack`)."""
         params = {}
         x = first_stage_args if len(first_stage_args) > 1 else first_stage_args[0]
         structs = []
@@ -158,11 +166,114 @@ class PipelineModule:
                 x = layer(x)
                 counts.append(0)
             structs.append(jax.eval_shape(lambda v: v, x))
+        self._detect_stack(params)
+        if self.stack is not None:
+            st = self.stack
+            body_names = [self._param_name(i) for i in range(st["start"], st["start"] + st["n_body"])]
+            body_params = [params.pop(nm) for nm in body_names]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *body_params)
+            params["blocks"] = jax.tree.map(
+                lambda a: a.reshape((self.num_stages, st["bps"]) + a.shape[1:]), stacked)
+            boundary_struct = structs[st["start"]]
+            return params, boundary_struct
         parts = self.plan_partition(param_counts=counts)
         # Activation crossing the first stage boundary (uniform across
         # boundaries for a well-formed pipeline).
         boundary_struct = structs[parts[1] - 1] if len(parts) > 2 else None
         return params, boundary_struct
+
+    # ------------------------------------------------------------------
+    # Stacked-body mode: stage-local parameter partitioning
+    # ------------------------------------------------------------------
+    def _detect_stack(self, params):
+        """Find the longest run of consecutive layers with identical class
+        and param shapes (the transformer body). With ``num_stages`` > 1
+        the run's params stack as [num_stages, bps, ...] and shard over
+        'pipe', so each device materializes only its own stage's layers —
+        the TPU-native analogue of the reference's per-stage layer
+        ownership (``deepspeed/runtime/pipe/module.py:370``). Layers
+        outside the run execute as stage-0 prologue / last-stage epilogue
+        with pipe-replicated (typically small: embed/norm/head) params."""
+        self.stack = None
+        S = self.num_stages
+        if S <= 1:
+            return
+
+        def signature(idx):
+            if self.tied_keys[idx] is not None or not _is_flax_module(self.layer_objs[idx]):
+                return None
+            lp = params.get(self._param_name(idx))
+            if not lp or not jax.tree.leaves(lp):
+                return None
+            from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import flatten_named
+            shapes = tuple((str(p), tuple(l.shape), str(l.dtype))
+                           for p, l in flatten_named(lp))
+            return (type(self.layer_objs[idx]).__name__, shapes)
+
+        sigs = [signature(i) for i in range(self.num_layers())]
+        best = (0, 0)  # (length, start)
+        i = 0
+        while i < len(sigs):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[0]:
+                best = (j - i, i)
+            i = j
+        run_len, start = best
+        if run_len < S:
+            return
+        bps = run_len // S
+        n_body = bps * S  # tail of the run beyond a multiple joins the epilogue
+        self.stack = {"start": start, "n_body": n_body, "bps": bps}
+        # Stage boundaries: prologue + first bps blocks on stage 0; the
+        # epilogue rides the last stage.
+        self.parts = [0] + [start + s * bps for s in range(1, S)] + [self.num_layers()]
+        self._parts_provisional = False
+
+    @property
+    def is_stacked(self):
+        return self.stack is not None
+
+    def prologue_apply(self, params, x):
+        """Layers before the stacked body (stage 0 only)."""
+        for i in range(self.stack["start"]):
+            x = self._apply_one(i, params.get(self._param_name(i), {}), x)
+        return x
+
+    def block_apply(self, block_params, x):
+        """One homogeneous body block with the given (unstacked) params."""
+        layer = self.layer_objs[self.stack["start"]]
+        return layer.apply({"params": block_params}, x)
+
+    def epilogue_loss(self, params, x, labels):
+        """Layers after the stacked body + the loss (last stage only)."""
+        st = self.stack
+        for i in range(st["start"] + st["n_body"], self.num_layers()):
+            x = self._apply_one(i, params.get(self._param_name(i), {}), x)
+        loss = (self.loss_fn(x, labels) if self.loss_fn is not None
+                else jnp.zeros((), jnp.float32))
+        return loss.astype(jnp.float32)
+
+    def sequential_apply(self, params, x, labels):
+        """Reference (unpipelined) loss with engine-layout params — used
+        by equivalence tests; handles both stacked and legacy layouts."""
+        if self.stack is None:
+            for i in range(self.num_layers()):
+                x = self._apply_one(i, params.get(self._param_name(i), {}), x)
+            loss = (self.loss_fn(x, labels) if self.loss_fn is not None
+                    else jnp.zeros((), jnp.float32))
+            return loss.astype(jnp.float32)
+        st = self.stack
+        x = self.prologue_apply(params, x)
+        flat_blocks = jax.tree.map(
+            lambda a: a.reshape((st["n_body"],) + a.shape[2:]), params["blocks"])
+        for b in range(st["n_body"]):
+            x = self.block_apply(jax.tree.map(lambda a: a[b], flat_blocks), x)
+        return self.epilogue_loss(params, x, labels)
 
     def _apply_one(self, idx, layer_params, x):
         layer = self.layer_objs[idx]
