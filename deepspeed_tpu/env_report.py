@@ -1,0 +1,107 @@
+"""Environment report — the ``ds_report`` equivalent.
+
+Capability match for the reference's ``deepspeed/env_report.py``
+(``op_report`` at env_report.py:41, ``debug_report`` at :141): prints
+the native-op compatibility table, framework/library versions, and the
+accelerator inventory. Run as ``python -m deepspeed_tpu.env_report``.
+"""
+
+import importlib
+import shutil
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{RED}[NO]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+
+COLUMNS = 76
+
+
+def _line(char="-"):
+    print(char * COLUMNS)
+
+
+def op_report(verbose=True):
+    """Which native (C++) ops can build / are prebuilt."""
+    import op_builder
+
+    _line()
+    print("DeepSpeedTPU C++/SIMD op report")
+    _line()
+    print(f"{'op name':<20} {'compatible':<16} {'built'}")
+    _line()
+    results = {}
+    for name, builder_cls in op_builder.ALL_OPS.items():
+        try:
+            b = builder_cls()
+            compatible = b.is_compatible(verbose=False)
+        except Exception:
+            compatible = False
+        built = False
+        if compatible:
+            try:
+                b.load()
+                built = True
+            except Exception:
+                built = False
+        results[name] = (compatible, built)
+        print(f"{name:<20} {(OKAY if compatible else NO):<25} {(OKAY if built else NO)}")
+    _line()
+    return results
+
+
+def version_report():
+    _line()
+    print("DeepSpeedTPU general environment info:")
+    _line()
+    print(f"{'python':<24} {sys.version.split()[0]}")
+    print(f"{'platform':<24} {sys.platform}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy", "deepspeed_tpu"):
+        try:
+            m = importlib.import_module(mod)
+            ver = getattr(m, "__version__", "unknown")
+            print(f"{mod:<24} {ver}")
+        except ImportError:
+            print(f"{mod:<24} {NO}")
+    for tool in ("g++", "cmake", "ninja"):
+        path = shutil.which(tool)
+        print(f"{tool:<24} {path or NO}")
+
+
+def accelerator_report():
+    _line()
+    print("Accelerator inventory:")
+    _line()
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"{'backend':<24} {devs[0].platform if devs else 'none'}")
+        print(f"{'device count':<24} {len(devs)}")
+        print(f"{'process count':<24} {jax.process_count()}")
+        for d in devs[:8]:
+            kind = getattr(d, "device_kind", "?")
+            print(f"  device {d.id:<4} {kind}")
+        if len(devs) > 8:
+            print(f"  ... and {len(devs) - 8} more")
+    except Exception as e:
+        print(f"jax backend unavailable: {e}")
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False):
+    if not hide_operator_status:
+        op_report(verbose=not hide_errors_and_warnings)
+    version_report()
+    accelerator_report()
+    return True
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

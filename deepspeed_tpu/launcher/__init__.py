@@ -1,0 +1,15 @@
+"""Launcher: the `deepspeed`-CLI equivalent for TPU slice jobs.
+
+- ``runner``: host discovery (hostfile / TPU pod metadata), include/
+  exclude filtering, multinode runner selection (pdsh/ssh/mpirun/srun)
+- ``launch``: per-node bootstrap — env contract into jax.distributed,
+  signal forwarding
+
+Parity: deepspeed/launcher/ (runner.py:388, launch.py:133,
+multinode_runner.py:51).
+"""
+
+from deepspeed_tpu.launcher.runner import (discover_resources, fetch_hostfile, main,
+                                           parse_inclusion_exclusion)
+
+__all__ = ["main", "fetch_hostfile", "parse_inclusion_exclusion", "discover_resources"]

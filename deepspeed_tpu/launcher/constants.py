@@ -1,0 +1,24 @@
+"""Launcher constants (parity: deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+PDSH_MAX_FAN_OUT = 1024
+
+OPENMPI_LAUNCHER = "openmpi"
+MPICH_LAUNCHER = "mpich"
+SLURM_LAUNCHER = "slurm"
+SSH_LAUNCHER = "ssh"
+LOCAL_LAUNCHER = "local"
+
+ELASTIC_TRAINING_ID_DEFAULT = "123456789"
+
+# Env vars forwarded from the runner to every worker process
+EXPORT_ENVS = [
+    "MASTER_ADDR", "MASTER_PORT", "RANK", "WORLD_SIZE", "LOCAL_RANK",
+    "PYTHONPATH", "XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_CHIPS_PER_HOST_BOUNDS",
+    "JAX_PLATFORMS", "DS_SEED", "DS_PALLAS",
+]
+
+# TPU pod metadata env (set by the TPU VM runtime / GKE)
+TPU_WORKER_ID = "TPU_WORKER_ID"
+TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"

@@ -1,0 +1,104 @@
+"""Per-node bootstrap: set the distributed env and exec the user script.
+
+Capability match for the reference's ``deepspeed/launcher/launch.py``
+(``main`` at launch.py:133: per-rank process fan-out, signal handling,
+rank log redirection). TPU-adapted: ONE worker process per host drives
+all local chips, so this bootstraps exactly one child, exports the
+``jax.distributed`` rendezvous contract (MASTER_ADDR/PORT + RANK/
+WORLD_SIZE, consumed by ``deepspeed_tpu.comm.init_distributed``), and
+forwards SIGINT/SIGTERM so a dying runner tears the whole slice job
+down (reference launch.py:217 sig_handler).
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="DeepSpeedTPU per-node launcher")
+    parser.add_argument("--node_rank", type=int, default=None,
+                        help="this host's process id (defaults to TPU_WORKER_ID / OMPI / SLURM env)")
+    parser.add_argument("--nnodes", type=int, default=None, help="total host count")
+    parser.add_argument("--master_addr", type=str, default="localhost")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--module", action="store_true",
+                        help="interpret user_script as a python module (python -m)")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec user_script directly without the python interpreter")
+    parser.add_argument("--save_pid", type=str, default=None,
+                        help="write the child pid to this file")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def _infer_node_rank(args):
+    if args.node_rank is not None:
+        return args.node_rank
+    for var in ("TPU_WORKER_ID", "OMPI_COMM_WORLD_RANK", "SLURM_PROCID", "RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
+    return 0
+
+
+def _infer_nnodes(args):
+    if args.nnodes is not None:
+        return args.nnodes
+    for var in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS", "WORLD_SIZE"):
+        if var in os.environ:
+            return int(os.environ[var])
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hostnames:
+        return len(hostnames.split(","))
+    return 1
+
+
+def main(args=None):
+    args = parse_args(args)
+    rank = _infer_node_rank(args)
+    world = _infer_nnodes(args)
+
+    env = os.environ.copy()
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    env["RANK"] = str(rank)
+    env["WORLD_SIZE"] = str(world)
+    env["LOCAL_RANK"] = "0"  # one process per host owns every local chip
+
+    if args.no_python:
+        cmd = [args.user_script] + args.user_args
+    elif args.module:
+        cmd = [sys.executable, "-m", args.user_script] + args.user_args
+    else:
+        cmd = [sys.executable, args.user_script] + args.user_args
+
+    logger.info(f"launch: node_rank={rank} nnodes={world} "
+                f"master={args.master_addr}:{args.master_port} cmd={cmd}")
+    # new process group so signal forwarding reaches the whole subtree
+    child = subprocess.Popen(cmd, env=env, start_new_session=True)
+    if args.save_pid:
+        with open(args.save_pid, "w") as f:
+            f.write(str(child.pid))
+
+    def forward(sig, frame):
+        logger.warning(f"launch: forwarding signal {sig} to pid {child.pid}")
+        try:
+            os.killpg(os.getpgid(child.pid), sig)
+        except ProcessLookupError:
+            pass
+
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+    rc = child.wait()
+    if rc != 0:
+        logger.error(f"launch: child exited with {rc}")
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Multi-node runners: build the command that starts one worker process
+per host.
+
+Capability match for the reference's
+``deepspeed/launcher/multinode_runner.py`` (``PDSHRunner`` at :51,
+``OpenMPIRunner`` at :150, ``MPICHRunner``, ``SlurmRunner``) with the
+contract adapted to JAX's single-controller model: the unit of
+parallelism is one PROCESS PER HOST driving all of that host's TPU
+chips, not one process per accelerator — so there is no per-rank
+``launch.py`` fan-out on each node; every node runs
+``python -m deepspeed_tpu.launcher.launch`` once with its process id.
+"""
+
+import os
+import shutil
+import subprocess
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.launcher.constants import EXPORT_ENVS, PDSH_MAX_FAN_OUT
+
+
+class MultiNodeRunner(ABC):
+
+    def __init__(self, args, world_info):
+        """``world_info``: ordered {hostname: slots} (slots = chips,
+        informational on TPU — process count is len(world_info))."""
+        self.args = args
+        self.world_info = world_info
+        self.exports = {}
+
+    def add_export(self, key, value):
+        self.exports[key.strip()] = str(value).strip()
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+    def backend_exists(self):
+        return True
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        ...
+
+    def _worker_cmd(self, rank, world_size, master_addr, master_port):
+        """The per-host bootstrap command."""
+        cmd = ["python", "-m", "deepspeed_tpu.launcher.launch",
+               f"--node_rank={rank}",
+               f"--nnodes={world_size}",
+               f"--master_addr={master_addr}",
+               f"--master_port={master_port}"]
+        if getattr(self.args, "module", False):
+            cmd.append("--module")
+        if getattr(self.args, "no_python", False):
+            cmd.append("--no_python")
+        cmd.append(self.args.user_script)
+        cmd.extend(self.args.user_args)
+        return cmd
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out: one ssh per host in parallel (reference :51)."""
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = list(active_resources.keys())
+        env_exports = " ".join(f"export {k}={v};" for k, v in self.exports.items())
+        # Each host resolves its own rank from its position in the list.
+        per_host = []
+        for rank, host in enumerate(hosts):
+            worker = " ".join(self._worker_cmd(rank, len(hosts),
+                                               self.args.master_addr, self.args.master_port))
+            per_host.append((host, f"{env_exports} cd {os.path.abspath('.')}; {worker}"))
+        # pdsh runs the same command on all hosts; rank-dependent args force
+        # one pdsh invocation per host batched under the fan-out limit.
+        cmds = [["pdsh", "-S", "-f", str(PDSH_MAX_FAN_OUT), "-w", host, cmd]
+                for host, cmd in per_host]
+        return cmds
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain ssh per host (no pdsh dependency)."""
+
+    def backend_exists(self):
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        hosts = list(active_resources.keys())
+        env_exports = " ".join(f"export {k}={v};" for k, v in self.exports.items())
+        cmds = []
+        for rank, host in enumerate(hosts):
+            worker = " ".join(self._worker_cmd(rank, len(hosts),
+                                               self.args.master_addr, self.args.master_port))
+            remote = f"{env_exports} cd {os.path.abspath('.')}; {worker}"
+            ssh = ["ssh"]
+            if getattr(self.args, "ssh_port", None):
+                ssh += ["-p", str(self.args.ssh_port)]
+            cmds.append(ssh + [host, remote])
+        return cmds
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun -np <hosts> --map-by ppr:1:node (reference :150) — rank
+    comes from OMPI_COMM_WORLD_RANK via comm.mpi_discovery."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        hosts = list(active_resources.keys())
+        cmd = ["mpirun", "-np", str(len(hosts)), "--host", ",".join(hosts),
+               "--map-by", "ppr:1:node"]
+        for k, v in self.exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        worker = self._worker_cmd(0, len(hosts), self.args.master_addr, self.args.master_port)
+        # node_rank placeholder is ignored: launch.py prefers OMPI env
+        worker = [w for w in worker if not w.startswith("--node_rank")]
+        return [cmd + worker]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun --ntasks-per-node=1 (reference :252)."""
+
+    def backend_exists(self):
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        hosts = list(active_resources.keys())
+        cmd = ["srun", f"--nodes={len(hosts)}", "--ntasks-per-node=1",
+               f"--nodelist={','.join(hosts)}"]
+        if getattr(self.args, "launcher_args", ""):
+            cmd += self.args.launcher_args.split()
+        worker = self._worker_cmd(0, len(hosts), self.args.master_addr, self.args.master_port)
+        worker = [w for w in worker if not w.startswith("--node_rank")]
+        return [cmd + worker]
+
+
+class LocalRunner(MultiNodeRunner):
+    """Single-host: exec launch.py directly (also used for tests that
+    simulate N hosts as N local processes)."""
+
+    def get_cmd(self, environment, active_resources):
+        return [self._worker_cmd(0, 1, self.args.master_addr, self.args.master_port)]
+
+
+def run_commands(cmds, env):
+    """Start all per-host commands, propagate SIGINT/SIGTERM, return the
+    first nonzero exit code (or 0)."""
+    import signal
+
+    procs = [subprocess.Popen(cmd, env=env) for cmd in cmds]
+
+    def forward(sig, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(sig)
+
+    old_int = signal.signal(signal.SIGINT, forward)
+    old_term = signal.signal(signal.SIGTERM, forward)
+    try:
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)

@@ -1,0 +1,182 @@
+"""The ``deepspeed``-equivalent CLI: discover hosts, pick a runner,
+boot one worker process per host.
+
+Capability match for the reference's ``deepspeed/launcher/runner.py``
+(``main`` at runner.py:388: hostfile parsing at :90, ``--include/
+--exclude`` filtering at :147, runner selection at :480). TPU-first
+differences:
+
+- the resource unit is a HOST (one JAX process drives all local chips),
+  so ``--num_gpus`` becomes informational ``slots``;
+- rendezvous is ``jax.distributed`` (coordinator = MASTER_ADDR:PORT),
+  the same env contract ``comm.init_distributed`` consumes;
+- TPU pod slices self-describe via TPU_WORKER_HOSTNAMES/TPU_WORKER_ID:
+  with no hostfile the runner uses them and otherwise falls back to
+  localhost.
+
+Run: ``python -m deepspeed_tpu.launcher.runner [opts] script.py args...``
+"""
+
+import argparse
+import os
+import re
+import sys
+from collections import OrderedDict
+
+from deepspeed_tpu.launcher.constants import (EXPORT_ENVS, LOCAL_LAUNCHER, MPICH_LAUNCHER,
+                                              OPENMPI_LAUNCHER, PDSH_LAUNCHER, SLURM_LAUNCHER,
+                                              SSH_LAUNCHER, TPU_WORKER_HOSTNAMES)
+from deepspeed_tpu.launcher.multinode_runner import (LocalRunner, OpenMPIRunner, PDSHRunner,
+                                                     SSHRunner, SlurmRunner, run_commands)
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeedTPU runner: launch one worker per host over a TPU slice")
+    parser.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                        help="hostfile: lines of '<hostname> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="hosts to include, e.g. 'worker-0@worker-1' or 'worker-0:0,1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="hosts to exclude")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit to the first N hosts")
+    parser.add_argument("--master_port", type=int,
+                        default=int(os.environ.get("DS_MASTER_PORT", 29500)))
+    parser.add_argument("--master_addr", type=str,
+                        default=os.environ.get("DS_MASTER_ADDR", ""))
+    parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
+                        help=f"{PDSH_LAUNCHER}|{SSH_LAUNCHER}|{OPENMPI_LAUNCHER}|"
+                             f"{SLURM_LAUNCHER}|{LOCAL_LAUNCHER}")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--ssh_port", type=int, default=None)
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse '<host> slots=<n>' lines → ordered {host: slots}
+    (reference runner.py:90)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resources = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)(?:\s+slots=(\d+))?$", line)
+            if m is None:
+                raise ValueError(f"bad hostfile line: {line!r}")
+            host, slots = m.group(1), int(m.group(2) or 1)
+            if host in resources:
+                raise ValueError(f"host {host} appears twice in hostfile")
+            resources[host] = slots
+    if not resources:
+        raise ValueError(f"hostfile {hostfile_path} is empty")
+    return resources
+
+
+def _parse_filter(spec):
+    """'h1@h2' or 'h1,h2' → list of hosts (per-slot selectors like
+    'h1:0,1' keep only the host part: TPU slots are not addressable)."""
+    hosts = []
+    for part in re.split(r"[@,]", spec):
+        part = part.strip()
+        if not part:
+            continue
+        hosts.append(part.split(":")[0])
+    return hosts
+
+
+def parse_inclusion_exclusion(resources, include_str, exclude_str):
+    """Filter the host pool (reference runner.py:147)."""
+    active = OrderedDict(resources)
+    if include_str:
+        keep = _parse_filter(include_str)
+        unknown = [h for h in keep if h not in active]
+        if unknown:
+            raise ValueError(f"--include hosts not in hostfile: {unknown}")
+        active = OrderedDict((h, active[h]) for h in keep)
+    if exclude_str:
+        drop = set(_parse_filter(exclude_str))
+        unknown = [h for h in drop if h not in active]
+        if unknown:
+            raise ValueError(f"--exclude hosts not in hostfile: {unknown}")
+        active = OrderedDict((h, s) for h, s in active.items() if h not in drop)
+    if not active:
+        raise ValueError("no hosts remain after include/exclude filtering")
+    return active
+
+
+def discover_resources(args):
+    """Host pool: hostfile > TPU pod metadata > localhost."""
+    resources = fetch_hostfile(args.hostfile)
+    if resources is None:
+        hostnames = os.environ.get(TPU_WORKER_HOSTNAMES, "")
+        if hostnames:
+            resources = OrderedDict((h.strip(), 1) for h in hostnames.split(",") if h.strip())
+            logger.info(f"discovered {len(resources)} hosts from {TPU_WORKER_HOSTNAMES}")
+        else:
+            resources = OrderedDict([("localhost", 1)])
+    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    return active
+
+
+def make_runner(args, active):
+    multi = len(active) > 1 or args.force_multi
+    if not multi or args.launcher == LOCAL_LAUNCHER:
+        return LocalRunner(args, active)
+    name = args.launcher.lower()
+    runner_cls = {
+        PDSH_LAUNCHER: PDSHRunner,
+        SSH_LAUNCHER: SSHRunner,
+        OPENMPI_LAUNCHER: OpenMPIRunner,
+        MPICH_LAUNCHER: OpenMPIRunner,
+        SLURM_LAUNCHER: SlurmRunner,
+    }.get(name)
+    if runner_cls is None:
+        raise ValueError(f"unknown launcher {args.launcher}")
+    runner = runner_cls(args, active)
+    if not runner.backend_exists():
+        # graceful degradation chain: pdsh → ssh → local
+        if isinstance(runner, PDSHRunner):
+            ssh = SSHRunner(args, active)
+            if ssh.backend_exists():
+                logger.warning("pdsh not found; falling back to plain ssh")
+                return ssh
+        raise RuntimeError(f"launcher backend for {args.launcher} not installed")
+    return runner
+
+
+def main(args=None):
+    args = parse_args(args)
+    active = discover_resources(args)
+    if not args.master_addr:
+        args.master_addr = next(iter(active.keys()))
+        if args.master_addr == "localhost":
+            args.master_addr = "127.0.0.1"
+
+    runner = make_runner(args, active)
+    logger.info(f"runner={runner.name} hosts={list(active.keys())} "
+                f"master={args.master_addr}:{args.master_port}")
+
+    env = os.environ.copy()
+    for var in EXPORT_ENVS:
+        if var in env:
+            runner.add_export(var, env[var])
+
+    cmds = runner.get_cmd(env, active)
+    rc = run_commands(cmds, env)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,11 @@ class PipelineEngine(DeepSpeedEngine):
         assert isinstance(self.module, PipelineModule), \
             "model must be deepspeed_tpu.pipe.PipelineModule"
         self.num_stages = groups.get_pipeline_parallel_world_size()
+        if self.module._num_stages is not None and self.module._num_stages != self.num_stages:
+            raise ValueError(
+                f"PipelineModule was built for {self.module._num_stages} stages but the mesh "
+                f"'pipe' axis has {self.num_stages} — the stacked body layout would silently "
+                f"drop layers; rebuild the module with num_stages={self.num_stages}")
         self.micro_batches = self.gradient_accumulation_steps()
         self.micro_batch_size = self.train_micro_batch_size_per_gpu()
         self._act_struct = None

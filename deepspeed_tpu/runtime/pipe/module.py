@@ -85,10 +85,12 @@ class PipelineModule:
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
                  seed_layers: bool = False,
-                 checkpointable_layers=None):
+                 checkpointable_layers=None,
+                 stack_params: bool = True):
         self.specs = list(layers)
         self.loss_fn = loss_fn
         self.partition_method = partition_method
+        self.stack_params = stack_params
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self._num_stages = num_stages
         self._topology = topology
@@ -197,6 +199,11 @@ class PipelineModule:
         self.stack = None
         S = self.num_stages
         if S <= 1:
+            return
+        # Respect explicit stage-boundary control: stack_params=False or
+        # a type:<regex> balancing method keeps the per-layer layout
+        # (pipe-replicated params, lax.switch execution).
+        if not self.stack_params or (self.partition_method or "").lower().startswith("type:"):
             return
 
         def signature(idx):

@@ -1,0 +1,158 @@
+"""Launcher tests (analogue of reference tests/unit/launcher/test_ds_arguments.py
++ test_run.py): hostfile parsing, include/exclude filtering, runner
+command construction, a local end-to-end launch, and a REAL two-process
+jax.distributed rendezvous on the CPU backend."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from collections import OrderedDict
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as ds_runner
+from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner, PDSHRunner, SSHRunner
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# comment\nworker-0 slots=4\nworker-1 slots=4\nsolo\n")
+    res = ds_runner.fetch_hostfile(str(hf))
+    assert res == OrderedDict([("worker-0", 4), ("worker-1", 4), ("solo", 1)])
+
+
+def test_fetch_hostfile_rejects_bad_lines(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=abc\n")
+    with pytest.raises(ValueError):
+        ds_runner.fetch_hostfile(str(hf))
+    hf.write_text("worker-0 slots=2\nworker-0 slots=2\n")
+    with pytest.raises(ValueError):
+        ds_runner.fetch_hostfile(str(hf))
+
+
+def test_missing_hostfile_returns_none(tmp_path):
+    assert ds_runner.fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_include_exclude():
+    pool = OrderedDict([("a", 1), ("b", 1), ("c", 1)])
+    assert list(ds_runner.parse_inclusion_exclusion(pool, "b@c", "")) == ["b", "c"]
+    assert list(ds_runner.parse_inclusion_exclusion(pool, "", "b")) == ["a", "c"]
+    with pytest.raises(ValueError):
+        ds_runner.parse_inclusion_exclusion(pool, "zzz", "")
+    with pytest.raises(ValueError):
+        ds_runner.parse_inclusion_exclusion(pool, "", "a@b@c")
+
+
+def test_discovery_from_tpu_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1,t2")
+    args = ds_runner.parse_args([
+        "--hostfile", str(tmp_path / "absent"), "train.py"])
+    active = ds_runner.discover_resources(args)
+    assert list(active) == ["t0", "t1", "t2"]
+
+
+def test_runner_commands_shape(tmp_path):
+    args = ds_runner.parse_args(["--hostfile", str(tmp_path / "absent"),
+                                 "--master_addr", "w0", "train.py", "--foo", "1"])
+    pool = OrderedDict([("w0", 4), ("w1", 4)])
+    ssh = SSHRunner(args, pool)
+    cmds = ssh.get_cmd({}, pool)
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][1] == "w0"
+    assert "--node_rank=0" in cmds[0][-1] and "--node_rank=1" in cmds[1][-1]
+    assert "--nnodes=2" in cmds[0][-1]
+    assert "train.py --foo 1" in cmds[0][-1]
+
+    mpi = OpenMPIRunner(args, pool)
+    (cmd,) = mpi.get_cmd({}, pool)
+    assert cmd[:3] == ["mpirun", "-np", "2"]
+    assert "--map-by" in cmd
+
+    pdsh = PDSHRunner(args, pool)
+    cmds = pdsh.get_cmd({}, pool)
+    assert len(cmds) == 2 and cmds[0][0] == "pdsh"
+
+
+def test_local_launch_end_to_end(tmp_path):
+    """runner → launch.py → user script, single host."""
+    script = tmp_path / "hello.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        assert os.environ["RANK"] == "0"
+        assert os.environ["WORLD_SIZE"] == "1"
+        assert os.environ["MASTER_PORT"] == "29123"
+        print("LAUNCH_OK")
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", str(tmp_path / "absent"), "--launcher", "local",
+         "--master_port", "29123", str(script)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "LAUNCH_OK" in out.stdout
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch", str(script)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 3
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_rendezvous(tmp_path):
+    """Two launch.py workers rendezvous through jax.distributed on the
+    CPU backend — the real multi-host boot path on one machine."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import deepspeed_tpu.comm as dist
+        dist.init_distributed()
+        assert jax.process_count() == 2, jax.process_count()
+        assert dist.get_process_count() == 2
+        assert len(jax.devices()) == 4, len(jax.devices())  # 2 per process
+        print(f"RDV_OK rank={jax.process_index()}")
+    """))
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("JAX_PLATFORMS", None)
+    procs = []
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             f"--node_rank={rank}", "--nnodes=2",
+             "--master_addr=127.0.0.1", f"--master_port={port}", str(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process rendezvous timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err
+        assert "RDV_OK" in out

@@ -194,6 +194,27 @@ class TestPipelineEngineE2E:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0], f"no learning: {losses}"
 
+    def test_stage_count_mismatch_raises(self):
+        mesh = make_mesh_topology(pipe=2, data=4)
+        groups.set_mesh(mesh)
+        model = build_llama_pipeline("debug", num_stages=4, num_hidden_layers=4)
+        config = {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 4,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                  "mesh": {"pipeline_parallel_size": 2}}
+        with pytest.raises(ValueError, match="stages"):
+            deepspeed_tpu.initialize(model=model, config=config, mesh=mesh)
+
+    def test_stack_opt_out(self):
+        """stack_params=False keeps the legacy per-layer layout."""
+        mesh = make_mesh_topology(pipe=2, data=4)
+        groups.set_mesh(mesh)
+        model = build_llama_pipeline("debug", num_stages=2, num_hidden_layers=4)
+        model.stack_params = False
+        import jax.numpy as jnp2
+        params, _ = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32))
+        assert not model.is_stacked
+        assert "blocks" not in params
+
     def test_forward_backward_forbidden(self):
         engine, _ = self._build()
         with pytest.raises(RuntimeError):
