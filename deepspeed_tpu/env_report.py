@@ -7,6 +7,7 @@ accelerator inventory. Run as ``python -m deepspeed_tpu.env_report``.
 """
 
 import importlib
+import os
 import shutil
 import sys
 
@@ -44,8 +45,9 @@ def op_report(verbose=True):
         built = False
         if compatible:
             try:
-                b.load()
-                built = True
+                # read-only probe: report the cached .so without triggering
+                # a JIT compile as a side effect of a diagnostic command
+                built = os.path.isfile(b.lib_path())
             except Exception:
                 built = False
         results[name] = (compatible, built)

@@ -12,8 +12,10 @@ chips, not one process per accelerator — so there is no per-rank
 """
 
 import os
+import shlex
 import shutil
 import subprocess
+import sys
 from abc import ABC, abstractmethod
 
 from deepspeed_tpu.launcher.constants import EXPORT_ENVS, PDSH_MAX_FAN_OUT
@@ -42,9 +44,13 @@ class MultiNodeRunner(ABC):
     def get_cmd(self, environment, active_resources):
         ...
 
-    def _worker_cmd(self, rank, world_size, master_addr, master_port):
+    def _env_exports(self):
+        """Shell-safe `export K=V;` prefix (XLA_FLAGS etc. carry spaces)."""
+        return " ".join(f"export {k}={shlex.quote(v)};" for k, v in self.exports.items())
+
+    def _worker_cmd(self, rank, world_size, master_addr, master_port, python_exec="python"):
         """The per-host bootstrap command."""
-        cmd = ["python", "-m", "deepspeed_tpu.launcher.launch",
+        cmd = [python_exec, "-m", "deepspeed_tpu.launcher.launch",
                f"--node_rank={rank}",
                f"--nnodes={world_size}",
                f"--master_addr={master_addr}",
@@ -67,13 +73,13 @@ class PDSHRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         environment["PDSH_RCMD_TYPE"] = "ssh"
         hosts = list(active_resources.keys())
-        env_exports = " ".join(f"export {k}={v};" for k, v in self.exports.items())
+        env_exports = self._env_exports()
         # Each host resolves its own rank from its position in the list.
         per_host = []
         for rank, host in enumerate(hosts):
-            worker = " ".join(self._worker_cmd(rank, len(hosts),
-                                               self.args.master_addr, self.args.master_port))
-            per_host.append((host, f"{env_exports} cd {os.path.abspath('.')}; {worker}"))
+            worker = shlex.join(self._worker_cmd(rank, len(hosts),
+                                                 self.args.master_addr, self.args.master_port))
+            per_host.append((host, f"{env_exports} cd {shlex.quote(os.path.abspath('.'))}; {worker}"))
         # pdsh runs the same command on all hosts; rank-dependent args force
         # one pdsh invocation per host batched under the fan-out limit.
         cmds = [["pdsh", "-S", "-f", str(PDSH_MAX_FAN_OUT), "-w", host, cmd]
@@ -89,12 +95,12 @@ class SSHRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         hosts = list(active_resources.keys())
-        env_exports = " ".join(f"export {k}={v};" for k, v in self.exports.items())
+        env_exports = self._env_exports()
         cmds = []
         for rank, host in enumerate(hosts):
-            worker = " ".join(self._worker_cmd(rank, len(hosts),
-                                               self.args.master_addr, self.args.master_port))
-            remote = f"{env_exports} cd {os.path.abspath('.')}; {worker}"
+            worker = shlex.join(self._worker_cmd(rank, len(hosts),
+                                                 self.args.master_addr, self.args.master_port))
+            remote = f"{env_exports} cd {shlex.quote(os.path.abspath('.'))}; {worker}"
             ssh = ["ssh"]
             if getattr(self.args, "ssh_port", None):
                 ssh += ["-p", str(self.args.ssh_port)]
@@ -121,6 +127,24 @@ class OpenMPIRunner(MultiNodeRunner):
         return [cmd + worker]
 
 
+class MPICHRunner(MultiNodeRunner):
+    """mpiexec (Hydra) flavor: -ppn 1 and -env instead of Open MPI's
+    --map-by/-x (reference multinode_runner.py MPICHRunner)."""
+
+    def backend_exists(self):
+        return shutil.which("mpiexec") is not None or shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        hosts = list(active_resources.keys())
+        launcher = "mpiexec" if shutil.which("mpiexec") else "mpirun"
+        cmd = [launcher, "-n", str(len(hosts)), "-hosts", ",".join(hosts), "-ppn", "1"]
+        for k, v in self.exports.items():
+            cmd += ["-env", k, v]
+        worker = self._worker_cmd(0, len(hosts), self.args.master_addr, self.args.master_port)
+        worker = [w for w in worker if not w.startswith("--node_rank")]
+        return [cmd + worker]
+
+
 class SlurmRunner(MultiNodeRunner):
     """srun --ntasks-per-node=1 (reference :252)."""
 
@@ -143,7 +167,10 @@ class LocalRunner(MultiNodeRunner):
     simulate N hosts as N local processes)."""
 
     def get_cmd(self, environment, active_resources):
-        return [self._worker_cmd(0, 1, self.args.master_addr, self.args.master_port)]
+        # local: the current interpreter is the right one ('python' may
+        # not exist on PATH, or resolve outside the venv)
+        return [self._worker_cmd(0, 1, self.args.master_addr, self.args.master_port,
+                                 python_exec=sys.executable)]
 
 
 def run_commands(cmds, env):

