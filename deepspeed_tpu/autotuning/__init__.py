@@ -1,0 +1,5 @@
+"""Autotuning (parity: deepspeed/autotuning/)."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune
+
+__all__ = ["Autotuner", "autotune"]

@@ -1,0 +1,143 @@
+"""Autotuner: search ZeRO stage × micro-batch for best throughput.
+
+Capability match for the reference's ``deepspeed/autotuning/autotuner.py``
+(``Autotuner`` at autotuner.py:42: builds an experiment grid over
+zero-stage/micro-batch tuning spaces, launches each config, ranks by a
+metric). TPU redesign: experiments run in-process — each candidate
+config builds an engine on the live mesh, times a few fused
+``train_batch`` steps (first step discarded: XLA compile), and the
+grid is pruned stage-first exactly like the reference's
+``tune_space`` fast mode. Results and the winning ds_config are
+written as JSON next to the experiment dir.
+"""
+
+import copy
+import json
+import os
+import time
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16, 32)
+DEFAULT_ZERO_STAGES = (0, 1, 2, 3)
+
+AUTOTUNING = "autotuning"
+AUTOTUNING_ENABLED_DEFAULT = False
+
+
+class Autotuner:
+    """In-process experiment grid.
+
+    Args:
+        model_fn: zero-arg callable returning a FRESH model (a flax
+            module); rebuilt per experiment.
+        base_config: ds_config dict; ``train_micro_batch_size_per_gpu``
+            and ``zero_optimization.stage`` are overridden per candidate.
+        batch_fn: ``batch_fn(micro_batch_size) -> (args...)`` producing
+            one micro-batch of synthetic data.
+        micro_batches / zero_stages: candidate lists.
+        steps: timed steps per experiment (after one compile step).
+    """
+
+    def __init__(self, model_fn, base_config, batch_fn, micro_batches=None,
+                 zero_stages=None, steps=3, mesh=None, results_dir=None,
+                 metric="throughput"):
+        self.model_fn = model_fn
+        self.base_config = base_config
+        self.batch_fn = batch_fn
+        self.micro_batches = list(micro_batches or DEFAULT_MICRO_BATCHES)
+        self.zero_stages = list(zero_stages or DEFAULT_ZERO_STAGES)
+        self.steps = steps
+        self.mesh = mesh
+        self.metric = metric
+        self.results_dir = results_dir
+        self.results = []
+        self.best = None
+
+    # ------------------------------------------------------------------
+    def _experiment_config(self, stage, mbs):
+        cfg = copy.deepcopy(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = mbs
+        cfg.setdefault("gradient_accumulation_steps", 1)
+        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        # the config triangulation derives train_batch_size from
+        # micro×gas×world — setting it here would double-specify and can
+        # silently inflate gradient accumulation
+        cfg.pop("train_batch_size", None)
+        return cfg
+
+    def run_experiment(self, stage, mbs):
+        """One candidate: build a fresh engine, time train_batch."""
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel import groups
+
+        record = {"zero_stage": stage, "micro_batch_size": mbs,
+                  "metric": self.metric, "value": None, "error": None}
+        cfg = self._experiment_config(stage, mbs)
+        try:
+            if self.mesh is None:
+                groups.destroy_mesh()
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model_fn(), config=cfg, mesh=self.mesh)
+            gas = engine.gradient_accumulation_steps()
+            batch = self.batch_fn(mbs)
+            stacked = tuple(np.stack([np.asarray(a)] * gas) for a in batch)
+            engine.train_batch(batch=stacked)  # compile step
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                engine.train_batch(batch=stacked)
+            dt = (time.perf_counter() - t0) / self.steps
+            record["value"] = engine.train_batch_size() / dt  # samples/sec
+            record["step_time_s"] = dt
+        except Exception as e:  # OOM / compile failure → prune candidate
+            record["error"] = f"{type(e).__name__}: {e}"
+            logger.warning(f"autotune: stage={stage} mbs={mbs} failed: {record['error'][:200]}")
+        finally:
+            if self.mesh is None:
+                groups.destroy_mesh()
+        self.results.append(record)
+        return record
+
+    def tune(self):
+        """Stage-major sweep with micro-batch hill-climb: within a stage,
+        stop growing the micro-batch after the first failure or regression
+        (the reference's fast tuning-space pruning)."""
+        for stage in self.zero_stages:
+            prev = None
+            for mbs in sorted(self.micro_batches):
+                rec = self.run_experiment(stage, mbs)
+                if rec["error"] is not None:
+                    break
+                if prev is not None and rec["value"] is not None and rec["value"] < prev * 0.98:
+                    break
+                prev = rec["value"]
+        ok = [r for r in self.results if r["value"] is not None]
+        if not ok:
+            raise RuntimeError("autotuning: every experiment failed; see results")
+        self.best = max(ok, key=lambda r: r["value"])
+        if self.results_dir:
+            self.write_results()
+        return self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"])
+
+    def write_results(self):
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "autotuning_results.json"), "w") as f:
+            json.dump(self.results, f, indent=1)
+        best_cfg = self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"])
+        with open(os.path.join(self.results_dir, "ds_config_optimal.json"), "w") as f:
+            json.dump(best_cfg, f, indent=1)
+
+    def print_tuning_results(self):
+        print(f"{'stage':>6} {'micro_bs':>9} {'samples/s':>12}  error")
+        for r in self.results:
+            val = f"{r['value']:.1f}" if r["value"] is not None else "-"
+            print(f"{r['zero_stage']:>6} {r['micro_batch_size']:>9} {val:>12}  "
+                  f"{(r['error'] or '')[:60]}")
+
+
+def autotune(model_fn, base_config, batch_fn, **kwargs):
+    """One-call convenience: returns the tuned ds_config."""
+    tuner = Autotuner(model_fn, base_config, batch_fn, **kwargs)
+    return tuner.tune()

@@ -1,0 +1,27 @@
+"""Autotuning config (reference deepspeed/autotuning/config.py)."""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedAutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: Optional[str] = "autotuning_results"
+    exps_dir: Optional[str] = "autotuning_exps"
+    overwrite: bool = True
+    metric: str = "throughput"
+    num_experiments: int = 50
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: Optional[int] = None
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+
+
+def get_autotuning_config(param_dict):
+    return DeepSpeedAutotuningConfig(**param_dict.get("autotuning", {}))

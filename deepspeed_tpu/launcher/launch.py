@@ -40,7 +40,8 @@ def parse_args(args=None):
 def _infer_node_rank(args):
     if args.node_rank is not None:
         return args.node_rank
-    for var in ("TPU_WORKER_ID", "OMPI_COMM_WORLD_RANK", "SLURM_PROCID", "RANK"):
+    for var in ("TPU_WORKER_ID", "OMPI_COMM_WORLD_RANK", "PMI_RANK", "PMIX_RANK",
+                "SLURM_PROCID", "RANK"):
         if var in os.environ:
             return int(os.environ[var])
     return 0
@@ -49,7 +50,7 @@ def _infer_node_rank(args):
 def _infer_nnodes(args):
     if args.nnodes is not None:
         return args.nnodes
-    for var in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS", "WORLD_SIZE"):
+    for var in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS", "WORLD_SIZE"):
         if var in os.environ:
             return int(os.environ[var])
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")

@@ -132,12 +132,12 @@ class MPICHRunner(MultiNodeRunner):
     --map-by/-x (reference multinode_runner.py MPICHRunner)."""
 
     def backend_exists(self):
-        return shutil.which("mpiexec") is not None or shutil.which("mpirun") is not None
+        # only mpiexec: Open MPI's mpirun rejects the Hydra flags below
+        return shutil.which("mpiexec") is not None
 
     def get_cmd(self, environment, active_resources):
         hosts = list(active_resources.keys())
-        launcher = "mpiexec" if shutil.which("mpiexec") else "mpirun"
-        cmd = [launcher, "-n", str(len(hosts)), "-hosts", ",".join(hosts), "-ppn", "1"]
+        cmd = ["mpiexec", "-n", str(len(hosts)), "-hosts", ",".join(hosts), "-ppn", "1"]
         for k, v in self.exports.items():
             cmd += ["-env", k, v]
         worker = self._worker_cmd(0, len(hosts), self.args.master_addr, self.args.master_port)

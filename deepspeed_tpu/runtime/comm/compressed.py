@@ -72,7 +72,13 @@ def quant_all_gather(x, axis, gather_dim=0, group_size=DEFAULT_GROUP_SIZE,
     local = x.astype(jnp.float32).reshape(1, -1)
     e = local.shape[1]
 
-    if hpz_size > 1 and n % hpz_size == 0 and hpz_size < n:
+    if hpz_size >= n > 1:
+        # the secondary partition spans the whole axis: the gather is
+        # entirely "intra-node" → full precision, no quantization
+        flat = jax.lax.all_gather(x.astype(dtype).reshape(-1), axis)  # [n, e]
+        return _concat_gather(flat.reshape((n,) + x.shape), gather_dim)
+
+    if hpz_size > 1 and n % hpz_size == 0:
         k = hpz_size
         inner_groups = [list(range(b, b + k)) for b in range(0, n, k)]
         # full-precision gather inside the subgroup

@@ -486,8 +486,7 @@ class DeepSpeedEngine:
         zc = self._config.zero_config
         if not (zc.zero_quantized_gradients or zc.zero_quantized_weights):
             return False
-        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-        return sizes.get("data", 1) > 1
+        return dict(self.mesh.shape).get("data", 1) > 1
 
     def _vag_core(self):
         """(params, scale, rng, args, kwargs) -> (loss, raw_grads).
@@ -522,7 +521,7 @@ class DeepSpeedEngine:
         qw = zc.zero_quantized_weights
         hpz = int(getattr(zc, "zero_hpz_partition_size", 1) or 1)
         axis = "data"
-        n = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[axis]
+        n = dict(self.mesh.shape)[axis]
 
         def axis_dim(spec):
             # -1 = axis absent (None would collapse the pytree)
@@ -622,12 +621,37 @@ class DeepSpeedEngine:
         self._jit_cache[key] = jitted
         return jitted
 
+    def _maybe_flops_profile(self, args, kwargs):
+        """Print the flops profile once, at flops_profiler.profile_step
+        (reference profiler hooks in engine forward; here one jaxpr walk)."""
+        fc = self._config.flops_profiler_config
+        if not fc.enabled or getattr(self, "_flops_profiled", False):
+            return
+        if self.global_steps + 1 < fc.profile_step:
+            return
+        self._flops_profiled = True
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+        prof = FlopsProfiler(model=self.module, ds_engine=self)
+        rng = jax.random.PRNGKey(0)
+
+        def fwd(params):
+            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
+            return out[0] if isinstance(out, (tuple, list)) else out
+
+        prof.profile(fwd, self.params, time_it=False)
+        prof.total_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.params))
+        prof.print_model_profile(profile_step=fc.profile_step, module_depth=fc.module_depth,
+                                 top_modules=fc.top_modules, detailed=fc.detailed,
+                                 output_file=fc.output_file)
+
     def forward(self, *args, **kwargs):
         """Compute loss (and, when training, gradients in the same fused
         dispatch). Returns the unscaled loss."""
         self._materialize_state(*args, **kwargs)
         args = self._shard_batch(args)
         kwargs = self._shard_batch(kwargs)
+        if self._is_training:
+            self._maybe_flops_profile(args, kwargs)
         if not self._is_training:
             if "eval" not in self._jit_cache:
                 self._jit_cache["eval"] = jax.jit(lambda p, a, k: self._apply_module(p, *a, **k))

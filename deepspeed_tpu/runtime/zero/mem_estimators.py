@@ -1,0 +1,130 @@
+"""ZeRO memory-needs estimators.
+
+Capability match for the reference's
+``deepspeed/runtime/zero/stage3.py:2764``
+(``estimate_zero3_model_states_mem_needs*``) and
+``stage_1_and_2.py:2429`` (``estimate_zero2_*``): given a parameter
+count and a device topology, print per-device HBM / host-RAM needs for
+each offload configuration. The arithmetic is the reference's (fp16/bf16
+params + fp32 master + 2 fp32 moments, partitioned per stage), with the
+GPU/TPU naming generalized — on TPU "cpu_offload" maps to the host
+offload path (``runtime/zero/offload.py``)."""
+
+import numpy as np
+
+import jax
+
+
+def _human(num_bytes):
+    for unit, div in (("TB", 1e12), ("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if num_bytes >= div:
+            return f"{num_bytes / div:.2f}{unit}"
+    return f"{num_bytes:.0f}B"
+
+
+def _total_params(model, rng=None, sample_args=None):
+    if hasattr(model, "init") and sample_args is not None:
+        variables = jax.eval_shape(lambda r: model.init(r, *sample_args),
+                                   rng or jax.random.PRNGKey(0))
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(variables))
+    leaves = jax.tree.leaves(model)
+    return sum(int(np.prod(l.shape)) for l in leaves if hasattr(l, "shape"))
+
+
+def estimate_zero2_model_states_mem_needs(total_params, num_gpus_per_node=1, num_nodes=1,
+                                          cpu_offload=True, additional_buffer_factor=1.5):
+    """→ (device_mem_bytes, host_mem_bytes) for ZeRO-2 (reference
+    stage_1_and_2.py:2429). Params+grads stay device-resident (2 bytes
+    each in bf16); optimizer state (fp32 master + 2 moments = 12-16
+    bytes/param) is partitioned over the data ranks or offloaded."""
+    total_devices = num_gpus_per_node * num_nodes
+    if cpu_offload:
+        device = 2 * total_params + 2 * total_params  # bf16 params + grads
+        host = total_params * max(4 * total_devices, 16) * additional_buffer_factor
+    else:
+        device = 4 * total_params + 16 * total_params / total_devices
+        host = total_params * 4 * num_gpus_per_node * additional_buffer_factor
+    return int(device), int(host)
+
+
+def estimate_zero3_model_states_mem_needs(total_params, largest_layer_params=0,
+                                          num_gpus_per_node=1, num_nodes=1,
+                                          cpu_offload=True, cpu_offload_params=False,
+                                          zero_init=True, additional_buffer_factor=1.5):
+    """→ (device_mem_bytes, host_mem_bytes, largest_layer_bytes) for
+    ZeRO-3 (reference stage3.py:2764): everything partitioned; the
+    per-device live set is the largest layer's gathered params."""
+    total_devices = num_gpus_per_node * num_nodes
+    gpus_factor = 1 / num_nodes
+    largest_layer_memory = 4 * largest_layer_params
+
+    if cpu_offload:
+        if cpu_offload_params:
+            device = largest_layer_memory
+            host = total_params * max(18 * total_devices, 36 if zero_init else 36 * num_gpus_per_node)
+        else:
+            device = largest_layer_memory + int(2 * total_params / total_devices)
+            host = total_params * max(16 * total_devices, 32 if zero_init else 32 * num_gpus_per_node)
+        host *= additional_buffer_factor / max(total_devices, 1)
+        host = max(host, largest_layer_memory)
+    else:
+        device = largest_layer_memory + int(18 * total_params / total_devices)
+        host = largest_layer_memory * (1 if zero_init else num_gpus_per_node * gpus_factor)
+    return int(device), int(host), int(largest_layer_memory)
+
+
+def _largest_layer(model_params):
+    sizes = [int(np.prod(l.shape)) for l in jax.tree.leaves(model_params)
+             if hasattr(l, "shape")]
+    return max(sizes) if sizes else 0
+
+
+def estimate_zero2_model_states_mem_needs_all_live(model, num_gpus_per_node=1, num_nodes=1,
+                                                   additional_buffer_factor=1.5,
+                                                   sample_args=None):
+    total_params = _total_params(model, sample_args=sample_args)
+    estimate_zero2_model_states_mem_needs_all_cold(
+        total_params, num_gpus_per_node, num_nodes, additional_buffer_factor)
+
+
+def estimate_zero2_model_states_mem_needs_all_cold(total_params, num_gpus_per_node=1,
+                                                   num_nodes=1, additional_buffer_factor=1.5):
+    print(f"Estimated memory needed for params, optim states and gradients for a:\n"
+          f"HW: Setup with {num_nodes} node(s), {num_gpus_per_node} device(s) per node.\n"
+          f"SW: Model with {int(total_params / 1e6)}M total params.")
+    print("  per device |  per host | options")
+    for cpu_offload in (True, False):
+        dev, host = estimate_zero2_model_states_mem_needs(
+            total_params, num_gpus_per_node, num_nodes, cpu_offload, additional_buffer_factor)
+        print(f"  {_human(dev):>10} | {_human(host):>9} | offload_optimizer={'cpu' if cpu_offload else 'none'}")
+
+
+def estimate_zero3_model_states_mem_needs_all_live(model, num_gpus_per_node=1, num_nodes=1,
+                                                   additional_buffer_factor=1.5,
+                                                   sample_args=None):
+    total_params = _total_params(model, sample_args=sample_args)
+    largest = 0
+    if not (hasattr(model, "init")):
+        largest = _largest_layer(model)
+    estimate_zero3_model_states_mem_needs_all_cold(
+        total_params, largest, num_gpus_per_node, num_nodes, additional_buffer_factor)
+
+
+def estimate_zero3_model_states_mem_needs_all_cold(total_params, largest_layer_params=0,
+                                                   num_gpus_per_node=1, num_nodes=1,
+                                                   additional_buffer_factor=1.5):
+    print(f"Estimated memory needed for params, optim states and gradients for a:\n"
+          f"HW: Setup with {num_nodes} node(s), {num_gpus_per_node} device(s) per node.\n"
+          f"SW: Model with {int(total_params / 1e6)}M total params, "
+          f"{int(largest_layer_params / 1e6)}M largest layer params.")
+    print("  per device |  per host | options")
+    for cpu_offload in (True, False):
+        for cpu_offload_params in ((True, False) if cpu_offload else (False,)):
+            for zero_init in (True, False):
+                dev, host, _ = estimate_zero3_model_states_mem_needs(
+                    total_params, largest_layer_params, num_gpus_per_node, num_nodes,
+                    cpu_offload, cpu_offload_params, zero_init, additional_buffer_factor)
+                opts = (f"offload_param={'cpu' if cpu_offload_params else 'none'}, "
+                        f"offload_optimizer={'cpu' if cpu_offload else 'none'}, "
+                        f"zero_init={int(zero_init)}")
+                print(f"  {_human(dev):>10} | {_human(host):>9} | {opts}")

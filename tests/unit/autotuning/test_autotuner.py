@@ -1,0 +1,91 @@
+"""Autotuner tests (analogue of reference tests/unit/autotuning/test_autotuning.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner, autotune
+from deepspeed_tpu.parallel import groups
+from unit.simple_model import SimpleModel
+
+HIDDEN = 32
+
+
+def batch_fn(mbs):
+    rng = np.random.RandomState(0)
+    x = rng.randn(mbs, HIDDEN).astype(np.float32)
+    y = rng.randint(0, HIDDEN, size=(mbs,)).astype(np.int64)
+    return (x, y)
+
+
+BASE = {
+    "train_batch_size": 8,
+    "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    "mesh": {"data_parallel_size": 8},
+}
+
+
+def test_autotuner_picks_and_records(tmp_path):
+    groups.destroy_mesh()
+    tuner = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        base_config=BASE,
+        batch_fn=batch_fn,
+        micro_batches=[8, 16],
+        zero_stages=[1],
+        steps=2,
+        results_dir=str(tmp_path),
+    )
+    best_cfg = tuner.tune()
+    assert best_cfg["zero_optimization"]["stage"] == 1
+    assert best_cfg["train_micro_batch_size_per_gpu"] in (8, 16)
+    # triangulation derives train_batch_size; it must not be pre-pinned
+    assert "train_batch_size" not in best_cfg
+    assert best_cfg["gradient_accumulation_steps"] == 1
+    assert len(tuner.results) >= 1
+    assert all(r["value"] is not None or r["error"] for r in tuner.results)
+
+    results = json.load(open(tmp_path / "autotuning_results.json"))
+    assert results == tuner.results
+    optimal = json.load(open(tmp_path / "ds_config_optimal.json"))
+    assert optimal == best_cfg
+
+
+def test_autotuner_prunes_on_failure():
+    groups.destroy_mesh()
+
+    class Exploding(SimpleModel):
+        pass
+
+    calls = []
+
+    def bad_batch(mbs):
+        calls.append(mbs)
+        if mbs > 8:
+            raise MemoryError("synthetic OOM")
+        return batch_fn(mbs)
+
+    tuner = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=1),
+        base_config=BASE,
+        batch_fn=bad_batch,
+        micro_batches=[8, 16, 32],
+        zero_stages=[0],
+        steps=1,
+    )
+    cfg = tuner.tune()
+    # 16 failed → 32 never attempted
+    assert 32 not in calls
+    failed = [r for r in tuner.results if r["error"]]
+    assert len(failed) == 1 and failed[0]["micro_batch_size"] == 16
+    assert cfg["train_micro_batch_size_per_gpu"] == 8
+
+
+def test_autotune_convenience():
+    groups.destroy_mesh()
+    cfg = autotune(lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=1), BASE, batch_fn,
+                   micro_batches=[8], zero_stages=[0], steps=1)
+    assert cfg["train_micro_batch_size_per_gpu"] == 8
