@@ -43,7 +43,7 @@ class Autotuner:
 
     def __init__(self, model_fn, base_config, batch_fn, micro_batches=None,
                  zero_stages=None, steps=3, mesh=None, results_dir=None,
-                 metric="throughput"):
+                 metric="throughput", autotuning_config=None):
         self.model_fn = model_fn
         self.base_config = base_config
         self.batch_fn = batch_fn
@@ -53,6 +53,17 @@ class Autotuner:
         self.mesh = mesh
         self.metric = metric
         self.results_dir = results_dir
+        if autotuning_config is None and isinstance(base_config.get("autotuning"), dict):
+            from deepspeed_tpu.autotuning.config import get_autotuning_config
+            autotuning_config = get_autotuning_config(base_config)
+        if autotuning_config is not None:
+            lo = autotuning_config.min_train_micro_batch_size_per_gpu
+            hi = autotuning_config.max_train_micro_batch_size_per_gpu
+            self.micro_batches = [m for m in self.micro_batches
+                                  if m >= lo and (hi is None or m <= hi)]
+            self.metric = autotuning_config.metric
+            if autotuning_config.results_dir and results_dir is None:
+                self.results_dir = autotuning_config.results_dir
         self.results = []
         self.best = None
 
@@ -89,7 +100,9 @@ class Autotuner:
             for _ in range(self.steps):
                 engine.train_batch(batch=stacked)
             dt = (time.perf_counter() - t0) / self.steps
-            record["value"] = engine.train_batch_size() / dt  # samples/sec
+            # throughput over the samples actually fed (mbs * gas), not the
+            # config's train_batch_size (whose world factor may differ)
+            record["value"] = (mbs * gas) / dt  # samples/sec
             record["step_time_s"] = dt
         except Exception as e:  # OOM / compile failure → prune candidate
             record["error"] = f"{type(e).__name__}: {e}"

@@ -1,0 +1,14 @@
+"""Inference v2: ragged (FastGen-style) serving.
+
+Parity: deepspeed/inference/v2/ — engine_v2.py:107 (InferenceEngineV2),
+ragged/ (state manager, sequence descriptors, blocked KV cache,
+ragged batch), plus the Dynamic SplitFuse continuous-batching scheduler
+the reference ships via DeepSpeed-MII."""
+
+from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, QuantizationConfig,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+
+__all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig", "DSStateManagerConfig",
+           "QuantizationConfig", "DynamicSplitFuseScheduler"]

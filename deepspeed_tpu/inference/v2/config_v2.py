@@ -1,0 +1,29 @@
+"""v2 inference engine config.
+
+Capability match for the reference's
+``deepspeed/inference/v2/config_v2.py`` (``RaggedInferenceEngineConfig``
+with its ``DSStateManagerConfig``)."""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DSStateManagerConfig(DeepSpeedConfigModel):
+    max_tracked_sequences: int = 2048
+    max_ragged_batch_size: int = 768
+    max_ragged_sequence_count: int = 512
+    max_context: int = 8192
+    memory_config_mode: str = "reserve"  # "reserve" | "allocate"
+    memory_reserve_percentage: int = 90
+    offload_kv: bool = False
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    quantization_mode: str = "none"
+
+
+class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
+    tensor_parallel_degree: int = 1
+    kv_block_size: int = 16
+    num_kv_blocks: int = 0  # 0 = derive from max_context * max sequences
+    state_manager: DSStateManagerConfig = DSStateManagerConfig()
+    quantization: QuantizationConfig = QuantizationConfig()

@@ -1,0 +1,127 @@
+"""InferenceEngineV2: ragged (continuous-batching) serving engine.
+
+Capability match for the reference's
+``deepspeed/inference/v2/engine_v2.py`` (``InferenceEngineV2`` at
+engine_v2.py:107: ``put(batch_uids, batch_tokens)`` runs one ragged
+batch; ``flush``/``query`` manage sequence state). TPU execution: one
+jitted step (compiled once, KV pool donated) consumes the padded flat
+batch from ``RaggedBatchWrapper``; mixed prefill chunks and decodes
+run in the same program — the Dynamic SplitFuse model."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.model_runner import ragged_forward
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
+from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
+from deepspeed_tpu.utils.logging import logger
+
+
+class InferenceEngineV2:
+
+    def __init__(self, model=None, config: RaggedInferenceEngineConfig = None,
+                 params=None, model_config=None, dtype=jnp.bfloat16, rng=None):
+        """``model``: a ``LlamaForCausalLM`` (its scan-stacked params are
+        initialized here when ``params`` is not given), or pass
+        ``params`` + ``model_config`` directly."""
+        self._config = config or RaggedInferenceEngineConfig()
+        sm = self._config.state_manager
+        self.dtype = dtype
+
+        if model_config is None:
+            model_config = model.config
+        self.model_config = model_config
+        if params is None:
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+            sample = jnp.zeros((1, 8), jnp.int32)
+            params = model.init(rng, sample)["params"]
+        self.params = jax.tree.map(
+            lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+        cfg = self.model_config
+        self.max_tokens = int(sm.max_ragged_batch_size)
+        self.max_seqs = int(sm.max_ragged_sequence_count)
+        self.block_size = int(self._config.kv_block_size)
+        self.max_blocks_per_seq = -(-int(sm.max_context) // self.block_size)
+        num_blocks = int(self._config.num_kv_blocks) or (
+            1 + self.max_seqs * self.max_blocks_per_seq)
+        self.kv_cache = BlockedKVCache(cfg.num_hidden_layers, num_blocks, self.block_size,
+                                       cfg.num_key_value_heads, cfg.head_dim, dtype=dtype)
+        self.state_manager = DSStateManager(self.kv_cache, self.max_seqs)
+        self._batch = RaggedBatchWrapper(self.max_tokens, self.max_seqs,
+                                         self.max_blocks_per_seq)
+        self._step = jax.jit(
+            lambda p, kc, vc, b: ragged_forward(p, kc, vc, b, cfg, dtype),
+            donate_argnums=(1, 2))
+        logger.info(f"InferenceEngineV2: max_tokens={self.max_tokens} "
+                    f"max_seqs={self.max_seqs} kv_blocks={num_blocks} "
+                    f"block_size={self.block_size} "
+                    f"kv_bytes={self.kv_cache.bytes()/1e6:.1f}MB")
+
+    # ------------------------------------------------------------------
+    def put(self, batch_uids, batch_tokens, do_checks=True):
+        """Run one ragged batch: ``batch_tokens[i]`` are the NEW tokens
+        (full prompt, a prefill chunk, or one decode token) for
+        ``batch_uids[i]``. Returns fp32 logits ``[len(uids), vocab]``
+        for each sequence's last scheduled token."""
+        batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]
+        # Validate the WHOLE batch before touching any sequence state: a
+        # mid-loop failure after allocate/advance would leave earlier
+        # sequences claiming KV that was never written.
+        total = sum(len(t) for t in batch_tokens)
+        if total > self.max_tokens:
+            raise ValueError(f"batch has {total} tokens > "
+                             f"max_ragged_batch_size={self.max_tokens}")
+        if len(batch_uids) > self.max_seqs:
+            raise ValueError(f"{len(batch_uids)} sequences > "
+                             f"max_ragged_sequence_count={self.max_seqs}")
+        max_ctx = self.max_blocks_per_seq * self.block_size
+        blocks_needed = 0
+        new_seqs = 0
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            desc = self.state_manager.query(uid)
+            seen = desc.seen_tokens if desc is not None else 0
+            if desc is None:
+                new_seqs += 1
+            if seen + len(tokens) > max_ctx:
+                raise ValueError(f"sequence {uid}: {seen}+{len(tokens)} tokens exceed "
+                                 f"max_context={max_ctx}")
+            blocks_needed += (desc.blocks_needed(len(tokens)) if desc is not None
+                              else -(-len(tokens) // self.block_size))
+        if blocks_needed > self.kv_cache.free_blocks:
+            raise RuntimeError(f"KV pool exhausted: need {blocks_needed} blocks, "
+                               f"{self.kv_cache.free_blocks} free — flush() sequences first")
+        if new_seqs > len(self.state_manager._free_slots):
+            raise RuntimeError("max_tracked_sequences exceeded for this batch")
+
+        self._batch.clear()
+        slots = []
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            desc = self.state_manager.get_or_create_sequence(uid)
+            self.state_manager.allocate_for(desc, len(tokens))
+            self._batch.insert_sequence(desc, tokens)
+            desc.advance(len(tokens))
+            slots.append(desc.slot)
+        arrays = self._batch.finalize()
+        logits, self.kv_cache.k, self.kv_cache.v = self._step(
+            self.params, self.kv_cache.k, self.kv_cache.v, arrays)
+        return np.asarray(logits)[np.asarray(slots)]
+
+    def query(self, uid):
+        """→ (seen_tokens, max_new_before_realloc) parity surface."""
+        desc = self.state_manager.query(uid)
+        if desc is None:
+            return None
+        room = desc.cur_allocated_blocks * self.block_size - desc.seen_tokens
+        return desc.seen_tokens, room
+
+    def flush(self, uid):
+        self.state_manager.flush_sequence(uid)
+
+    @property
+    def free_blocks(self):
+        return self.kv_cache.free_blocks

@@ -1,0 +1,104 @@
+"""Ragged model execution: flat token batches against a paged KV cache.
+
+Capability match for the reference's v2 model implementations
+(``deepspeed/inference/v2/model_implementations/llama_v2/model.py`` over
+the ragged kernels in ``deepspeed/inference/v2/kernels/ragged_ops/``:
+linear_blocked_kv_rotary, atom-based blocked attention). TPU redesign:
+one jitted function consumes the padded flat batch —
+
+- tokens are a flat ``[T]`` buffer with per-token (slot, position);
+- each layer scatters new K/V into the block pool at
+  ``(block_tables[slot, pos // bs], pos % bs)`` and attends by
+  gathering the sequence's block table (masked to ``pos``), which
+  handles mixed prefill chunks + decodes in ONE program — the
+  Dynamic SplitFuse execution model;
+- the layer stack is ``lax.scan`` over the flagship Llama's stacked
+  scan params, so any ``LlamaForCausalLM`` checkpoint serves directly.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import LlamaConfig, rope_frequencies
+
+
+def _rms(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_flat(x, cos, sin, positions):
+    """x: [T, H, D]; cos/sin tables [maxlen, D/2]; positions [T]."""
+    c = cos[positions][:, None, :]
+    s = sin[positions][:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _layer_step(cfg, cos, sin, batch, h, xs):
+    lp, kc, vc = xs
+    T, D = h.shape
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    bs = kc.shape[1]
+    attn = lp["self_attn"]
+
+    hn = _rms(h, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+    q = (hn @ attn["q_proj"]["kernel"].astype(h.dtype)).reshape(T, H, Dh)
+    k = (hn @ attn["k_proj"]["kernel"].astype(h.dtype)).reshape(T, Hkv, Dh)
+    v = (hn @ attn["v_proj"]["kernel"].astype(h.dtype)).reshape(T, Hkv, Dh)
+    q = _rope_flat(q, cos, sin, batch["token_pos"])
+    k = _rope_flat(k, cos, sin, batch["token_pos"])
+
+    # scatter this step's K/V into the paged pool (pad tokens hit the
+    # null block owned by the pad slot)
+    blk = batch["block_tables"][batch["token_seq"], batch["token_pos"] // bs]  # [T]
+    off = batch["token_pos"] % bs
+    kc = kc.at[blk, off].set(k.astype(kc.dtype))
+    vc = vc.at[blk, off].set(v.astype(vc.dtype))
+
+    # attend over each token's block-tabled context: Pallas decode
+    # kernel on TPU, gather-based XLA path elsewhere
+    from deepspeed_tpu.ops.pallas import use_pallas
+    from deepspeed_tpu.ops.pallas.paged_attention import (kernel_supported,
+                                                          paged_decode_attention,
+                                                          xla_paged_attention)
+    tab = batch["block_tables"][batch["token_seq"]]  # [T, MB]
+    attn_fn = paged_decode_attention if (use_pallas() and kernel_supported(Dh, bs)) \
+        else xla_paged_attention
+    out = attn_fn(q, kc, vc, tab, batch["token_pos"])
+    h = h + out.reshape(T, H * Dh) @ attn["o_proj"]["kernel"].astype(h.dtype)
+
+    hn2 = _rms(h, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+    mlp = lp["mlp"]
+    gate = hn2 @ mlp["gate_proj"]["kernel"].astype(h.dtype)
+    up = hn2 @ mlp["up_proj"]["kernel"].astype(h.dtype)
+    h = h + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(h.dtype)
+    return h, (kc, vc)
+
+
+def ragged_forward(params, kcache, vcache, batch, cfg: LlamaConfig, dtype=jnp.bfloat16):
+    """→ (last-token logits [max_seqs, vocab] fp32, new kcache, new vcache).
+
+    ``kcache``/``vcache``: [L, NB, bs, Hkv, Dh]; ``batch``: the arrays
+    of ``RaggedBatchWrapper.finalize()``."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    embed = params["model"]["embed_tokens"]
+    h = embed[batch["token_ids"]].astype(dtype)  # [T, D]
+
+    step = functools.partial(_layer_step, cfg, cos, sin, batch)
+    h, (kc, vc) = jax.lax.scan(step, h, (params["model"]["layers"], kcache, vcache))
+
+    h = _rms(h, params["model"]["norm"]["scale"], cfg.rms_norm_eps)
+    if "lm_head" in params:
+        logits = h @ params["lm_head"]["kernel"].astype(h.dtype)
+    else:  # tied embeddings
+        logits = h @ embed.T.astype(h.dtype)
+    sel = logits[batch["last_index"]]  # [max_seqs, V]
+    return sel.astype(jnp.float32), kc, vc

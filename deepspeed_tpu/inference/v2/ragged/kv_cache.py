@@ -1,0 +1,49 @@
+"""Blocked (paged) KV cache.
+
+Capability match for the reference's
+``deepspeed/inference/v2/ragged/kv_cache.py`` (``BlockedKVCache`` at
+kv_cache.py:40): a pool of fixed-size KV blocks shared by all
+sequences, fronted by :class:`BlockedAllocator`. TPU design: the pool
+is two device arrays ``[num_layers, num_blocks, block_size, n_kv_heads,
+head_dim]`` updated functionally (the engine donates them through the
+jitted step, so XLA updates in place). Block 0 is reserved as the
+null block — padding tokens scatter there and no live sequence ever
+owns it."""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+
+NULL_BLOCK = 0
+
+
+class BlockedKVCache:
+
+    def __init__(self, num_layers, num_blocks, block_size, n_kv_heads, head_dim,
+                 dtype=jnp.bfloat16):
+        assert num_blocks >= 2, "need at least one real block beyond the null block"
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        shape = (num_layers, num_blocks, block_size, n_kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._allocator = BlockedAllocator(num_blocks)
+        self._allocator.allocate(1)  # pin the null block forever
+
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    def reserve(self, num_blocks):
+        return self._allocator.allocate(num_blocks)
+
+    def free(self, blocks):
+        if len(blocks):
+            self._allocator.free(blocks)
+
+    def bytes(self) -> int:
+        return 2 * self.k.size * self.k.dtype.itemsize

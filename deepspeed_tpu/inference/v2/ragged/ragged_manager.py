@@ -1,0 +1,52 @@
+"""Sequence state manager.
+
+Capability match for the reference's
+``deepspeed/inference/v2/ragged/ragged_manager.py`` (``DSStateManager``
+at ragged_manager.py:19): tracks live sequences (uid → descriptor),
+owns the KV block allocation for each, and hands out batch slots."""
+
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+
+
+class DSStateManager:
+
+    def __init__(self, kv_cache: BlockedKVCache, max_tracked_sequences: int):
+        self.kv_cache = kv_cache
+        self.max_tracked_sequences = max_tracked_sequences
+        self._seqs = {}  # uid -> descriptor
+        self._free_slots = list(range(max_tracked_sequences))
+
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.kv_cache.free_blocks
+
+    def query(self, uid):
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid) -> DSSequenceDescriptor:
+        desc = self._seqs.get(uid)
+        if desc is not None:
+            return desc
+        if not self._free_slots:
+            raise RuntimeError(f"max_tracked_sequences={self.max_tracked_sequences} exceeded")
+        slot = self._free_slots.pop(0)
+        desc = DSSequenceDescriptor(uid, slot, self.kv_cache.block_size)
+        self._seqs[uid] = desc
+        return desc
+
+    def allocate_for(self, desc: DSSequenceDescriptor, new_tokens: int) -> None:
+        need = desc.blocks_needed(new_tokens)
+        if need > 0:
+            desc.extend_blocks(self.kv_cache.reserve(need))
+
+    def flush_sequence(self, uid) -> None:
+        desc = self._seqs.pop(uid, None)
+        if desc is None:
+            raise KeyError(f"unknown sequence {uid}")
+        self.kv_cache.free(desc.blocks)
+        self._free_slots.append(desc.slot)

@@ -1,0 +1,77 @@
+"""Ragged batch assembly.
+
+Capability match for the reference's
+``deepspeed/inference/v2/ragged/ragged_wrapper.py``
+(``RaggedBatchWrapper``: flat token buffer + per-sequence metadata the
+kernels consume). TPU adaptation: every array is padded to the STATIC
+shapes (max_tokens, max_seqs, max_blocks_per_seq) so the jitted step
+compiles exactly once; padding tokens point at a dedicated pad slot
+whose block table is all null blocks."""
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.ragged.kv_cache import NULL_BLOCK
+
+
+class RaggedBatchWrapper:
+
+    def __init__(self, max_tokens, max_seqs, max_blocks_per_seq):
+        self.max_tokens = max_tokens
+        self.max_seqs = max_seqs
+        self.max_blocks = max_blocks_per_seq
+        self.clear()
+
+    def clear(self):
+        self.token_ids = np.zeros(self.max_tokens, np.int32)
+        # pad tokens live in the extra pad slot (row max_seqs)
+        self.token_seq = np.full(self.max_tokens, self.max_seqs, np.int32)
+        self.token_pos = np.zeros(self.max_tokens, np.int32)
+        self.block_tables = np.full((self.max_seqs + 1, self.max_blocks), NULL_BLOCK, np.int32)
+        self.last_index = np.zeros(self.max_seqs, np.int32)
+        self.seq_valid = np.zeros(self.max_seqs, bool)
+        self._cursor = 0
+        self._order = []  # slots in insertion order
+
+    @property
+    def current_tokens(self):
+        return self._cursor
+
+    @property
+    def current_sequences(self):
+        return len(self._order)
+
+    def insert_sequence(self, desc, tokens):
+        """Append ``tokens`` (this step's chunk) for ``desc``; positions
+        continue from the tokens already in the KV cache."""
+        n = len(tokens)
+        if self._cursor + n > self.max_tokens:
+            raise ValueError(f"ragged batch overflow: {self._cursor}+{n} > {self.max_tokens}")
+        if desc.slot >= self.max_seqs:
+            raise ValueError(f"slot {desc.slot} out of range")
+        if len(desc.blocks) > self.max_blocks:
+            raise ValueError(f"sequence {desc.uid} owns {len(desc.blocks)} blocks > "
+                             f"max_blocks_per_seq={self.max_blocks} (context overflow)")
+        sl = slice(self._cursor, self._cursor + n)
+        self.token_ids[sl] = np.asarray(tokens, np.int32)
+        self.token_seq[sl] = desc.slot
+        self.token_pos[sl] = desc.seen_tokens + np.arange(n, dtype=np.int32)
+        blocks = desc.blocks
+        self.block_tables[desc.slot, :len(blocks)] = blocks
+        self.last_index[desc.slot] = self._cursor + n - 1
+        self.seq_valid[desc.slot] = True
+        self._cursor += n
+        self._order.append(desc.slot)
+
+    def finalize(self):
+        """→ dict of numpy arrays for the device step."""
+        return {
+            "token_ids": self.token_ids,
+            "token_seq": self.token_seq,
+            "token_pos": self.token_pos,
+            "block_tables": self.block_tables,
+            "last_index": self.last_index,
+            "num_tokens": np.int32(self._cursor),
+        }
+
+    def slots_in_order(self):
+        return list(self._order)

@@ -1,0 +1,40 @@
+"""Per-sequence tracking state.
+
+Capability match for the reference's
+``deepspeed/inference/v2/ragged/sequence_descriptor.py``
+(``DSSequenceDescriptor``): host-side bookkeeping of how many tokens a
+sequence has in the KV cache, which cache blocks it owns, and its slot
+in the (fixed-size) batch tables."""
+
+import numpy as np
+
+
+class DSSequenceDescriptor:
+
+    def __init__(self, uid: int, slot: int, block_size: int):
+        self.uid = uid
+        self.slot = slot  # row in the device block table / batch tables
+        self.block_size = block_size
+        self.seen_tokens = 0  # tokens already written to the KV cache
+        self.blocks = []  # owned KV block ids, in order
+        self.in_flight_tokens = 0
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.blocks)
+
+    def blocks_needed(self, new_tokens: int) -> int:
+        """How many more blocks to hold ``new_tokens`` beyond seen."""
+        total = self.seen_tokens + new_tokens
+        need = -(-total // self.block_size)  # ceil
+        return max(0, need - len(self.blocks))
+
+    def extend_blocks(self, block_ids) -> None:
+        self.blocks.extend(int(b) for b in np.atleast_1d(block_ids))
+
+    def advance(self, n_tokens: int) -> None:
+        self.seen_tokens += n_tokens
+
+    def __repr__(self):
+        return (f"DSSequenceDescriptor(uid={self.uid}, slot={self.slot}, "
+                f"seen={self.seen_tokens}, blocks={len(self.blocks)})")

@@ -1,0 +1,111 @@
+"""Continuous-batching scheduler (Dynamic SplitFuse).
+
+Capability match for the scheduling policy the reference ships in
+DeepSpeed-MII on top of ``InferenceEngineV2`` (and described in the
+DeepSpeed-FastGen paper): every engine step carries a fixed token
+budget; running (decode) sequences get one token each first, and the
+remaining budget is filled with chunks of pending prompts — long
+prompts are SPLIT across steps, decodes are FUSED into prefill steps,
+so step latency stays flat and the MXU stays fed."""
+
+from collections import OrderedDict
+
+import numpy as np
+
+
+class Request:
+
+    def __init__(self, uid, prompt_tokens, max_new_tokens):
+        self.uid = uid
+        self.prompt = list(np.atleast_1d(np.asarray(prompt_tokens)).tolist())
+        self.max_new_tokens = max_new_tokens
+        self.prefill_cursor = 0  # prompt tokens already scheduled
+        self.generated = []
+        self.next_token = None  # decode token awaiting scheduling
+        self.done = False
+
+    @property
+    def prefilling(self):
+        return self.prefill_cursor < len(self.prompt)
+
+
+class DynamicSplitFuseScheduler:
+    """Drives an :class:`InferenceEngineV2` to completion over a request
+    stream. ``sample_fn(logits) -> token`` picks the next token
+    (default greedy argmax); generation stops at ``eos_token_id`` or
+    ``max_new_tokens``."""
+
+    def __init__(self, engine, token_budget=None, sample_fn=None, eos_token_id=None):
+        self.engine = engine
+        self.budget = int(token_budget or engine.max_tokens)
+        if self.budget > engine.max_tokens:
+            raise ValueError(f"budget {self.budget} > engine max_tokens {engine.max_tokens}")
+        self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
+        self.eos_token_id = eos_token_id
+        self.requests = OrderedDict()  # uid -> Request
+
+    def add_request(self, uid, prompt_tokens, max_new_tokens=16):
+        if uid in self.requests:
+            raise ValueError(f"uid {uid} already queued")
+        self.requests[uid] = Request(uid, prompt_tokens, max_new_tokens)
+
+    @property
+    def has_work(self):
+        return any(not r.done for r in self.requests.values())
+
+    def _plan(self):
+        """One step's (uids, token-chunks) within the budget: decodes
+        first, then prompt chunks (splitting long prompts)."""
+        uids, chunks = [], []
+        budget = self.budget
+        max_seqs = self.engine.max_seqs
+        live = [r for r in self.requests.values() if not r.done]
+        # 1) decodes: one token each
+        for r in live:
+            if r.next_token is not None and budget > 0 and len(uids) < max_seqs:
+                uids.append(r.uid)
+                chunks.append([r.next_token])
+                r.next_token = None
+                budget -= 1
+        # 2) prefills: fill the remaining budget with prompt chunks
+        for r in live:
+            if budget <= 0 or len(uids) >= max_seqs:
+                break
+            if r.prefilling and r.uid not in uids:
+                take = min(budget, len(r.prompt) - r.prefill_cursor)
+                chunk = r.prompt[r.prefill_cursor:r.prefill_cursor + take]
+                r.prefill_cursor += take
+                uids.append(r.uid)
+                chunks.append(chunk)
+                budget -= take
+        return uids, chunks
+
+    def step(self):
+        """Schedule + run one engine step; returns the uids stepped."""
+        uids, chunks = self._plan()
+        if not uids:
+            return []
+        logits = self.engine.put(uids, chunks)
+        for uid, row in zip(uids, logits):
+            r = self.requests[uid]
+            if r.prefilling:
+                continue  # mid-prompt chunk: its last-token logits are unused
+            tok = self.sample_fn(row)
+            r.generated.append(tok)
+            if (self.eos_token_id is not None and tok == self.eos_token_id) \
+                    or len(r.generated) >= r.max_new_tokens:
+                r.done = True
+                self.engine.flush(uid)
+            else:
+                r.next_token = tok
+        return uids
+
+    def run_to_completion(self, max_steps=10000):
+        """→ {uid: generated tokens} after all requests finish."""
+        steps = 0
+        while self.has_work:
+            stepped = self.step()
+            steps += 1
+            if steps > max_steps or (not stepped and self.has_work):
+                raise RuntimeError("scheduler stalled")
+        return {uid: list(r.generated) for uid, r in self.requests.items()}

@@ -1,0 +1,145 @@
+"""Paged decode attention kernel: one query token vs a block-tabled KV.
+
+TPU-native counterpart of the reference's ragged decode kernels
+(``deepspeed/inference/v2/kernels/ragged_ops/atom_builder`` +
+``blocked_flash`` over the blocked KV cache,
+``csrc/.../ragged_ops/``). Each grid step handles ONE token: its block
+table rides in SMEM (scalar prefetch), KV blocks are dynamically
+indexed out of the pool, and scores accumulate flash-style (running
+max / sum) with positions beyond the token's context masked. GQA is
+handled by viewing the query heads as [Hkv, G, Dh].
+
+The XLA reference path (``xla_paged_attention``) is the same math via
+gather; the v2 model runner dispatches the kernel on TPU through
+``use_pallas()`` and this fallback elsewhere.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def xla_paged_attention(q, kc, vc, block_tables, token_pos):
+    """Reference math. q: [T, H, Dh]; kc/vc: [NB, bs, Hkv, Dh];
+    block_tables: [T, MB] (per TOKEN, already indexed by its sequence);
+    token_pos: [T]. → [T, H, Dh]; attends to positions <= token_pos."""
+    T, H, Dh = q.shape
+    _, bs, Hkv, _ = kc.shape
+    ks = kc[block_tables].reshape(T, -1, Hkv, Dh).astype(q.dtype)
+    vs = vc[block_tables].reshape(T, -1, Hkv, Dh).astype(q.dtype)
+    if Hkv != H:
+        rep = H // Hkv
+        ks = jnp.repeat(ks, rep, axis=2)
+        vs = jnp.repeat(vs, rep, axis=2)
+    scale = 1.0 / np.sqrt(Dh)
+    scores = jnp.einsum("thd,tchd->thc", q, ks).astype(jnp.float32) * scale
+    mask = (jnp.arange(ks.shape[1])[None, :] <= token_pos[:, None])[:, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("thc,tchd->thd", probs, vs)
+
+
+def kernel_supported(head_dim, block_size):
+    """Mosaic constraint: the per-block DMA slices the pool's last dim,
+    which must be 128-lane aligned — i.e. head_dim % 128 == 0 (true for
+    the production Llama family; tiny debug configs fall back to XLA)."""
+    return head_dim % 128 == 0 and block_size % 8 == 0
+
+
+def _kernel(tab_ref, pos_ref, q_ref, kc_ref, vc_ref, o_ref,
+            k_buf, v_buf, k_sem, v_sem, *, bs, max_blocks, groups):
+    """One token: q_ref [1, H, Dh] (VMEM); kc/vc whole pool
+    [NB, bs, Hkv, Dh] stay in HBM (ANY) — each table block is DMA'd
+    into the VMEM scratch buffers; tab/pos in SMEM via scalar prefetch."""
+    t = pl.program_id(0)
+    H, Dh = q_ref.shape[1], q_ref.shape[2]
+    Hkv = kc_ref.shape[2]
+    G = groups
+    pos = pos_ref[t]
+    scale = 1.0 / np.sqrt(Dh)
+    # everything stays 2-D: Mosaic's vector layouts reject >2-D reshapes
+    q = q_ref[0].astype(jnp.float32) * scale  # [H, Dh], heads grouped [Hkv x G]
+
+    def block_step(i, carry):
+        m, l, acc = carry  # [H, 1], [H, 1], [H, Dh]
+        blk = tab_ref[t, i]
+        ck = pltpu.make_async_copy(kc_ref.at[blk], k_buf, k_sem)
+        cv = pltpu.make_async_copy(vc_ref.at[blk], v_buf, v_sem)
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+        # per-kv-head 2-D matmuls, statically unrolled
+        s_parts = []
+        for h in range(Hkv):
+            kh = k_buf[:, h, :].astype(jnp.float32)  # [bs, Dh]
+            qh = jax.lax.slice(q, (h * G, 0), ((h + 1) * G, Dh))  # [G, Dh]
+            s_parts.append(jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                                               precision=jax.lax.Precision.HIGHEST))
+        s = jnp.concatenate(s_parts, axis=0)  # [H, bs]
+        kv_pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(kv_pos <= pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv_parts = []
+        for h in range(Hkv):
+            vh = v_buf[:, h, :].astype(jnp.float32)  # [bs, Dh]
+            ph = jax.lax.slice(p, (h * G, 0), ((h + 1) * G, bs))  # [G, bs]
+            pv_parts.append(jax.lax.dot_general(ph, vh, (((1,), (0,)), ((), ())),
+                                                precision=jax.lax.Precision.HIGHEST))
+        pv = jnp.concatenate(pv_parts, axis=0)  # [H, Dh]
+        acc_new = acc * alpha + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    a0 = jnp.zeros((H, Dh), jnp.float32)
+    n_blocks = jnp.minimum(pos // bs + 1, max_blocks)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, block_step, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, kc, vc, block_tables, token_pos, interpret=None):
+    """Pallas path of :func:`xla_paged_attention` (same contract)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T, H, Dh = q.shape
+    NB, bs, Hkv, _ = kc.shape
+    MB = block_tables.shape[1]
+    groups = H // Hkv
+    if not interpret and not kernel_supported(Dh, bs):
+        return xla_paged_attention(q, kc, vc, block_tables, token_pos)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, positions
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda t, tab, pos: (t, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda t, tab, pos: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bs, Hkv, Dh), kc.dtype),
+            pltpu.VMEM((bs, Hkv, Dh), vc.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(_kernel, bs=bs, max_blocks=MB, groups=groups)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, H, Dh), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), token_pos.astype(jnp.int32), q, kc, vc)

@@ -221,7 +221,6 @@ class FlopsProfiler:
     # the work --------------------------------------------------------------
     def profile(self, fn, *args, time_it=True, **kwargs):
         self.total_flops, self.total_macs, self.by_module = profile_fn(fn, *args, **kwargs)
-        params = [a for a in jax.tree.leaves(args) if hasattr(a, "shape")]
         if time_it:
             try:
                 jitted = jax.jit(fn)

@@ -919,6 +919,8 @@ class DeepSpeedEngine:
         self._materialize_state(*jax.tree.map(lambda x: x[0], batch[0]),
                                 **jax.tree.map(lambda x: x[0], batch[1]))
         batch = self._shard_batch(batch, extra_leading=1)
+        self._maybe_flops_profile(jax.tree.map(lambda x: x[0], batch[0]),
+                                  jax.tree.map(lambda x: x[0], batch[1]))
 
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()

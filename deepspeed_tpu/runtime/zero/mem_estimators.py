@@ -22,13 +22,21 @@ def _human(num_bytes):
     return f"{num_bytes:.0f}B"
 
 
-def _total_params(model, rng=None, sample_args=None):
-    if hasattr(model, "init") and sample_args is not None:
+def _total_and_largest(model, rng=None, sample_args=None):
+    """→ (total param count, largest single-leaf param count)."""
+    if hasattr(model, "init"):
+        if sample_args is None:
+            raise ValueError("pass sample_args=(example_inputs,) to size a flax module "
+                             "(its params only exist after abstract init)")
         variables = jax.eval_shape(lambda r: model.init(r, *sample_args),
                                    rng or jax.random.PRNGKey(0))
-        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(variables))
-    leaves = jax.tree.leaves(model)
-    return sum(int(np.prod(l.shape)) for l in leaves if hasattr(l, "shape"))
+        leaves = jax.tree.leaves(variables)
+    else:
+        leaves = [l for l in jax.tree.leaves(model) if hasattr(l, "shape")]
+    sizes = [int(np.prod(l.shape)) for l in leaves]
+    if not sizes:
+        raise ValueError("model has no parameter leaves to size")
+    return sum(sizes), max(sizes)
 
 
 def estimate_zero2_model_states_mem_needs(total_params, num_gpus_per_node=1, num_nodes=1,
@@ -73,16 +81,10 @@ def estimate_zero3_model_states_mem_needs(total_params, largest_layer_params=0,
     return int(device), int(host), int(largest_layer_memory)
 
 
-def _largest_layer(model_params):
-    sizes = [int(np.prod(l.shape)) for l in jax.tree.leaves(model_params)
-             if hasattr(l, "shape")]
-    return max(sizes) if sizes else 0
-
-
 def estimate_zero2_model_states_mem_needs_all_live(model, num_gpus_per_node=1, num_nodes=1,
                                                    additional_buffer_factor=1.5,
                                                    sample_args=None):
-    total_params = _total_params(model, sample_args=sample_args)
+    total_params, _ = _total_and_largest(model, sample_args=sample_args)
     estimate_zero2_model_states_mem_needs_all_cold(
         total_params, num_gpus_per_node, num_nodes, additional_buffer_factor)
 
@@ -102,10 +104,7 @@ def estimate_zero2_model_states_mem_needs_all_cold(total_params, num_gpus_per_no
 def estimate_zero3_model_states_mem_needs_all_live(model, num_gpus_per_node=1, num_nodes=1,
                                                    additional_buffer_factor=1.5,
                                                    sample_args=None):
-    total_params = _total_params(model, sample_args=sample_args)
-    largest = 0
-    if not (hasattr(model, "init")):
-        largest = _largest_layer(model)
+    total_params, largest = _total_and_largest(model, sample_args=sample_args)
     estimate_zero3_model_states_mem_needs_all_cold(
         total_params, largest, num_gpus_per_node, num_nodes, additional_buffer_factor)
 

@@ -84,6 +84,22 @@ def test_engine_profile_hook(capsys):
     assert "Flops Profiler" not in capsys.readouterr().out
 
 
+def test_engine_profile_hook_train_batch(capsys):
+    """The fused train_batch path must also trigger the profiler."""
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"data_parallel_size": 8},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+    }
+    model = SimpleModel(hidden_dim=32, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    x, y = random_dataloader(None, 8, 32, batch_size=8)[0]
+    engine.train_batch(batch=(x, y))
+    assert "Flops Profiler" in capsys.readouterr().out
+
+
 def test_formatting_helpers():
     from deepspeed_tpu.profiling.flops_profiler.profiler import (duration_to_string,
                                                                  flops_to_string,
