@@ -1,0 +1,87 @@
+"""Perf experiment harness for the north-star config (not the driver bench)."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12  # v5e bf16
+
+
+def run(name, *, hidden=1536, inter=4096, layers=16, heads=16, B=4, S=2048,
+        stage=3, remat=True, remat_policy="full", attention_impl="auto",
+        steps=6, warmup=2, gas=1):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+    groups.destroy_mesh()
+
+    model = build_llama("160m", hidden_size=hidden, intermediate_size=inter,
+                        num_hidden_layers=layers, num_attention_heads=heads,
+                        num_key_value_heads=heads, max_position_embeddings=max(2048, S),
+                        remat=remat, remat_policy=remat_policy,
+                        attention_impl=attention_impl)
+    config = {
+        "train_batch_size": B * gas,
+        "train_micro_batch_size_per_gpu": B,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, model.config.vocab_size,
+                                  size=(B * gas, S)).astype(np.int32))
+    try:
+        for _ in range(warmup):
+            engine.train_batch(batch=(ids, ids))
+        jax.block_until_ready(engine.params)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            engine.train_batch(batch=(ids, ids))
+            jax.block_until_ready(engine.params)
+            times.append(time.perf_counter() - t0)
+        dt = min(times)  # min filters chip contention spikes
+    except Exception as e:
+        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:160]}")
+        return None
+    n_params = int(sum(np.prod(x.shape) for x in jax.tree.leaves(engine.params)))
+    tokens = B * gas * S
+    dense = 6.0 * n_params * tokens
+    attn = 12.0 * layers * tokens * S * hidden
+    mfu = (dense + attn) / dt / PEAK
+    print(f"{name}: params={n_params/1e6:.0f}M step={dt*1e3:.1f}ms "
+          f"tok/s={tokens/dt:,.0f} MFU={mfu:.3f} (dense-only {dense/dt/PEAK:.3f})")
+    return mfu
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "sweep"
+    if which == "sweep":
+        run("A: r01 config (zero1,remat-full)", stage=1, steps=8)
+        run("C: zero1 dots remat", stage=1, remat_policy="dots", steps=8)
+        run("D: zero3 dots", stage=3, remat_policy="dots", steps=8)
+        run("B: zero3 no remat", stage=3, remat=False, steps=8)
+        run("E: zero3 dots B=8", stage=3, remat_policy="dots", B=8, steps=8)
+    elif which == "base":
+        run("A: r01 config (zero1,remat-full)", stage=1)
+    elif which == "noremat":
+        run("B: no remat", remat=False)
+    elif which == "dots":
+        run("C: dots remat", remat_policy="dots")
+    elif which == "z3":
+        run("D: zero3 dots", stage=3, remat_policy="dots")
+    elif which == "b8":
+        run("E: zero3 dots B=8", stage=3, remat_policy="dots", B=8)
+    elif which == "big":
+        run("F: ~1B zero3 dots", hidden=2048, inter=5504, layers=20, heads=16,
+            stage=3, remat_policy="dots")
+    elif which == "einsum":
+        run("G: einsum attention dots", remat_policy="dots", attention_impl="einsum")

@@ -61,8 +61,13 @@ class Autotuner:
             hi = autotuning_config.max_train_micro_batch_size_per_gpu
             self.micro_batches = [m for m in self.micro_batches
                                   if m >= lo and (hi is None or m <= hi)]
-            self.metric = autotuning_config.metric
-            if autotuning_config.results_dir and results_dir is None:
+            # config overrides only the fields the user actually set —
+            # an explicit constructor argument wins otherwise
+            set_fields = getattr(autotuning_config, "model_fields_set",
+                                 getattr(autotuning_config, "__fields_set__", set()))
+            if "metric" in set_fields:
+                self.metric = autotuning_config.metric
+            if "results_dir" in set_fields and results_dir is None:
                 self.results_dir = autotuning_config.results_dir
         self.results = []
         self.best = None

@@ -51,7 +51,10 @@ class InferenceEngineV2:
             1 + self.max_seqs * self.max_blocks_per_seq)
         self.kv_cache = BlockedKVCache(cfg.num_hidden_layers, num_blocks, self.block_size,
                                        cfg.num_key_value_heads, cfg.head_dim, dtype=dtype)
-        self.state_manager = DSStateManager(self.kv_cache, self.max_seqs)
+        self.state_manager = DSStateManager(self.kv_cache, int(sm.max_tracked_sequences))
+        # positions are bounded by BOTH the block table and the RoPE table
+        self.max_ctx_tokens = min(self.max_blocks_per_seq * self.block_size,
+                                  int(cfg.max_position_embeddings))
         self._batch = RaggedBatchWrapper(self.max_tokens, self.max_seqs,
                                          self.max_blocks_per_seq)
         self._step = jax.jit(
@@ -67,7 +70,11 @@ class InferenceEngineV2:
         """Run one ragged batch: ``batch_tokens[i]`` are the NEW tokens
         (full prompt, a prefill chunk, or one decode token) for
         ``batch_uids[i]``. Returns fp32 logits ``[len(uids), vocab]``
-        for each sequence's last scheduled token."""
+        for each sequence's last scheduled token.
+
+        ``do_checks`` exists for reference API parity but is ignored:
+        validation is what keeps sequence state consistent with the KV
+        pool, so it always runs."""
         batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]
         # Validate the WHOLE batch before touching any sequence state: a
         # mid-loop failure after allocate/advance would leave earlier
@@ -79,7 +86,7 @@ class InferenceEngineV2:
         if len(batch_uids) > self.max_seqs:
             raise ValueError(f"{len(batch_uids)} sequences > "
                              f"max_ragged_sequence_count={self.max_seqs}")
-        max_ctx = self.max_blocks_per_seq * self.block_size
+        max_ctx = self.max_ctx_tokens
         blocks_needed = 0
         new_seqs = 0
         for uid, tokens in zip(batch_uids, batch_tokens):
@@ -95,13 +102,15 @@ class InferenceEngineV2:
         if blocks_needed > self.kv_cache.free_blocks:
             raise RuntimeError(f"KV pool exhausted: need {blocks_needed} blocks, "
                                f"{self.kv_cache.free_blocks} free — flush() sequences first")
-        if new_seqs > len(self.state_manager._free_slots):
+        if new_seqs + self.state_manager.n_tracked_sequences > \
+                self.state_manager.max_tracked_sequences:
             raise RuntimeError("max_tracked_sequences exceeded for this batch")
 
         self._batch.clear()
         slots = []
-        for uid, tokens in zip(batch_uids, batch_tokens):
+        for i, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
             desc = self.state_manager.get_or_create_sequence(uid)
+            desc.slot = i  # slots are per-batch rows in the device tables
             self.state_manager.allocate_for(desc, len(tokens))
             self._batch.insert_sequence(desc, tokens)
             desc.advance(len(tokens))

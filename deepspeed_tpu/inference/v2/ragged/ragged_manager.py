@@ -15,7 +15,6 @@ class DSStateManager:
         self.kv_cache = kv_cache
         self.max_tracked_sequences = max_tracked_sequences
         self._seqs = {}  # uid -> descriptor
-        self._free_slots = list(range(max_tracked_sequences))
 
     @property
     def n_tracked_sequences(self) -> int:
@@ -32,10 +31,9 @@ class DSStateManager:
         desc = self._seqs.get(uid)
         if desc is not None:
             return desc
-        if not self._free_slots:
+        if len(self._seqs) >= self.max_tracked_sequences:
             raise RuntimeError(f"max_tracked_sequences={self.max_tracked_sequences} exceeded")
-        slot = self._free_slots.pop(0)
-        desc = DSSequenceDescriptor(uid, slot, self.kv_cache.block_size)
+        desc = DSSequenceDescriptor(uid, self.kv_cache.block_size)
         self._seqs[uid] = desc
         return desc
 
@@ -49,4 +47,3 @@ class DSStateManager:
         if desc is None:
             raise KeyError(f"unknown sequence {uid}")
         self.kv_cache.free(desc.blocks)
-        self._free_slots.append(desc.slot)

@@ -11,9 +11,11 @@ import numpy as np
 
 class DSSequenceDescriptor:
 
-    def __init__(self, uid: int, slot: int, block_size: int):
+    def __init__(self, uid: int, block_size: int, slot: int = -1):
         self.uid = uid
-        self.slot = slot  # row in the device block table / batch tables
+        # row in the device batch tables; assigned per ragged batch (a
+        # tracked sequence only occupies a slot while it is IN a batch)
+        self.slot = slot
         self.block_size = block_size
         self.seen_tokens = 0  # tokens already written to the KV cache
         self.blocks = []  # owned KV block ids, in order

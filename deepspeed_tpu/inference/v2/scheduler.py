@@ -47,7 +47,10 @@ class DynamicSplitFuseScheduler:
     def add_request(self, uid, prompt_tokens, max_new_tokens=16):
         if uid in self.requests:
             raise ValueError(f"uid {uid} already queued")
-        self.requests[uid] = Request(uid, prompt_tokens, max_new_tokens)
+        req = Request(uid, prompt_tokens, max_new_tokens)
+        if not req.prompt:
+            raise ValueError(f"uid {uid}: empty prompt can never be scheduled")
+        self.requests[uid] = req
 
     @property
     def has_work(self):

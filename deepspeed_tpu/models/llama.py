@@ -49,6 +49,10 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash"
     remat: bool = True
+    # "full" recomputes everything in backward (min memory, ~8N flops);
+    # "dots" saves matmul outputs and recomputes elementwise (the usual
+    # MFU/memory sweet spot); only read when remat=True
+    remat_policy: str = "full"  # "full" | "dots"
     # MoE (0 = dense)
     moe_num_experts: int = 0
     moe_top_k: int = 2
@@ -279,8 +283,9 @@ class LlamaModel(nn.Module):
 
         block = LlamaBlock
         if cfg.remat and not decode:
-            block = nn.remat(block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+            policy = (jax.checkpoint_policies.dots_saveable if cfg.remat_policy == "dots"
+                      else jax.checkpoint_policies.nothing_saveable)
+            block = nn.remat(block, prevent_cse=False, policy=policy)
         carry0 = (h, jnp.zeros((), jnp.float32))
         if decode:
             # cache leaves carry a leading L dim and scan over layers

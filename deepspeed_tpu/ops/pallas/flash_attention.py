@@ -56,9 +56,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU inputs stay in the storage dtype (bf16): fp32 operands run
+        # the MXU at a fraction of peak; accumulation is fp32 regardless
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         s, _ = _mask(s, iq, ik, block_q, block_k, seq_len, causal)
@@ -70,7 +72,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -99,10 +103,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
 
@@ -110,12 +114,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                                 preferred_element_type=jnp.float32) * sm_scale
         s, valid = _mask(s, iq, ik, block_q, block_k, seq_len, causal)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        p16 = p.astype(q.dtype)
 
-        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(p16, do, (((0,), (0,)), ((), ())),
                                                     preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                                     preferred_element_type=jnp.float32)
 
@@ -140,10 +145,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
 
@@ -153,8 +158,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
-        dq_scr[:] = dq_scr[:] + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                                    preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_k - 1)
     def _finish():
@@ -303,7 +309,7 @@ def _reference(q, k, v, causal, sm_scale):
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
-def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=512, block_k=512,
+def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1024,
                     interpret=None, force_pallas=None):
     """Blocked flash attention on [B, S, H, D] tensors.
 
