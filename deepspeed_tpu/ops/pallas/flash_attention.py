@@ -28,17 +28,22 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _mask(s, iq, ik, block_q, block_k, seq_len, causal):
-    """Additive validity mask for one [block_q, block_k] score tile."""
+def _mask(s, iq, ik, block_q, block_k, seq_len, causal, seg_q=None, seg_k=None):
+    """Additive validity mask for one [block_q, block_k] score tile.
+    ``seg_q``/``seg_k``: [block_q, 1] / [block_k, 1] int32 segment ids —
+    packed sequences attend only within equal ids."""
     q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_idx = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     valid = k_idx < seq_len
     if causal:
         valid = jnp.logical_and(valid, q_idx >= k_idx)
+    if seg_q is not None:
+        same = seg_q == jnp.transpose(seg_k)  # [block_q, block_k]
+        valid = jnp.logical_and(valid, same)
     return jnp.where(valid, s, NEG_INF), valid
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, sm_scale, causal, block_q, block_k, seq_len, n_k):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -63,7 +68,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s, _ = _mask(s, iq, ik, block_q, block_k, seq_len, causal)
+        s, _ = _mask(s, iq, ik, block_q, block_k, seq_len, causal,
+                     sq_ref[0][:, :1], sk_ref[0][:, :1])
 
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
@@ -87,8 +93,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l_safe), lse_ref.shape[1:])
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, seq_len, n_q):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, sm_scale, causal, block_q, block_k, seq_len, n_q):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
 
@@ -112,7 +119,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s, valid = _mask(s, iq, ik, block_q, block_k, seq_len, causal)
+        s, valid = _mask(s, iq, ik, block_q, block_k, seq_len, causal,
+                         sq_ref[0][:, :1], sk_ref[0][:, :1])
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         p16 = p.astype(q.dtype)
 
@@ -130,8 +138,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-               *, sm_scale, causal, block_q, block_k, seq_len, n_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+               dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k, seq_len, n_k):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -154,7 +162,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s, valid = _mask(s, iq, ik, block_q, block_k, seq_len, causal)
+        s, valid = _mask(s, iq, ik, block_q, block_k, seq_len, causal,
+                         sq_ref[0][:, :1], sk_ref[0][:, :1])
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -179,12 +188,20 @@ def _blocked_shapes(seq_len, block_q, block_k):
     return block_q, block_k, s_pad
 
 
-def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    """q/k/v: [BH, S, D] → (o [BH, S, D], lse [BH, S_pad])."""
+def _seg_lanes(seg, bh, s_pad):
+    """[BH, S] int32 → [BH, S_pad, 128] lane-replicated (TPU tiling)."""
+    if seg.shape[1] != s_pad:
+        seg = jnp.pad(seg, ((0, 0), (0, s_pad - seg.shape[1])))
+    return jnp.broadcast_to(seg[:, :, None], (bh, s_pad, 128)).astype(jnp.int32)
+
+
+def _fwd_impl(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret):
+    """q/k/v: [BH, S, D]; seg: [BH, S] int32 → (o, lse [BH, S_pad])."""
     bh, seq_len, d = q.shape
     block_q, block_k, s_pad = _blocked_shapes(seq_len, block_q, block_k)
     pad = lambda x: jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]), (0, 0))) if x.shape[1] != s_pad else x
     q_p, k_p, v_p = pad(q), pad(k), pad(v)
+    seg_p = _seg_lanes(seg, bh, s_pad)
     n_q, n_k = s_pad // block_q, s_pad // block_k
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -196,6 +213,8 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 128), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -211,17 +230,18 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q_p, k_p, v_p)
+    )(q_p, k_p, v_p, seg_p, seg_p)
     # Drop the lane replication before saving lse as a VJP residual
     # (128x HBM otherwise); the backward re-broadcasts it.
     return o[:, :seq_len], lse[:, :, 0]
 
 
-def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
+def _bwd_impl(q, k, v, seg, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
     bh, seq_len, d = q.shape
     block_q, block_k, s_pad = _blocked_shapes(seq_len, block_q, block_k)
     pad = lambda x: jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]), (0, 0))) if x.shape[1] != s_pad else x
     q_p, k_p, v_p, do_p = pad(q), pad(k), pad(v), pad(do)
+    seg_p = _seg_lanes(seg, bh, s_pad)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, S]
     if delta.shape[1] != s_pad:
         delta = jnp.pad(delta, ((0, 0), (0, s_pad - delta.shape[1])))
@@ -241,6 +261,8 @@ def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 128), lambda b, j, i: (b, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -255,7 +277,7 @@ def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q_p, k_p, v_p, do_p, lse_p, delta)
+    )(q_p, k_p, v_p, do_p, lse_p, delta, seg_p, seg_p)
     dk, dv = dkv
 
     dq = pl.pallas_call(
@@ -269,53 +291,72 @@ def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 128), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q_p, k_p, v_p, do_p, lse_p, delta)
+    )(q_p, k_p, v_p, do_p, lse_p, delta, seg_p, seg_p)
 
     return dq[:, :seq_len], dk[:, :seq_len], dv[:, :seq_len]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd_impl(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, seg, o, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    return _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret)
+    q, k, v, seg, o, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, seg, o, lse, do, causal, sm_scale,
+                           block_q, block_k, interpret)
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)  # int operand: no tangent
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _reference(q, k, v, causal, sm_scale):
-    """XLA fallback; identical math, fp32 softmax. [BH, S, D] layout."""
+def _reference(q, k, v, causal, sm_scale, seg=None, bias=None):
+    """XLA fallback; identical math, fp32 softmax. [BH, S, D] layout;
+    ``seg``: [BH, S] int32 segment ids; ``bias``: [BH, Sq, Sk]."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    valid = jnp.ones(s.shape[-2:], bool)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, NEG_INF)
+        valid = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+    valid = jnp.broadcast_to(valid, s.shape)
+    if seg is not None:
+        valid = jnp.logical_and(valid, seg[:, :, None] == seg[:, None, :])
+    s = jnp.where(valid, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1024,
-                    interpret=None, force_pallas=None):
+                    segment_ids=None, bias=None, interpret=None, force_pallas=None):
     """Blocked flash attention on [B, S, H, D] tensors.
 
     On TPU runs the Pallas kernels; elsewhere defaults to the XLA
     reference (set ``force_pallas=True``/``interpret=True`` to exercise
     the kernels off-TPU, as the unit tests do).
+
+    ``segment_ids``: [B, S] int32 — packed sequences attend only within
+    equal ids (composes with ``causal``); supported by the kernels.
+    ``bias``: additive [B, 1 or H, Sq, Sk] (Evoformer-style); bias
+    tensors are O(S^2) by construction, so this path uses the XLA
+    reference — blocking saves nothing over an S^2 operand — and is
+    differentiable through bias.
     """
     b, s, h, d = q.shape
     if sm_scale is None:
@@ -333,8 +374,20 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1
     def from_bh(x, heads):
         return x.reshape(b, heads, s, d).transpose(0, 2, 1, 3)
 
-    if not force_pallas:
-        out = _reference(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale)
+    seg_bh = None
+    if segment_ids is not None:
+        seg_bh = jnp.repeat(jnp.asarray(segment_ids, jnp.int32), h, axis=0)  # [B*H, S]
+
+    if bias is not None:
+        bias = jnp.broadcast_to(bias, (b, h, s, s)).reshape(b * h, s, s)
+        out = _reference(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale,
+                         seg=seg_bh, bias=bias)
         return from_bh(out, h)
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale, block_q, block_k, interpret)
+    if not force_pallas:
+        out = _reference(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale, seg=seg_bh)
+        return from_bh(out, h)
+    if seg_bh is None:
+        seg_bh = jnp.zeros((b * h, s), jnp.int32)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), seg_bh, causal, sm_scale,
+                 block_q, block_k, interpret)
     return from_bh(out, h)

@@ -1,0 +1,75 @@
+"""1-bit (sign + scale) compressed all-reduce with error feedback.
+
+Capability match for the reference's compressed-communication backends
+(``deepspeed/runtime/comm/nccl.py:16`` ``NcclBackend.compressed_allreduce``,
+``csrc/includes/compress.h``): gradients/momenta are compressed to one
+SIGN BIT per value plus one fp32 scale per worker chunk, exchanged, and
+decompressed as ``scale * sign``; the compression error is fed back into
+the next step's input (error feedback), which is what keeps 1-bit Adam
+convergent.
+
+TPU redesign: the exchange is an ``all_gather`` of bit-PACKED uint8
+signs (8 values/byte on the wire — the same 32x wire reduction as the
+reference's CUDA pack kernels) inside a manual ``shard_map`` region
+over the 'data' axis. A note on value: over ICI the bandwidth win is
+usually small (ICI is fast); over DCN (multi-pod) it matters — the op
+is provided for both, measured honestly by the comms logger.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _pack_signs(x_flat):
+    """[N] float → ([N/8] uint8 bitmask, N). Requires N % 8 == 0."""
+    bits = (x_flat >= 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))[None, :]
+    return jnp.sum(bits * weights, axis=1).astype(jnp.uint8)
+
+
+def _unpack_signs(packed, n):
+    """[N/8] uint8 → [N] float32 in {-1, +1}."""
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))[None, :]
+    bits = (packed[:, None] & weights) > 0
+    return jnp.where(bits, 1.0, -1.0).astype(jnp.float32).reshape(-1)[:n]
+
+
+def onebit_allreduce(x, axis, error_feedback=None):
+    """Mean-all-reduce of ``x`` over manual mesh ``axis`` with 1-bit
+    compression + error feedback. Must run inside shard_map.
+
+    Returns ``(mean_estimate, new_error_feedback)`` where the estimate is
+    ``mean_i(scale_i * sign(x_i + e_i))`` and the new error is the local
+    compression residual (reference onebit/adam.py:168 semantics)."""
+    n_ranks = jax.lax.axis_size(axis)
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    if error_feedback is not None:
+        flat = flat + error_feedback.reshape(-1)
+    pad = (-n) % 8
+    flat_p = jnp.pad(flat, (0, pad)) if pad else flat
+
+    scale = jnp.mean(jnp.abs(flat))  # one fp32 scale per worker
+    packed = _pack_signs(flat_p)      # [N/8] uint8 on the wire
+    # fp16 overflow protection: a non-finite scale must still poison the
+    # OUTPUT (so the engine's overflow skip triggers) but never the
+    # persistent error-feedback buffer — a NaN residual would stall the
+    # compressed stage forever
+    finite = jnp.isfinite(scale)
+    own = jnp.where(finite, scale, 0.0) * _unpack_signs(packed, n)
+    new_error = jnp.where(finite, flat - own, 0.0)
+
+    all_packed = jax.lax.all_gather(packed, axis)  # [n_ranks, N/8] uint8
+    all_scales = jax.lax.all_gather(scale, axis)   # [n_ranks]
+
+    def add_rank(i, acc):
+        return acc + all_scales[i] * _unpack_signs(all_packed[i], n)
+
+    total = jax.lax.fori_loop(0, n_ranks, add_rank, jnp.zeros_like(flat))
+    mean = (total / n_ranks).reshape(x.shape)
+    return mean, new_error.reshape(x.shape)
+
+
+def compressed_allreduce(x, axis, error_feedback=None):
+    """Reference-named alias (NcclBackend.compressed_allreduce)."""
+    return onebit_allreduce(x, axis, error_feedback)

@@ -207,6 +207,7 @@ class DeepSpeedEngine:
         self._jit_cache = {}
         self._grads_acc = None
         self._host_offload = None  # set by _materialize_state when offloading
+        self._trainable_mask = None  # set by _materialize_state (frozen_parameters)
         self._pending = None  # (loss, grads) from the last forward
         self.global_grad_norm = 0.0
         self.overflow = False
@@ -317,6 +318,14 @@ class DeepSpeedEngine:
             return DeepSpeedCPUAdagrad(**params)
         if name == SGD_OPTIMIZER:
             return SGD(**params)
+        if name == "onebitadam":
+            from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
+            return OnebitAdam(**params)
+        if name in ("zerooneadam", "onebitlamb"):
+            raise NotImplementedError(
+                f"{name}: not implemented — OneBitAdam (type 'OneBitAdam') is the "
+                f"supported compressed optimizer; its gradient-domain error feedback "
+                f"covers the same wire format")
         raise ValueError(f"Unknown optimizer {name}")
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -403,8 +412,13 @@ class DeepSpeedEngine:
         self._opt_specs = self.sharding_policy.tree_opt_specs(self.params)
         self._grad_specs = self.sharding_policy.tree_grad_specs(self.params)
         self._grad_shardings = self.sharding_policy.tree_grad_shardings(self.params)
+        self._trainable_mask = self._build_trainable_mask()
 
         offload_device = self._config.zero_config.offload_optimizer_device().value
+        if offload_device != "none" and self._config._param_dict.get("frozen_parameters"):
+            raise NotImplementedError(
+                "frozen_parameters with offload_optimizer is not supported yet: the host "
+                "SIMD update path has no per-leaf mask — unfreeze or disable offload")
         if offload_device != "none":
             # ZeRO-Offload: fp32 master + moments on host (RAM or NVMe),
             # update on host SIMD (runtime/zero/offload.py). The device
@@ -488,6 +502,109 @@ class DeepSpeedEngine:
             return False
         return dict(self.mesh.shape).get("data", 1) > 1
 
+    def _onebit_enabled(self):
+        return getattr(self.optimizer, "freeze_step", None) is not None and \
+            dict(self.mesh.shape).get("data", 1) > 1
+
+    def _manual_data_specs(self):
+        """Shared spec derivation for manual-'data' shard_map regions
+        (quantized + 1-bit gradient cores): per-leaf manual in-specs for
+        params (the data-sharded dim when divisible), the matching dim
+        maps, and the batch-leaf heuristic."""
+        axis = "data"
+        n = dict(self.mesh.shape)[axis]
+
+        def axis_dim(spec):
+            # -1 = axis absent (None would collapse the pytree)
+            for d, entry in enumerate(spec):
+                entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+                if axis in entries:
+                    return d
+            return -1
+
+        # manual in/out specs require exact divisibility (GSPMD pads,
+        # shard_map does not): non-divisible dims stay replicated
+        divisible = lambda leaf, dim: dim if (dim >= 0 and leaf.shape[dim] % n == 0) else -1
+        param_dims = jax.tree.map(axis_dim, self._param_specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+        param_dims = jax.tree.map(divisible, self.params, param_dims)
+        grad_dims = jax.tree.map(axis_dim, self._grad_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        grad_dims = jax.tree.map(divisible, self.params, grad_dims)
+        manual_spec = lambda dim, ndim: P(*[axis if d == dim else None for d in range(ndim)])
+        to_specs = lambda dims: jax.tree.map(
+            lambda leaf, dim: manual_spec(dim, leaf.ndim) if dim >= 0 else P(),
+            self.params, dims)
+        # Only true batch leaves (leading dim == the micro-batch size) are
+        # split over 'data' in manual mode; anything else (position ids,
+        # shared masks, scalars) stays replicated — splitting a non-batch
+        # input would silently change the loss.
+        mb = self.train_micro_batch_size_per_gpu()
+        batch_spec_of = lambda leaf: P(axis) if (
+            getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == mb and mb % n == 0) else P()
+        return axis, n, param_dims, grad_dims, to_specs, batch_spec_of
+
+    def _onebit_core(self):
+        """Compressed-stage gradient core for 1-bit Adam: per-shard grads
+        exchanged as sign bits + scale with persistent error feedback
+        (reference onebit/adam.py compressed stage over
+        comm/nccl.py:compressed_allreduce)."""
+        from deepspeed_tpu.ops.pallas import manual_axes
+        from deepspeed_tpu.runtime.comm.onebit import onebit_allreduce
+        gas = self.gradient_accumulation_steps()
+
+        def loss_of(params, scale, rng, args, kwargs):
+            out = self._apply_module(params, *args, rngs={"dropout": rng}, **kwargs)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            return (loss.astype(jnp.float32) * scale) / gas, loss
+
+        axis, n, param_dims, _, to_specs, batch_spec_of = self._manual_data_specs()
+        param_in_specs = to_specs(param_dims)
+        efb_specs = jax.tree.map(lambda leaf: P(axis), self.params)
+
+        def body(params, scale, rng, args, kwargs, efb):
+            with manual_axes({axis}):
+                def gather(leaf, dim):
+                    if dim < 0:
+                        return leaf
+                    return jax.lax.all_gather(leaf, axis, axis=dim, tiled=True)
+
+                full = jax.tree.map(gather, params, param_dims)
+                (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                    full, scale, rng, args, kwargs)
+
+                def red(g, e):
+                    mean, e_new = onebit_allreduce(g, axis, e[0])
+                    return mean.astype(g.dtype), e_new[None].astype(e.dtype)
+
+                pairs = jax.tree.map(red, grads, efb)
+                treedef = jax.tree.structure(grads)
+                leaves = treedef.flatten_up_to(pairs)
+                grads = treedef.unflatten([x[0] for x in leaves])
+                efb_new = treedef.unflatten([x[1] for x in leaves])
+                loss = jax.lax.pmean(loss, axis)
+            return loss, grads, efb_new
+
+        def core(params, scale, rng, args, kwargs, efb):
+            mapped = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(param_in_specs, P(), P(),
+                          jax.tree.map(batch_spec_of, args),
+                          jax.tree.map(batch_spec_of, kwargs),
+                          efb_specs),
+                out_specs=(P(), jax.tree.map(lambda _: P(), self.params), efb_specs),
+                axis_names={axis}, check_vma=False)
+            return mapped(params, scale, rng, args, kwargs, efb)
+
+        return core
+
+    def _init_onebit_efb(self):
+        n = dict(self.mesh.shape)["data"]
+        return jax.tree.map(
+            lambda p: jax.device_put(
+                jnp.zeros((n,) + p.shape, jnp.float32),
+                NamedSharding(self.mesh, P("data"))), self.params)
+
     def _vag_core(self):
         """(params, scale, rng, args, kwargs) -> (loss, raw_grads).
 
@@ -520,40 +637,9 @@ class DeepSpeedEngine:
         qg = zc.zero_quantized_gradients
         qw = zc.zero_quantized_weights
         hpz = int(getattr(zc, "zero_hpz_partition_size", 1) or 1)
-        axis = "data"
-        n = dict(self.mesh.shape)[axis]
-
-        def axis_dim(spec):
-            # -1 = axis absent (None would collapse the pytree)
-            for d, entry in enumerate(spec):
-                entries = entry if isinstance(entry, (tuple, list)) else (entry,)
-                if axis in entries:
-                    return d
-            return -1
-
-        param_dims = jax.tree.map(axis_dim, self._param_specs,
-                                  is_leaf=lambda x: isinstance(x, P))
-        grad_dims = jax.tree.map(axis_dim, self._grad_specs,
-                                 is_leaf=lambda x: isinstance(x, P))
-        # manual in/out specs require exact divisibility (GSPMD pads,
-        # shard_map does not): non-divisible dims stay replicated/all-reduced
-        divisible = lambda leaf, dim: dim if (dim >= 0 and leaf.shape[dim] % n == 0) else -1
-        param_dims = jax.tree.map(divisible, self.params, param_dims)
-        grad_dims = jax.tree.map(divisible, self.params, grad_dims)
-        manual_spec = lambda dim, ndim: P(*[axis if d == dim else None for d in range(ndim)])
-        param_in_specs = jax.tree.map(
-            lambda leaf, dim: manual_spec(dim, leaf.ndim) if dim >= 0 else P(),
-            self.params, param_dims)
-        grad_out_specs = jax.tree.map(
-            lambda leaf, dim: manual_spec(dim, leaf.ndim) if dim >= 0 else P(),
-            self.params, grad_dims)
-        # Only true batch leaves (leading dim == the micro-batch size) are
-        # split over 'data' in manual mode; anything else (position ids,
-        # shared masks, scalars) stays replicated — splitting a non-batch
-        # input would silently change the loss.
-        mb = self.train_micro_batch_size_per_gpu()
-        batch_spec_of = lambda leaf: P(axis) if (
-            getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == mb and mb % n == 0) else P()
+        axis, n, param_dims, grad_dims, to_specs, batch_spec_of = self._manual_data_specs()
+        param_in_specs = to_specs(param_dims)
+        grad_out_specs = to_specs(grad_dims)
 
         def body(params, scale, rng, args, kwargs):
             with manual_axes({axis}):
@@ -601,6 +687,24 @@ class DeepSpeedEngine:
             return mapped(params, scale, rng, args, kwargs)
 
         return core
+
+    def _value_and_grad_onebit_fn(self):
+        key = "vag_onebit"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        acc_dtype = self._grad_accum_dtype
+        grad_specs = self._grad_specs
+        core = self._onebit_core()
+
+        def fn(params, scale, rng, args, kwargs, efb):
+            loss, grads, efb_new = core(params, scale, rng, args, kwargs, efb)
+            grads = jax.tree.map(
+                lambda g, spec: jax.lax.with_sharding_constraint(
+                    g.astype(acc_dtype), NamedSharding(self.mesh, spec)), grads, grad_specs)
+            return loss, grads, efb_new
+
+        self._jit_cache[key] = jax.jit(fn, donate_argnums=(5,))
+        return self._jit_cache[key]
 
     def _value_and_grad_fn(self):
         key = "vag"
@@ -660,7 +764,14 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self._dropout_rng, sub = jax.random.split(self._dropout_rng)
         scale = self.scaler_state["cur_scale"]
-        loss, grads = self._value_and_grad_fn()(self.params, scale, sub, args, kwargs)
+        if self._onebit_enabled() and self.global_steps >= self.optimizer.freeze_step:
+            # compressed stage: 1-bit grad exchange with error feedback
+            if getattr(self, "_onebit_efb", None) is None:
+                self._onebit_efb = self._init_onebit_efb()
+            loss, grads, self._onebit_efb = self._value_and_grad_onebit_fn()(
+                self.params, scale, sub, args, kwargs, self._onebit_efb)
+        else:
+            loss, grads = self._value_and_grad_fn()(self.params, scale, sub, args, kwargs)
         self._pending = (loss, grads)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -696,6 +807,34 @@ class DeepSpeedEngine:
         # Gradient reduction is fused into the sharded update by XLA.
         pass
 
+    def _build_trainable_mask(self):
+        """Static per-leaf bools from the `frozen_parameters` config list
+        (regex over leaf paths) — the analogue of requires_grad=False
+        (reference stage3 frozen-param handling). None = all trainable."""
+        patterns = self._config._param_dict.get("frozen_parameters", [])
+        if not patterns:
+            return None
+        compiled = [re.compile(p) for p in patterns]
+        return path_tree_map(
+            lambda path, x: not any(c.search(path) for c in compiled), self.params)
+
+    def _apply_trainable_mask(self, new_tree, old_tree):
+        """Keep frozen leaves at their old values (static select: no
+        runtime cost for the trainable ones)."""
+        if self._trainable_mask is None:
+            return new_tree
+        params_treedef = jax.tree.structure(self.params)
+
+        def mask_like(new, old):
+            if jax.tree.structure(new) == params_treedef:
+                return jax.tree.map(lambda keep, n, o: n if keep else o,
+                                    self._trainable_mask, new, old)
+            return new
+
+        if isinstance(new_tree, dict) and jax.tree.structure(new_tree) != params_treedef:
+            return {k: mask_like(v, old_tree[k]) for k, v in new_tree.items()}
+        return mask_like(new_tree, old_tree)
+
     def _update_math(self, params, master, opt_state, grads, scaler_st, lr):
         """Shared traced update body: unscale, overflow check, clip,
         optimizer update, skip-on-overflow select, compute-dtype re-cast,
@@ -712,6 +851,8 @@ class DeepSpeedEngine:
             grads32 = jax.tree.map(lambda g: g * factor, grads32)
 
         new_master, new_opt = self._opt_update(grads32, opt_state, master, lr)
+        new_master = self._apply_trainable_mask(new_master, master)
+        new_opt = self._apply_trainable_mask(new_opt, opt_state)
 
         # skip the update on overflow
         def sel(new, old):
@@ -925,6 +1066,22 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         self._dropout_rng, sub = jax.random.split(self._dropout_rng)
+        if self._onebit_enabled() and self.global_steps >= self.optimizer.freeze_step:
+            # compressed stage threads error feedback through each micro
+            # step: run the unfused forward/backward loop + one step()
+            micro_losses = []
+            for g in range(gas):
+                micro = jax.tree.map(lambda x: x[g], batch)
+                loss = self.forward(*micro[0], **micro[1])
+                self.backward(loss)
+                micro_losses.append(loss)
+            self.step()
+            mean_loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in micro_losses]))
+            self.losses = mean_loss
+            self.timers(TRAIN_BATCH_TIMER).stop()
+            self.tput_timer.stop(global_step=True)
+            self._write_monitor(loss=mean_loss)
+            return mean_loss
         if self._host_offload is not None:
             grads32, mean_loss, gnorm, overflow = self._train_batch_grads_fn()(
                 self.params, self.scaler_state, sub, batch)
@@ -1315,6 +1472,12 @@ class DeepSpeedEngine:
 
     # module state dict parity
     def module_state_dict(self, exclude_frozen_parameters=False):
+        if exclude_frozen_parameters and getattr(self, "_trainable_mask", None) is not None:
+            named = flatten_named(self.params)
+            mask = dict(flatten_named(self._trainable_mask))
+            from deepspeed_tpu.utils.zero_to_fp32 import _nest
+            return _nest({p: np.asarray(jax.device_get(x))
+                          for p, x in named if mask.get(p, True)})
         return _to_serializable(self.params)
 
     def load_module_state_dict(self, state_dict, strict=True, custom_load_fn=None):

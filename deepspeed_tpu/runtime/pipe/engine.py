@@ -103,6 +103,7 @@ class PipelineEngine(DeepSpeedEngine):
         self._grad_specs = self._pipe_tree_specs(self.params, self.sharding_policy.grad_spec)
         self.params = jax.tree.map(lambda x, s: jax.device_put(x, s),
                                    self.params, self._param_shardings)
+        self._trainable_mask = self._build_trainable_mask()
 
         mixed = self.compute_dtype != jnp.float32
         if mixed or self.zero_stage >= 1:

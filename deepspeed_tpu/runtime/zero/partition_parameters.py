@@ -70,10 +70,18 @@ class GatheredParameters:
     re-places modified values with their original shardings.
     """
 
-    def __init__(self, params, modifier_rank=None, fwd_module=None, enabled=True):
+    def __init__(self, params, modifier_rank=None, fwd_module=None, enabled=True,
+                 engine=None):
+        """``engine``: when given, modifications made to the gathered
+        tree (reassign leaves in the returned dict) are re-partitioned
+        onto the original shardings and written back to ``engine.params``
+        on exit — the analogue of the reference's ``modifier_rank``
+        write-back (partition_parameters.py:2100)."""
         self.params = params
         self.enabled = enabled
+        self.engine = engine
         self.full = None
+        self._shardings = None
 
     def __enter__(self):
         if not self.enabled:
@@ -84,10 +92,37 @@ class GatheredParameters:
                 return jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
             return x
 
+        # False sentinel (None would collapse the pytree) for non-placed leaves
+        self._shardings = jax.tree.map(
+            lambda x: x.sharding if hasattr(x, "sharding") else False, self.params)
         self.full = jax.tree.map(gather, self.params)
         return self.full
 
     def __exit__(self, *exc):
+        if exc and exc[0] is not None:
+            return False
+        if self.engine is not None and self.full is not None:
+            def replace(full_leaf, orig, sharding):
+                if sharding is False:
+                    return full_leaf
+                import jax.numpy as jnp
+                return jax.device_put(jnp.asarray(full_leaf).astype(orig.dtype), sharding)
+
+            self.engine.params = jax.tree.map(replace, self.full, self.params,
+                                              self._shardings)
+            if self.engine.master_params is self.params:
+                self.engine.master_params = self.engine.params
+            elif self.engine.master_params is not None:
+                # distinct fp32 master (mixed precision / ZeRO>=1): it is
+                # the optimizer's source of truth — without this the next
+                # step() recomputes params from the stale master and
+                # silently reverts the surgery
+                import jax.numpy as jnp
+                self.engine.master_params = jax.tree.map(
+                    lambda full_leaf, m: jax.device_put(
+                        jnp.asarray(full_leaf).astype(m.dtype), m.sharding)
+                    if hasattr(m, "sharding") else full_leaf,
+                    self.full, self.engine.master_params)
         return False
 
 

@@ -147,3 +147,81 @@ class TestQuantization:
         v, s, shape = quantize_int8(x, group_size=64, interpret=True)
         back = dequantize_int8(v, s, shape, interpret=True)
         np.testing.assert_array_equal(np.asarray(back), np.zeros(128, np.float32))
+
+
+class TestFlashSegmentsAndBias:
+    """VERDICT weak-edge: packed sequences (segment ids) and additive
+    bias in the attention API."""
+
+    def test_segment_ids_match_per_sequence_attention(self):
+        import numpy as np
+        import jax, jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.RandomState(0)
+        B, S, H, D = 2, 128, 2, 32
+        q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        # two packed sequences: [0]*64 + [1]*64
+        seg = jnp.asarray(np.repeat([[0, 1]], 64, axis=1).reshape(1, S).repeat(B, 0))
+        packed = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                                 force_pallas=True, interpret=True, block_q=64, block_k=64)
+        # reference: run each 64-token segment independently
+        for lo, hi in ((0, 64), (64, 128)):
+            part = flash_attention(q[:, lo:hi], k[:, lo:hi], v[:, lo:hi], causal=True,
+                                   force_pallas=False)
+            np.testing.assert_allclose(np.asarray(packed[:, lo:hi]), np.asarray(part),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_segment_ids_xla_path_matches_kernel(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.RandomState(1)
+        B, S, H, D = 1, 96, 2, 16
+        q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        seg = jnp.asarray(rng.randint(0, 3, size=(B, S)).astype(np.int32))
+        a = flash_attention(q, q, q, causal=False, segment_ids=seg,
+                            force_pallas=True, interpret=True, block_q=32, block_k=32)
+        b = flash_attention(q, q, q, causal=False, segment_ids=seg, force_pallas=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5)
+
+    def test_bias_differentiable(self):
+        import numpy as np
+        import jax, jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.RandomState(2)
+        B, S, H, D = 1, 32, 2, 16
+        q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        bias = jnp.asarray(rng.randn(B, 1, S, S).astype(np.float32) * 0.1)
+
+        def loss(bias):
+            return flash_attention(q, q, q, causal=True, bias=bias).sum()
+
+        g = jax.grad(loss)(bias)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).max()) > 0
+
+    def test_segment_grads_respect_boundaries(self):
+        import numpy as np
+        import jax, jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.RandomState(3)
+        B, S, H, D = 1, 64, 1, 16
+        q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        seg = jnp.asarray(np.repeat([[0, 1]], 32, axis=1).reshape(1, S))
+
+        def loss_first_half(kv):
+            k2, v2 = kv
+            out = flash_attention(q, k2, v2, causal=True, segment_ids=seg,
+                                  force_pallas=True, interpret=True,
+                                  block_q=32, block_k=32)
+            return out[:, :32].astype(jnp.float32).sum()
+
+        gk, gv = jax.grad(loss_first_half)((k, v))
+        # second segment's k/v must get zero gradient from the first's loss
+        assert float(jnp.abs(gk[:, 32:]).max()) == 0.0
+        assert float(jnp.abs(gv[:, 32:]).max()) == 0.0
+        assert float(jnp.abs(gk[:, :32]).max()) > 0
