@@ -1,0 +1,10 @@
+"""Compression (parity: deepspeed/compression/): QAT, pruning, layer
+reduction as functional transforms over the params pytree."""
+
+from deepspeed_tpu.compression.basic_layer import (head_pruning_mask, row_pruning_mask,
+                                                    sparse_pruning_mask, ste_quantize)
+from deepspeed_tpu.compression.compress import (init_compression, layer_reduction,
+                                                 redundancy_clean)
+
+__all__ = ["init_compression", "redundancy_clean", "layer_reduction",
+           "ste_quantize", "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask"]

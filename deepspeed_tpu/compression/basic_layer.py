@@ -1,0 +1,77 @@
+"""Compression primitives: fake quantization (QAT), pruning masks.
+
+Capability match for the reference's
+``deepspeed/compression/basic_layer.py`` (``LinearLayer_Compress`` with
+weight/activation quantization and sparse/row/head pruning) — redesigned
+functionally: instead of module surgery, each technique is a transform
+on params or activations with a straight-through estimator, applied
+either inside the model (QAT during training) or offline
+(``redundancy_clean``)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def ste_quantize(x, bits: int = 8, symmetric: bool = True):
+    """Fake-quantize with a straight-through gradient (reference
+    Quantizer forward + STE backward)."""
+    return _quantize_value(x, bits, symmetric)
+
+
+def _quantize_value(x, bits, symmetric):
+    x32 = x.astype(jnp.float32)
+    qmax = 2.0 ** (bits - 1) - 1 if symmetric else 2.0 ** bits - 1
+    if symmetric:
+        scale = jnp.maximum(jnp.max(jnp.abs(x32)), 1e-8) / qmax
+        q = jnp.clip(jnp.round(x32 / scale), -qmax - 1, qmax)
+        return (q * scale).astype(x.dtype)
+    lo, hi = jnp.min(x32), jnp.max(x32)
+    scale = jnp.maximum(hi - lo, 1e-8) / qmax
+    q = jnp.clip(jnp.round((x32 - lo) / scale), 0, qmax)
+    return (q * scale + lo).astype(x.dtype)
+
+
+def _ste_fwd(x, bits, symmetric):
+    return _quantize_value(x, bits, symmetric), None
+
+
+def _ste_bwd(bits, symmetric, _res, g):
+    return (g,)  # straight through
+
+
+ste_quantize.defvjp(_ste_fwd, _ste_bwd)
+
+
+def sparse_pruning_mask(w, dense_ratio: float):
+    """Unstructured magnitude mask keeping the top ``dense_ratio``
+    fraction (reference SparsePruningMethod)."""
+    flat = jnp.abs(w).reshape(-1)
+    k = max(1, int(round(flat.shape[0] * dense_ratio)))
+    threshold = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= threshold).astype(w.dtype)
+
+
+def row_pruning_mask(w, dense_ratio: float):
+    """Structured row mask by L1 row norm (reference RowPruningMethod);
+    rows are the INPUT dim of a [in, out] kernel."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    k = max(1, int(round(norms.shape[0] * dense_ratio)))
+    threshold = jnp.sort(norms)[-k]
+    mask = (norms >= threshold).astype(w.dtype)
+    return mask.reshape((-1,) + (1,) * (w.ndim - 1))
+
+
+def head_pruning_mask(w, dense_ratio: float, num_heads: int):
+    """Structured head mask for a [in, heads*dim] attention output
+    projection (reference HeadPruningMethod)."""
+    in_dim, out_dim = w.shape
+    head_dim = out_dim // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(in_dim, num_heads, head_dim)), axis=(0, 2))
+    k = max(1, int(round(num_heads * dense_ratio)))
+    threshold = jnp.sort(per_head)[-k]
+    mask = (per_head >= threshold).astype(w.dtype)
+    return jnp.repeat(mask, head_dim)[None, :]

@@ -1,0 +1,115 @@
+"""Compression entry points.
+
+Capability match for the reference's ``deepspeed/compression/compress.py``
+(``init_compression`` at compress.py:100, ``redundancy_clean``): the
+``compression_training`` ds_config section selects techniques by
+module-name patterns; here the techniques act on the params pytree by
+leaf-path regex —
+
+- ``layer_reduction``: keep a subset of the scan-stacked transformer
+  layers (a pure slice of the leading layer dim — TPU-native student
+  initialization for knowledge distillation);
+- ``weight_quantization``: returns a params-transform applying
+  :func:`ste_quantize` in the forward (QAT);
+- ``sparse/row/head_pruning``: magnitude masks, applied softly during
+  training and permanently by :func:`redundancy_clean`.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.basic_layer import (head_pruning_mask, row_pruning_mask,
+                                                   sparse_pruning_mask, ste_quantize)
+from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+
+
+def _section(ds_config, *keys, default=None):
+    node = ds_config.get("compression_training", {})
+    for k in keys:
+        if not isinstance(node, dict) or k not in node:
+            return default
+        node = node[k]
+    return node
+
+
+def _match_any(path, patterns):
+    return any(re.search(p, path) for p in patterns)
+
+
+def layer_reduction(params, keep_layers, layer_key="layers"):
+    """Slice scan-stacked layer params down to ``keep_layers`` (list of
+    layer indices) — reference ``student_initialization``/teacher-layer
+    mapping (compress.py:36) without any module surgery."""
+    idx = jnp.asarray(sorted(keep_layers), jnp.int32)
+
+    def maybe_slice(path, x):
+        if f"/{layer_key}/" in f"/{path}/" and x.ndim >= 1 and x.shape[0] > int(idx[-1]):
+            return jnp.take(x, idx, axis=0)
+        return x
+
+    return path_tree_map(maybe_slice, params)
+
+
+def init_compression(params, ds_config, num_heads=None):
+    """→ ``(params, forward_transform)``: ``forward_transform(params)``
+    applies the configured QAT/pruning inside the training forward (wrap
+    your apply: ``model.apply({'params': transform(p)}, ...)``).
+
+    Layer reduction (if enabled) is applied to ``params`` immediately."""
+    lr_cfg = _section(ds_config, "layer_reduction", default={}) or {}
+    if lr_cfg.get("enabled", False):
+        params = layer_reduction(params, lr_cfg["teacher_layer"],
+                                 layer_key=lr_cfg.get("layer_name", "layers"))
+
+    wq = _section(ds_config, "weight_quantization", "shared_parameters", default={}) or {}
+    wq_groups = _section(ds_config, "weight_quantization", "different_groups", default={}) or {}
+    sp = _section(ds_config, "sparse_pruning", "shared_parameters", default={}) or {}
+    sp_groups = _section(ds_config, "sparse_pruning", "different_groups", default={}) or {}
+    rp_groups = _section(ds_config, "row_pruning", "different_groups", default={}) or {}
+    hp_groups = _section(ds_config, "head_pruning", "different_groups", default={}) or {}
+
+    def group_patterns(groups):
+        pats, cfgs = [], []
+        for g in groups.values():
+            mods = g.get("modules", ["*"])
+            pats.append([m.replace("*", ".*") for m in mods])
+            cfgs.append(g.get("params", {}))
+        return list(zip(pats, cfgs))
+
+    wq_rules = group_patterns(wq_groups) if wq.get("enabled", False) else []
+    sp_rules = group_patterns(sp_groups) if sp.get("enabled", False) else []
+    rp_rules = group_patterns(rp_groups)
+    hp_rules = group_patterns(hp_groups)
+
+    def forward_transform(p):
+        def leaf(path, x):
+            if x.ndim < 2:
+                return x
+            for pats, cfg in sp_rules:
+                if _match_any(path, pats):
+                    x = x * sparse_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
+            for pats, cfg in rp_rules:
+                if _match_any(path, pats):
+                    x = x * row_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
+            for pats, cfg in hp_rules:
+                if _match_any(path, pats):
+                    x = x * head_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)),
+                                              int(cfg.get("num_heads", num_heads or 1)))
+            for pats, cfg in wq_rules:
+                if _match_any(path, pats):
+                    x = ste_quantize(x, int(cfg.get("start_bits", 8)), True)
+            return x
+
+        return path_tree_map(leaf, p)
+
+    return params, forward_transform
+
+
+def redundancy_clean(params, ds_config, num_heads=None):
+    """Make the soft masks permanent (reference compress.py
+    ``redundancy_clean``): returns params with pruning masks burned in
+    and weights quantize-dequantized once."""
+    _, transform = init_compression(params, ds_config, num_heads=num_heads)
+    return jax.tree.map(jax.lax.stop_gradient, transform(params))

@@ -1,0 +1,10 @@
+"""Data efficiency (parity: deepspeed/runtime/data_pipeline/):
+curriculum learning, curriculum-aware sampling, random-LTD."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_routing.random_ltd import (RandomLTDScheduler,
+                                                                          apply_random_ltd)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import DeepSpeedDataSampler
+
+__all__ = ["CurriculumScheduler", "DeepSpeedDataSampler", "RandomLTDScheduler",
+           "apply_random_ltd"]

@@ -1,0 +1,88 @@
+"""Curriculum learning scheduler.
+
+Capability match for the reference's
+``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler``): maps the global step to a training
+"difficulty" (typically the sequence length) under fixed_linear /
+fixed_root / fixed_discrete / custom schedules. The engine truncates
+each batch's sequence dim to the current difficulty (legacy
+``curriculum_learning`` config section) — on TPU the changing length
+means a few compiled variants, so difficulties snap to
+``difficulty_step`` multiples (keep it a multiple of 64+ to bound
+recompiles, exactly the reference's guidance for Tensor Cores)."""
+
+import math
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config):
+        self.state = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires the config '{key}'")
+        self.curriculum_type = config["curriculum_type"]
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.current_difficulty = self.min_difficulty
+        self.config = config
+        self.custom_get_difficulty = None
+        if self.curriculum_type in (FIXED_LINEAR, FIXED_ROOT):
+            sched = config.get("schedule_config", {})
+            if "total_curriculum_step" not in sched:
+                raise ValueError("schedule_config.total_curriculum_step is required")
+            self.total_step = int(sched["total_curriculum_step"])
+            self.difficulty_step = int(sched.get("difficulty_step", 8))
+            self.root_degree = int(sched.get("root_degree", 2))
+        elif self.curriculum_type == FIXED_DISCRETE:
+            sched = config.get("schedule_config", {})
+            self.difficulties = list(sched["difficulty"])
+            self.max_steps = list(sched["max_step"])
+            if len(self.difficulties) != len(self.max_steps) + 1:
+                raise ValueError("need len(difficulty) == len(max_step) + 1")
+        elif self.curriculum_type == CUSTOM:
+            pass
+        else:
+            raise ValueError(f"unknown curriculum_type {self.curriculum_type}")
+
+    def set_custom_get_difficulty(self, fn):
+        self.custom_get_difficulty = fn
+
+    def get_difficulty(self, global_steps: int) -> int:
+        t = self.curriculum_type
+        if t == CUSTOM:
+            assert self.custom_get_difficulty is not None, \
+                "set_custom_get_difficulty() first for curriculum_type=custom"
+            d = self.custom_get_difficulty(global_steps)
+        elif t == FIXED_DISCRETE:
+            d = self.difficulties[-1]
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if global_steps <= until:
+                    d = diff
+                    break
+        else:
+            frac = min(1.0, max(0.0, global_steps / max(self.total_step, 1)))
+            if t == FIXED_ROOT:
+                frac = frac ** (1.0 / self.root_degree)
+            span = self.max_difficulty - self.min_difficulty
+            d = self.min_difficulty + frac * span
+            # snap to difficulty_step multiples (bounds TPU recompiles)
+            d = int(d / self.difficulty_step) * self.difficulty_step
+            d = max(d, self.min_difficulty)
+        return int(min(d, self.max_difficulty))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    # state-dict parity (reference curriculum_scheduler.py state handling)
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

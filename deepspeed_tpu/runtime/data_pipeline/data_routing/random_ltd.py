@@ -1,0 +1,66 @@
+"""Random layer-token drop (random-LTD).
+
+Capability match for the reference's random-LTD
+(``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py``
+``RandomLayerTokenDrop`` + ``scheduler.py`` ``RandomLTDScheduler``):
+middle transformer layers process only a random SUBSET of tokens per
+step; the kept-token count anneals from ``mini_seq`` up to the full
+sequence. TPU redesign: the gather/scatter pair is expressed as static
+-shape ``jnp.take``/``scatter`` on a per-step random permutation (the
+kept count changes only at schedule boundaries, so XLA compiles a few
+variants, not one per step)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RandomLTDScheduler:
+    """Anneals the kept-token count (reference scheduler.py semantics:
+    fixed_linear from min_value to max_value over schedule steps)."""
+
+    def __init__(self, max_value, min_value, schedule_steps, step_size=16):
+        self.max_value = int(max_value)
+        self.min_value = int(min_value)
+        self.schedule_steps = int(schedule_steps)
+        self.step_size = int(step_size)
+        self.current_seq = self.min_value
+
+    def get_seq(self, global_steps: int) -> int:
+        frac = min(1.0, global_steps / max(self.schedule_steps, 1))
+        seq = self.min_value + frac * (self.max_value - self.min_value)
+        seq = int(seq / self.step_size) * self.step_size
+        self.current_seq = int(min(max(seq, self.min_value), self.max_value))
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd["current_seq"]
+
+
+def random_token_select(rng, seq_len: int, keep: int):
+    """→ (kept_idx [keep], rest_idx [seq_len-keep]) — a random split of
+    token positions, sorted so relative order (and thus causal masks /
+    RoPE positions) is preserved (reference gpt_sample_tokens)."""
+    perm = jax.random.permutation(rng, seq_len)
+    kept = jnp.sort(perm[:keep])
+    rest = jnp.sort(perm[keep:])
+    return kept, rest
+
+
+def apply_random_ltd(layer_fn, h, rng, keep: int, positions=None):
+    """Run ``layer_fn`` on a random token subset and scatter its outputs
+    back; dropped tokens pass through unchanged (the residual identity).
+
+    ``h``: [B, S, D]; ``layer_fn(h_subset, positions_subset) -> out``.
+    Returns the merged [B, S, D]."""
+    B, S, D = h.shape
+    if keep >= S:
+        return layer_fn(h, positions)
+    kept, _ = random_token_select(rng, S, keep)
+    h_sub = jnp.take(h, kept, axis=1)
+    pos_sub = jnp.take(positions, kept, axis=-1) if positions is not None else None
+    out_sub = layer_fn(h_sub, pos_sub)
+    return h.at[:, kept, :].set(out_sub.astype(h.dtype))

@@ -1,0 +1,64 @@
+"""Curriculum-aware data sampler.
+
+Capability match for the reference's
+``deepspeed/runtime/data_pipeline/data_sampling/data_sampler.py``
+(``DeepSpeedDataSampler`` at data_sampler.py:36): samples training
+indices so that early steps see "easy" examples, widening the pool as
+the curriculum difficulty grows. The reference reads offline-analyzed
+index→metric files (data_analyzer.py); here the metric is supplied as
+an array or callable (``difficulty_fn(index) -> value``) — the offline
+analysis step collapses to a numpy argsort."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self, total_samples, batch_size, difficulties, curriculum_config,
+                 seed=1234, drop_last=True):
+        """``difficulties``: array-like [total_samples] metric values
+        (lower = easier), or a callable mapping index → value."""
+        self.total_samples = int(total_samples)
+        self.batch_size = int(batch_size)
+        if callable(difficulties):
+            difficulties = np.asarray([difficulties(i) for i in range(total_samples)])
+        self.difficulties = np.asarray(difficulties, dtype=np.float64)
+        if self.difficulties.shape[0] != total_samples:
+            raise ValueError("difficulties must have one entry per sample")
+        # ascending difficulty order: the curriculum admits a prefix
+        self.order = np.argsort(self.difficulties, kind="stable")
+        self.scheduler = CurriculumScheduler(curriculum_config)
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        self._rng = np.random.RandomState(seed)
+
+    def _admitted(self):
+        """Pool admitted at the current difficulty: samples whose metric
+        is within the scheduler's current difficulty, min one batch."""
+        d = self.scheduler.update_difficulty(self.global_step)
+        count = int(np.searchsorted(self.difficulties[self.order], d, side="right"))
+        return self.order[:max(count, min(self.batch_size, self.total_samples))]
+
+    def next_batch(self):
+        pool = self._admitted()
+        idx = self._rng.choice(pool, size=self.batch_size,
+                               replace=len(pool) < self.batch_size)
+        self.global_step += 1
+        return idx.astype(np.int64)
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()
+
+    def state_dict(self):
+        return {"global_step": self.global_step,
+                "rng": self._rng.get_state(),
+                "scheduler": self.scheduler.state_dict()}
+
+    def load_state_dict(self, sd):
+        self.global_step = sd["global_step"]
+        self._rng.set_state(sd["rng"])
+        self.scheduler.load_state_dict(sd["scheduler"])

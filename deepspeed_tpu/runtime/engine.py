@@ -203,6 +203,15 @@ class DeepSpeedEngine:
         # Data loader
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
 
+        # Legacy curriculum learning: the engine truncates each batch's
+        # sequence dim to the scheduled difficulty (reference engine
+        # exposes curriculum_scheduler; megatron consumes curriculum_seqlen)
+        self.curriculum_scheduler_legacy = None
+        if getattr(self._config, "curriculum_enabled_legacy", False):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler_legacy = CurriculumScheduler(
+                self._config.curriculum_params_legacy)
+
         # caches for jitted callables and last-forward microbatch
         self._jit_cache = {}
         self._grads_acc = None
@@ -574,8 +583,12 @@ class DeepSpeedEngine:
                     full, scale, rng, args, kwargs)
 
                 def red(g, e):
-                    mean, e_new = onebit_allreduce(g, axis, e[0])
-                    return mean.astype(g.dtype), e_new[None].astype(e.dtype)
+                    # compress in the UNSCALED domain: the efb residual
+                    # persists across steps, and a dynamic loss-scale
+                    # change between steps would otherwise mis-weight it
+                    gu = g.astype(jnp.float32) / scale
+                    mean, e_new = onebit_allreduce(gu, axis, e[0])
+                    return (mean * scale).astype(g.dtype), e_new[None].astype(e.dtype)
 
                 pairs = jax.tree.map(red, grads, efb)
                 treedef = jax.tree.structure(grads)
@@ -843,6 +856,12 @@ class DeepSpeedEngine:
         fp16 = self.fp16_enabled()
         scale = scaler_st["cur_scale"]
         grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+        if self._trainable_mask is not None:
+            # requires_grad=False semantics: frozen leaves contribute
+            # nothing to the grad norm, clipping, or overflow detection
+            grads32 = jax.tree.map(
+                lambda keep, g: g if keep else jnp.zeros_like(g),
+                self._trainable_mask, grads32)
         overflow = has_overflow(grads32) if fp16 else jnp.zeros((), bool)
 
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads32)))
@@ -1057,6 +1076,15 @@ class DeepSpeedEngine:
                     lambda x: x.reshape((gas, self.train_micro_batch_size_per_gpu()) + x.shape[1:]), batch)
         if not (isinstance(batch, tuple) and len(batch) == 2 and isinstance(batch[1], dict)):
             batch = ((batch,) if not isinstance(batch, (tuple, list)) else tuple(batch), {})
+        if self.curriculum_scheduler_legacy is not None:
+            seqlen = self.curriculum_scheduler_legacy.update_difficulty(self.global_steps + 1)
+            # truncate only [gas, mbs, S] token-id/label leaves; anything
+            # with more dims (attention masks [.., S, S], images) passes
+            # through — models with such inputs consume the scheduler
+            # directly (engine.curriculum_scheduler_legacy)
+            trunc = lambda x: x[:, :, :seqlen] if getattr(x, "ndim", 0) == 3 else x
+            batch = (tuple(jax.tree.map(trunc, a) for a in batch[0]),
+                     jax.tree.map(trunc, batch[1]))
         self._materialize_state(*jax.tree.map(lambda x: x[0], batch[0]),
                                 **jax.tree.map(lambda x: x[0], batch[1]))
         batch = self._shard_batch(batch, extra_leading=1)

@@ -80,6 +80,11 @@ class GatheredParameters:
         self.params = params
         self.enabled = enabled
         self.engine = engine
+        if engine is not None and params is not engine.params:
+            raise ValueError(
+                "GatheredParameters(engine=...) write-back requires the FULL "
+                "engine.params tree (a subtree would replace the whole tree on "
+                "exit); gather subtrees without engine= for read-only access")
         self.full = None
         self._shardings = None
 

@@ -1,0 +1,89 @@
+"""Compression tests (analogue of reference
+tests/unit/compression/test_compression.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (head_pruning_mask, init_compression, layer_reduction,
+                                       redundancy_clean, row_pruning_mask,
+                                       sparse_pruning_mask, ste_quantize)
+
+
+def test_ste_quantize_roundtrip_and_grad():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(32, 16).astype(np.float32))
+    q8 = ste_quantize(w, 8, True)
+    assert float(jnp.abs(q8 - w).max()) < float(jnp.abs(w).max()) / 100
+    q2 = ste_quantize(w, 2, True)
+    assert len(np.unique(np.asarray(q2))) <= 4  # 2-bit symmetric levels
+    # straight-through gradient
+    g = jax.grad(lambda w: (ste_quantize(w, 4, True) ** 2).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(2 * ste_quantize(w, 4, True)),
+                               rtol=1e-5)
+
+
+def test_pruning_masks():
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    m = sparse_pruning_mask(w, 0.25)
+    assert abs(float(m.mean()) - 0.25) < 0.05
+    # kept entries are the largest-magnitude ones
+    kept = np.abs(np.asarray(w))[np.asarray(m) > 0]
+    dropped = np.abs(np.asarray(w))[np.asarray(m) == 0]
+    assert kept.min() >= dropped.max()
+
+    rm = row_pruning_mask(w, 0.5)
+    assert rm.shape == (16, 1)
+    assert int(np.asarray(rm).sum()) == 8
+
+    hm = head_pruning_mask(w, 0.5, num_heads=4)
+    assert hm.shape == (1, 32)
+    per_head = np.asarray(hm).reshape(4, 8)
+    assert set(per_head.min(1)) <= {0.0, 1.0}
+    assert (per_head.min(1) == per_head.max(1)).all()  # whole heads on/off
+    assert per_head.max(1).sum() == 2
+
+
+def test_layer_reduction_slices_scan_stack():
+    params = {"model": {"layers": {"w": jnp.arange(6 * 4).reshape(6, 4).astype(jnp.float32)},
+                        "norm": {"scale": jnp.ones(4)}}}
+    student = layer_reduction(params, keep_layers=[0, 2, 5])
+    assert student["model"]["layers"]["w"].shape == (3, 4)
+    np.testing.assert_array_equal(np.asarray(student["model"]["layers"]["w"][1]),
+                                  np.arange(8, 12))
+    assert student["model"]["norm"]["scale"].shape == (4,)
+
+
+def test_init_compression_end_to_end():
+    """QAT + pruning transform on the flagship llama params."""
+    from deepspeed_tpu.models import build_llama
+    model = build_llama("debug")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"wq1": {"modules": ["mlp"], "params": {"start_bits": 8}}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"sp1": {"modules": ["q_proj"], "params": {"dense_ratio": 0.5}}}},
+    }}
+    params2, transform = init_compression(params, cfg)
+    comp = transform(params2)
+    q = np.asarray(comp["model"]["layers"]["self_attn"]["q_proj"]["kernel"])
+    sparsity = (q == 0).mean()
+    assert 0.4 < sparsity < 0.6, sparsity
+    # untouched leaves stay identical
+    np.testing.assert_array_equal(
+        np.asarray(comp["model"]["embed_tokens"]),
+        np.asarray(params2["model"]["embed_tokens"]))
+    # loss still computes through the compressed forward
+    loss, _ = model.apply({"params": transform(params2)},
+                          jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 8), jnp.int32))
+    assert np.isfinite(float(loss))
+
+    cleaned = redundancy_clean(params2, cfg)
+    qc = np.asarray(cleaned["model"]["layers"]["self_attn"]["q_proj"]["kernel"])
+    assert ((qc == 0).mean() > 0.4)

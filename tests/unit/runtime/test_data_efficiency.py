@@ -1,0 +1,128 @@
+"""Data-efficiency tests (analogue of reference
+tests/unit/runtime/test_data_efficiency.py: curriculum schedules,
+curriculum sampler, random-LTD)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler, DeepSpeedDataSampler,
+                                                 RandomLTDScheduler, apply_random_ltd)
+from unit.simple_model import SimpleModel, random_dataloader
+
+
+class TestCurriculumScheduler:
+
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({"curriculum_type": "fixed_linear", "min_difficulty": 8,
+                                 "max_difficulty": 64,
+                                 "schedule_config": {"total_curriculum_step": 100,
+                                                     "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(50) == 32  # halfway, snapped to 8
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(10**6) == 64
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({"curriculum_type": "fixed_root", "min_difficulty": 8,
+                                 "max_difficulty": 72,
+                                 "schedule_config": {"total_curriculum_step": 100,
+                                                     "difficulty_step": 8,
+                                                     "root_degree": 2}})
+        # sqrt schedule front-loads difficulty growth
+        assert s.get_difficulty(25) >= 8 + (72 - 8) // 4
+        assert s.get_difficulty(100) == 72
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({"curriculum_type": "fixed_discrete", "min_difficulty": 2,
+                                 "max_difficulty": 10,
+                                 "schedule_config": {"difficulty": [2, 4, 10],
+                                                     "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 2
+        assert s.get_difficulty(7) == 4
+        assert s.get_difficulty(50) == 10
+
+    def test_missing_keys_raise(self):
+        with pytest.raises(ValueError):
+            CurriculumScheduler({"curriculum_type": "fixed_linear"})
+
+
+class TestDataSampler:
+
+    def test_pool_widens_with_difficulty(self):
+        diffs = np.arange(100, dtype=np.float64)  # sample i has difficulty i
+        sampler = DeepSpeedDataSampler(
+            100, batch_size=4, difficulties=diffs,
+            curriculum_config={"curriculum_type": "fixed_linear", "min_difficulty": 10,
+                               "max_difficulty": 100,
+                               "schedule_config": {"total_curriculum_step": 10,
+                                                   "difficulty_step": 10}})
+        early = sampler.next_batch()
+        assert early.max() <= 10  # only the easy prefix is admitted
+        for _ in range(20):
+            late = sampler.next_batch()
+        assert late.max() > 10  # pool widened
+
+
+class TestRandomLTD:
+
+    def test_scheduler_anneals(self):
+        s = RandomLTDScheduler(max_value=128, min_value=32, schedule_steps=100, step_size=16)
+        assert s.get_seq(0) == 32
+        assert s.get_seq(100) == 128
+        assert 32 < s.get_seq(50) < 128
+
+    def test_apply_preserves_dropped_tokens(self):
+        rng = jax.random.PRNGKey(0)
+        h = jnp.asarray(np.random.RandomState(0).randn(2, 16, 8), jnp.float32)
+        marker = lambda x, pos: x + 100.0
+        out = apply_random_ltd(marker, h, rng, keep=4)
+        changed = np.isclose(np.asarray(out - h), 100.0).all(axis=(0, 2))
+        assert changed.sum() == 4  # exactly `keep` positions went through the layer
+        untouched = np.asarray(out - h)[:, ~changed, :]
+        assert np.abs(untouched).max() == 0.0
+
+    def test_keep_all_is_identity_path(self):
+        rng = jax.random.PRNGKey(0)
+        h = jnp.ones((1, 8, 4))
+        out = apply_random_ltd(lambda x, p: x * 2, h, rng, keep=8)
+        assert np.allclose(np.asarray(out), 2.0)
+
+
+class TestEngineCurriculum:
+
+    def test_legacy_curriculum_truncates_seqlen(self):
+        import flax.linen as nn
+
+        class SeqModel(nn.Module):
+            @nn.compact
+            def __call__(self, ids, labels):
+                emb = nn.Embed(64, 16)(ids)
+                logits = nn.Dense(64)(emb)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                return -jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None], -1).mean()
+
+        groups.destroy_mesh()
+        seen = []
+
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"data_parallel_size": 8},
+            "curriculum_learning": {"enabled": True, "curriculum_type": "fixed_linear",
+                                    "min_difficulty": 8, "max_difficulty": 32,
+                                    "schedule_config": {"total_curriculum_step": 4,
+                                                        "difficulty_step": 8}},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=SeqModel(), config=config)
+        ids = np.zeros((8, 32), np.int32)
+        for step in range(5):
+            engine.train_batch(batch=(ids, ids))
+            seen.append(engine.curriculum_scheduler_legacy.current_difficulty)
+        assert seen[0] == 8
+        assert seen[-1] == 32
+        assert seen == sorted(seen)
