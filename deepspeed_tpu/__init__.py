@@ -78,8 +78,26 @@ def initialize(args=None,
 
     config_class = DeepSpeedConfig(config, mpu=mpu, mesh_device=mesh)
 
+    hybrid = bool((config_class._param_dict.get("hybrid_engine", {}) or {}).get("enabled", False))
     pp = int(config_class.mesh_shape.get("pipeline_parallel_size", 1)) if config_class.mesh_shape else 1
-    if pp > 1 or _is_pipeline_module(model):
+    if hybrid:
+        # RLHF train + rollout on the same weights (reference
+        # hybrid_engine.py via the hybrid_engine config section)
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine = DeepSpeedHybridEngine(args=args,
+                                       model=model,
+                                       optimizer=optimizer,
+                                       model_parameters=model_parameters,
+                                       training_data=training_data,
+                                       lr_scheduler=lr_scheduler,
+                                       mpu=mpu,
+                                       dist_init_required=dist_init_required,
+                                       collate_fn=collate_fn,
+                                       config=config,
+                                       config_class=config_class,
+                                       mesh=mesh,
+                                       loss_fn=loss_fn)
+    elif pp > 1 or _is_pipeline_module(model):
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
         engine = PipelineEngine(args=args,
                                 model=model,

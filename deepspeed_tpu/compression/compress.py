@@ -63,9 +63,11 @@ def init_compression(params, ds_config, num_heads=None):
         params = layer_reduction(params, lr_cfg["teacher_layer"],
                                  layer_key=lr_cfg.get("layer_name", "layers"))
 
-    wq = _section(ds_config, "weight_quantization", "shared_parameters", default={}) or {}
+    def enabled(technique):
+        shared = _section(ds_config, technique, "shared_parameters", default={}) or {}
+        return shared.get("enabled", False)
+
     wq_groups = _section(ds_config, "weight_quantization", "different_groups", default={}) or {}
-    sp = _section(ds_config, "sparse_pruning", "shared_parameters", default={}) or {}
     sp_groups = _section(ds_config, "sparse_pruning", "different_groups", default={}) or {}
     rp_groups = _section(ds_config, "row_pruning", "different_groups", default={}) or {}
     hp_groups = _section(ds_config, "head_pruning", "different_groups", default={}) or {}
@@ -78,10 +80,10 @@ def init_compression(params, ds_config, num_heads=None):
             cfgs.append(g.get("params", {}))
         return list(zip(pats, cfgs))
 
-    wq_rules = group_patterns(wq_groups) if wq.get("enabled", False) else []
-    sp_rules = group_patterns(sp_groups) if sp.get("enabled", False) else []
-    rp_rules = group_patterns(rp_groups)
-    hp_rules = group_patterns(hp_groups)
+    wq_rules = group_patterns(wq_groups) if enabled("weight_quantization") else []
+    sp_rules = group_patterns(sp_groups) if enabled("sparse_pruning") else []
+    rp_rules = group_patterns(rp_groups) if enabled("row_pruning") else []
+    hp_rules = group_patterns(hp_groups) if enabled("head_pruning") else []
 
     def forward_transform(p):
         def leaf(path, x):
@@ -109,7 +111,7 @@ def init_compression(params, ds_config, num_heads=None):
 
 def redundancy_clean(params, ds_config, num_heads=None):
     """Make the soft masks permanent (reference compress.py
-    ``redundancy_clean``): returns params with pruning masks burned in
-    and weights quantize-dequantized once."""
-    _, transform = init_compression(params, ds_config, num_heads=num_heads)
-    return jax.tree.map(jax.lax.stop_gradient, transform(params))
+    ``redundancy_clean``): returns params with layer reduction applied,
+    pruning masks burned in, and weights quantize-dequantized once."""
+    reduced, transform = init_compression(params, ds_config, num_heads=num_heads)
+    return jax.tree.map(jax.lax.stop_gradient, transform(reduced))

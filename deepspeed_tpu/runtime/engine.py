@@ -179,6 +179,7 @@ class DeepSpeedEngine:
             param_persistence_threshold=int(zc.param_persistence_threshold),
             offload_optimizer=zc.offload_optimizer_device().value != "none",
             offload_param=zc.offload_param_device().value != "none",
+            mics_shard_size=max(0, int(zc.mics_shard_size)),
         )
 
         # Monitors / timers
@@ -1078,11 +1079,13 @@ class DeepSpeedEngine:
             batch = ((batch,) if not isinstance(batch, (tuple, list)) else tuple(batch), {})
         if self.curriculum_scheduler_legacy is not None:
             seqlen = self.curriculum_scheduler_legacy.update_difficulty(self.global_steps + 1)
-            # truncate only [gas, mbs, S] token-id/label leaves; anything
-            # with more dims (attention masks [.., S, S], images) passes
+            # truncate only integer [gas, mbs, S] token-id/label leaves;
+            # float features, attention masks [.., S, S], images pass
             # through — models with such inputs consume the scheduler
             # directly (engine.curriculum_scheduler_legacy)
-            trunc = lambda x: x[:, :, :seqlen] if getattr(x, "ndim", 0) == 3 else x
+            trunc = lambda x: x[:, :, :seqlen] if (
+                getattr(x, "ndim", 0) == 3 and
+                jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)) else x
             batch = (tuple(jax.tree.map(trunc, a) for a in batch[0]),
                      jax.tree.map(trunc, batch[1]))
         self._materialize_state(*jax.tree.map(lambda x: x[0], batch[0]),

@@ -1,0 +1,95 @@
+"""Hybrid engine: one set of weights for RLHF train + generate.
+
+Capability match for the reference's ``deepspeed/runtime/hybrid_engine.py``
+(``DeepSpeedHybridEngine`` at hybrid_engine.py:32: flips a ZeRO-3
+training module into inference-optimized containers for the rollout
+phase of RLHF, then back). The TPU story is structurally simpler —
+params are immutable sharded arrays, so the SAME leaves feed both the
+training step and a jitted KV-cache decode loop with no copy, no
+gather-and-repartition, no module surgery:
+
+- :meth:`generate` prefication + ``lax.scan`` greedy/sampled decode on
+  the flagship Llama interface (``__call__(ids, cache=..., start_pos=...)``
+  + ``init_cache``), compiled once per (batch, prompt, new-token) shape;
+- :meth:`eval` / :meth:`train` flip the mode as the reference does; the
+  rollout uses the live training params of the current step.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._gen_cache = {}
+        self._gen_rng = jax.random.PRNGKey(int(jnp.asarray(0)))
+
+    # ------------------------------------------------------------------
+    def _decode_fn(self, prompt_len, max_new_tokens, do_sample, temperature):
+        # separate from inference/engine.py's decode on purpose: this one
+        # runs on the TRAINING shardings/mesh (no re-placement for the
+        # rollout); temperature is baked into the trace, hence the key
+        key = ("gen", prompt_len, max_new_tokens, do_sample, float(temperature))
+        if key in self._gen_cache:
+            return self._gen_cache[key]
+        model = self.module
+        from deepspeed_tpu.models.llama import init_cache
+
+        def fn(params, input_ids, rng):
+            B = input_ids.shape[0]
+            max_len = prompt_len + max_new_tokens
+            cache = init_cache(model.config, B, max_len, dtype=self.compute_dtype)
+            logits, cache = model.apply({"params": params}, input_ids,
+                                        cache=cache, start_pos=0)
+            last = logits[:, -1, :].astype(jnp.float32)
+
+            def pick(lg, r):
+                if do_sample:
+                    return jax.random.categorical(r, lg / temperature, axis=-1)
+                return jnp.argmax(lg, axis=-1)
+
+            rng, sub = jax.random.split(rng)
+            tok = pick(last, sub).astype(jnp.int32)
+
+            def step(carry, _):
+                cache, tok, pos, rng = carry
+                logits, cache = model.apply({"params": params}, tok[:, None],
+                                            cache=cache, start_pos=pos)
+                rng, sub = jax.random.split(rng)
+                nxt = pick(logits[:, -1, :].astype(jnp.float32), sub).astype(jnp.int32)
+                return (cache, nxt, pos + 1, rng), nxt
+
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (cache, tok, prompt_len, rng), None, length=max_new_tokens - 1)
+            return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+        jitted = jax.jit(fn)
+        self._gen_cache[key] = jitted
+        return jitted
+
+    def generate(self, input_ids, max_new_tokens=16, do_sample=False, temperature=1.0,
+                 synced_gpus=False, **kwargs):
+        """Rollout generation on the CURRENT training weights (the
+        reference's inference-container forward, hybrid_engine.py:109)."""
+        assert self._initialized, "run a forward/train_batch before generate()"
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        fn = self._decode_fn(input_ids.shape[1], int(max_new_tokens),
+                             bool(do_sample), float(temperature))
+        self._gen_rng, sub = jax.random.split(self._gen_rng)
+        new_tokens = fn(self.params, input_ids, sub)
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+    # mode flips (reference eval()/train() on the hybrid module)
+    def eval(self):
+        self._is_training = False
+        return self
+
+    def train(self, mode=True):
+        self._is_training = bool(mode)
+        return self

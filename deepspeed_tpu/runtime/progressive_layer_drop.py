@@ -1,0 +1,57 @@
+"""Progressive layer drop (PLD).
+
+Capability match for the reference's
+``deepspeed/runtime/progressive_layer_drop.py``
+(``ProgressiveLayerDrop``): the layer keep-probability anneals as
+``theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar`` and each
+transformer block is stochastically skipped (identity residual) with
+depth-scaled probability. ``apply_pld`` is the TPU-side primitive: a
+``lax.cond``-free where-select so the skip costs nothing under jit."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, gamma, p):
+            return (1.0 - p) * math.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+
+def layer_keep_prob(theta, layer_idx, num_layers):
+    """Depth-scaled keep probability (deeper layers drop more often):
+    p_l = 1 - l/L * (1 - theta)."""
+    return 1.0 - (layer_idx / max(num_layers, 1)) * (1.0 - theta)
+
+
+def apply_pld(layer_fn, h, rng, keep_prob):
+    """Stochastic residual skip: with prob ``keep_prob`` run the layer
+    (output scaled 1/p so expectations match eval), else identity.
+    ``lax.cond`` makes the skip REAL — a dropped step executes none of
+    the layer's FLOPs, which is where PLD's speedup comes from."""
+    keep = jax.random.bernoulli(rng, keep_prob)
+    inv_p = jnp.asarray(1.0 / max(float(keep_prob), 1e-6), h.dtype) \
+        if not hasattr(keep_prob, "dtype") else (1.0 / jnp.maximum(keep_prob, 1e-6)).astype(h.dtype)
+
+    def run(h):
+        out = layer_fn(h)
+        return h + (out - h) * inv_p
+
+    return jax.lax.cond(keep, run, lambda h: h, h)

@@ -1,0 +1,53 @@
+"""Sparse tensor parity surface.
+
+The reference's ``deepspeed/runtime/sparse_tensor.py`` wraps torch
+sparse COO gradients (sparse embedding grads flow through its allreduce
+as index/value pairs). XLA gradients are DENSE by design: an embedding
+lookup's backward lowers to a fused scatter-add, and GSPMD shards it
+like any other array, so there is no sparse gradient path to preserve —
+the fusion IS the optimization. This module keeps the reference's API
+shape for code that constructs/inspects SparseTensor objects, backed by
+a COO (indices, values) pair with dense conversion."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """COO (indices [N], values [N, ...row]) over dim 0 of ``dense_size``."""
+
+    def __init__(self, dense_tensor=None, indices=None, values=None, dense_size=None):
+        if dense_tensor is not None:
+            dense = jnp.asarray(dense_tensor)
+            nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+            self.indices = jnp.nonzero(nz)[0].astype(jnp.int32)
+            self.values = dense[self.indices]
+            self.dense_size = dense.shape
+        else:
+            self.indices = jnp.asarray(indices, jnp.int32)
+            self.values = jnp.asarray(values)
+            self.dense_size = tuple(dense_size)
+        self.orig_dense_size = self.dense_size
+
+    def to_coo_tensor(self):
+        return self.indices, self.values
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        dense = int(np.prod(self.dense_size))
+        sparse = int(self.indices.size + self.values.size)
+        return sparse, dense
+
+    def add(self, other):
+        assert self.dense_size == other.dense_size
+        self.indices = jnp.concatenate([self.indices, other.indices])
+        self.values = jnp.concatenate([self.values, other.values])
+        return self
+
+    def __str__(self):
+        return (f"SparseTensor(indices={self.indices.size}, "
+                f"values={self.values.shape}, dense={self.dense_size})")

@@ -99,16 +99,53 @@ class ZeroShardingPolicy:
 
     def __init__(self, mesh: Mesh, stage: int, tp_rule: Optional[Callable] = None,
                  param_persistence_threshold: int = 0, offload_optimizer: bool = False,
-                 offload_param: bool = False):
+                 offload_param: bool = False, mics_shard_size: int = 0):
         self.mesh = mesh
         self.stage = stage
         self.tp_rule = tp_rule or (lambda path, shape: P())
         self.param_persistence_threshold = param_persistence_threshold
         self.offload_optimizer = offload_optimizer
         self.offload_param = offload_param
+        self.mics_shard_size = int(mics_shard_size or 0)
+        if self.mics_shard_size > 0:
+            self._mics_axes = self._solve_mics_axes(self.mics_shard_size)
+
+    def _solve_mics_axes(self, shard_size):
+        """MiCS (reference runtime/zero/mics.py:64): ZeRO-3 partitions
+        parameters within a SUB-GROUP of size ``mics_shard_size`` and
+        replicates across groups, so the per-layer all-gather stays on
+        fast links. On a named mesh the sub-group is a suffix of the
+        zero axes (innermost = fastest ICI): pick the innermost zero
+        axes whose sizes multiply to the shard size."""
+        sizes = _axis_sizes(self.mesh)
+        axes = []
+        prod = 1
+        for a in reversed(ZERO_AXES):  # innermost first
+            if sizes.get(a, 1) == 1:
+                continue
+            if prod == shard_size:
+                break
+            axes.append(a)
+            prod *= sizes[a]
+        if prod != shard_size:
+            zero_prod = int(np.prod([sizes.get(a, 1) for a in ZERO_AXES]))
+            raise ValueError(
+                f"mics_shard_size={shard_size} is not an innermost-axes factor of the "
+                f"zero axes {ZERO_AXES} with sizes {[sizes.get(a, 1) for a in ZERO_AXES]} "
+                f"(full zero world = {zero_prod})")
+        return tuple(reversed(axes))
 
     def _zero_axes_for(self, path):
         return EXPERT_ZERO_AXES if is_expert_param(path) else ZERO_AXES
+
+    def _param_zero_axes(self, path):
+        full = self._zero_axes_for(path)
+        if self.mics_shard_size > 0 and self.stage >= 3:
+            # MiCS: param partitioning restricted to the sub-group; the
+            # optimizer/grad sharding keeps the full zero axes (grads are
+            # still reduced globally — the hierarchical-allreduce analogue)
+            return tuple(a for a in full if a in self._mics_axes)
+        return full
 
     def _base_spec(self, path, shape):
         spec = self.tp_rule(path, shape)
@@ -130,7 +167,7 @@ class ZeroShardingPolicy:
             return base
         if int(np.prod(shape)) < self.param_persistence_threshold:
             return base
-        return shard_largest_free_dim(shape, base, self._zero_axes_for(path), self.mesh)
+        return shard_largest_free_dim(shape, base, self._param_zero_axes(path), self.mesh)
 
     def opt_spec(self, path: str, shape) -> P:
         """Sharding of fp32 master params and optimizer moments."""
