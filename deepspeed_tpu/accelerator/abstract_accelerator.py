@@ -136,3 +136,135 @@ class DeepSpeedAccelerator(ABC):
     @abc.abstractmethod
     def op_builder_dir(self):
         ...
+
+    # ------------------------------------------------------------------
+    # Extended reference surface (abstract_accelerator.py parity: RNG
+    # state, streams/events/graphs, cache/reserved memory, tensor ctors,
+    # pinning, env contracts). Subclasses override where meaningful.
+    # ------------------------------------------------------------------
+    def is_synchronized_device(self):
+        return False
+
+    def use_host_timers(self):
+        return self.is_synchronized_device()
+
+    def resolves_data_dependency(self):
+        return not self.is_synchronized_device()
+
+    def handles_memory_backpressure(self):
+        return False
+
+    def set_rng_state(self, new_state, device_index=None):
+        ...
+
+    def get_rng_state(self, device_index=None):
+        ...
+
+    def manual_seed_all(self, seed):
+        return self.manual_seed(seed)
+
+    def initial_seed(self):
+        ...
+
+    def default_generator(self, device_index):
+        ...
+
+    def Stream(self, device=None, priority=0, **kwargs):
+        ...
+
+    def stream(self, stream):
+        ...
+
+    def current_stream(self, device_index=None):
+        ...
+
+    def default_stream(self, device_index=None):
+        ...
+
+    def Event(self, **kwargs):
+        ...
+
+    def memory_cached(self, device_index=None):
+        return self.memory_allocated(device_index)
+
+    def max_memory_cached(self, device_index=None):
+        return self.max_memory_allocated(device_index)
+
+    def reset_max_memory_cached(self, device_index=None):
+        return self.reset_max_memory_allocated(device_index)
+
+    def reset_peak_memory_stats(self, device_index=None):
+        return self.reset_max_memory_allocated(device_index)
+
+    def memory_reserved(self, device_index=None):
+        return self.memory_allocated(device_index)
+
+    def max_memory_reserved(self, device_index=None):
+        return self.max_memory_allocated(device_index)
+
+    def amp(self):
+        ...
+
+    def create_graph(self):
+        ...
+
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        ...
+
+    def replay_graph(self, graph):
+        ...
+
+    @property
+    def BFloat16Tensor(self):
+        ...
+
+    @property
+    def ByteTensor(self):
+        ...
+
+    @property
+    def DoubleTensor(self):
+        ...
+
+    @property
+    def FloatTensor(self):
+        ...
+
+    @property
+    def HalfTensor(self):
+        ...
+
+    @property
+    def IntTensor(self):
+        ...
+
+    @property
+    def LongTensor(self):
+        ...
+
+    def pin_memory(self, tensor, align_bytes=1):
+        return tensor
+
+    def is_pinned(self, tensor):
+        return True
+
+    def on_accelerator(self, tensor):
+        ...
+
+    def build_extension(self):
+        ...
+
+    def export_envs(self):
+        return []
+
+    def visible_devices_envs(self):
+        return []
+
+    def set_visible_devices_envs(self, current_env, local_accelerator_ids):
+        ...
+
+    def get_compile_backend(self):
+        ...
+
+    def set_compile_backend(self, backend):
+        ...

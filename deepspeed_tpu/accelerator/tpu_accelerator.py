@@ -178,3 +178,140 @@ class TPU_Accelerator(DeepSpeedAccelerator):
 
     def export_envs(self):
         return ["JAX_", "XLA_", "TPU_", "LIBTPU"]
+
+    # ------------------------------------------------------------------
+    # Extended surface (reference cuda_accelerator.py parity, TPU forms)
+    # ------------------------------------------------------------------
+    def set_rng_state(self, new_state, device_index=None):
+        self._rng_state = new_state
+
+    def get_rng_state(self, device_index=None):
+        import jax
+        state = getattr(self, "_rng_state", None)
+        return state if state is not None else jax.random.PRNGKey(self._seed)
+
+    # Streams/events: XLA owns scheduling — these are inert handles that
+    # keep stream-structured caller code running unchanged.
+    class _NullStream:
+        def synchronize(self):
+            pass
+
+        def wait_stream(self, other):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    class _NullEvent:
+        def record(self, stream=None):
+            import time
+            self._t = time.perf_counter()
+
+        def synchronize(self):
+            pass
+
+        def elapsed_time(self, other):
+            return abs(getattr(other, "_t", 0.0) - getattr(self, "_t", 0.0)) * 1e3
+
+        def query(self):
+            return True
+
+    def Stream(self, device=None, priority=0, **kwargs):
+        return TPU_Accelerator._NullStream()
+
+    def stream(self, stream):
+        return stream if hasattr(stream, "__enter__") else TPU_Accelerator._NullStream()
+
+    def current_stream(self, device_index=None):
+        return TPU_Accelerator._NullStream()
+
+    def default_stream(self, device_index=None):
+        return TPU_Accelerator._NullStream()
+
+    def Event(self, **kwargs):
+        return TPU_Accelerator._NullEvent()
+
+    def amp(self):
+        return None  # precision policy is the engine's dtype config
+
+    # CUDA-graph parity: a jitted callable IS the captured graph
+    def create_graph(self):
+        return {"fn": None}
+
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        import contextlib
+        return contextlib.nullcontext(graph)
+
+    def replay_graph(self, graph):
+        fn = graph.get("fn")
+        if fn is not None:
+            return fn()
+
+    @property
+    def BFloat16Tensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.bfloat16)
+
+    @property
+    def ByteTensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.uint8)
+
+    @property
+    def DoubleTensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.float64)
+
+    @property
+    def FloatTensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.float32)
+
+    @property
+    def HalfTensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.float16)
+
+    @property
+    def IntTensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.int32)
+
+    @property
+    def LongTensor(self):
+        import functools
+        import jax.numpy as jnp
+        return functools.partial(jnp.asarray, dtype=jnp.int64)
+
+    def pin_memory(self, tensor, align_bytes=1):
+        return tensor  # host numpy feeds DMA directly under PJRT
+
+    def is_pinned(self, tensor):
+        return True
+
+    def on_accelerator(self, tensor):
+        import jax
+        return isinstance(tensor, jax.Array) and any(
+            d.platform == "tpu" for d in tensor.devices())
+
+    def visible_devices_envs(self):
+        return ["TPU_VISIBLE_DEVICES"]
+
+    def set_visible_devices_envs(self, current_env, local_accelerator_ids):
+        for env in self.visible_devices_envs():
+            current_env[env] = ",".join(map(str, local_accelerator_ids))
+
+    def get_compile_backend(self):
+        return self._compile_backend
+
+    def set_compile_backend(self, backend):
+        self._compile_backend = backend

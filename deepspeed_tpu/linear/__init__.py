@@ -1,0 +1,8 @@
+"""OptimizedLinear / LoRA (parity: deepspeed/linear/)."""
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.optimized_linear import (OptimizedLinear, QuantizedParameter,
+                                                    lora_frozen_patterns)
+
+__all__ = ["OptimizedLinear", "LoRAConfig", "QuantizationConfig", "QuantizedParameter",
+           "lora_frozen_patterns"]

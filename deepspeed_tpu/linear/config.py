@@ -1,0 +1,24 @@
+"""Configs for OptimizedLinear (reference ``deepspeed/linear/config.py``)."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """Reference linear/config.py:10 — ``lora_r`` the low-rank dim,
+    ``lora_alpha`` the scaling numerator, ``base_weight_sharding`` the
+    number of shards the frozen base weight is split over (on TPU this
+    maps to ZeRO-3's sharding of the frozen base, so it is informational)."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference linear/config.py:27 — weight-only quantization of the
+    frozen base weight (int8 here; the reference's fp8/fp6 variants map
+    to the same group-quant storage with different bit widths)."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512

@@ -1,0 +1,91 @@
+"""OptimizedLinear: LoRA + quantized frozen base.
+
+Capability match for the reference's
+``deepspeed/linear/optimized_linear.py`` (``OptimizedLinear`` at
+optimized_linear.py:18: frozen, optionally sharded/quantized base
+weight + trainable low-rank adapters). TPU redesign as a flax module:
+
+- the base kernel is stored int8 + per-group fp32 scales when
+  ``quantization_config`` is given (weight-only storage; dequantized to
+  the compute dtype at use — the MXU computes in bf16 either way);
+- the LoRA pair (``lora_a`` [in, r], ``lora_b`` [r, out]) is trainable;
+  the base is excluded from updates by the engine's
+  ``frozen_parameters`` mask (pattern ``"base_kernel"``);
+- base-weight sharding is ZeRO-3's job (the param policy shards the
+  frozen leaf like any other), so ``base_weight_sharding`` needs no
+  special machinery here.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+
+
+class QuantizedParameter:
+    """Host-side helper mirroring reference quantization.py: quantize a
+    weight to int8 groups once, dequantize on demand."""
+
+    def __init__(self, weight, quantization_config: Optional[QuantizationConfig] = None):
+        from deepspeed_tpu.ops.pallas.quantization import quantize_int8
+        self.config = quantization_config or QuantizationConfig()
+        v, s, shape = quantize_int8(jnp.asarray(weight), group_size=self.config.group_size)
+        self.values, self.scales, self.shape = v, s, shape
+
+    def dequantized(self, dtype=jnp.bfloat16):
+        from deepspeed_tpu.ops.pallas.quantization import dequantize_int8
+        return dequantize_int8(self.values, self.scales, self.shape, dtype=dtype)
+
+
+class OptimizedLinear(nn.Module):
+    """y = x @ W_base + (x @ A) @ B * (alpha / r)  — W_base frozen."""
+
+    output_dim: int
+    lora_config: Optional[LoRAConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        lora = self.lora_config or LoRAConfig()
+        if self.quantization_config is not None:
+            gs = self.quantization_config.group_size
+            n = in_dim * self.output_dim
+            groups = -(-n // gs)
+            values = self.param("base_kernel_q",
+                                lambda k, s: jnp.zeros(s, jnp.int8), (groups, gs))
+            scales = self.param("base_kernel_scales",
+                                lambda k, s: jnp.ones(s, jnp.float32), (groups,))
+            from deepspeed_tpu.ops.pallas.quantization import dequantize_int8
+            base = dequantize_int8(values, scales, (in_dim, self.output_dim),
+                                   dtype=self.dtype)
+        else:
+            base = self.param("base_kernel", nn.initializers.lecun_normal(),
+                              (in_dim, self.output_dim), jnp.float32).astype(self.dtype)
+        base = jax.lax.stop_gradient(base)  # frozen; adapters learn
+
+        a = self.param("lora_a", nn.initializers.lecun_normal(),
+                       (in_dim, lora.lora_r), jnp.float32).astype(self.dtype)
+        b = self.param("lora_b", nn.initializers.zeros,
+                       (lora.lora_r, self.output_dim), jnp.float32).astype(self.dtype)
+        y = x @ base + (x @ a) @ b * (lora.lora_alpha / lora.lora_r)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.output_dim,), jnp.float32).astype(self.dtype)
+        return y
+
+
+def init_lora(params):
+    """Freeze-pattern helper: the engine config entry that freezes every
+    OptimizedLinear base (``"frozen_parameters": lora_frozen_patterns()``)."""
+    return params
+
+
+def lora_frozen_patterns():
+    return ["base_kernel", "base_kernel_q", "base_kernel_scales"]

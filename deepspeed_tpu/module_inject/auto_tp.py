@@ -1,17 +1,39 @@
 """Automatic tensor-parallel sharding rules.
 
 Analogue of the reference's ``deepspeed/module_inject/auto_tp.py``
-(``AutoTP`` at auto_tp.py:189): instead of physically slicing torch
-Linear weights and inserting allreduce modules, AutoTP here produces a
-``(param_path, shape) -> PartitionSpec`` rule that shards matmul weights
-over the 'tensor' mesh axis — column-parallel (output dim) for QKV /
-gate / up projections, row-parallel (input dim) for output / down
-projections — and XLA inserts the reduction collectives.
+(``AutoTP`` at auto_tp.py:189 + ``replace_module.py:30``): instead of
+physically slicing torch Linear weights and inserting allreduce
+modules, AutoTP here produces a ``(param_path, shape) -> PartitionSpec``
+rule that shards matmul weights over the 'tensor' mesh axis — column-
+parallel (output dim) for QKV / gate / up projections, row-parallel
+(input dim) for output / down projections — and XLA inserts the
+reduction collectives.
+
+Two parsers compose (mirroring the reference's module-tree walk +
+policy fallback):
+
+1. **Structural** (:class:`AutoTP` built via :meth:`tp_parser` with a
+   params tree): infers the model's hidden size from the most common
+   square/embedding dims, then classifies each 2-D kernel by SHAPE —
+   ``[hidden, k*hidden_or_larger]`` → column-parallel,
+   ``[larger, hidden]`` → row-parallel, ``[vocab, hidden]`` → embedding
+   — so models with unconventional names still get a real TP layout,
+   and anything unclassifiable is reported instead of silently
+   replicated (reference replace_module's "unable to parallelize"
+   warnings).
+2. **Name patterns** (``default_tp_rule``): the conventional names,
+   consulted first since names are more precise than shapes when
+   present.
 """
 
 import re
+from collections import Counter
+
+import numpy as np
 
 from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
 
 # Column-parallel: shard the output features (last dim of a [in, out] kernel).
 COLUMN_PATTERNS = [
@@ -26,34 +48,106 @@ ROW_PATTERNS = [
 EMBED_PATTERNS = [r"embed", r"wte", r"lm_head", r"output_layer"]
 
 
-def default_tp_rule(path, shape):
-    """Map a parameter path+shape to a tensor-parallel PartitionSpec."""
+def _name_class(path):
     lowered = path.lower()
+    if any(re.search(p, lowered) for p in ROW_PATTERNS):
+        return "row"
+    if any(re.search(p, lowered) for p in COLUMN_PATTERNS):
+        return "column"
+    if any(re.search(p, lowered) for p in EMBED_PATTERNS):
+        return "embed"
+    return None
+
+
+def default_tp_rule(path, shape):
+    """Name-pattern rule (the round-1 behavior, kept as the fast path)."""
     ndim = len(shape)
     if ndim < 1:
         return P()
-    if any(re.search(p, lowered) for p in ROW_PATTERNS):
+    cls = _name_class(path)
+    if cls == "row":
         if ndim >= 2:
             return P(*(("tensor",) + (None,) * (ndim - 1)))
         return P()  # bias of a row-parallel layer is replicated (added post-reduce)
-    if any(re.search(p, lowered) for p in COLUMN_PATTERNS):
+    if cls == "column":
         return P(*((None,) * (ndim - 1) + ("tensor",)))
-    if any(re.search(p, lowered) for p in EMBED_PATTERNS):
+    if cls == "embed":
         if ndim >= 2:
             return P(*((None,) * (ndim - 1) + ("tensor",)))
         return P()
     return P()
 
 
-class AutoTP:
-    """Holds a tp rule; ``tp_parser`` surface kept for parity."""
+def infer_hidden_size(named_shapes):
+    """The model's hidden size = the dim that appears most often across
+    exactly-2-D kernels (every projection touches it; >2-D kernels are
+    excluded — their heads/head_dim factors would outvote hidden)."""
+    counts = Counter()
+    for _, shape in named_shapes:
+        if len(shape) == 2:
+            counts.update(shape)
+    if not counts:
+        return None
+    return counts.most_common(1)[0][0]
 
-    def __init__(self, rule=None):
+
+def structural_specs(named_shapes, hidden):
+    """Shape-based classification of 2-D kernels (reference module-tree
+    parse): → ({path: P}, unparallelized_paths). Paths the shape logic
+    cannot classify (1-D, >2-D, unrelated dims) are OMITTED so the
+    name-pattern rule still gets a shot at them."""
+    specs = {}
+    unmatched = []
+    for path, shape in named_shapes:
+        if len(shape) != 2:
+            continue  # name rule handles biases and >2-D kernels
+        d_in, d_out = shape
+        if d_in == hidden and d_out == hidden:
+            # square projection: position is ambiguous by shape alone;
+            # fall back to names, defaulting to column (reference shards
+            # attention dense column-first)
+            cls = _name_class(path) or "column"
+        elif d_in == hidden:
+            cls = "column"  # up-proj / qkv / vocab head: shard outputs
+        elif d_out == hidden:
+            cls = "row"  # down-proj / o-proj / embed table: shard inputs
+        else:
+            unmatched.append(path)
+            continue
+        specs[path] = P("tensor", None) if cls == "row" else P(None, "tensor")
+    return specs, unmatched
+
+
+class AutoTP:
+    """TP rule provider. ``AutoTP.tp_parser(params=...)`` builds the
+    structural parser; bare ``AutoTP()`` uses name patterns only."""
+
+    def __init__(self, rule=None, specs=None):
         self.rule = rule or default_tp_rule
+        self.specs = specs or {}
 
     @staticmethod
-    def tp_parser(model=None):
-        return AutoTP()
+    def tp_parser(model=None, params=None):
+        """Structural parse of a params pytree (preferred); falls back to
+        name patterns when no tree is given (parity surface keeps the
+        ``model`` arg)."""
+        if params is None:
+            return AutoTP()
+        from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+        named = []
+        path_tree_map(lambda p, x: named.append((p, tuple(np.shape(x)))) or x, params)
+        hidden = infer_hidden_size(named)
+        if hidden is None:
+            logger.warning("AutoTP: no 2-D kernels found; model stays replicated")
+            return AutoTP()
+        specs, unmatched = structural_specs(named, hidden)
+        if unmatched:
+            logger.warning(
+                f"AutoTP: {len(unmatched)} parameters could not be classified by shape "
+                f"(e.g. {unmatched[:3]}) — falling back to name patterns for them")
+        return AutoTP(specs=specs)
 
     def __call__(self, path, shape):
+        if path in self.specs:
+            return self.specs[path]
         return self.rule(path, shape)

@@ -1,0 +1,123 @@
+"""Standalone activation checkpointing API.
+
+Capability match for the reference's
+``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(``checkpoint`` at checkpointing.py:976, ``configure`` at :1132, the
+Megatron-style model-parallel RNG tracker): the TPU forms —
+
+- :func:`checkpoint` wraps a function in ``jax.checkpoint`` with the
+  configured policy (partitioned/contiguous memory knobs are CUDA
+  buffer-management concepts; XLA's rematerializer owns buffers, so
+  they parse and are recorded but change nothing — documented no-op);
+- ``profile=True`` in :func:`configure` selects the ``dots_saveable``
+  policy (cheap recompute), matching the reference's
+  PROFILE_TIME intent of trading memory for less recompute;
+- the RNG tracker is a fold-in: JAX PRNG keys are values, so the
+  reference's fork/restore state machine collapses to
+  ``fold_in(key, name)``.
+"""
+
+import zlib
+
+import jax
+
+_config = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "num_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "configured": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None, checkpoint_in_cpu=None,
+              synchronize=None, profile=None):
+    """Record the activation-checkpointing options (reference :1132)."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if ac is not None:
+            _config["partition_activations"] = bool(ac.partition_activations)
+            _config["contiguous_memory_optimization"] = bool(ac.contiguous_memory_optimization)
+            _config["cpu_checkpointing"] = bool(ac.cpu_checkpointing)
+            _config["num_checkpoints"] = ac.number_checkpoints
+            _config["profile"] = bool(ac.profile)
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("num_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize_checkpoint_boundary", synchronize),
+                     ("profile", profile)):
+        if val is not None:
+            _config[key] = val
+    _config["configured"] = True
+
+
+def is_configured():
+    return _config["configured"]
+
+
+def _policy():
+    if _config["profile"]:
+        return jax.checkpoint_policies.dots_saveable
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def checkpoint(function, *args):
+    """Rematerialized apply (reference ``checkpoint`` at :976): the
+    wrapped function's activations are recomputed in backward instead of
+    stored. Returns ``function(*args)``.
+
+    ``function`` must be pure in its traced inputs. To checkpoint a flax
+    SUBMODULE (whose parameters are created during trace), use
+    ``flax.linen.remat(Module)`` instead — wrapping module construction
+    here leaks flax's mutable trace state."""
+    return jax.checkpoint(function, policy=_policy(), prevent_cse=False)(*args)
+
+
+def checkpoint_wrapped(function):
+    """Decorator form: ``fn = checkpoint_wrapped(fn)``."""
+    return jax.checkpoint(function, policy=_policy(), prevent_cse=False)
+
+
+class CudaRNGStatesTracker:
+    """Megatron RNG tracker parity: names map to deterministic fold-ins
+    of a base key — there is no global RNG state to fork/restore."""
+
+    def __init__(self):
+        self._base = jax.random.PRNGKey(0)
+        self._names = {}
+
+    def reset(self):
+        self._names = {}
+
+    def add(self, name, seed):
+        # crc32, not hash(): builtin hash is salted per process, which
+        # would desynchronize model-parallel RNG across hosts
+        self._names[name] = jax.random.fold_in(
+            jax.random.PRNGKey(seed), zlib.crc32(name.encode()) % (2**31))
+
+    def get_states(self):
+        return dict(self._names)
+
+    def fork(self, name="model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self._names.get(name, self._base)
+        return ctx()
+
+
+_CUDA_RNG_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker():
+    return _CUDA_RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed):
+    _CUDA_RNG_TRACKER.reset()
+    _CUDA_RNG_TRACKER.add("model-parallel-rng", seed)

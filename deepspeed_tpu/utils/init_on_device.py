@@ -1,0 +1,61 @@
+"""OnDevice: construct models without materializing weights.
+
+Capability match for the reference's ``deepspeed/utils/init_on_device.py``
+(``OnDevice``: patches tensor constructors to build on 'meta' or a
+target device). JAX already separates definition from materialization —
+``jax.eval_shape`` IS meta-device init — so this context manager simply
+carries the requested dtype/device and offers :meth:`abstract_init` /
+:meth:`materialize` helpers."""
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    _dtype = None
+    _device = None
+
+    def __init__(self, dtype=jnp.bfloat16, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        if self.enabled:
+            OnDevice._dtype = self.dtype
+            OnDevice._device = self.device
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._dtype = None
+        OnDevice._device = None
+        return False
+
+    def abstract_init(self, model, *sample_args, rng=None):
+        """→ ShapeDtypeStruct pytree: the 'meta device' params."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        variables = jax.eval_shape(lambda r: model.init(r, *sample_args), rng)
+        params = variables.get("params", variables)
+        if self.dtype is not None:
+            params = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, self.dtype if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+                params)
+        return params
+
+    def materialize(self, model, *sample_args, rng=None, shardings=None):
+        """Materialize for real, optionally straight into shardings (the
+        'device' path; models never exist unsharded on any host)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def init_fn(r):
+            params = model.init(r, *sample_args).get("params")
+            if self.dtype is not None:
+                params = jax.tree.map(
+                    lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    params)
+            return params
+
+        if shardings is not None:
+            return jax.jit(init_fn, out_shardings=shardings)(rng)
+        return jax.jit(init_fn)(rng)

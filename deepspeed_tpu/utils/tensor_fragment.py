@@ -1,0 +1,88 @@
+"""Full-parameter access helpers for ZeRO-partitioned state.
+
+Capability match for the reference's ``deepspeed/utils/tensor_fragment.py``
+(``safe_get_full_fp32_param`` etc., the documented user API for reading/
+writing ZeRO-sharded parameters and optimizer state). The reference maps
+flat-partition fragments back to tensors; on TPU every leaf is a global
+``jax.Array``, so "get full" is a replication re-placement and "set"
+is a re-placement of new values onto the existing sharding.
+
+All functions take the ENGINE and a '/'-joined leaf path (e.g.
+``"model/layers/mlp/gate_proj/kernel"``)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _leaf(tree, path):
+    node = tree
+    for part in path.split("/"):
+        if part.startswith("#"):
+            node = node[int(part[1:])]
+        else:
+            node = node[part]
+    return node
+
+
+def _set_leaf(tree, path, value):
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[int(part[1:])] if part.startswith("#") else node[part]
+    node[parts[-1]] = value
+
+
+def safe_get_full_fp32_param(engine, path):
+    """→ np.ndarray fp32 of the master weight (reference
+    tensor_fragment.py:207)."""
+    src = engine.master_params if engine.master_params is not None else engine.params
+    return np.asarray(jax.device_get(_leaf(src, path))).astype(np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value):
+    """Write a full fp32 master value back onto its sharding (reference
+    :279); the compute-dtype param is refreshed too."""
+    src = engine.master_params if engine.master_params is not None else engine.params
+    cur = _leaf(src, path)
+    new = jax.device_put(jnp.asarray(value, cur.dtype), cur.sharding)
+    _set_leaf(src, path, new)
+    if engine.master_params is not None and engine.master_params is not engine.params:
+        p_cur = _leaf(engine.params, path)
+        _set_leaf(engine.params, path,
+                  jax.device_put(jnp.asarray(value).astype(p_cur.dtype), p_cur.sharding))
+
+
+def safe_get_full_optimizer_state(engine, path, optim_state_key):
+    """→ np.ndarray fp32 of one optimizer moment (reference :231)."""
+    assert engine.opt_state is not None, "optimizer state not materialized (offload?)"
+    return np.asarray(jax.device_get(_leaf(engine.opt_state[optim_state_key], path))).astype(np.float32)
+
+
+def safe_set_full_optimizer_state(engine, path, value, optim_state_key):
+    cur = _leaf(engine.opt_state[optim_state_key], path)
+    _set_leaf(engine.opt_state[optim_state_key], path,
+              jax.device_put(jnp.asarray(value, cur.dtype), cur.sharding))
+
+
+def safe_get_full_grad(engine, path):
+    """→ np.ndarray fp32 of the accumulated gradient, or None before
+    backward (reference :191)."""
+    grads = engine._grads_acc if engine._grads_acc is not None else (
+        engine._pending[1] if engine._pending is not None else None)
+    if grads is None:
+        return None
+    return np.asarray(jax.device_get(_leaf(grads, path))).astype(np.float32)
+
+
+# local-fragment aliases: on TPU the addressable shard IS the fragment
+def safe_get_local_fp32_param(engine, path):
+    src = engine.master_params if engine.master_params is not None else engine.params
+    leaf = _leaf(src, path)
+    return np.asarray(leaf.addressable_shards[0].data).astype(np.float32)
+
+
+def safe_get_local_optimizer_state(engine, path, optim_state_key):
+    leaf = _leaf(engine.opt_state[optim_state_key], path)
+    return np.asarray(leaf.addressable_shards[0].data).astype(np.float32)

@@ -1,0 +1,65 @@
+"""z3 leaf-module API (reference ``deepspeed/utils/z3_leaf_module.py``).
+
+The reference marks modules whose children must be fetched as one unit
+(``set_z3_leaf_modules``) so ZeRO-3's prefetch coordinator doesn't trace
+into them. On TPU the scan-over-layers + XLA scheduling replaces the
+prefetch coordinator entirely — the marker is kept as real bookkeeping
+(the sharding policy reads it to keep a leaf module's params unsharded
+as one persistence unit)."""
+
+_Z3_LEAF_ATTR = "_z3_leaf"
+
+
+def z3_leaf_module(model) -> bool:
+    return getattr(model, _Z3_LEAF_ATTR, False)
+
+
+def z3_leaf_parameters(model):
+    return getattr(model, "_z3_leaf_parameters", [])
+
+
+def get_z3_leaf_modules(model):
+    return [m for m in _walk(model) if z3_leaf_module(m)]
+
+
+def set_z3_leaf_module(model, flag: bool = True):
+    object.__setattr__(model, _Z3_LEAF_ATTR, flag)
+
+
+def set_z3_leaf_modules(model, leaf_module_classes):
+    """Mark every submodule whose class is in ``leaf_module_classes``."""
+    leaf_module_classes = tuple(leaf_module_classes)
+    marked = []
+    for m in _walk(model):
+        if isinstance(m, leaf_module_classes):
+            set_z3_leaf_module(m, True)
+            marked.append(m)
+    if not marked:
+        raise ValueError(f"no submodules of classes {leaf_module_classes} found")
+    return marked
+
+
+def unset_z3_leaf_modules(model, leaf_module_classes):
+    leaf_module_classes = tuple(leaf_module_classes)
+    marked = []
+    for m in _walk(model):
+        if isinstance(m, leaf_module_classes) and z3_leaf_module(m):
+            set_z3_leaf_module(m, False)
+            marked.append(m)
+    return marked
+
+
+def _walk(model):
+    """Model + flax submodule instances (best effort: dataclass fields)."""
+    seen = [model]
+    seen_ids = {id(model)}
+    for node in seen:
+        for name in getattr(node, "__dataclass_fields__", {}):
+            child = getattr(node, name, None)
+            if hasattr(child, "__dataclass_fields__") and hasattr(child, "apply"):
+                # identity, not equality: structurally-equal sibling
+                # modules are distinct instances and must both be walked
+                if id(child) not in seen_ids:
+                    seen.append(child)
+                    seen_ids.add(id(child))
+    return seen
