@@ -1,0 +1,5 @@
+from deepspeed_tpu.inference.quantization.quantization import (QuantizedWeight,
+                                                                _init_group_wise_weight_quantization,
+                                                                quantized_bytes)
+
+__all__ = ["_init_group_wise_weight_quantization", "QuantizedWeight", "quantized_bytes"]

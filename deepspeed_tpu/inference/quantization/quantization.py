@@ -1,0 +1,84 @@
+"""ZeRO-Inference weight-only quantization.
+
+Capability match for the reference's ``deepspeed/inference/quantization/``
+(``_init_group_wise_weight_quantization``: swaps Linears for
+QuantizedLinear with int-quantized weights, cutting serving memory).
+TPU functional form: the params PYTREE is quantized (int8 or fp8 group
+storage per leaf) and a transform dequantizes each leaf at use — the
+jitted forward consumes the transform's output, so XLA fuses the
+dequant into the first matmul and only the quantized bytes live in HBM."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+
+
+class QuantizedWeight:
+    """One quantized leaf: int8 or fp8 group values + fp32 scales.
+    Registered as a pytree so quantized trees pass straight through jit
+    (dequantization then happens inside the compiled serving step and
+    XLA fuses it into the first matmul)."""
+
+    def __init__(self, values, scales, shape, scheme):
+        self.values = values
+        self.scales = scales
+        self.shape = tuple(shape)
+        self.scheme = scheme
+
+    def dequantized(self, dtype=jnp.bfloat16):
+        if self.scheme == "fp8":
+            from deepspeed_tpu.ops.fp_quantizer.quantize import dequantize_fp8
+            return dequantize_fp8(self.values, self.scales, self.shape, dtype=dtype)
+        from deepspeed_tpu.ops.pallas.quantization import dequantize_int8
+        return dequantize_int8(self.values, self.scales, self.shape, dtype=dtype)
+
+    def nbytes(self):
+        return int(self.values.size * self.values.dtype.itemsize +
+                   self.scales.size * self.scales.dtype.itemsize)
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight,
+    lambda qw: ((qw.values, qw.scales), (qw.shape, qw.scheme)),
+    lambda aux, children: QuantizedWeight(children[0], children[1], aux[0], aux[1]))
+
+
+def _init_group_wise_weight_quantization(params, ds_config=None, num_bits=8,
+                                         group_size=512, modules=None, scheme="int8"):
+    """→ (quantized_tree, dequant_transform). ``modules``: regex list of
+    leaf paths to quantize (default: every >=2-D float kernel)."""
+    patterns = [re.compile(m) for m in (modules or [r".*"])]
+
+    def q_leaf(path, x):
+        if (getattr(x, "ndim", 0) < 2 or not jnp.issubdtype(x.dtype, jnp.floating)
+                or not any(p.search(path) for p in patterns)):
+            return x
+        if scheme == "fp8":
+            from deepspeed_tpu.ops.fp_quantizer.quantize import quantize_fp8
+            v, s, shape = quantize_fp8(x, group_size=group_size)
+        else:
+            from deepspeed_tpu.ops.pallas.quantization import quantize_int8
+            v, s, shape = quantize_int8(x, group_size=group_size)
+        return QuantizedWeight(v, s, shape, scheme)
+
+    qtree = path_tree_map(q_leaf, params)
+
+    def dequant(tree, dtype=jnp.bfloat16):
+        return jax.tree.map(
+            lambda x: x.dequantized(dtype) if isinstance(x, QuantizedWeight) else x,
+            tree, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+
+    return qtree, dequant
+
+
+def quantized_bytes(qtree):
+    total = 0
+    for leaf in jax.tree.leaves(qtree, is_leaf=lambda x: isinstance(x, QuantizedWeight)):
+        if isinstance(leaf, QuantizedWeight):
+            total += leaf.nbytes()
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total

@@ -47,6 +47,8 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    # Qwen2-style QKV biases (Llama/Mistral/Mixtral: False)
+    attention_bias: bool = False
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash"
     remat: bool = True
     # "full" recomputes everything in backward (min memory, ~8N flops);
@@ -78,6 +80,25 @@ LLAMA_CONFIGS = {
                        num_attention_heads=40, num_key_value_heads=40),
     "70b": LlamaConfig(hidden_size=8192, intermediate_size=28672, num_hidden_layers=80,
                        num_attention_heads=64, num_key_value_heads=8),
+    # Llama-family presets (the reference's inference-v2 model zoo —
+    # mistral/mixtral/qwen2 are Llama-architecture with GQA / MoE; the
+    # debug-scale variants exercise the same code paths in tests):
+    "mistral-7b": LlamaConfig(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                              num_hidden_layers=32, num_attention_heads=32,
+                              num_key_value_heads=8, max_position_embeddings=32768,
+                              rope_theta=1e6),
+    "mixtral-8x7b": LlamaConfig(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                                num_hidden_layers=32, num_attention_heads=32,
+                                num_key_value_heads=8, max_position_embeddings=32768,
+                                rope_theta=1e6, moe_num_experts=8, moe_top_k=2),
+    "qwen2-7b": LlamaConfig(vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+                            num_hidden_layers=28, num_attention_heads=28,
+                            num_key_value_heads=4, max_position_embeddings=32768,
+                            rope_theta=1e6, attention_bias=True),
+    "mixtral-debug": LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                                 num_hidden_layers=2, num_attention_heads=4,
+                                 num_key_value_heads=2, max_position_embeddings=128,
+                                 moe_num_experts=4, moe_top_k=2),
 }
 
 
@@ -178,9 +199,10 @@ class LlamaAttention(nn.Module):
         B, S, D = h.shape
         H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
-        q = nn.Dense(H * Dh, use_bias=False, name="q_proj")(h).reshape(B, S, H, Dh)
-        k = nn.Dense(Hkv * Dh, use_bias=False, name="k_proj")(h).reshape(B, S, Hkv, Dh)
-        v = nn.Dense(Hkv * Dh, use_bias=False, name="v_proj")(h).reshape(B, S, Hkv, Dh)
+        qkv_bias = cfg.attention_bias
+        q = nn.Dense(H * Dh, use_bias=qkv_bias, name="q_proj")(h).reshape(B, S, H, Dh)
+        k = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
+        v = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
 
         cos, sin = rope_frequencies(Dh, cfg.max_position_embeddings, cfg.rope_theta)
         q = apply_rope(q, cos, sin, positions)

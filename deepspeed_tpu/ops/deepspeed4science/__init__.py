@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.deepspeed4science.evoformer_attn import DS4Sci_EvoformerAttention
+
+__all__ = ["DS4Sci_EvoformerAttention"]

@@ -1,0 +1,35 @@
+"""DS4Sci_EvoformerAttention.
+
+Capability match for the reference's
+``deepspeed/ops/deepspeed4science/evoformer_attn.py``
+(``DS4Sci_EvoformerAttention`` over the CUTLASS fMHA kernels in
+``csrc/deepspeed4science/evoformer_attn/``): memory-efficient attention
+with up to TWO additive bias terms (the AlphaFold pair/MSA biases),
+differentiable through both. TPU form: the biases sum into one additive
+term consumed by :func:`flash_attention`'s bias path; XLA's autodiff
+produces both bias gradients (the reference hand-writes them)."""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def DS4Sci_EvoformerAttention(Q, K, V, biases):
+    """Q/K/V: [*, H, S, D] (reference layout: batch dims then heads);
+    ``biases``: list of 0-2 tensors broadcastable to [*, H, S, S].
+    → [*, H, S, D]."""
+    if len(biases) > 2:
+        raise ValueError("DS4Sci_EvoformerAttention supports at most 2 bias terms")
+    *lead, H, S, D = Q.shape
+    B = 1
+    for d in lead:
+        B *= d
+    # [B, H, S, D] → flash layout [B, S, H, D]
+    to_flash = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    q, k, v = to_flash(Q), to_flash(K), to_flash(V)
+    bias = None
+    for b in biases:
+        term = jnp.broadcast_to(b, tuple(lead) + (H, S, S)).reshape(B, H, S, S)
+        bias = term if bias is None else bias + term
+    out = flash_attention(q, k, v, causal=False, bias=bias)
+    return out.transpose(0, 2, 1, 3).reshape(*lead, H, S, D)

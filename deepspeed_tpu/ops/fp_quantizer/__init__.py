@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.fp_quantizer.quantize import (FP_Quantize, dequantize_fp8, quantize_fp8)
+
+__all__ = ["FP_Quantize", "quantize_fp8", "dequantize_fp8"]

@@ -1,0 +1,58 @@
+"""Grouped (per-expert) GEMM for MoE.
+
+Capability match for the reference's grouped GEMM usage in MoE inference
+kernels (``deepspeed/inference/v2/kernels/cutlass_ops/mixed_gemm`` /
+``grouped_gemm``): tokens sorted by expert multiply each expert's weight
+without materializing the [E, capacity, ...] dense dispatch tensor.
+TPU-native: ``jax.lax.ragged_dot`` IS the grouped GEMM — XLA lowers it
+to MXU-tiled loops over contiguous groups, so no Pallas kernel is
+needed for the hot path.
+
+``moe_grouped_mlp`` is the drop-in computation for a top-1/top-k MoE
+FFN over flat tokens; the capacity-based einsum dispatch in
+``deepspeed_tpu/moe/sharded_moe.py`` remains the training path (its
+fixed shapes compose with GSPMD's expert-parallel all-to-all), while
+this grouped path serves inference and single-shard experts where
+dropless exactness matters.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def grouped_gemm(tokens, expert_weights, group_sizes, preferred_element_type=jnp.float32):
+    """tokens: [T, D] sorted by expert; expert_weights: [E, D, F];
+    group_sizes: [E] with sum == T → [T, F]."""
+    return jax.lax.ragged_dot(tokens, expert_weights, group_sizes.astype(jnp.int32),
+                              preferred_element_type=preferred_element_type)
+
+
+def sort_by_expert(x, expert_idx, num_experts):
+    """→ (x_sorted [T, D], group_sizes [E], unsort_idx [T]): contiguous
+    per-expert grouping of a flat token batch."""
+    order = jnp.argsort(expert_idx, stable=True)
+    x_sorted = jnp.take(x, order, axis=0)
+    group_sizes = jnp.bincount(expert_idx, length=num_experts)
+    unsort = jnp.argsort(order, stable=True)
+    return x_sorted, group_sizes, unsort
+
+
+def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation=jax.nn.silu):
+    """Dropless top-1 MoE FFN: x [T, D]; expert_idx [T]; weights
+    [E, D, F] / [E, D, F] / [E, F, D] → [T, D]. Every token reaches its
+    expert (no capacity drops — the grouped-GEMM advantage)."""
+    xs, sizes, unsort = sort_by_expert(x, expert_idx, num_experts)
+    gate = grouped_gemm(xs, w_gate, sizes).astype(x.dtype)
+    up = grouped_gemm(xs, w_up, sizes).astype(x.dtype)
+    inter = activation(gate) * up
+    out = grouped_gemm(inter, w_down, sizes).astype(x.dtype)
+    return jnp.take(out, unsort, axis=0)
+
+
+def dense_reference_mlp(x, expert_idx, w_gate, w_up, w_down, activation=jax.nn.silu):
+    """O(T*E) dense check: every token through every expert, select own."""
+    gate = jnp.einsum("td,edf->tef", x, w_gate)
+    up = jnp.einsum("td,edf->tef", x, w_up)
+    inter = activation(gate) * up
+    out = jnp.einsum("tef,efd->ted", inter, w_down)
+    return jnp.take_along_axis(out, expert_idx[:, None, None], axis=1)[:, 0, :].astype(x.dtype)

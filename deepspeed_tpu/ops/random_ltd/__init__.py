@@ -1,0 +1,10 @@
+"""Random-LTD ops (parity: deepspeed/ops/random_ltd/): the gather/
+scatter kernels are jnp.take / .at[].set — XLA's fused scatter replaces
+the CUDA token_sort/gather kernels. The scheduling + layer wrapper live
+in runtime/data_pipeline/data_routing/random_ltd.py."""
+
+from deepspeed_tpu.runtime.data_pipeline.data_routing.random_ltd import (RandomLTDScheduler,
+                                                                          apply_random_ltd,
+                                                                          random_token_select)
+
+__all__ = ["RandomLTDScheduler", "apply_random_ltd", "random_token_select"]

