@@ -142,16 +142,26 @@ class MOELayer(nn.Module):
                                                noisy_gate_policy=self.noisy_gate_policy,
                                                name="gate")(tokens, train=train)
 
-        # [E, C, D] expert-major dispatch (XLA inserts token→expert a2a)
-        dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), tokens)
+        # [E, C, D] expert-major dispatch (XLA inserts token→expert a2a).
+        # The big operand stays 3-D [B, S, D]: flattening it first would
+        # reshape a multi-axis-sharded token dim and XLA pays an
+        # involuntary full rematerialization on the reshard.
+        E, C = dispatch.shape[1], dispatch.shape[2]
+        disp4 = dispatch.reshape(B, S, E, C)
+        dispatched = jnp.einsum("bsec,bsd->ecd", disp4.astype(x.dtype), x)
         dispatched = constrain(dispatched, ("expert", None, None))
 
         out = self.experts(dispatched)
         out = constrain(out, ("expert", None, None))
 
         # combine back to token-major (expert→token a2a)
-        combined = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)
-        return combined.reshape(B, S, D), aux_loss
+        combined = jnp.einsum("bsec,ecd->bsd", combine.reshape(B, S, E, C).astype(x.dtype), out)
+        # Note on the XLA "Involuntary full rematerialization" warnings
+        # visible in multi-axis dryruns: they were chased to the GATE's
+        # top-k bookkeeping tensors ([B, S, capacity]-sized, ~KBs), not
+        # the activation path — the big operands above stay 3-D exactly
+        # so their token dim is never reshaped across shardings.
+        return combined, aux_loss
 
     def experts(self, dispatched):
         """SwiGLU expert FFNs over [E, C, D]; params stacked on E."""
