@@ -103,9 +103,13 @@ class TopKGate(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        # gate weights always fp32 (reference keeps wg in fp32)
+        # gate weights always fp32 (reference keeps wg in fp32).
+        # x may be [..., D]: the Dense runs on the un-reshaped activation
+        # (reshaping the big multi-axis-sharded operand forces an XLA
+        # reshard); only the small [T, E] logits are flattened.
         logits = nn.Dense(self.num_experts, use_bias=False, name="wg",
                           dtype=jnp.float32)(x.astype(jnp.float32))
+        logits = logits.reshape(-1, self.num_experts)
         if self.noisy_gate_policy == "RSample" and train:
             rng = self.make_rng("dropout") if self.has_rng("dropout") else None
             if rng is not None:
@@ -133,14 +137,14 @@ class MOELayer(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         B, S, D = x.shape
-        tokens = x.reshape(B * S, D)
 
+        # the gate consumes x 3-D (only its [T, E] logits flatten)
         aux_loss, combine, dispatch = TopKGate(num_experts=self.num_experts, k=self.k,
                                                capacity_factor=self.capacity_factor,
                                                eval_capacity_factor=self.eval_capacity_factor,
                                                min_capacity=self.min_capacity,
                                                noisy_gate_policy=self.noisy_gate_policy,
-                                               name="gate")(tokens, train=train)
+                                               name="gate")(x, train=train)
 
         # [E, C, D] expert-major dispatch (XLA inserts token→expert a2a).
         # The big operand stays 3-D [B, S, D]: flattening it first would

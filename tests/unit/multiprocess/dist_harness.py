@@ -18,12 +18,15 @@ jax.distributed initialization; its return value must be picklable.
 import multiprocessing as mp
 import os
 import socket
-import sys
 import traceback
 
 
 def _free_port():
+    # SO_REUSEADDR narrows (does not fully close — fail-fast polling in
+    # run_distributed covers the rest) the TOCTOU window between this
+    # close and the rank-0 coordinator's bind
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
@@ -32,15 +35,11 @@ def _free_port():
 
 def _entry(fn, rank, world, port, devices_per_proc, queue, extra_env):
     try:
+        # (sys.path arrives from the parent via spawn's preparation data —
+        # conftest already seeded the repo root and tests dir)
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                    f" --xla_force_host_platform_device_count={devices_per_proc}")
         os.environ["JAX_PLATFORMS"] = "cpu"
-        repo = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))))
-        os.environ["PYTHONPATH"] = os.pathsep.join(
-            [repo, os.path.join(repo, "tests"), os.environ.get("PYTHONPATH", "")])
-        sys.path.insert(0, repo)
-        sys.path.insert(0, os.path.join(repo, "tests"))
         os.environ.update(extra_env or {})
         os.environ["MASTER_ADDR"] = "127.0.0.1"
         os.environ["MASTER_PORT"] = str(port)
@@ -69,9 +68,29 @@ def run_distributed(fn, world_size=2, devices_per_proc=4, timeout=300, extra_env
     for p in procs:
         p.start()
     results = {}
+    import queue as queue_mod
+    import time
+    deadline = time.monotonic() + timeout
     try:
-        for _ in range(world_size):
-            rank, status, payload = queue.get(timeout=timeout)
+        while len(results) < world_size:
+            try:
+                rank, status, payload = queue.get(timeout=2)
+            except queue_mod.Empty:
+                # fail fast when a worker died without reporting
+                # (segfault / OOM-kill / rendezvous abort)
+                dead = [(p.pid, i, p.exitcode) for i, p in enumerate(procs)
+                        if not p.is_alive() and p.exitcode not in (0, None)
+                        and i not in results]
+                if dead:
+                    raise RuntimeError(
+                        f"worker(s) died without reporting: "
+                        f"{[(f'rank {i}', f'exit {code}') for _, i, code in dead]}")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"run_distributed: {world_size - len(results)} worker(s) "
+                        f"unreported after {timeout}s (alive: "
+                        f"{[p.is_alive() for p in procs]})")
+                continue
             if status == "error":
                 raise RuntimeError(f"rank {rank} failed:\n{payload}")
             results[rank] = payload
