@@ -1,0 +1,76 @@
+"""Offline data analysis for curriculum learning.
+
+Capability match for the reference's
+``deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py``
+(``DataAnalyzer`` at :22 / ``DistributedDataAnalyzer`` at :455): walks
+the training dataset once, computes each sample's difficulty metrics,
+and persists index→metric maps the curriculum sampler consumes. The
+mmap'd indexed-dataset machinery collapses to ``.npy`` files — the
+sampler reads them with ``np.load(mmap_mode='r')``."""
+
+import json
+import os
+
+import numpy as np
+
+
+class DataAnalyzer:
+
+    def __init__(self, dataset, metric_names=None, metric_functions=None,
+                 save_path="./data_analysis", num_workers=1, worker_id=0,
+                 batch_size=1024):
+        """``metric_functions[i](sample) -> float`` scores one sample for
+        ``metric_names[i]`` (e.g. sequence length, loss, rarity)."""
+        self.dataset = dataset
+        self.metric_names = list(metric_names or [])
+        self.metric_functions = list(metric_functions or [])
+        assert len(self.metric_names) == len(self.metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+
+    def _metric_path(self, name, worker_id=None):
+        suffix = f"_w{worker_id}" if worker_id is not None else ""
+        return os.path.join(self.save_path, f"{name}_index_to_metric{suffix}.npy")
+
+    def run_map(self):
+        """This worker's shard: compute metrics for its stride of sample
+        indices and write per-worker partial files (reference run_map)."""
+        os.makedirs(self.save_path, exist_ok=True)
+        n = len(self.dataset)
+        idx = np.arange(self.worker_id, n, self.num_workers)
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            values = np.asarray([float(fn(self.dataset[int(i)])) for i in idx], np.float64)
+            np.save(self._metric_path(name, self.worker_id),
+                    np.stack([idx.astype(np.float64), values]))
+        return len(idx)
+
+    def run_reduce(self):
+        """Merge every worker's partials into the final index→metric map
+        + a sorted index→sample map (reference run_reduce)."""
+        n = len(self.dataset)
+        summary = {}
+        for name in self.metric_names:
+            merged = np.full(n, np.nan)
+            for w in range(self.num_workers):
+                part = np.load(self._metric_path(name, w))
+                merged[part[0].astype(np.int64)] = part[1]
+            if np.isnan(merged).any():
+                missing = int(np.isnan(merged).sum())
+                raise RuntimeError(f"metric {name}: {missing} samples unanalyzed — "
+                                   f"did every worker run run_map()?")
+            np.save(self._metric_path(name), merged)
+            order = np.argsort(merged, kind="stable")
+            np.save(os.path.join(self.save_path, f"{name}_metric_to_sample.npy"), order)
+            summary[name] = {"min": float(merged.min()), "max": float(merged.max()),
+                             "mean": float(merged.mean())}
+        with open(os.path.join(self.save_path, "analysis_summary.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+        return summary
+
+    @staticmethod
+    def load_index_to_metric(save_path, metric_name):
+        """→ mmap'd [N] metric array for DeepSpeedDataSampler."""
+        return np.load(os.path.join(save_path, f"{metric_name}_index_to_metric.npy"),
+                       mmap_mode="r")

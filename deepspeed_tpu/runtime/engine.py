@@ -508,7 +508,8 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _quantized_comm_enabled(self):
         zc = self._config.zero_config
-        if not (zc.zero_quantized_gradients or zc.zero_quantized_weights):
+        if not (zc.zero_quantized_gradients or zc.zero_quantized_weights
+                or zc.zero_quantized_nontrainable_weights):
             return False
         return dict(self.mesh.shape).get("data", 1) > 1
 
@@ -650,6 +651,14 @@ class DeepSpeedEngine:
         zc = self._config.zero_config
         qg = zc.zero_quantized_gradients
         qw = zc.zero_quantized_weights
+        # nontrainable-only variant: quantize the gather of FROZEN leaves
+        # (reference semantics — trainable weights stay full precision)
+        qnw = zc.zero_quantized_nontrainable_weights
+        if qnw and not qw and self._trainable_mask is None:
+            logger.warning("zero_quantized_nontrainable_weights set but no "
+                           "frozen_parameters configured — nothing to quantize")
+        trainable = (self._trainable_mask if self._trainable_mask is not None
+                     else jax.tree.map(lambda _: True, self.params))
         hpz = int(getattr(zc, "zero_hpz_partition_size", 1) or 1)
         axis, n, param_dims, grad_dims, to_specs, batch_spec_of = self._manual_data_specs()
         param_in_specs = to_specs(param_dims)
@@ -663,10 +672,12 @@ class DeepSpeedEngine:
                 seed_base = jax.random.randint(jax.random.fold_in(rng, 0x5eed), (),
                                                0, jnp.iinfo(jnp.int32).max)
 
+                trainable_leaves = jax.tree.structure(params).flatten_up_to(trainable)
+
                 def gather(i, leaf, dim):
                     if dim < 0:
                         return leaf
-                    if qw:
+                    if qw or (qnw and not trainable_leaves[i]):
                         return quant_all_gather(leaf, axis, gather_dim=dim,
                                                 hpz_size=hpz, dtype=leaf.dtype,
                                                 seed=seed_base + 2 * i)
@@ -677,14 +688,18 @@ class DeepSpeedEngine:
                     full, scale, rng, args, kwargs)
 
                 def reduce(i, g, dim):
+                    # fp32 for the exact collectives: bf16 psum/psum_scatter
+                    # aborts XLA's CPU backend inside manual shard_map
                     seed = seed_base + 2 * i + 1
+                    g32 = g.astype(jnp.float32)
                     if dim >= 0:
                         if qg:
                             return quant_reduce_scatter(g, axis, scatter_dim=dim, seed=seed) / n
-                        return jax.lax.psum_scatter(g, axis, scatter_dimension=dim, tiled=True) / n
+                        return (jax.lax.psum_scatter(g32, axis, scatter_dimension=dim,
+                                                     tiled=True) / n).astype(g.dtype)
                     if qg:
                         return quant_all_reduce(g, axis, seed=seed) / n
-                    return jax.lax.psum(g, axis) / n
+                    return (jax.lax.psum(g32, axis) / n).astype(g.dtype)
 
                 grads = _tree_map_indexed(reduce, grads, grad_dims)
                 loss = jax.lax.pmean(loss, axis)

@@ -180,6 +180,26 @@ class TestEngineZeroPP:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0], losses
 
+    def test_qwz_bf16_without_qgz(self):
+        """Regression: qwZ alone under bf16 aborted XLA's CPU backend —
+        bf16 psum/psum_scatter of grad cotangents inside the manual
+        region ('Invalid binary instruction opcode copy'); the exact
+        collectives now run their wire in fp32."""
+        from deepspeed_tpu.models import build_llama
+        groups.destroy_mesh()
+        config = {
+            "train_batch_size": 8, "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "zero_quantized_weights": True,
+                                  "stage3_param_persistence_threshold": 0},
+            "mesh": {"data_parallel_size": 8},
+        }
+        e, _, _, _ = deepspeed_tpu.initialize(model=build_llama("debug"), config=config)
+        ids = (np.arange(8 * 32, dtype=np.int32).reshape(8, 32) % 256)
+        losses = [float(e.train_batch(batch=(ids, ids))) for _ in range(4)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
     def test_qgz_fused_train_batch(self):
         qg = make_engine(2, {"zero_quantized_gradients": True})
         x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]

@@ -126,3 +126,42 @@ class TestEngineCurriculum:
         assert seen[0] == 8
         assert seen[-1] == 32
         assert seen == sorted(seen)
+
+
+class TestDataAnalyzer:
+
+    def test_map_reduce_and_sampler_integration(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import DataAnalyzer
+        data = [list(range(n)) for n in [5, 2, 9, 1, 7, 3, 8, 6]]  # "difficulty" = length
+        # two workers analyze disjoint strides
+        for w in range(2):
+            DataAnalyzer(data, metric_names=["seqlen"],
+                         metric_functions=[len],
+                         save_path=str(tmp_path), num_workers=2, worker_id=w).run_map()
+        summary = DataAnalyzer(data, metric_names=["seqlen"], metric_functions=[len],
+                               save_path=str(tmp_path), num_workers=2).run_reduce()
+        assert summary["seqlen"]["min"] == 1 and summary["seqlen"]["max"] == 9
+
+        metrics = DataAnalyzer.load_index_to_metric(str(tmp_path), "seqlen")
+        assert list(metrics) == [5, 2, 9, 1, 7, 3, 8, 6]
+        order = np.load(tmp_path / "seqlen_metric_to_sample.npy")
+        assert list(metrics[order]) == sorted(metrics)
+
+        # feeds the curriculum sampler directly
+        sampler = DeepSpeedDataSampler(
+            len(data), batch_size=2, difficulties=metrics,
+            curriculum_config={"curriculum_type": "fixed_linear", "min_difficulty": 2,
+                               "max_difficulty": 9,
+                               "schedule_config": {"total_curriculum_step": 4,
+                                                   "difficulty_step": 1}})
+        first = sampler.next_batch()
+        assert all(metrics[i] <= 2 for i in first)
+
+    def test_reduce_detects_missing_worker(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import DataAnalyzer
+        data = [[0]] * 6
+        DataAnalyzer(data, metric_names=["m"], metric_functions=[len],
+                     save_path=str(tmp_path), num_workers=2, worker_id=0).run_map()
+        with pytest.raises((RuntimeError, FileNotFoundError)):
+            DataAnalyzer(data, metric_names=["m"], metric_functions=[len],
+                         save_path=str(tmp_path), num_workers=2).run_reduce()
