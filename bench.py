@@ -7,7 +7,7 @@ North-star (BASELINE.json): ZeRO-3 Llama >=45% MFU on v5e;
 ``vs_baseline`` reports measured MFU / 0.45.
 
 Measured config: ZeRO-3, bf16 + fp32 master, dots-saveable remat,
-gas=16 fused micro-batch scan (amortizes the fixed per-dispatch cost),
+gas=32 fused micro-batch scan (amortizes the fixed per-dispatch cost),
 B=4 x S=2048 per micro-batch on a ~551M Llama (the largest that holds
 fp32 optimizer states + saved activations in one v5e chip's HBM).
 MFU accounting includes the attention quadratic term:
@@ -57,7 +57,7 @@ def main():
                             num_hidden_layers=layers, num_attention_heads=16,
                             num_key_value_heads=16, max_position_embeddings=2048,
                             remat_policy="dots")
-        B, S, gas, steps, warmup = 4, 2048, 16, 3, 1
+        B, S, gas, steps, warmup = 4, 2048, 32, 3, 1
     else:
         model = build_llama("debug")
         layers, hidden = model.config.num_hidden_layers, model.config.hidden_size

@@ -48,25 +48,45 @@ class DataAnalyzer:
 
     def run_reduce(self):
         """Merge every worker's partials into the final index→metric map
-        + a sorted index→sample map (reference run_reduce)."""
+        + a sorted index→sample map (reference run_reduce). Coverage is
+        tracked with an explicit mask (a metric may legitimately be NaN)
+        and each partial is validated against this analysis's stride so
+        stale files from a previous run can't silently merge; partials
+        are deleted after a successful reduce."""
         n = len(self.dataset)
         summary = {}
+        consumed = []
         for name in self.metric_names:
-            merged = np.full(n, np.nan)
+            merged = np.zeros(n, np.float64)
+            covered = np.zeros(n, bool)
             for w in range(self.num_workers):
-                part = np.load(self._metric_path(name, w))
-                merged[part[0].astype(np.int64)] = part[1]
-            if np.isnan(merged).any():
-                missing = int(np.isnan(merged).sum())
-                raise RuntimeError(f"metric {name}: {missing} samples unanalyzed — "
-                                   f"did every worker run run_map()?")
+                path = self._metric_path(name, w)
+                part = np.load(path)
+                idx = part[0].astype(np.int64)
+                expect = np.arange(w, n, self.num_workers)
+                if idx.shape != expect.shape or not np.array_equal(idx, expect):
+                    raise RuntimeError(
+                        f"metric {name}: worker {w} partial covers {idx.shape[0]} samples, "
+                        f"expected the stride of {expect.shape[0]} — stale file from a "
+                        f"previous run with different num_workers/dataset? ({path})")
+                merged[idx] = part[1]
+                covered[idx] = True
+                consumed.append(path)
+            if not covered.all():
+                raise RuntimeError(f"metric {name}: {int((~covered).sum())} samples "
+                                   f"unanalyzed — did every worker run run_map()?")
             np.save(self._metric_path(name), merged)
             order = np.argsort(merged, kind="stable")
             np.save(os.path.join(self.save_path, f"{name}_metric_to_sample.npy"), order)
-            summary[name] = {"min": float(merged.min()), "max": float(merged.max()),
-                             "mean": float(merged.mean())}
+            summary[name] = {"min": float(np.nanmin(merged)), "max": float(np.nanmax(merged)),
+                             "mean": float(np.nanmean(merged))}
         with open(os.path.join(self.save_path, "analysis_summary.json"), "w") as f:
             json.dump(summary, f, indent=1)
+        for path in consumed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         return summary
 
     @staticmethod

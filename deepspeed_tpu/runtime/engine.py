@@ -508,8 +508,12 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _quantized_comm_enabled(self):
         zc = self._config.zero_config
-        if not (zc.zero_quantized_gradients or zc.zero_quantized_weights
-                or zc.zero_quantized_nontrainable_weights):
+        # the nontrainable-only flag quantizes frozen-leaf gathers, so it
+        # has an effect (and is worth the manual-DP region) only when a
+        # frozen_parameters mask exists
+        qnw_active = (zc.zero_quantized_nontrainable_weights
+                      and self._config._param_dict.get("frozen_parameters"))
+        if not (zc.zero_quantized_gradients or zc.zero_quantized_weights or qnw_active):
             return False
         return dict(self.mesh.shape).get("data", 1) > 1
 
