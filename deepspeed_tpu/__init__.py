@@ -18,6 +18,7 @@ from deepspeed_tpu import ops  # noqa: F401
 from deepspeed_tpu import module_inject  # noqa: F401
 from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: F401
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
 from deepspeed_tpu.runtime import lr_schedules  # noqa: F401
 from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
