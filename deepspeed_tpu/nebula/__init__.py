@@ -1,0 +1,3 @@
+from deepspeed_tpu.nebula.config import DeepSpeedNebulaConfig, get_nebula_config
+
+__all__ = ["DeepSpeedNebulaConfig", "get_nebula_config"]

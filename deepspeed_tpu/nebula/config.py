@@ -1,0 +1,26 @@
+"""Nebula (Azure async checkpoint service) config parity
+(reference deepspeed/nebula/config.py). The service itself is
+Azure-proprietary; the sharded checkpoint engine is the TPU-native
+async-ish path — this config parses and reports unsupported."""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedNebulaConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: int = 100
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+    load_path: Optional[str] = None
+
+
+def get_nebula_config(param_dict):
+    cfg = DeepSpeedNebulaConfig(**param_dict.get("nebula", {}))
+    if cfg.enabled:
+        raise NotImplementedError(
+            "nebula: the Azure Nebula checkpoint service is not available on TPU — "
+            "use the sharded checkpoint engine (default) or 'checkpoint': {'sharded': true}")
+    return cfg

@@ -1520,6 +1520,26 @@ class DeepSpeedEngine:
                     self.opt_state[k] = jax.device_put(
                         np.asarray(scalars[k]).astype(cur.dtype), cur.sharding)
 
+    def compile(self, backend=None, compile_kwargs=None):
+        """torch.compile parity (reference engine.py:3612 ``compile``):
+        on this engine every hot path is ALREADY a jitted XLA program —
+        forward/backward, the fused train_batch scan, and the optimizer
+        update compile on first use — so this records the request and
+        returns the engine. ``backend`` other than 'xla' raises."""
+        if backend not in (None, "xla"):
+            raise ValueError(f"compile backend {backend!r} unsupported (XLA is built in)")
+        if compile_kwargs:
+            logger.warning(f"engine.compile: ignoring torch.compile kwargs {list(compile_kwargs)} "
+                           f"— XLA jit has no equivalents")
+        self._is_compiled = True
+        return self
+
+    @property
+    def is_compiled(self):
+        # jit compilation is unconditional; the flag only records that
+        # compile() was requested (reference semantics)
+        return getattr(self, "_is_compiled", False)
+
     # module state dict parity
     def module_state_dict(self, exclude_frozen_parameters=False):
         if exclude_frozen_parameters and getattr(self, "_trainable_mask", None) is not None:

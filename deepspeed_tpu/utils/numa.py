@@ -1,0 +1,53 @@
+"""NUMA-aware CPU binding for the host-offload workers.
+
+Capability match for the reference's ``deepspeed/utils/numa.py``
+(parses numactl topology, binds ranks to cores for CPU-Adam offload).
+TPU-VM hosts are plain Linux: the same goal is met with
+``os.sched_setaffinity`` over a per-rank core slice."""
+
+import os
+
+
+def get_numa_cores():
+    """→ list of per-node core lists (best effort; single pseudo-node
+    when sysfs topology is unavailable)."""
+    nodes = []
+    base = "/sys/devices/system/node"
+    try:
+        for entry in sorted(os.listdir(base)):
+            if entry.startswith("node") and entry[4:].isdigit():
+                with open(os.path.join(base, entry, "cpulist")) as f:
+                    nodes.append(_parse_cpulist(f.read().strip()))
+    except OSError:
+        pass
+    if not nodes:
+        nodes = [list(range(os.cpu_count() or 1))]
+    return nodes
+
+
+def _parse_cpulist(spec):
+    cores = []
+    for part in spec.split(","):
+        if "-" in part:
+            lo, hi = part.split("-")
+            cores.extend(range(int(lo), int(hi) + 1))
+        elif part:
+            cores.append(int(part))
+    return cores
+
+
+def check_for_numactl():  # reference surface
+    return os.path.isdir("/sys/devices/system/node/node0")
+
+
+def bind_rank_to_cores(rank, num_ranks):
+    """Pin this process to its 1/num_ranks slice of the host cores
+    (reference get_numactl_cmd's effect, without spawning numactl)."""
+    cores = [c for node in get_numa_cores() for c in node]
+    per = max(1, len(cores) // max(num_ranks, 1))
+    mine = cores[rank * per:(rank + 1) * per] or cores
+    try:
+        os.sched_setaffinity(0, mine)
+    except (AttributeError, OSError):
+        return None
+    return mine

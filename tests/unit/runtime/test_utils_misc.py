@@ -182,3 +182,34 @@ class TestStructuralAutoTP:
              "mlp": {"up": {"kernel": jnp.zeros((HIDDEN, 2 * HIDDEN))}}}
         tp = AutoTP.tp_parser(params=p)
         assert tuple(tp("attn/o_proj/kernel", (HIDDEN, HIDDEN))) == ("tensor", None)
+
+
+class TestParityOdds:
+
+    def test_nebula_config_rejects_enabled(self):
+        from deepspeed_tpu.nebula import get_nebula_config
+        assert get_nebula_config({}).enabled is False
+        with pytest.raises(NotImplementedError):
+            get_nebula_config({"nebula": {"enabled": True}})
+
+    def test_numa_binding(self):
+        from deepspeed_tpu.utils.numa import bind_rank_to_cores, get_numa_cores
+        nodes = get_numa_cores()
+        assert nodes and all(isinstance(c, int) for c in nodes[0])
+        import os
+        before = os.sched_getaffinity(0)
+        mine = bind_rank_to_cores(0, 1)
+        assert mine  # full-core slice for a single rank
+        os.sched_setaffinity(0, before)  # restore
+
+    def test_engine_compile_surface(self):
+        groups.destroy_mesh()
+        cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "mesh": {"data_parallel_size": 8}}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HIDDEN, nlayers=1), config=cfg)
+        assert not engine.is_compiled
+        assert engine.compile() is engine
+        assert engine.is_compiled
+        with pytest.raises(ValueError):
+            engine.compile(backend="tvm")
