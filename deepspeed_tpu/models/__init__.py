@@ -1,2 +1,4 @@
 from deepspeed_tpu.models.llama import (LLAMA_CONFIGS, LlamaConfig, LlamaForCausalLM, build_llama,
                                         causal_lm_loss, llama_tp_rule)  # noqa: F401
+from deepspeed_tpu.models.gpt import (GPT_CONFIGS, GPTConfig, GPTForCausalLM, build_gpt,
+                                      gpt_tp_rule, init_gpt_cache)  # noqa: F401

@@ -1,0 +1,385 @@
+"""GPT-lineage causal decoders, TPU-first.
+
+One configurable flax decoder covering the architecture axes that
+separate the reference's injection-container model zoo
+(``deepspeed/module_inject/containers/{gpt2,gptj,gptneo,gptneox,opt,
+bloom,...}.py`` and ``deepspeed/inference/v2/model_implementations/
+{falcon,opt,phi,...}``):
+
+- position encoding: learned (GPT-2/OPT), rotary incl. partial rotary
+  (GPT-J/GPT-NeoX/Phi), or ALiBi (Bloom);
+- block wiring: sequential post-attention MLP (GPT-2/OPT/Bloom) or
+  parallel attention+MLP off a single norm (GPT-J/Falcon/Phi);
+- head layout: MHA, GQA, or MQA (Falcon);
+- norms, activations, and projection biases per family.
+
+Like the flagship Llama (``models/llama.py``) it is built for XLA:
+``nn.scan`` over one compiled block body (layer-stacked params — the
+layout ZeRO-3 and the pipeline engine want), ``nn.remat`` inside the
+scan, Ulysses seq↔head re-layouts around attention, and a Megatron
+``tp_rule`` consumed by the ZeRO sharding policy.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.llama import (RMSNorm, apply_rope, causal_lm_loss, einsum_attention,
+                                        rope_frequencies, _local_attention)
+from deepspeed_tpu.sequence.layer import constrain, constrain_hidden, head_to_seq_shard, seq_to_head_shard
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: int = 12        # == heads: MHA; 1: MQA (Falcon); else GQA
+    max_position_embeddings: int = 2048
+    position_embedding: str = "learned"  # "learned" | "rope" | "alibi"
+    learned_pos_offset: int = 0          # OPT reserves the first 2 slots
+    rotary_pct: float = 1.0              # partial rotary (GPT-J/NeoX/Phi)
+    rope_theta: float = 10000.0
+    parallel_block: bool = False         # GPT-J/Falcon/Phi: attn ∥ mlp off one norm
+    parallel_two_norms: bool = False     # GPT-NeoX/Falcon-40B: separate ln_attn/ln_mlp
+    norm_type: str = "layernorm"         # "layernorm" | "rmsnorm"
+    layer_norm_eps: float = 1e-5
+    embedding_layernorm: bool = False    # Bloom: LN right after the embedding
+    activation: str = "gelu"             # "gelu" | "gelu_new" | "relu"
+    attention_bias: bool = True
+    mlp_bias: bool = True
+    tie_word_embeddings: bool = True
+    attention_impl: str = "auto"
+    remat: bool = True
+    remat_policy: str = "full"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_dim(self):
+        d = int(self.head_dim * self.rotary_pct)
+        return d - d % 2
+
+
+GPT_CONFIGS = {
+    "gpt2-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
+                            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                            max_position_embeddings=128),
+    "gpt2": GPTConfig(max_position_embeddings=1024),
+    "gpt2-xl": GPTConfig(hidden_size=1600, intermediate_size=6400, num_hidden_layers=48,
+                         num_attention_heads=25, num_key_value_heads=25, max_position_embeddings=1024),
+    "opt-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
+                           num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                           max_position_embeddings=128, activation="relu", learned_pos_offset=2),
+    "opt-13b": GPTConfig(vocab_size=50272, hidden_size=5120, intermediate_size=20480,
+                         num_hidden_layers=40, num_attention_heads=40, num_key_value_heads=40,
+                         activation="relu", learned_pos_offset=2),
+    "bloom-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
+                             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                             position_embedding="alibi", embedding_layernorm=True),
+    "bloom-7b": GPTConfig(vocab_size=250880, hidden_size=4096, intermediate_size=16384,
+                          num_hidden_layers=30, num_attention_heads=32, num_key_value_heads=32,
+                          position_embedding="alibi", embedding_layernorm=True),
+    "neox-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
+                            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                            position_embedding="rope", rotary_pct=0.25, parallel_block=True,
+                            parallel_two_norms=True, tie_word_embeddings=False),
+    "gptj-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
+                            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                            position_embedding="rope", rotary_pct=0.5, parallel_block=True,
+                            activation="gelu_new", tie_word_embeddings=False),
+    "gptj-6b": GPTConfig(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+                         num_hidden_layers=28, num_attention_heads=16, num_key_value_heads=16,
+                         position_embedding="rope", rotary_pct=0.25, parallel_block=True,
+                         activation="gelu_new", tie_word_embeddings=False),
+    "gpt-neox-20b": GPTConfig(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+                              num_hidden_layers=44, num_attention_heads=64, num_key_value_heads=64,
+                              position_embedding="rope", rotary_pct=0.25, parallel_block=True,
+                              parallel_two_norms=True, tie_word_embeddings=False),
+    "falcon-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
+                              num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+                              position_embedding="rope", parallel_block=True,
+                              attention_bias=False, mlp_bias=False),
+    "falcon-7b": GPTConfig(vocab_size=65024, hidden_size=4544, intermediate_size=18176,
+                           num_hidden_layers=32, num_attention_heads=71, num_key_value_heads=1,
+                           position_embedding="rope", parallel_block=True,
+                           attention_bias=False, mlp_bias=False),
+    "falcon-40b": GPTConfig(vocab_size=65024, hidden_size=8192, intermediate_size=32768,
+                            num_hidden_layers=60, num_attention_heads=128, num_key_value_heads=8,
+                            position_embedding="rope", parallel_block=True, parallel_two_norms=True,
+                            attention_bias=False, mlp_bias=False),
+    "phi-2": GPTConfig(vocab_size=51200, hidden_size=2560, intermediate_size=10240,
+                       num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+                       position_embedding="rope", rotary_pct=0.4, parallel_block=True,
+                       activation="gelu_new", tie_word_embeddings=False),
+}
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Bloom's ALiBi head slopes (geometric sequence; handles non-pow2)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** (i + 1) for i in range(closest)]
+    if closest < num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base ** (i + 1) for i in range(0, 2 * (num_heads - closest), 2)]
+    return np.asarray(slopes, np.float32)
+
+
+def alibi_bias(num_heads: int, q_positions, k_positions) -> jnp.ndarray:
+    """Additive attention bias [1, H, Sq, Sk]: slope_h * (k_pos - q_pos),
+    as in Bloom — the relative-distance linear penalty."""
+    slopes = jnp.asarray(alibi_slopes(num_heads))
+    rel = (k_positions[None, :] - q_positions[:, None]).astype(jnp.float32)  # [Sq, Sk]
+    return slopes[None, :, None, None] * rel[None, None, :, :]
+
+
+def _activation(name: str):
+    return {"gelu": lambda x: nn.gelu(x, approximate=False),
+            "gelu_new": lambda x: nn.gelu(x, approximate=True),
+            "relu": nn.relu}[name]
+
+
+class Norm(nn.Module):
+    """LayerNorm or RMSNorm per config (fused Pallas path via RMSNorm /
+    nn.LayerNorm + XLA fusion)."""
+    config: GPTConfig
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        if cfg.norm_type == "rmsnorm":
+            return RMSNorm(eps=cfg.layer_norm_eps, name="norm")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="norm")(x)
+
+
+class GPTAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, h, positions, layer_cache=None):
+        cfg = self.config
+        B, S, D = h.shape
+        H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+        q = nn.Dense(H * Dh, use_bias=cfg.attention_bias, name="q_proj")(h).reshape(B, S, H, Dh)
+        k = nn.Dense(Hkv * Dh, use_bias=cfg.attention_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
+        v = nn.Dense(Hkv * Dh, use_bias=cfg.attention_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
+
+        if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
+            rd = cfg.rotary_dim
+            cos, sin = rope_frequencies(rd, cfg.max_position_embeddings, cfg.rope_theta)
+            if rd == Dh:
+                q = apply_rope(q, cos, sin, positions)
+                k = apply_rope(k, cos, sin, positions)
+            else:  # partial rotary (GPT-J/NeoX/Phi): rotate the first rd dims
+                q = jnp.concatenate([apply_rope(q[..., :rd], cos, sin, positions), q[..., rd:]], -1)
+                k = jnp.concatenate([apply_rope(k[..., :rd], cos, sin, positions), k[..., rd:]], -1)
+
+        if layer_cache is not None:
+            start = positions[0, 0]
+            k_full = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, start, 0, 0))
+            v_full = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, start, 0, 0))
+            new_cache = {"k": k_full, "v": v_full}
+            kx, vx = k_full, v_full
+            if Hkv != H:
+                kx = jnp.repeat(kx, H // Hkv, axis=2)
+                vx = jnp.repeat(vx, H // Hkv, axis=2)
+            s_max = kx.shape[1]
+            k_idx = jnp.arange(s_max)[None, :]
+            q_pos = (start + jnp.arange(S))[:, None]
+            mask = (k_idx <= q_pos)[None, None, :, :]
+            bias = None
+            if cfg.position_embedding == "alibi":
+                bias = alibi_bias(H, start + jnp.arange(S), jnp.arange(s_max))
+            out = einsum_attention(q, kx, vx, bias=bias, mask=mask)
+            out = out.reshape(B, S, H * Dh)
+            return nn.Dense(D, use_bias=cfg.attention_bias, name="o_proj")(out), new_cache
+
+        if Hkv != H:
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
+
+        if cfg.position_embedding == "alibi":
+            # Bias tensors are O(S^2): the flash path gains nothing, so
+            # attention runs on the XLA reference with the full bias
+            # (sharded by GSPMD like the score matrix itself).
+            q = seq_to_head_shard(q)
+            k = seq_to_head_shard(k)
+            v = seq_to_head_shard(v)
+            pos = positions[0]
+            out = einsum_attention(q, k, v, causal=True, bias=alibi_bias(H, pos, pos))
+            out = head_to_seq_shard(out)
+        else:
+            q = seq_to_head_shard(q)
+            k = seq_to_head_shard(k)
+            v = seq_to_head_shard(v)
+            out = _local_attention(q, k, v, cfg.attention_impl, causal=True)
+            out = head_to_seq_shard(out)
+
+        out = out.reshape(B, S, H * Dh)
+        return nn.Dense(D, use_bias=cfg.attention_bias, name="o_proj")(out), None
+
+
+class GPTMLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        inter = nn.Dense(cfg.intermediate_size, use_bias=cfg.mlp_bias, name="fc_in")(h)
+        inter = _activation(cfg.activation)(inter)
+        inter = constrain(inter, (("data", "expert"), "sequence", "tensor"))
+        return nn.Dense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="fc_out")(inter)
+
+
+class GPTBlock(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, carry, positions, layer_cache=None):
+        h, aux = carry
+        cfg = self.config
+        decode = layer_cache is not None
+        if cfg.parallel_block:
+            # GPT-J/Falcon-7B/Phi wiring: one input norm feeds both
+            # branches; GPT-NeoX/Falcon-40B norm each branch separately
+            # (ln_attn/ln_mlp). Residual adds attn_out + mlp_out.
+            x_attn = Norm(cfg, name="input_layernorm")(h)
+            x_mlp = (Norm(cfg, name="mlp_layernorm")(h)
+                     if cfg.parallel_two_norms else x_attn)
+            attn_out, new_cache = GPTAttention(cfg, name="attn")(x_attn, positions, layer_cache)
+            mlp_out = GPTMLP(cfg, name="mlp")(x_mlp)
+            h = h + attn_out + mlp_out
+            if not decode:
+                h = constrain_hidden(h)
+        else:
+            x = Norm(cfg, name="input_layernorm")(h)
+            attn_out, new_cache = GPTAttention(cfg, name="attn")(x, positions, layer_cache)
+            h = h + attn_out
+            if not decode:
+                h = constrain_hidden(h)
+            x = Norm(cfg, name="post_attention_layernorm")(h)
+            h = h + GPTMLP(cfg, name="mlp")(x)
+            if not decode:
+                h = constrain_hidden(h)
+        return (h, aux), new_cache
+
+
+class GPTModel(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, cache=None, start_pos=0):
+        cfg = self.config
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size))
+        h = jnp.take(embed, input_ids, axis=0)
+        decode = cache is not None
+        positions = (start_pos + jnp.arange(input_ids.shape[1]))[None, :]
+        if cfg.position_embedding == "learned":
+            pos_table = self.param("embed_positions", nn.initializers.normal(0.02),
+                                   (cfg.max_position_embeddings + cfg.learned_pos_offset,
+                                    cfg.hidden_size))
+            h = h + jnp.take(pos_table, positions[0] + cfg.learned_pos_offset, axis=0)[None]
+        if cfg.embedding_layernorm:
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="embed_layernorm")(h)
+        if not decode:
+            h = constrain_hidden(h)
+
+        block = GPTBlock
+        if cfg.remat and not decode:
+            policy = (jax.checkpoint_policies.dots_saveable if cfg.remat_policy == "dots"
+                      else jax.checkpoint_policies.nothing_saveable)
+            block = nn.remat(block, prevent_cse=False, policy=policy)
+        carry0 = (h, jnp.zeros((), jnp.float32))
+        if decode:
+            ScanBlocks = nn.scan(block,
+                                 variable_axes={"params": 0},
+                                 split_rngs={"params": True, "dropout": True},
+                                 in_axes=(nn.broadcast, 0),
+                                 out_axes=0,
+                                 length=cfg.num_hidden_layers,
+                                 metadata_params={nn.PARTITION_NAME: "layers"})
+            (h, _), new_cache = ScanBlocks(cfg, name="layers")(carry0, positions, cache)
+        else:
+            ScanBlocks = nn.scan(block,
+                                 variable_axes={"params": 0},
+                                 split_rngs={"params": True, "dropout": True},
+                                 in_axes=nn.broadcast,
+                                 length=cfg.num_hidden_layers,
+                                 metadata_params={nn.PARTITION_NAME: "layers"})
+            (h, _), new_cache = ScanBlocks(cfg, name="layers")(carry0, positions)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layernorm")(h) \
+            if cfg.norm_type == "layernorm" else RMSNorm(eps=cfg.layer_norm_eps, name="final_norm")(h)
+        return h, embed, new_cache
+
+
+class GPTForCausalLM(nn.Module):
+    """Causal LM head over :class:`GPTModel`; same calling convention as
+    the flagship ``LlamaForCausalLM`` so every engine path (training,
+    pipeline, inference v1/v2) accepts it interchangeably."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, cache=None, start_pos=0):
+        cfg = self.config
+        decode = cache is not None
+        h, embed, new_cache = GPTModel(cfg, name="model")(input_ids, cache=cache, start_pos=start_pos)
+        if cfg.tie_word_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
+        if decode:
+            return logits, new_cache
+        logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, labels), logits
+
+    def tp_rule(self, path: str, shape) -> P:
+        return gpt_tp_rule(path, shape)
+
+
+def gpt_tp_rule(path: str, shape) -> P:
+    """Megatron sharding for the GPT family: QKV/fc_in column-parallel,
+    o_proj/fc_out row-parallel, vocab-sharded embedding."""
+    lead = [None] * (len(shape) - 2)
+    if any(k in path for k in ("q_proj/kernel", "k_proj/kernel", "v_proj/kernel", "fc_in/kernel")):
+        return P(*lead, None, "tensor")
+    if any(k in path for k in ("q_proj/bias", "k_proj/bias", "v_proj/bias", "fc_in/bias")):
+        return P(*[None] * (len(shape) - 1), "tensor")
+    if any(k in path for k in ("o_proj/kernel", "fc_out/kernel")):
+        return P(*lead, "tensor", None)
+    if "embed_tokens" in path:
+        return P("tensor", None)
+    if "lm_head/kernel" in path:
+        return P(None, "tensor")
+    return P()
+
+
+def init_gpt_cache(config: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    shape = (config.num_hidden_layers, batch_size, max_len,
+             config.num_key_value_heads, config.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def build_gpt(preset_or_config="gpt2-debug", **overrides) -> GPTForCausalLM:
+    if isinstance(preset_or_config, GPTConfig):
+        cfg = preset_or_config
+    else:
+        cfg = GPT_CONFIGS[preset_or_config]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return GPTForCausalLM(cfg)

@@ -1,0 +1,132 @@
+"""GPT-lineage model family tests: the architecture axes that separate
+the reference's injection containers (gpt2/gptj/gptneox/opt/bloom,
+``deepspeed/module_inject/containers/``) and v2 zoo (falcon/opt/phi,
+``deepspeed/inference/v2/model_implementations/``): learned/rotary/ALiBi
+positions, sequential vs parallel blocks, MHA/MQA, and TP training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_gpt
+from deepspeed_tpu.models.gpt import alibi_slopes, init_gpt_cache
+
+DEBUG_PRESETS = ["gpt2-debug", "opt-debug", "bloom-debug", "gptj-debug", "falcon-debug",
+                 "neox-debug"]
+
+
+def _batch(cfg, B=2, S=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+
+
+class TestGPTForward:
+
+    @pytest.mark.parametrize("preset", DEBUG_PRESETS)
+    def test_loss_and_grad_finite(self, preset):
+        model = build_gpt(preset)
+        ids = _batch(model.config)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        loss, logits = model.apply({"params": params}, ids, ids)
+        assert logits.shape == (2, 16, model.config.vocab_size)
+        assert np.isfinite(float(loss))
+        g = jax.grad(lambda p: model.apply({"params": p}, ids, ids)[0])(params)
+        flat = jax.tree_util.tree_leaves(g)
+        assert all(np.all(np.isfinite(np.asarray(x))) for x in flat)
+
+    def test_scanned_params_have_layer_dim(self):
+        model = build_gpt("gpt2-debug")
+        ids = _batch(model.config)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        k = params["model"]["layers"]["attn"]["q_proj"]["kernel"]
+        assert k.shape[0] == model.config.num_hidden_layers
+
+    def test_mqa_falcon_kv_heads(self):
+        model = build_gpt("falcon-debug")
+        ids = _batch(model.config)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        k = params["model"]["layers"]["attn"]["k_proj"]["kernel"]
+        assert k.shape[-1] == model.config.head_dim  # 1 kv head
+
+    def test_two_norm_parallel_block_has_both_norms(self):
+        model = build_gpt("neox-debug")
+        ids = _batch(model.config)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        layers = params["model"]["layers"]
+        assert "input_layernorm" in layers and "mlp_layernorm" in layers
+
+    def test_alibi_slopes_pow2_and_non_pow2(self):
+        s8 = alibi_slopes(8)
+        # standard Bloom slopes for 8 heads: 2^-1 ... 2^-8... actually
+        # geometric with ratio 2^(-1): [0.5, 0.25, ...]
+        np.testing.assert_allclose(s8, [2 ** (-(i + 1)) for i in range(8)], rtol=1e-6)
+        s6 = alibi_slopes(6)
+        assert s6.shape == (6,) and np.all(s6 > 0) and np.all(np.diff(s6[:4]) < 0)
+
+    def test_learned_positions_shift_matters(self):
+        """Same tokens at different start positions give different logits
+        (learned positions are live)."""
+        model = build_gpt("gpt2-debug", remat=False)
+        ids = _batch(model.config, S=8)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        cache = init_gpt_cache(model.config, 2, 32, dtype=jnp.float32)
+        l0, _ = model.apply({"params": params}, ids, cache=cache, start_pos=0)
+        l4, _ = model.apply({"params": params}, ids, cache=cache, start_pos=4)
+        assert float(jnp.abs(l0 - l4).max()) > 1e-3
+
+
+class TestGPTDecode:
+
+    @pytest.mark.parametrize("preset", DEBUG_PRESETS)
+    def test_prefill_decode_equals_full_forward(self, preset):
+        model = build_gpt(preset, remat=False)
+        ids = _batch(model.config, S=16)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        cache = init_gpt_cache(model.config, 2, 32, dtype=jnp.float32)
+        lp, cache = model.apply({"params": params}, ids[:, :8], cache=cache, start_pos=0)
+        full8 = model.apply({"params": params}, ids[:, :8])
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(full8), atol=1e-4, rtol=1e-4)
+        ld, cache = model.apply({"params": params}, ids[:, 8:9], cache=cache, start_pos=8)
+        full9 = model.apply({"params": params}, ids[:, :9])
+        np.testing.assert_allclose(np.asarray(ld[:, 0]), np.asarray(full9[:, 8]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+class TestGPTSharded:
+
+    def test_tp_engine_train(self):
+        model = build_gpt("gpt2-debug")
+        config = {
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"tensor_parallel_size": 2, "sequence_parallel_size": 2},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        ids = _batch(model.config, B=4, S=16)
+        losses = [float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+                  for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+        # column-parallel q_proj genuinely sharded over 'tensor'
+        k = engine.params["model"]["layers"]["attn"]["q_proj"]["kernel"]
+        assert not k.sharding.is_fully_replicated
+
+    def test_zero3_alibi_train(self):
+        """Bloom-style ALiBi model under ZeRO-3 (bias path + param sharding)."""
+        model = build_gpt("bloom-debug")
+        config = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        ids = _batch(model.config, B=8, S=16)
+        loss = engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+        assert np.isfinite(float(loss))
