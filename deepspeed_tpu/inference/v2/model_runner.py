@@ -1,10 +1,11 @@
 """Ragged model execution: flat token batches against a paged KV cache.
 
 Capability match for the reference's v2 model implementations
-(``deepspeed/inference/v2/model_implementations/llama_v2/model.py`` over
-the ragged kernels in ``deepspeed/inference/v2/kernels/ragged_ops/``:
-linear_blocked_kv_rotary, atom-based blocked attention). TPU redesign:
-one jitted function consumes the padded flat batch —
+(``deepspeed/inference/v2/model_implementations/`` — llama_v2, mistral,
+mixtral, qwen, falcon, opt, phi — over the ragged kernels in
+``deepspeed/inference/v2/kernels/ragged_ops/``: linear_blocked_kv_rotary,
+atom-based blocked attention). TPU redesign: one jitted function
+consumes the padded flat batch —
 
 - tokens are a flat ``[T]`` buffer with per-token (slot, position);
 - each layer scatters new K/V into the block pool at
@@ -12,8 +13,10 @@ one jitted function consumes the padded flat batch —
   gathering the sequence's block table (masked to ``pos``), which
   handles mixed prefill chunks + decodes in ONE program — the
   Dynamic SplitFuse execution model;
-- the layer stack is ``lax.scan`` over the flagship Llama's stacked
-  scan params, so any ``LlamaForCausalLM`` checkpoint serves directly.
+- the layer stack is ``lax.scan`` over the model's stacked scan params,
+  so any ``LlamaForCausalLM`` (Llama/Mistral/Mixtral/Qwen2) or
+  ``GPTForCausalLM`` (GPT-2/J/NeoX, OPT, Bloom, Falcon, Phi) checkpoint
+  serves directly.
 """
 
 import functools
@@ -32,6 +35,23 @@ def _rms(x, scale, eps):
     return (y * scale.astype(jnp.float32)).astype(x.dtype)
 
 
+def _layernorm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _proj(x, p):
+    """Dense apply from raw params (kernel + optional bias, e.g. Qwen2's
+    QKV biases or the GPT family's biased projections)."""
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
 def _rope_flat(x, cos, sin, positions):
     """x: [T, H, D]; cos/sin tables [maxlen, D/2]; positions [T]."""
     c = cos[positions][:, None, :]
@@ -41,61 +61,169 @@ def _rope_flat(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
-def _layer_step(cfg, cos, sin, batch, h, xs):
-    lp, kc, vc = xs
-    T, D = h.shape
-    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None):
+    """Scatter new K/V into the paged pool and attend over each token's
+    block-tabled context. Pallas decode kernel on TPU, gather-based XLA
+    path elsewhere (and always for ALiBi)."""
     bs = kc.shape[1]
-    attn = lp["self_attn"]
-
-    hn = _rms(h, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
-    q = (hn @ attn["q_proj"]["kernel"].astype(h.dtype)).reshape(T, H, Dh)
-    k = (hn @ attn["k_proj"]["kernel"].astype(h.dtype)).reshape(T, Hkv, Dh)
-    v = (hn @ attn["v_proj"]["kernel"].astype(h.dtype)).reshape(T, Hkv, Dh)
-    q = _rope_flat(q, cos, sin, batch["token_pos"])
-    k = _rope_flat(k, cos, sin, batch["token_pos"])
-
-    # scatter this step's K/V into the paged pool (pad tokens hit the
-    # null block owned by the pad slot)
     blk = batch["block_tables"][batch["token_seq"], batch["token_pos"] // bs]  # [T]
     off = batch["token_pos"] % bs
     kc = kc.at[blk, off].set(k.astype(kc.dtype))
     vc = vc.at[blk, off].set(v.astype(vc.dtype))
 
-    # attend over each token's block-tabled context: Pallas decode
-    # kernel on TPU, gather-based XLA path elsewhere
     from deepspeed_tpu.ops.pallas import use_pallas
     from deepspeed_tpu.ops.pallas.paged_attention import (kernel_supported,
                                                           paged_decode_attention,
                                                           xla_paged_attention)
     tab = batch["block_tables"][batch["token_seq"]]  # [T, MB]
-    attn_fn = paged_decode_attention if (use_pallas() and kernel_supported(Dh, bs)) \
-        else xla_paged_attention
-    out = attn_fn(q, kc, vc, tab, batch["token_pos"])
-    h = h + out.reshape(T, H * Dh) @ attn["o_proj"]["kernel"].astype(h.dtype)
+    if alibi is not None:
+        out = xla_paged_attention(q, kc, vc, tab, batch["token_pos"], alibi_slopes=alibi)
+    elif use_pallas() and kernel_supported(Dh, bs):
+        out = paged_decode_attention(q, kc, vc, tab, batch["token_pos"])
+    else:
+        out = xla_paged_attention(q, kc, vc, tab, batch["token_pos"])
+    return out, kc, vc
+
+
+def _layer_step(cfg, cos, sin, batch, h, xs):
+    lp, kc, vc = xs
+    T, D = h.shape
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    attn = lp["self_attn"]
+
+    hn = _rms(h, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+    q = _proj(hn, attn["q_proj"]).reshape(T, H, Dh)
+    k = _proj(hn, attn["k_proj"]).reshape(T, Hkv, Dh)
+    v = _proj(hn, attn["v_proj"]).reshape(T, Hkv, Dh)
+    q = _rope_flat(q, cos, sin, batch["token_pos"])
+    k = _rope_flat(k, cos, sin, batch["token_pos"])
+
+    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh)
+    h = h + _proj(out.reshape(T, H * Dh), attn["o_proj"])
 
     hn2 = _rms(h, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
-    mlp = lp["mlp"]
-    gate = hn2 @ mlp["gate_proj"]["kernel"].astype(h.dtype)
-    up = hn2 @ mlp["up_proj"]["kernel"].astype(h.dtype)
-    h = h + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(h.dtype)
+    if "moe_mlp" in lp:
+        h = h + _moe_mlp(hn2, lp["moe_mlp"]["deepspeed_moe"], cfg.moe_top_k)
+    else:
+        mlp = lp["mlp"]
+        gate = _proj(hn2, mlp["gate_proj"])
+        up = _proj(hn2, mlp["up_proj"])
+        h = h + _proj(jax.nn.silu(gate) * up, mlp["down_proj"])
     return h, (kc, vc)
 
 
-def ragged_forward(params, kcache, vcache, batch, cfg: LlamaConfig, dtype=jnp.bfloat16):
+def _moe_mlp(x, p, k):
+    """Dropless top-k MoE over the flat [T, D] batch (Mixtral serving —
+    reference inference/v2 cutlass MoE gather/scatter). At serving time
+    capacity dropping is undesirable, so every token gets its full
+    top-k: all experts run densely (E/k extra expert FLOPs — fine at
+    ragged batch sizes) and the combine is a [T, E] weighted sum."""
+    gates = jax.nn.softmax(
+        (x.astype(jnp.float32) @ p["gate"]["wg"]["kernel"].astype(jnp.float32)), axis=-1)
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
+    if k > 1:
+        topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
+    T, E = gates.shape
+    w_tok = jnp.zeros((T, E), jnp.float32)
+    for j in range(k):
+        w_tok = w_tok + topk_vals[:, j, None] * jax.nn.one_hot(topk_idx[:, j], E)
+    w1, w3, w2 = p["experts_w1"], p["experts_w3"], p["experts_w2"]
+    hexp = jax.nn.silu(jnp.einsum("td,edi->tei", x, w1.astype(x.dtype)))
+    hexp = hexp * jnp.einsum("td,edi->tei", x, w3.astype(x.dtype))
+    out_e = jnp.einsum("tei,eid->ted", hexp, w2.astype(x.dtype))
+    return jnp.einsum("te,ted->td", w_tok.astype(x.dtype), out_e)
+
+
+def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):
+    """One GPT-family block over the flat ragged batch (sequential or
+    parallel wiring, optional partial rotary / ALiBi, biased
+    projections, LayerNorm or RMSNorm)."""
+    lp, kc, vc = xs
+    T, D = h.shape
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    attn = lp["attn"]
+
+    def norm(p, x):
+        p = p["norm"]
+        if cfg.norm_type == "rmsnorm":
+            return _rms(x, p["scale"], cfg.layer_norm_eps)
+        return _layernorm(x, p, cfg.layer_norm_eps)
+
+    x_attn = norm(lp["input_layernorm"], h)
+    q = _proj(x_attn, attn["q_proj"]).reshape(T, H, Dh)
+    k = _proj(x_attn, attn["k_proj"]).reshape(T, Hkv, Dh)
+    v = _proj(x_attn, attn["v_proj"]).reshape(T, Hkv, Dh)
+    if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
+        rd = cfg.rotary_dim
+        if rd == Dh:
+            q = _rope_flat(q, cos, sin, batch["token_pos"])
+            k = _rope_flat(k, cos, sin, batch["token_pos"])
+        else:
+            q = jnp.concatenate(
+                [_rope_flat(q[..., :rd], cos, sin, batch["token_pos"]), q[..., rd:]], -1)
+            k = jnp.concatenate(
+                [_rope_flat(k[..., :rd], cos, sin, batch["token_pos"]), k[..., rd:]], -1)
+
+    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=alibi)
+    attn_out = _proj(out.reshape(T, H * Dh), attn["o_proj"])
+
+    def mlp(x):
+        inter = _proj(x, lp["mlp"]["fc_in"])
+        if cfg.activation == "relu":
+            inter = jax.nn.relu(inter)
+        else:
+            inter = jax.nn.gelu(inter, approximate=(cfg.activation == "gelu_new"))
+        return _proj(inter, lp["mlp"]["fc_out"])
+
+    if cfg.parallel_block:
+        x_mlp = norm(lp["mlp_layernorm"], h) if cfg.parallel_two_norms else x_attn
+        h = h + attn_out + mlp(x_mlp)
+    else:
+        h = h + attn_out
+        h = h + mlp(norm(lp["post_attention_layernorm"], h))
+    return h, (kc, vc)
+
+
+def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16):
     """→ (last-token logits [max_seqs, vocab] fp32, new kcache, new vcache).
 
     ``kcache``/``vcache``: [L, NB, bs, Hkv, Dh]; ``batch``: the arrays
-    of ``RaggedBatchWrapper.finalize()``."""
-    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta)
-    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    of ``RaggedBatchWrapper.finalize()``. ``cfg`` is a ``LlamaConfig``
+    or ``GPTConfig``; the layer wiring follows it."""
+    is_gpt = hasattr(cfg, "position_embedding")
     embed = params["model"]["embed_tokens"]
     h = embed[batch["token_ids"]].astype(dtype)  # [T, D]
 
-    step = functools.partial(_layer_step, cfg, cos, sin, batch)
+    if is_gpt:
+        cos = sin = None
+        if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
+            cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_position_embeddings,
+                                        cfg.rope_theta)
+            cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+        alibi = None
+        if cfg.position_embedding == "alibi":
+            from deepspeed_tpu.models.gpt import alibi_slopes
+            alibi = jnp.asarray(alibi_slopes(cfg.num_attention_heads))
+        if cfg.position_embedding == "learned":
+            pos_table = params["model"]["embed_positions"]
+            h = h + pos_table[batch["token_pos"] + cfg.learned_pos_offset].astype(dtype)
+        if cfg.embedding_layernorm:
+            h = _layernorm(h, params["model"]["embed_layernorm"], cfg.layer_norm_eps)
+        step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch)
+    else:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+        step = functools.partial(_layer_step, cfg, cos, sin, batch)
+
     h, (kc, vc) = jax.lax.scan(step, h, (params["model"]["layers"], kcache, vcache))
 
-    h = _rms(h, params["model"]["norm"]["scale"], cfg.rms_norm_eps)
+    if is_gpt:
+        if cfg.norm_type == "layernorm":
+            h = _layernorm(h, params["model"]["final_layernorm"], cfg.layer_norm_eps)
+        else:
+            h = _rms(h, params["model"]["final_norm"]["scale"], cfg.layer_norm_eps)
+    else:
+        h = _rms(h, params["model"]["norm"]["scale"], cfg.rms_norm_eps)
     if "lm_head" in params:
         logits = h @ params["lm_head"]["kernel"].astype(h.dtype)
     else:  # tied embeddings

@@ -74,10 +74,11 @@ class GPTConfig:
 GPT_CONFIGS = {
     "gpt2-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
                             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
-                            max_position_embeddings=128),
-    "gpt2": GPTConfig(max_position_embeddings=1024),
+                            max_position_embeddings=128, activation="gelu_new"),
+    "gpt2": GPTConfig(max_position_embeddings=1024, activation="gelu_new"),
     "gpt2-xl": GPTConfig(hidden_size=1600, intermediate_size=6400, num_hidden_layers=48,
-                         num_attention_heads=25, num_key_value_heads=25, max_position_embeddings=1024),
+                         num_attention_heads=25, num_key_value_heads=25,
+                         max_position_embeddings=1024, activation="gelu_new"),
     "opt-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
                            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
                            max_position_embeddings=128, activation="relu", learned_pos_offset=2),
@@ -86,10 +87,12 @@ GPT_CONFIGS = {
                          activation="relu", learned_pos_offset=2),
     "bloom-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
                              num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
-                             position_embedding="alibi", embedding_layernorm=True),
+                             position_embedding="alibi", embedding_layernorm=True,
+                             activation="gelu_new"),
     "bloom-7b": GPTConfig(vocab_size=250880, hidden_size=4096, intermediate_size=16384,
                           num_hidden_layers=30, num_attention_heads=32, num_key_value_heads=32,
-                          position_embedding="alibi", embedding_layernorm=True),
+                          position_embedding="alibi", embedding_layernorm=True,
+                          activation="gelu_new"),
     "neox-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
                             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
                             position_embedding="rope", rotary_pct=0.25, parallel_block=True,
@@ -369,10 +372,10 @@ def gpt_tp_rule(path: str, shape) -> P:
     return P()
 
 
-def init_gpt_cache(config: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
-    shape = (config.num_hidden_layers, batch_size, max_len,
-             config.num_key_value_heads, config.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+# Same [L, B, S_max, Hkv, D] cache layout as the flagship (llama.py
+# init_cache reads only num_hidden_layers/num_key_value_heads/head_dim,
+# which GPTConfig also provides) — one allocator, two names for parity.
+from deepspeed_tpu.models.llama import init_cache as init_gpt_cache  # noqa: E402
 
 
 def build_gpt(preset_or_config="gpt2-debug", **overrides) -> GPTForCausalLM:

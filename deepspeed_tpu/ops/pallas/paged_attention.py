@@ -26,10 +26,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(np.finfo(np.float32).min)
 
 
-def xla_paged_attention(q, kc, vc, block_tables, token_pos):
+def xla_paged_attention(q, kc, vc, block_tables, token_pos, alibi_slopes=None):
     """Reference math. q: [T, H, Dh]; kc/vc: [NB, bs, Hkv, Dh];
     block_tables: [T, MB] (per TOKEN, already indexed by its sequence);
-    token_pos: [T]. → [T, H, Dh]; attends to positions <= token_pos."""
+    token_pos: [T]. → [T, H, Dh]; attends to positions <= token_pos.
+    ``alibi_slopes``: optional [H] — adds the Bloom-style linear
+    relative-position penalty slope_h * (k_pos - q_pos) to the scores."""
     T, H, Dh = q.shape
     _, bs, Hkv, _ = kc.shape
     ks = kc[block_tables].reshape(T, -1, Hkv, Dh).astype(q.dtype)
@@ -40,7 +42,11 @@ def xla_paged_attention(q, kc, vc, block_tables, token_pos):
         vs = jnp.repeat(vs, rep, axis=2)
     scale = 1.0 / np.sqrt(Dh)
     scores = jnp.einsum("thd,tchd->thc", q, ks).astype(jnp.float32) * scale
-    mask = (jnp.arange(ks.shape[1])[None, :] <= token_pos[:, None])[:, None, :]
+    k_idx = jnp.arange(ks.shape[1])
+    if alibi_slopes is not None:
+        rel = (k_idx[None, :] - token_pos[:, None]).astype(jnp.float32)  # [T, C]
+        scores = scores + alibi_slopes[None, :, None] * rel[:, None, :]
+    mask = (k_idx[None, :] <= token_pos[:, None])[:, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("thc,tchd->thd", probs, vs)

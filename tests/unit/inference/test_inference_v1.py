@@ -50,6 +50,18 @@ class TestInferenceEngine:
         refeed = np.asarray(jnp.argmax(engine(out[:, :-1])[:, ids.shape[1] - 1:], -1))
         np.testing.assert_array_equal(out[:, ids.shape[1]:], refeed)
 
+    @pytest.mark.parametrize("preset", ["gpt2-debug", "bloom-debug", "falcon-debug"])
+    def test_gpt_family_greedy_matches_teacher_forcing(self, preset):
+        """v1 generate over the GPT model zoo (learned/ALiBi positions,
+        MQA) — greedy decode must agree with teacher-forced argmax."""
+        from deepspeed_tpu.models import build_gpt
+        model = build_gpt(preset, remat=False)
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        ids = _ids()
+        out = np.asarray(engine.generate(ids, max_new_tokens=4))
+        refeed = np.asarray(jnp.argmax(engine(out[:, :-1])[:, ids.shape[1] - 1:], -1))
+        np.testing.assert_array_equal(out[:, ids.shape[1]:], refeed)
+
     def test_gqa_decode(self):
         # kv heads != q heads exercises the GQA cache path
         model = build_llama("debug", remat=False, num_attention_heads=4, num_key_value_heads=2)
