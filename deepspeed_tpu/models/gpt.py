@@ -60,6 +60,8 @@ class GPTConfig:
     attention_impl: str = "auto"
     remat: bool = True
     remat_policy: str = "full"
+    # ZeRO-Infinity param offload (see LlamaConfig.offload_params)
+    offload_params: bool = False
 
     @property
     def head_dim(self):
@@ -302,6 +304,12 @@ class GPTModel(nn.Module):
             h = constrain_hidden(h)
 
         block = GPTBlock
+        if cfg.offload_params:
+            from deepspeed_tpu.runtime.zero.param_stream import make_block_stream
+            stream = ((lambda vs: vs) if self.is_initializing()
+                      else make_block_stream(gpt_tp_rule))
+            block = nn.map_variables(block, "params", trans_in_fn=stream,
+                                     init=self.is_initializing())
         if cfg.remat and not decode:
             policy = (jax.checkpoint_policies.dots_saveable if cfg.remat_policy == "dots"
                       else jax.checkpoint_policies.nothing_saveable)
@@ -334,6 +342,8 @@ class GPTForCausalLM(nn.Module):
     the flagship ``LlamaForCausalLM`` so every engine path (training,
     pipeline, inference v1/v2) accepts it interchangeably."""
     config: GPTConfig
+
+    param_stream_prefix = "model/layers/"
 
     @nn.compact
     def __call__(self, input_ids, labels=None, cache=None, start_pos=0):

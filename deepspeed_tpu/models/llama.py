@@ -55,6 +55,10 @@ class LlamaConfig:
     # "dots" saves matmul outputs and recomputes elementwise (the usual
     # MFU/memory sweet spot); only read when remat=True
     remat_policy: str = "full"  # "full" | "dots"
+    # ZeRO-Infinity param offload: engine sets this when the ds_config
+    # has zero_optimization.offload_param — the scanned blocks then
+    # stream their layer slice host→HBM (runtime/zero/param_stream.py)
+    offload_params: bool = False
     # MoE (0 = dense)
     moe_num_experts: int = 0
     moe_top_k: int = 2
@@ -304,6 +308,17 @@ class LlamaModel(nn.Module):
         positions = (start_pos + jnp.arange(input_ids.shape[1]))[None, :]
 
         block = LlamaBlock
+        if cfg.offload_params:
+            # Training: inside remat, so the host→device copies are
+            # recomputed in the backward instead of saved (saving them
+            # would pin every layer's device copy until its backward
+            # runs). Decode (hybrid-engine generate): same streaming per
+            # decode step — ZeRO-Inference semantics.
+            from deepspeed_tpu.runtime.zero.param_stream import make_block_stream
+            stream = ((lambda vs: vs) if self.is_initializing()
+                      else make_block_stream(llama_tp_rule))
+            block = nn.map_variables(block, "params", trans_in_fn=stream,
+                                     init=self.is_initializing())
         if cfg.remat and not decode:
             policy = (jax.checkpoint_policies.dots_saveable if cfg.remat_policy == "dots"
                       else jax.checkpoint_policies.nothing_saveable)
@@ -340,6 +355,10 @@ class LlamaForCausalLM(nn.Module):
     ignored (HF convention).
     """
     config: LlamaConfig
+
+    # Subtree the engine may place in pinned_host when offload_param is
+    # on (the scanned blocks stream these leaves themselves).
+    param_stream_prefix = "model/layers/"
 
     @nn.compact
     def __call__(self, input_ids, labels=None, cache=None, start_pos=0):

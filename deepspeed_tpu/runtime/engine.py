@@ -404,9 +404,59 @@ class DeepSpeedEngine:
         params = jax.jit(init_fn, out_shardings=shardings)(rng)
         return jax.tree.map(lambda x: x.astype(self.compute_dtype) if _is_float(x) else x, params)
 
+    def _configure_param_offload(self):
+        """Validate + arm ZeRO-Infinity param offload (offload_param).
+
+        Reference semantics (``deepspeed/runtime/zero/stage3.py`` offload
+        branches): params may be offloaded only under ZeRO-3. The TPU
+        mechanism needs a model whose scanned blocks stream their own
+        layer slices (``param_stream_prefix`` + ``config.offload_params``),
+        so anything else raises instead of silently ignoring the config.
+        """
+        zc = self._config.zero_config
+        device = zc.offload_param_device().value
+        self._param_offload_enabled = device != "none"
+        if not self._param_offload_enabled:
+            return
+        if self.zero_stage < 3:
+            raise ValueError(
+                f"zero_optimization.offload_param requires stage 3 (got stage {self.zero_stage})")
+        if device != "cpu":
+            raise NotImplementedError(
+                "offload_param.device=nvme is not supported on TPU — the pinned_host "
+                "memory space is host RAM; use offload_optimizer.device=nvme for "
+                "NVMe-resident optimizer state")
+        if self._quantized_comm_enabled() or self._onebit_enabled():
+            raise NotImplementedError(
+                "offload_param cannot combine with quantized/1-bit communication: the "
+                "manual shard_map gradient core does not stream host-resident params")
+        cfg = getattr(self.module, "config", None)
+        prefix = getattr(self.module, "param_stream_prefix", None)
+        if cfg is None or prefix is None or not hasattr(cfg, "offload_params"):
+            raise NotImplementedError(
+                f"offload_param needs a model with param-streaming support "
+                f"(config.offload_params + param_stream_prefix); "
+                f"{type(self.module).__name__} has neither — use a deepspeed_tpu model "
+                f"or disable offload_param")
+        if not cfg.offload_params:
+            import dataclasses as _dc
+            self.module = self.module.clone(config=_dc.replace(cfg, offload_params=True))
+
+    def _enforce_param_memory_kinds(self):
+        """Param-offload contract: offloaded leaves live in pinned_host
+        between steps. The update writes them back in-program where the
+        backend supports host-placed outputs (TPU); where it silently
+        leaves them in device memory (CPU SPMD), re-place here."""
+        if not getattr(self, "_param_offload_enabled", False):
+            return
+        self.params = jax.tree.map(
+            lambda x, s: x if x.sharding.memory_kind == s.memory_kind else jax.device_put(x, s),
+            self.params, self._param_shardings)
+
     def _materialize_state(self, *fwd_args, **fwd_kwargs):
         if self._initialized:
             return
+        self._configure_param_offload()
         if self.params is None:
             self.params = self._init_params(*fwd_args, **fwd_kwargs)
         else:
@@ -423,6 +473,16 @@ class DeepSpeedEngine:
         self._grad_specs = self.sharding_policy.tree_grad_specs(self.params)
         self._grad_shardings = self.sharding_policy.tree_grad_shardings(self.params)
         self._trainable_mask = self._build_trainable_mask()
+
+        if self._param_offload_enabled:
+            # ZeRO-Infinity param offload: the scanned-layer subtree lives
+            # in the device's pinned_host memory space; the model streams
+            # each layer slice to HBM inside the scan (param_stream.py).
+            prefix = self.module.param_stream_prefix
+            self._param_shardings = path_tree_map(
+                lambda path, s: s.with_memory_kind("pinned_host")
+                if path.startswith(prefix) else s, self._param_shardings)
+            self.params = jax.tree.map(jax.device_put, self.params, self._param_shardings)
 
         offload_device = self._config.zero_config.offload_optimizer_device().value
         if offload_device != "none" and self._config._param_dict.get("frozen_parameters"):
@@ -449,9 +509,14 @@ class DeepSpeedEngine:
             # fp32 master copy sharded like optimizer state (ZeRO-1 partitioning)
             mixed = self.compute_dtype != jnp.float32
             if mixed or self.zero_stage >= 1:
+                src = self.params
+                if self._param_offload_enabled:
+                    # computing on pinned_host operands is illegal inside
+                    # a partitioned program — hop to HBM first (init-only)
+                    src = jax.device_put(src, self._opt_shardings)
                 self.master_params = jax.jit(
                     lambda p: jax.tree.map(lambda x: x.astype(jnp.float32) if _is_float(x) else x, p),
-                    out_shardings=self._opt_shardings)(self.params)
+                    out_shardings=self._opt_shardings)(src)
             else:
                 self.master_params = self.params
 
@@ -899,10 +964,18 @@ class DeepSpeedEngine:
 
         new_master = sel(new_master, master)
         new_opt = sel(new_opt, opt_state)
-        new_params = jax.tree.map(
-            lambda m, spec: jax.lax.with_sharding_constraint(
-                m.astype(self.compute_dtype) if _is_float(m) else m, NamedSharding(self.mesh, spec)),
-            new_master, self._param_specs)
+        if getattr(self, "_param_offload_enabled", False):
+            # device_put (not a constraint): offloaded leaves must land
+            # back in pinned_host so the next step streams them again
+            new_params = jax.tree.map(
+                lambda m, s: jax.device_put(
+                    m.astype(self.compute_dtype) if _is_float(m) else m, s),
+                new_master, self._param_shardings)
+        else:
+            new_params = jax.tree.map(
+                lambda m, spec: jax.lax.with_sharding_constraint(
+                    m.astype(self.compute_dtype) if _is_float(m) else m, NamedSharding(self.mesh, spec)),
+                new_master, self._param_specs)
         new_scaler = update_scale(scaler_st, overflow, **dict(self._scaler_kwargs))
         return new_params, new_master, new_opt, new_scaler, gnorm, overflow
 
@@ -950,7 +1023,10 @@ class DeepSpeedEngine:
 
             jitted = jax.jit(fn, donate_argnums=(0, 1, 2, 3))
         else:
-            jitted = jax.jit(body, donate_argnums=(0, 1, 2, 3, 4))
+            # pinned_host param buffers can't alias device outputs — skip
+            # donating params under param offload
+            donate = (1, 2, 3, 4) if self._param_offload_enabled else (0, 1, 2, 3, 4)
+            jitted = jax.jit(body, donate_argnums=donate)
         self._jit_cache[key] = (jitted, tied)
         return self._jit_cache[key]
 
@@ -973,6 +1049,7 @@ class DeepSpeedEngine:
             else:
                 out = fn(self.params, self.master_params, self.opt_state, self._grads_acc, self.scaler_state, lr)
                 self.params, self.master_params, self.opt_state, self.scaler_state, gnorm, overflow = out
+            self._enforce_param_memory_kinds()
             self.overflow = bool(overflow) if self.fp16_enabled() else False
             self.global_grad_norm = float(gnorm)
         self._grads_acc = None
@@ -1037,7 +1114,8 @@ class DeepSpeedEngine:
 
             jitted = jax.jit(fn, donate_argnums=(0, 1, 2))
         else:
-            jitted = jax.jit(body, donate_argnums=(0, 1, 2, 3))
+            donate = (1, 2, 3) if self._param_offload_enabled else (0, 1, 2, 3)
+            jitted = jax.jit(body, donate_argnums=donate)
         self._jit_cache[key] = (jitted, tied)
         return self._jit_cache[key]
 
@@ -1146,6 +1224,7 @@ class DeepSpeedEngine:
             else:
                 out = fn(self.params, self.master_params, self.opt_state, self.scaler_state, lr, sub, batch)
                 self.params, self.master_params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
+            self._enforce_param_memory_kinds()
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
