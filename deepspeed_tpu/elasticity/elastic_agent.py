@@ -1,0 +1,128 @@
+"""Elastic agent: restart-based worker recovery for slice jobs.
+
+Capability match for the reference's ``DSElasticAgent``
+(``deepspeed/elasticity/elastic_agent.py:32``, a ``LocalElasticAgent``
+subclass over torch-elastic rendezvous: on worker failure or membership
+change, workers are torn down and relaunched; recovery is
+checkpoint-based). The TPU design has no torch-elastic: one worker
+process per host drives all local chips, so the agent is a per-host
+supervisor loop —
+
+- spawn the worker in its own process group;
+- on non-zero exit, re-resolve the environment (world size / master
+  may have changed when hosts joined or left) and relaunch, up to
+  ``max_restarts`` times within the failure window;
+- export ``DS_ELASTIC_RESTART_COUNT`` so the training script knows it
+  is resuming (and should ``load_checkpoint`` before stepping);
+- the batch math stays valid across world sizes because
+  ``compute_elastic_config`` (elasticity.py) pre-computed a divisor-rich
+  global batch — the relaunched job just picks the new gas.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DSElasticAgent:
+    """Per-host supervisor: run → monitor → relaunch on failure.
+
+    ``cmd``: worker argv. ``env_fn``: called before every (re)launch to
+    produce the environment — re-resolving rendezvous info there is what
+    makes membership changes take effect on restart. ``max_restarts``
+    failures within ``failure_window`` seconds abort the job (a steady
+    crash loop should surface, not spin); successes reset the budget.
+    """
+
+    def __init__(self, cmd: Sequence[str], env_fn: Optional[Callable[[], dict]] = None,
+                 max_restarts: int = 3, failure_window: float = 300.0,
+                 monitor_interval: float = 1.0):
+        self.cmd = list(cmd)
+        self.env_fn = env_fn or (lambda: os.environ.copy())
+        self.max_restarts = int(max_restarts)
+        self.failure_window = float(failure_window)
+        self.monitor_interval = float(monitor_interval)
+        self.restart_count = 0
+        self._child = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    def _spawn(self):
+        env = dict(self.env_fn())
+        env["DS_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
+        env["DS_ELASTIC_ENABLED"] = "1"
+        logger.info(f"[elastic] launching worker (restart {self.restart_count}/"
+                    f"{self.max_restarts}): {self.cmd}")
+        self._child = subprocess.Popen(self.cmd, env=env, start_new_session=True)
+        return self._child
+
+    def _kill_child(self, sig=signal.SIGTERM):
+        if self._child is None or self._child.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self._child.pid), sig)
+        except ProcessLookupError:
+            pass
+
+    def shutdown(self, sig=signal.SIGTERM):
+        self._shutdown = True
+        self._kill_child(sig)
+
+    # ------------------------------------------------------------------
+    def run(self) -> int:
+        """Supervise until clean exit, crash-loop abort, or shutdown().
+        Returns the final worker exit code."""
+        failures = []  # timestamps of recent failures
+        for s in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(s, lambda *_: self.shutdown())
+            except ValueError:
+                pass  # not the main thread (tests)
+
+        while not self._shutdown:
+            child = self._spawn()
+            while child.poll() is None and not self._shutdown:
+                time.sleep(self.monitor_interval)
+            if self._shutdown:
+                self._kill_child()
+                child.wait()
+                return child.returncode or 0
+            rc = child.returncode
+            if rc == 0:
+                logger.info("[elastic] worker exited cleanly")
+                return 0
+            now = time.monotonic()
+            failures = [t for t in failures if now - t < self.failure_window] + [now]
+            if len(failures) > self.max_restarts:
+                logger.error(f"[elastic] {len(failures)} failures within "
+                             f"{self.failure_window}s — giving up (rc={rc})")
+                return rc
+            self.restart_count += 1
+            logger.warning(f"[elastic] worker died rc={rc}; relaunching "
+                           f"({len(failures)}/{self.max_restarts} recent failures)")
+        return 0
+
+
+def main(argv=None):
+    """CLI: ``python -m deepspeed_tpu.elasticity.elastic_agent [--max-restarts N] -- cmd...``"""
+    import argparse
+    parser = argparse.ArgumentParser(description="DeepSpeedTPU elastic agent")
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--failure-window", type=float, default=300.0)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("no worker command given")
+    agent = DSElasticAgent(cmd, max_restarts=args.max_restarts,
+                           failure_window=args.failure_window)
+    sys.exit(agent.run())
+
+
+if __name__ == "__main__":
+    main()

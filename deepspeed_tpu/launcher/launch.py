@@ -32,6 +32,16 @@ def parse_args(args=None):
                         help="exec user_script directly without the python interpreter")
     parser.add_argument("--save_pid", type=str, default=None,
                         help="write the child pid to this file")
+    parser.add_argument("--enable_elastic_training", action="store_true",
+                        help="supervise the worker with the elastic agent: relaunch on "
+                             "failure (reference launch.py --enable_elastic_training / "
+                             "DSElasticAgent)")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3)
+    parser.add_argument("--elastic_rendezvous_file", type=str, default=None,
+                        help="JSON file re-read before every elastic relaunch; keys "
+                             "master_addr/master_port/node_rank/nnodes override the CLI "
+                             "values, so an external controller can change membership "
+                             "between restarts")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -61,15 +71,32 @@ def _infer_nnodes(args):
 
 def main(args=None):
     args = parse_args(args)
+
+    def resolve_env():
+        # Re-run per (re)launch. The launcher's own env/CLI are static,
+        # so genuine membership changes come from the rendezvous file —
+        # an external controller rewrites it, and the next restart picks
+        # up the new world. Without the file, restarts reuse the same
+        # env (covers the common transient-worker-crash case).
+        rdv = {}
+        if args.elastic_rendezvous_file and os.path.exists(args.elastic_rendezvous_file):
+            import json
+            try:
+                with open(args.elastic_rendezvous_file) as f:
+                    rdv = json.load(f)
+            except (OSError, ValueError) as e:
+                logger.warning(f"launch: unreadable rendezvous file: {e}")
+        env = os.environ.copy()
+        env["MASTER_ADDR"] = str(rdv.get("master_addr", args.master_addr))
+        env["MASTER_PORT"] = str(rdv.get("master_port", args.master_port))
+        env["RANK"] = str(rdv.get("node_rank", _infer_node_rank(args)))
+        env["WORLD_SIZE"] = str(rdv.get("nnodes", _infer_nnodes(args)))
+        env["LOCAL_RANK"] = "0"  # one process per host owns every local chip
+        return env
+
     rank = _infer_node_rank(args)
     world = _infer_nnodes(args)
-
-    env = os.environ.copy()
-    env["MASTER_ADDR"] = args.master_addr
-    env["MASTER_PORT"] = str(args.master_port)
-    env["RANK"] = str(rank)
-    env["WORLD_SIZE"] = str(world)
-    env["LOCAL_RANK"] = "0"  # one process per host owns every local chip
+    env = resolve_env()
 
     if args.no_python:
         cmd = [args.user_script] + args.user_args
@@ -80,6 +107,16 @@ def main(args=None):
 
     logger.info(f"launch: node_rank={rank} nnodes={world} "
                 f"master={args.master_addr}:{args.master_port} cmd={cmd}")
+
+    if args.enable_elastic_training:
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+        if args.save_pid:
+            # no stable child pid across restarts — record the agent's
+            with open(args.save_pid, "w") as f:
+                f.write(str(os.getpid()))
+        agent = DSElasticAgent(cmd, env_fn=resolve_env,
+                               max_restarts=args.max_elastic_restarts)
+        sys.exit(agent.run())
     # new process group so signal forwarding reaches the whole subtree
     child = subprocess.Popen(cmd, env=env, start_new_session=True)
     if args.save_pid:

@@ -305,11 +305,8 @@ class GPTModel(nn.Module):
 
         block = GPTBlock
         if cfg.offload_params:
-            from deepspeed_tpu.runtime.zero.param_stream import make_block_stream
-            stream = ((lambda vs: vs) if self.is_initializing()
-                      else make_block_stream(gpt_tp_rule))
-            block = nn.map_variables(block, "params", trans_in_fn=stream,
-                                     init=self.is_initializing())
+            from deepspeed_tpu.runtime.zero.param_stream import wrap_streaming_block
+            block = wrap_streaming_block(block, gpt_tp_rule, self.is_initializing())
         if cfg.remat and not decode:
             policy = (jax.checkpoint_policies.dots_saveable if cfg.remat_policy == "dots"
                       else jax.checkpoint_policies.nothing_saveable)

@@ -83,6 +83,11 @@ class PipelineEngine(DeepSpeedEngine):
     def _materialize_state(self, sample_inputs, sample_labels):
         if self._initialized:
             return
+        if self._config.zero_config.offload_param_device().value != "none":
+            raise NotImplementedError(
+                "offload_param with the pipeline engine is not supported: the pipe "
+                "shard_map schedule does not stream host-resident stage params — "
+                "drop offload_param or use the non-pipeline engine")
         if self.params is None:
             params, act_struct = self.module.init(self._param_rng, sample_inputs)
             self.params = jax.tree.map(

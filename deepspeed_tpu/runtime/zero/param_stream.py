@@ -47,3 +47,12 @@ def make_block_stream(tp_rule):
         return path_tree_map(put, variables)
 
     return trans_in
+
+
+def wrap_streaming_block(block, tp_rule, is_initializing: bool):
+    """Wrap a scanned block class so its per-layer param slice streams
+    host→HBM at apply time (identity during init — flax creates the
+    params normally and the engine decides their placement)."""
+    import flax.linen as nn
+    stream = (lambda vs: vs) if is_initializing else make_block_stream(tp_rule)
+    return nn.map_variables(block, "params", trans_in_fn=stream, init=is_initializing)

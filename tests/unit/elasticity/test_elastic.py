@@ -1,9 +1,107 @@
 """Elasticity tests (analogue of reference tests/unit/elasticity/test_elastic.py)."""
 
+import os
+import sys
+import tempfile
+
 import pytest
 
 from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
 from deepspeed_tpu.elasticity.config import ElasticityConfigError, ElasticityIncompatibleWorldSize
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+
+class TestElasticAgent:
+    """Restart-based recovery (reference DSElasticAgent,
+    elasticity/elastic_agent.py:32): worker failures relaunch with a
+    fresh env until the restart budget is exhausted."""
+
+    def _flaky_script(self, tmpdir, fail_times):
+        """Script exits 1 for the first ``fail_times`` runs, then 0,
+        recording DS_ELASTIC_RESTART_COUNT for each attempt."""
+        marker = os.path.join(tmpdir, "attempts")
+        script = os.path.join(tmpdir, "flaky.py")
+        with open(script, "w") as f:
+            f.write(f"""
+import os, sys
+with open({marker!r}, "a") as m:
+    m.write(os.environ.get("DS_ELASTIC_RESTART_COUNT", "?") + "\\n")
+n = sum(1 for _ in open({marker!r}))
+sys.exit(1 if n <= {fail_times} else 0)
+""")
+        return script, marker
+
+    def test_recovers_after_failures(self):
+        with tempfile.TemporaryDirectory() as d:
+            script, marker = self._flaky_script(d, fail_times=2)
+            agent = DSElasticAgent([sys.executable, script],
+                                   max_restarts=3, monitor_interval=0.05)
+            rc = agent.run()
+            assert rc == 0
+            attempts = open(marker).read().split()
+            assert attempts == ["0", "1", "2"]  # restart count exported per attempt
+
+    def test_crash_loop_gives_up(self):
+        with tempfile.TemporaryDirectory() as d:
+            script, marker = self._flaky_script(d, fail_times=99)
+            agent = DSElasticAgent([sys.executable, script],
+                                   max_restarts=2, monitor_interval=0.05)
+            rc = agent.run()
+            assert rc != 0
+            assert len(open(marker).read().split()) == 3  # initial + 2 restarts
+
+    def test_launch_rendezvous_file_reresolved(self):
+        """launch.py --elastic_rendezvous_file: membership edits land on
+        the next restart (the worker itself rewrites the file here to
+        simulate an external controller)."""
+        import json
+        import subprocess
+        with tempfile.TemporaryDirectory() as d:
+            rdv = os.path.join(d, "rdv.json")
+            marker = os.path.join(d, "worlds")
+            with open(rdv, "w") as f:
+                json.dump({"nnodes": 4}, f)
+            script = os.path.join(d, "w.py")
+            with open(script, "w") as f:
+                f.write(f"""
+import json, os, sys
+with open({marker!r}, "a") as m:
+    m.write(os.environ["WORLD_SIZE"] + "\\n")
+json.dump({{"nnodes": 2}}, open({rdv!r}, "w"))  # controller shrinks the job
+sys.exit(1 if sum(1 for _ in open({marker!r})) < 2 else 0)
+""")
+            rc = subprocess.run(
+                [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+                 "--enable_elastic_training", "--max_elastic_restarts", "3",
+                 "--elastic_rendezvous_file", rdv, script],
+                cwd="/root/repo", timeout=120).returncode
+            assert rc == 0
+            assert open(marker).read().split() == ["4", "2"]
+
+    def test_env_fn_reresolved_each_launch(self):
+        """Membership changes: env_fn is consulted before every launch."""
+        calls = []
+
+        def env_fn():
+            calls.append(1)
+            env = os.environ.copy()
+            env["WORLD_SIZE"] = str(len(calls))
+            return env
+
+        with tempfile.TemporaryDirectory() as d:
+            marker = os.path.join(d, "worlds")
+            script = os.path.join(d, "w.py")
+            with open(script, "w") as f:
+                f.write(f"""
+import os, sys
+with open({marker!r}, "a") as m:
+    m.write(os.environ["WORLD_SIZE"] + "\\n")
+sys.exit(1 if sum(1 for _ in open({marker!r})) < 2 else 0)
+""")
+            agent = DSElasticAgent([sys.executable, script], env_fn=env_fn,
+                                   max_restarts=3, monitor_interval=0.05)
+            assert agent.run() == 0
+            assert open(marker).read().split() == ["1", "2"]
 
 base_ds_config = {
     "elasticity": {

@@ -124,6 +124,18 @@ class TestParamOffload:
         with pytest.raises(NotImplementedError, match="nvme"):
             engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
 
+    def test_pipeline_engine_rejects_param_offload(self):
+        from deepspeed_tpu.models.llama_pipe import build_llama_pipeline
+        cfg = _cfg(offload_param={"device": "cpu"})
+        cfg["mesh"] = {"pipeline_parallel_size": 2}
+        cfg["train_micro_batch_size_per_gpu"] = 4
+        cfg["train_batch_size"] = 8
+        model = build_llama_pipeline("debug", num_stages=2, num_hidden_layers=4)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        ids = _ids(B=8)
+        with pytest.raises(NotImplementedError, match="pipeline"):
+            engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+
     def test_non_streaming_model_raises(self):
         import flax.linen as nn
 
