@@ -34,9 +34,11 @@ class DSElasticAgent:
 
     ``cmd``: worker argv. ``env_fn``: called before every (re)launch to
     produce the environment — re-resolving rendezvous info there is what
-    makes membership changes take effect on restart. ``max_restarts``
-    failures within ``failure_window`` seconds abort the job (a steady
-    crash loop should surface, not spin); successes reset the budget.
+    makes membership changes take effect on restart. The job aborts once
+    MORE than ``max_restarts`` failures land within ``failure_window``
+    seconds (i.e. up to ``max_restarts`` relaunches after the initial
+    attempt — a steady crash loop should surface, not spin); failures
+    outside the window age out of the budget.
     """
 
     def __init__(self, cmd: Sequence[str], env_fn: Optional[Callable[[], dict]] = None,

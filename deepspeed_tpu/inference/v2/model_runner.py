@@ -115,23 +115,24 @@ def _layer_step(cfg, cos, sin, batch, h, xs):
 def _moe_mlp(x, p, k):
     """Dropless top-k MoE over the flat [T, D] batch (Mixtral serving —
     reference inference/v2 cutlass MoE gather/scatter). At serving time
-    capacity dropping is undesirable, so every token gets its full
-    top-k: all experts run densely (E/k extra expert FLOPs — fine at
-    ragged batch sizes) and the combine is a [T, E] weighted sum."""
+    capacity dropping is undesirable, so every token reaches its full
+    top-k: tokens are replicated k× and pushed through the grouped GEMM
+    (``ops/grouped_gemm.py`` — ``lax.ragged_dot`` over expert-sorted
+    rows), then combined with the renormalized gate weights."""
+    from deepspeed_tpu.ops.grouped_gemm import moe_grouped_mlp
     gates = jax.nn.softmax(
         (x.astype(jnp.float32) @ p["gate"]["wg"]["kernel"].astype(jnp.float32)), axis=-1)
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
     if k > 1:
         topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
     T, E = gates.shape
-    w_tok = jnp.zeros((T, E), jnp.float32)
-    for j in range(k):
-        w_tok = w_tok + topk_vals[:, j, None] * jax.nn.one_hot(topk_idx[:, j], E)
     w1, w3, w2 = p["experts_w1"], p["experts_w3"], p["experts_w2"]
-    hexp = jax.nn.silu(jnp.einsum("td,edi->tei", x, w1.astype(x.dtype)))
-    hexp = hexp * jnp.einsum("td,edi->tei", x, w3.astype(x.dtype))
-    out_e = jnp.einsum("tei,eid->ted", hexp, w2.astype(x.dtype))
-    return jnp.einsum("te,ted->td", w_tok.astype(x.dtype), out_e)
+    x_rep = jnp.repeat(x, k, axis=0)                      # [T*k, D]
+    idx_rep = topk_idx.reshape(-1)                        # [T*k]
+    out_rep = moe_grouped_mlp(x_rep, idx_rep, w1.astype(x.dtype), w3.astype(x.dtype),
+                              w2.astype(x.dtype), num_experts=E)
+    out_k = out_rep.reshape(T, k, -1)                     # [T, k, D]
+    return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
 
 
 def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):

@@ -86,6 +86,10 @@ def main(args=None):
                     rdv = json.load(f)
             except (OSError, ValueError) as e:
                 logger.warning(f"launch: unreadable rendezvous file: {e}")
+            if not isinstance(rdv, dict):
+                logger.warning(f"launch: rendezvous file is not a JSON object "
+                               f"({type(rdv).__name__}) — using CLI values")
+                rdv = {}
         env = os.environ.copy()
         env["MASTER_ADDR"] = str(rdv.get("master_addr", args.master_addr))
         env["MASTER_PORT"] = str(rdv.get("master_port", args.master_port))
@@ -94,8 +98,6 @@ def main(args=None):
         env["LOCAL_RANK"] = "0"  # one process per host owns every local chip
         return env
 
-    rank = _infer_node_rank(args)
-    world = _infer_nnodes(args)
     env = resolve_env()
 
     if args.no_python:
@@ -105,8 +107,8 @@ def main(args=None):
     else:
         cmd = [sys.executable, args.user_script] + args.user_args
 
-    logger.info(f"launch: node_rank={rank} nnodes={world} "
-                f"master={args.master_addr}:{args.master_port} cmd={cmd}")
+    logger.info(f"launch: node_rank={env['RANK']} nnodes={env['WORLD_SIZE']} "
+                f"master={env['MASTER_ADDR']}:{env['MASTER_PORT']} cmd={cmd}")
 
     if args.enable_elastic_training:
         from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent

@@ -70,11 +70,13 @@ with open({marker!r}, "a") as m:
 json.dump({{"nnodes": 2}}, open({rdv!r}, "w"))  # controller shrinks the job
 sys.exit(1 if sum(1 for _ in open({marker!r})) < 2 else 0)
 """)
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
             rc = subprocess.run(
                 [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
                  "--enable_elastic_training", "--max_elastic_restarts", "3",
                  "--elastic_rendezvous_file", rdv, script],
-                cwd="/root/repo", timeout=120).returncode
+                cwd=repo_root, timeout=120).returncode
             assert rc == 0
             assert open(marker).read().split() == ["4", "2"]
 
