@@ -2,3 +2,6 @@ from deepspeed_tpu.models.llama import (LLAMA_CONFIGS, LlamaConfig, LlamaForCaus
                                         causal_lm_loss, llama_tp_rule)  # noqa: F401
 from deepspeed_tpu.models.gpt import (GPT_CONFIGS, GPTConfig, GPTForCausalLM, build_gpt,
                                       gpt_tp_rule, init_gpt_cache)  # noqa: F401
+from deepspeed_tpu.models.bert import (BERT_CONFIGS, BertConfig, BertForMaskedLM,
+                                       BertForSequenceClassification, bert_tp_rule,
+                                       build_bert)  # noqa: F401

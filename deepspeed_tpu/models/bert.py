@@ -1,0 +1,228 @@
+"""BERT-family bidirectional encoders, TPU-first.
+
+Capability match for the reference's encoder-side model support:
+injection containers ``deepspeed/module_inject/containers/bert.py`` /
+``distil_bert.py`` and the fused encoder kernels they wire in
+(``csrc/transformer/ds_transformer_cuda.cpp``). Same design rules as
+the decoders (``models/llama.py``): one ``nn.scan`` over a single
+compiled post-LN encoder block (layer-stacked params), fused-by-XLA /
+Pallas hot ops, Megatron ``tp_rule``, padding handled as segment ids
+so the flash kernel skips pad keys.
+
+Families covered by config axes: BERT (post-LN, learned positions,
+token types), DistilBERT (no token types), RoBERTa (pad offset).
+Heads: masked-LM (tied decoder) and sequence classification (pooler).
+"""
+
+import dataclasses
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.llama import einsum_attention, masked_cross_entropy
+from deepspeed_tpu.sequence.layer import constrain, constrain_hidden
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2          # 0 = no token-type table (DistilBERT)
+    layer_norm_eps: float = 1e-12
+    hidden_dropout: float = 0.0
+    position_offset: int = 0          # RoBERTa reserves pad+1 slots
+    attention_impl: str = "auto"
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+BERT_CONFIGS = {
+    "bert-debug": BertConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                             num_hidden_layers=2, num_attention_heads=4,
+                             max_position_embeddings=64),
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(hidden_size=1024, intermediate_size=4096,
+                             num_hidden_layers=24, num_attention_heads=16),
+    "distilbert-base": BertConfig(num_hidden_layers=6, type_vocab_size=0),
+    "roberta-base": BertConfig(vocab_size=50265, position_offset=2),
+    "distilbert-debug": BertConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                                   num_hidden_layers=2, num_attention_heads=4,
+                                   max_position_embeddings=64, type_vocab_size=0),
+}
+
+
+def _attention(q, k, v, attention_mask, impl):
+    """Bidirectional attention with a [B, S] validity mask. The flash
+    path encodes padding as segment ids (pad tokens get their own
+    segment, so valid keys never attend across)."""
+    B, S, H, D = q.shape
+    from deepspeed_tpu.ops.pallas import use_pallas
+    if impl == "auto":
+        impl = "flash" if use_pallas() and S >= 256 else "einsum"
+    if impl == "flash":
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        segment_ids = None
+        if attention_mask is not None:
+            valid = jnp.asarray(attention_mask).reshape(B, S) > 0
+            segment_ids = jnp.where(valid, 0, 1).astype(jnp.int32)
+        return flash_attention(q, k, v, causal=False, segment_ids=segment_ids)
+    mask = None
+    if attention_mask is not None:
+        valid = jnp.asarray(attention_mask).reshape(B, S) > 0
+        mask = valid[:, None, None, :]  # [B, 1, 1, S] key mask
+    return einsum_attention(q, k, v, causal=False, mask=mask)
+
+
+class BertBlock(nn.Module):
+    """Classic post-LN encoder block."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, carry, attention_mask):
+        h, _ = carry
+        cfg = self.config
+        B, S, D = h.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+
+        q = nn.Dense(H * Dh, name="q_proj")(h).reshape(B, S, H, Dh)
+        k = nn.Dense(H * Dh, name="k_proj")(h).reshape(B, S, H, Dh)
+        v = nn.Dense(H * Dh, name="v_proj")(h).reshape(B, S, H, Dh)
+        ctx = _attention(q, k, v, attention_mask, cfg.attention_impl).reshape(B, S, H * Dh)
+        ctx = nn.Dense(D, name="o_proj")(ctx)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="attn_layernorm")(h + ctx)
+        h = constrain_hidden(h)
+
+        inter = nn.Dense(cfg.intermediate_size, name="fc_in")(h)
+        inter = jax.nn.gelu(inter, approximate=False)
+        inter = constrain(inter, (("data", "expert"), "sequence", "tensor"))
+        out = nn.Dense(D, name="fc_out")(inter)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ffn_layernorm")(h + out)
+        h = constrain_hidden(h)
+        return (h, jnp.zeros((), jnp.float32)), None
+
+
+class BertModel(nn.Module):
+    """Encoder trunk: embeddings (word + position + optional token type,
+    then LN) + scanned post-LN blocks."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        B, S = input_ids.shape
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size))
+        h = jnp.take(embed, input_ids, axis=0)
+        pos_table = self.param("embed_positions", nn.initializers.normal(0.02),
+                               (cfg.max_position_embeddings + cfg.position_offset,
+                                cfg.hidden_size))
+        h = h + jnp.take(pos_table, jnp.arange(S) + cfg.position_offset, axis=0)[None]
+        if cfg.type_vocab_size > 0:
+            type_table = self.param("embed_token_types", nn.initializers.normal(0.02),
+                                    (cfg.type_vocab_size, cfg.hidden_size))
+            tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+            h = h + jnp.take(type_table, tt, axis=0)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="embed_layernorm")(h)
+        h = constrain_hidden(h)
+
+        block = BertBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False)
+        ScanBlocks = nn.scan(block,
+                             variable_axes={"params": 0},
+                             split_rngs={"params": True, "dropout": True},
+                             in_axes=nn.broadcast,
+                             length=cfg.num_hidden_layers,
+                             metadata_params={nn.PARTITION_NAME: "layers"})
+        (h, _), _ = ScanBlocks(cfg, name="layers")((h, jnp.zeros((), jnp.float32)),
+                                                   attention_mask)
+        return h, embed
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head: transform (dense+gelu+LN) then tied decoder over the
+    vocab. ``labels`` uses the -100 ignore convention; returns
+    ``(loss, logits)`` with labels, logits otherwise."""
+    config: BertConfig
+
+    param_stream_prefix = "model/layers/"
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        h, embed = BertModel(cfg, name="model")(input_ids, attention_mask, token_type_ids)
+        h = nn.Dense(cfg.hidden_size, name="mlm_transform")(h)
+        h = jax.nn.gelu(h, approximate=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="mlm_layernorm")(h)
+        bias = self.param("mlm_bias", nn.initializers.zeros, (cfg.vocab_size,))
+        logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype)) + bias
+        if labels is None:
+            return logits
+        return masked_cross_entropy(logits, labels), logits
+
+    def tp_rule(self, path: str, shape) -> P:
+        return bert_tp_rule(path, shape)
+
+
+class BertForSequenceClassification(nn.Module):
+    """[CLS] pooler (dense+tanh) + classifier; cross-entropy with int
+    labels, returns ``(loss, logits)`` / logits."""
+    config: BertConfig
+    num_labels: int = 2
+
+    param_stream_prefix = "model/layers/"
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        h, _ = BertModel(cfg, name="model")(input_ids, attention_mask, token_type_ids)
+        pooled = jnp.tanh(nn.Dense(cfg.hidden_size, name="pooler")(h[:, 0]))
+        logits = nn.Dense(self.num_labels, name="classifier")(pooled)
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, labels.astype(jnp.int32)[:, None], axis=-1).mean()
+        return loss, logits
+
+    def tp_rule(self, path: str, shape) -> P:
+        return bert_tp_rule(path, shape)
+
+
+def bert_tp_rule(path: str, shape) -> P:
+    """Megatron sharding for the encoder (same column/row split as the
+    decoders; biases on column-parallel layers shard with the features)."""
+    lead = [None] * (len(shape) - 2)
+    if any(s in path for s in ("q_proj/kernel", "k_proj/kernel", "v_proj/kernel", "fc_in/kernel")):
+        return P(*lead, None, "tensor")
+    if any(s in path for s in ("q_proj/bias", "k_proj/bias", "v_proj/bias", "fc_in/bias")):
+        return P(*[None] * (len(shape) - 1), "tensor")
+    if any(s in path for s in ("o_proj/kernel", "fc_out/kernel")):
+        return P(*lead, "tensor", None)
+    if "embed_tokens" in path:
+        return P("tensor", None)
+    return P()
+
+
+def build_bert(preset_or_config="bert-debug", head="mlm", num_labels=2, **overrides):
+    if isinstance(preset_or_config, BertConfig):
+        cfg = preset_or_config
+    else:
+        cfg = BERT_CONFIGS[preset_or_config]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    if head == "mlm":
+        return BertForMaskedLM(cfg)
+    if head in ("classification", "sequence_classification"):
+        return BertForSequenceClassification(cfg, num_labels=num_labels)
+    raise ValueError(f"unknown head {head!r} (mlm | classification)")

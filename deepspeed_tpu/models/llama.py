@@ -407,16 +407,22 @@ def llama_tp_rule(path: str, shape) -> P:
     return P()  # norms, biases, gates replicated
 
 
-def causal_lm_loss(logits, labels):
-    """Next-token cross entropy with -100 ignore mask, fp32."""
-    logits = logits[:, :-1].astype(jnp.float32)
-    targets = labels[:, 1:].astype(jnp.int32)
+def masked_cross_entropy(logits, targets):
+    """Mean token cross entropy in fp32; positions with target -100 are
+    ignored (HF convention). Shared by the causal and MLM heads."""
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.int32)
     mask = (targets != -100)
     safe = jnp.where(mask, targets, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(mask.sum(), 1)
     return jnp.where(mask, nll, 0.0).sum() / denom
+
+
+def causal_lm_loss(logits, labels):
+    """Next-token cross entropy with -100 ignore mask, fp32."""
+    return masked_cross_entropy(logits[:, :-1], labels[:, 1:])
 
 
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
