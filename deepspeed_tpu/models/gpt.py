@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.llama import (RMSNorm, apply_rope, causal_lm_loss, einsum_attention,
-                                        rope_frequencies, _local_attention)
+                                        rope_frequencies, _local_attention, _remat_policy)
 from deepspeed_tpu.sequence.layer import constrain, constrain_hidden, head_to_seq_shard, seq_to_head_shard
 
 
@@ -308,8 +308,7 @@ class GPTModel(nn.Module):
             from deepspeed_tpu.runtime.zero.param_stream import wrap_streaming_block
             block = wrap_streaming_block(block, gpt_tp_rule, self.is_initializing())
         if cfg.remat and not decode:
-            policy = (jax.checkpoint_policies.dots_saveable if cfg.remat_policy == "dots"
-                      else jax.checkpoint_policies.nothing_saveable)
+            policy = _remat_policy(cfg.remat_policy)
             block = nn.remat(block, prevent_cse=False, policy=policy)
         carry0 = (h, jnp.zeros((), jnp.float32))
         if decode:
