@@ -1,1 +1,2 @@
-from deepspeed_tpu.module_inject.auto_tp import AutoTP, default_tp_rule
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, default_tp_rule  # noqa: F401
+from deepspeed_tpu.module_inject.hf_import import from_hf  # noqa: F401

@@ -1,0 +1,407 @@
+"""HuggingFace checkpoint import: torch state_dicts → native param trees.
+
+This is the TPU analogue of the reference's injection/checkpoint-loading
+glue (``deepspeed/module_inject/replace_module.py:183``
+``replace_transformer_layer``, ``module_inject/load_checkpoint.py``,
+``inference/v2/model_implementations`` parameter maps): where the
+reference surgically replaces torch modules around existing HF weights,
+here the weights are CONVERTED once into the framework's scan-stacked
+flax layout and the native models (``models/llama.py``, ``models/gpt.py``,
+``models/bert.py``) run them — so a reference user can bring their HF
+checkpoints across unchanged.
+
+Supported model types (``hf_config.model_type``): llama, mistral,
+mixtral*, qwen2 → Llama family; gpt2, opt, bloom → GPT family; bert
+(masked-LM checkpoints) → BERT family. Weights arrive as a ``state_dict()`` mapping
+or an in-memory HF model; per-layer tensors are stacked on the leading
+scan dim. (*mixtral routing weights are mapped onto the framework's MoE
+layer: w1/w3/w2 stacks + gate.)
+
+Every function is pure numpy — no torch import is required unless you
+pass torch tensors (they are converted via ``.detach().cpu().numpy()``).
+"""
+
+import numpy as np
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _t(t):
+    return _np(t).T.copy()
+
+
+def _stack(state, fmt, n_layers, transform=_t):
+    return np.stack([transform(state[fmt.format(i)]) for i in range(n_layers)])
+
+
+# ---------------------------------------------------------------------------
+# Llama family (llama / mistral / qwen2 / mixtral)
+# ---------------------------------------------------------------------------
+
+def import_llama(state, hf_config):
+    """HF ``{Llama,Mistral,Mixtral,Qwen2}ForCausalLM`` state_dict → params
+    for :class:`deepspeed_tpu.models.llama.LlamaForCausalLM`."""
+    L = hf_config.num_hidden_layers
+    moe = getattr(hf_config, "num_local_experts", 0) or 0
+
+    attn = {
+        "q_proj": {"kernel": _stack(state, "model.layers.{}.self_attn.q_proj.weight", L)},
+        "k_proj": {"kernel": _stack(state, "model.layers.{}.self_attn.k_proj.weight", L)},
+        "v_proj": {"kernel": _stack(state, "model.layers.{}.self_attn.v_proj.weight", L)},
+        "o_proj": {"kernel": _stack(state, "model.layers.{}.self_attn.o_proj.weight", L)},
+    }
+    for p in ("q_proj", "k_proj", "v_proj"):
+        bias_key = f"model.layers.0.self_attn.{p}.bias"
+        if bias_key in state:  # Qwen2
+            attn[p]["bias"] = _stack(state, f"model.layers.{{}}.self_attn.{p}.bias", L, _np)
+
+    layers = {
+        "self_attn": attn,
+        "input_layernorm": {"scale": _stack(state, "model.layers.{}.input_layernorm.weight", L, _np)},
+        "post_attention_layernorm": {
+            "scale": _stack(state, "model.layers.{}.post_attention_layernorm.weight", L, _np)},
+    }
+    if moe:
+        E = moe
+        def experts(i, w):
+            return np.stack([_t(state[f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"])
+                             for e in range(E)])
+        layers["moe_mlp"] = {"deepspeed_moe": {
+            "gate": {"wg": {"kernel": _stack(state, "model.layers.{}.block_sparse_moe.gate.weight", L)}},
+            "experts_w1": np.stack([experts(i, "w1") for i in range(L)]),
+            "experts_w3": np.stack([experts(i, "w3") for i in range(L)]),
+            "experts_w2": np.stack([experts(i, "w2") for i in range(L)]),
+        }}
+    else:
+        layers["mlp"] = {
+            "gate_proj": {"kernel": _stack(state, "model.layers.{}.mlp.gate_proj.weight", L)},
+            "up_proj": {"kernel": _stack(state, "model.layers.{}.mlp.up_proj.weight", L)},
+            "down_proj": {"kernel": _stack(state, "model.layers.{}.mlp.down_proj.weight", L)},
+        }
+
+    params = {"model": {
+        "embed_tokens": _np(state["model.embed_tokens.weight"]),
+        "layers": layers,
+        "norm": {"scale": _np(state["model.norm.weight"])},
+    }}
+    if not getattr(hf_config, "tie_word_embeddings", False):
+        params["lm_head"] = {"kernel": _t(state["lm_head.weight"])}
+    return params
+
+
+def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
+    from deepspeed_tpu.models.llama import LlamaConfig
+    moe = getattr(hf_config, "num_local_experts", 0) or 0
+    if getattr(hf_config, "rope_scaling", None):
+        # Llama-3.x rescales inv_freq; importing without it would produce
+        # silently wrong logits — refuse rather than diverge.
+        raise NotImplementedError(
+            f"rope_scaling={hf_config.rope_scaling!r} is not supported by the importer; "
+            f"only plain rope_theta checkpoints (Llama-2 family) convert exactly")
+    sw = getattr(hf_config, "sliding_window", None)
+    if sw and sw < hf_config.max_position_embeddings and not ignore_sliding_window:
+        raise NotImplementedError(
+            f"sliding_window={sw}: the native model attends fully causally, so logits "
+            f"diverge past the window. Pass ignore_sliding_window=True to accept "
+            f"full-attention semantics (exact for sequences <= {sw} tokens)")
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=getattr(hf_config, "num_key_value_heads",
+                                    hf_config.num_attention_heads),
+        max_position_embeddings=hf_config.max_position_embeddings,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        attention_bias=getattr(hf_config, "attention_bias", False)
+        or hf_config.model_type == "qwen2",
+        moe_num_experts=moe,
+        moe_top_k=getattr(hf_config, "num_experts_per_tok", 2) if moe else 2,
+        **overrides)
+
+
+# ---------------------------------------------------------------------------
+# GPT family (gpt2 / opt / bloom)
+# ---------------------------------------------------------------------------
+
+def import_gpt2(state, hf_config):
+    L = hf_config.num_hidden_layers
+    D = hf_config.hidden_size
+
+    def split_qkv(i):
+        w = _np(state[f"transformer.h.{i}.attn.c_attn.weight"])  # Conv1D: [D, 3D]
+        b = _np(state[f"transformer.h.{i}.attn.c_attn.bias"])
+        return (w[:, :D], w[:, D:2 * D], w[:, 2 * D:]), (b[:D], b[D:2 * D], b[2 * D:])
+
+    qkv = [split_qkv(i) for i in range(L)]
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": np.stack([w[0] for w, _ in qkv]),
+                       "bias": np.stack([b[0] for _, b in qkv])},
+            "k_proj": {"kernel": np.stack([w[1] for w, _ in qkv]),
+                       "bias": np.stack([b[1] for _, b in qkv])},
+            "v_proj": {"kernel": np.stack([w[2] for w, _ in qkv]),
+                       "bias": np.stack([b[2] for _, b in qkv])},
+            "o_proj": {"kernel": _stack(state, "transformer.h.{}.attn.c_proj.weight", L, _np),
+                       "bias": _stack(state, "transformer.h.{}.attn.c_proj.bias", L, _np)},
+        },
+        "input_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_1.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_1.bias", L, _np)}},
+        "post_attention_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_2.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_2.bias", L, _np)}},
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "transformer.h.{}.mlp.c_fc.weight", L, _np),
+                      "bias": _stack(state, "transformer.h.{}.mlp.c_fc.bias", L, _np)},
+            "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.c_proj.weight", L, _np),
+                       "bias": _stack(state, "transformer.h.{}.mlp.c_proj.bias", L, _np)},
+        },
+    }
+    return {"model": {
+        "embed_tokens": _np(state["transformer.wte.weight"]),
+        "embed_positions": _np(state["transformer.wpe.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
+                            "bias": _np(state["transformer.ln_f.bias"])},
+    }}
+
+
+def import_opt(state, hf_config):
+    if hf_config.word_embed_proj_dim != hf_config.hidden_size:
+        raise NotImplementedError(
+            f"OPT variant with word_embed_proj_dim={hf_config.word_embed_proj_dim} != "
+            f"hidden_size={hf_config.hidden_size} (e.g. opt-350m): the project_in/out "
+            f"layers have no native mapping")
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise NotImplementedError(
+            "OPT with do_layer_norm_before=False (post-LN, e.g. opt-350m) does not map "
+            "onto the pre-LN native decoder")
+    L = hf_config.num_hidden_layers
+    pre = "model.decoder."
+
+    def lin(name, i):
+        return {"kernel": _t(state[f"{pre}layers.{i}.{name}.weight"]),
+                "bias": _np(state[f"{pre}layers.{i}.{name}.bias"])}
+
+    def stack_lin(name):
+        per = [lin(name, i) for i in range(L)]
+        return {"kernel": np.stack([p["kernel"] for p in per]),
+                "bias": np.stack([p["bias"] for p in per])}
+
+    def stack_ln(name):
+        return {"norm": {
+            "scale": _stack(state, pre + "layers.{}." + name + ".weight", L, _np),
+            "bias": _stack(state, pre + "layers.{}." + name + ".bias", L, _np)}}
+
+    layers = {
+        "attn": {"q_proj": stack_lin("self_attn.q_proj"),
+                 "k_proj": stack_lin("self_attn.k_proj"),
+                 "v_proj": stack_lin("self_attn.v_proj"),
+                 "o_proj": stack_lin("self_attn.out_proj")},
+        "input_layernorm": stack_ln("self_attn_layer_norm"),
+        "post_attention_layernorm": stack_ln("final_layer_norm"),
+        "mlp": {"fc_in": stack_lin("fc1"), "fc_out": stack_lin("fc2")},
+    }
+    return {"model": {
+        "embed_tokens": _np(state[pre + "embed_tokens.weight"]),
+        # HF OPT's table already contains the 2 reserved offset rows
+        "embed_positions": _np(state[pre + "embed_positions.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state[pre + "final_layer_norm.weight"]),
+                            "bias": _np(state[pre + "final_layer_norm.bias"])},
+    }}
+
+
+def import_bloom(state, hf_config):
+    L = hf_config.n_layer
+    D = hf_config.hidden_size
+    H = hf_config.n_head
+    Dh = D // H
+
+    def split_qkv(i):
+        # Bloom fuses QKV per head: weight [3D, D] viewed [H, 3, Dh, D]
+        w = _np(state[f"transformer.h.{i}.self_attention.query_key_value.weight"])
+        b = _np(state[f"transformer.h.{i}.self_attention.query_key_value.bias"])
+        w = w.reshape(H, 3, Dh, D)
+        b = b.reshape(H, 3, Dh)
+        ws = [w[:, j].reshape(H * Dh, D).T.copy() for j in range(3)]  # [D, D] each
+        bs = [b[:, j].reshape(H * Dh) for j in range(3)]
+        return ws, bs
+
+    qkv = [split_qkv(i) for i in range(L)]
+
+    def stack_ln(name):
+        return {"norm": {
+            "scale": _stack(state, "transformer.h.{}." + name + ".weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}." + name + ".bias", L, _np)}}
+
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": np.stack([w[0] for w, _ in qkv]),
+                       "bias": np.stack([b[0] for _, b in qkv])},
+            "k_proj": {"kernel": np.stack([w[1] for w, _ in qkv]),
+                       "bias": np.stack([b[1] for _, b in qkv])},
+            "v_proj": {"kernel": np.stack([w[2] for w, _ in qkv]),
+                       "bias": np.stack([b[2] for _, b in qkv])},
+            "o_proj": {"kernel": _stack(state, "transformer.h.{}.self_attention.dense.weight", L),
+                       "bias": _stack(state, "transformer.h.{}.self_attention.dense.bias", L, _np)},
+        },
+        "input_layernorm": stack_ln("input_layernorm"),
+        "post_attention_layernorm": stack_ln("post_attention_layernorm"),
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_h_to_4h.weight", L),
+                      "bias": _stack(state, "transformer.h.{}.mlp.dense_h_to_4h.bias", L, _np)},
+            "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_4h_to_h.weight", L),
+                       "bias": _stack(state, "transformer.h.{}.mlp.dense_4h_to_h.bias", L, _np)},
+        },
+    }
+    return {"model": {
+        "embed_tokens": _np(state["transformer.word_embeddings.weight"]),
+        "embed_layernorm": {"scale": _np(state["transformer.word_embeddings_layernorm.weight"]),
+                            "bias": _np(state["transformer.word_embeddings_layernorm.bias"])},
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
+                            "bias": _np(state["transformer.ln_f.bias"])},
+    }}
+
+
+def gpt_config_from_hf(hf_config, **overrides):
+    from deepspeed_tpu.models.gpt import GPTConfig
+    mt = hf_config.model_type
+    if mt == "gpt2":
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
+                         intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+                         num_hidden_layers=hf_config.n_layer,
+                         num_attention_heads=hf_config.n_head,
+                         num_key_value_heads=hf_config.n_head,
+                         max_position_embeddings=hf_config.n_positions,
+                         activation="gelu_new", layer_norm_eps=hf_config.layer_norm_epsilon,
+                         **overrides)
+    if mt == "opt":
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                         intermediate_size=hf_config.ffn_dim,
+                         num_hidden_layers=hf_config.num_hidden_layers,
+                         num_attention_heads=hf_config.num_attention_heads,
+                         num_key_value_heads=hf_config.num_attention_heads,
+                         max_position_embeddings=hf_config.max_position_embeddings,
+                         activation="relu", learned_pos_offset=2, layer_norm_eps=1e-5,
+                         **overrides)
+    if mt == "bloom":
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                         intermediate_size=4 * hf_config.hidden_size,
+                         num_hidden_layers=hf_config.n_layer,
+                         num_attention_heads=hf_config.n_head,
+                         num_key_value_heads=hf_config.n_head,
+                         max_position_embeddings=2048,
+                         position_embedding="alibi", embedding_layernorm=True,
+                         activation="gelu_new", layer_norm_eps=hf_config.layer_norm_epsilon,
+                         **overrides)
+    raise ValueError(f"unsupported GPT-family model_type {mt!r}")
+
+
+# ---------------------------------------------------------------------------
+# BERT family
+# ---------------------------------------------------------------------------
+
+def import_bert(state, hf_config):
+    L = hf_config.num_hidden_layers
+    pre = "bert." if any(k.startswith("bert.") for k in state) else ""
+
+    def stack_lin(name):
+        return {"kernel": _stack(state, pre + "encoder.layer.{}." + name + ".weight", L),
+                "bias": _stack(state, pre + "encoder.layer.{}." + name + ".bias", L, _np)}
+
+    def stack_ln(name):
+        return {"scale": _stack(state, pre + "encoder.layer.{}." + name + ".weight", L, _np),
+                "bias": _stack(state, pre + "encoder.layer.{}." + name + ".bias", L, _np)}
+
+    layers = {
+        "q_proj": stack_lin("attention.self.query"),
+        "k_proj": stack_lin("attention.self.key"),
+        "v_proj": stack_lin("attention.self.value"),
+        "o_proj": stack_lin("attention.output.dense"),
+        "attn_layernorm": stack_ln("attention.output.LayerNorm"),
+        "fc_in": stack_lin("intermediate.dense"),
+        "fc_out": stack_lin("output.dense"),
+        "ffn_layernorm": stack_ln("output.LayerNorm"),
+    }
+    params = {"model": {
+        "embed_tokens": _np(state[pre + "embeddings.word_embeddings.weight"]),
+        "embed_positions": _np(state[pre + "embeddings.position_embeddings.weight"]),
+        "embed_layernorm": {"scale": _np(state[pre + "embeddings.LayerNorm.weight"]),
+                            "bias": _np(state[pre + "embeddings.LayerNorm.bias"])},
+        "layers": layers,
+    }}
+    tt_key = pre + "embeddings.token_type_embeddings.weight"
+    if tt_key in state:
+        params["model"]["embed_token_types"] = _np(state[tt_key])
+    if "cls.predictions.transform.dense.weight" in state:
+        params["mlm_transform"] = {"kernel": _t(state["cls.predictions.transform.dense.weight"]),
+                                   "bias": _np(state["cls.predictions.transform.dense.bias"])}
+        params["mlm_layernorm"] = {"scale": _np(state["cls.predictions.transform.LayerNorm.weight"]),
+                                   "bias": _np(state["cls.predictions.transform.LayerNorm.bias"])}
+        params["mlm_bias"] = _np(state["cls.predictions.bias"])
+    return params
+
+
+def bert_config_from_hf(hf_config, **overrides):
+    from deepspeed_tpu.models.bert import BertConfig
+    return BertConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                      intermediate_size=hf_config.intermediate_size,
+                      num_hidden_layers=hf_config.num_hidden_layers,
+                      num_attention_heads=hf_config.num_attention_heads,
+                      max_position_embeddings=hf_config.max_position_embeddings,
+                      type_vocab_size=getattr(hf_config, "type_vocab_size", 0),
+                      layer_norm_eps=hf_config.layer_norm_eps, **overrides)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+_LLAMA_TYPES = ("llama", "mistral", "mixtral", "qwen2")
+
+
+def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
+    """HF model (or state_dict + config) → ``(native_model, params)``.
+
+    >>> hf = transformers.AutoModelForCausalLM.from_pretrained(...)
+    >>> model, params = from_hf(hf)
+    >>> engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params, ...)
+    """
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = dict(hf_model_or_state)
+    mt = hf_config.model_type
+    if mt in _LLAMA_TYPES:
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        cfg = llama_config_from_hf(hf_config, ignore_sliding_window=ignore_sliding_window)
+        return LlamaForCausalLM(cfg), import_llama(state, hf_config)
+    if mt == "gpt2":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt2(state, hf_config)
+    if mt == "opt":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_opt(state, hf_config)
+    if mt == "bloom":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_bloom(state, hf_config)
+    if mt == "bert":
+        if "cls.predictions.transform.dense.weight" not in state:
+            raise NotImplementedError(
+                "only BertForMaskedLM checkpoints are supported (the state_dict has no "
+                "cls.predictions MLM head; classifier heads have no native mapping)")
+        from deepspeed_tpu.models.bert import BertForMaskedLM
+        return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
+    raise ValueError(
+        f"unsupported model_type {mt!r}; supported: {_LLAMA_TYPES + ('gpt2', 'opt', 'bloom', 'bert')}")
