@@ -50,6 +50,11 @@ class LlamaConfig:
     # Qwen2-style QKV biases (Llama/Mistral/Mixtral: False)
     attention_bias: bool = False
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash"
+    # sequence parallelism: "ulysses" trades seq shards for head shards
+    # around local attention (bounded by head count); "ring" keeps the
+    # sequence sharded and rotates K/V blocks over the ICI ring
+    # (sequence/ring_attention.py) — scales past the head count
+    sp_impl: str = "ulysses"  # "ulysses" | "ring"
     remat: bool = True
     # "full" recomputes everything in backward (min memory, ~8N flops);
     # "dots" saves matmul outputs and recomputes elementwise (the usual
@@ -245,17 +250,25 @@ class LlamaAttention(nn.Module):
             out = out.reshape(B, S, H * Dh)
             return nn.Dense(D, use_bias=False, name="o_proj")(out), new_cache
 
-        # GQA: expand kv heads to match q heads
-        if Hkv != H:
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
-
-        # Ulysses: trade sequence shard for head shard around local attention
-        q = seq_to_head_shard(q)
-        k = seq_to_head_shard(k)
-        v = seq_to_head_shard(v)
-        out = _local_attention(q, k, v, cfg.attention_impl, causal=True)
-        out = head_to_seq_shard(out)
+        if cfg.sp_impl == "ring":
+            # Ring context parallelism: stay sequence-sharded; K/V blocks
+            # rotate over the 'sequence' axis (no seq↔head exchange).
+            # GQA K/V travel the ring unexpanded (H/Hkv less traffic).
+            from deepspeed_tpu.sequence.ring_attention import ring_attention
+            out = ring_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+        elif cfg.sp_impl == "ulysses":
+            # GQA: expand kv heads to match q heads
+            if Hkv != H:
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
+            # Ulysses: trade sequence shard for head shard around local attention
+            q = seq_to_head_shard(q)
+            k = seq_to_head_shard(k)
+            v = seq_to_head_shard(v)
+            out = _local_attention(q, k, v, cfg.attention_impl, causal=True)
+            out = head_to_seq_shard(out)
+        else:
+            raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}: expected 'ulysses' or 'ring'")
 
         out = out.reshape(B, S, H * Dh)
         return nn.Dense(D, use_bias=False, name="o_proj")(out), None

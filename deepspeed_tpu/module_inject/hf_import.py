@@ -103,6 +103,8 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
             f"rope_scaling={hf_config.rope_scaling!r} is not supported by the importer; "
             f"only plain rope_theta checkpoints (Llama-2 family) convert exactly")
     sw = getattr(hf_config, "sliding_window", None)
+    if not getattr(hf_config, "use_sliding_window", True):
+        sw = None  # Qwen2-style configs carry a window but disable it
     if sw and sw < hf_config.max_position_embeddings and not ignore_sliding_window:
         raise NotImplementedError(
             f"sliding_window={sw}: the native model attends fully causally, so logits "
