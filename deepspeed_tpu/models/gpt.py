@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.llama import (RMSNorm, apply_rope, causal_lm_loss, einsum_attention,
-                                        rope_frequencies, _local_attention, _remat_policy)
+                                        repeat_kv, rope_frequencies, _local_attention,
+                                        _remat_policy)
 from deepspeed_tpu.sequence.layer import constrain, constrain_hidden, head_to_seq_shard, seq_to_head_shard
 
 
@@ -197,10 +198,7 @@ class GPTAttention(nn.Module):
             v_full = jax.lax.dynamic_update_slice(
                 layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, start, 0, 0))
             new_cache = {"k": k_full, "v": v_full}
-            kx, vx = k_full, v_full
-            if Hkv != H:
-                kx = jnp.repeat(kx, H // Hkv, axis=2)
-                vx = jnp.repeat(vx, H // Hkv, axis=2)
+            kx, vx = repeat_kv(k_full, v_full, H // Hkv)
             s_max = kx.shape[1]
             k_idx = jnp.arange(s_max)[None, :]
             q_pos = (start + jnp.arange(S))[:, None]
@@ -212,9 +210,7 @@ class GPTAttention(nn.Module):
             out = out.reshape(B, S, H * Dh)
             return nn.Dense(D, use_bias=cfg.attention_bias, name="o_proj")(out), new_cache
 
-        if Hkv != H:
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
+        k, v = repeat_kv(k, v, H // Hkv)
 
         if cfg.position_embedding == "alibi":
             # Bias tensors are O(S^2): the flash path gains nothing, so

@@ -162,6 +162,14 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
+def repeat_kv(k, v, n_rep: int):
+    """GQA head expansion on [.., S, Hkv, D] K/V (shared by every
+    attention path; no-op when n_rep == 1)."""
+    if n_rep == 1:
+        return k, v
+    return jnp.repeat(k, n_rep, axis=-2), jnp.repeat(v, n_rep, axis=-2)
+
+
 def einsum_attention(q, k, v, causal=True, bias=None, mask=None):
     """Reference attention: [B, S, H, D] → [B, S, H, D]; softmax in fp32.
 
@@ -237,10 +245,7 @@ class LlamaAttention(nn.Module):
             v_full = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype),
                                                   (0, start, 0, 0))
             new_cache = {"k": k_full, "v": v_full}
-            kx, vx = k_full, v_full
-            if Hkv != H:
-                kx = jnp.repeat(kx, H // Hkv, axis=2)
-                vx = jnp.repeat(vx, H // Hkv, axis=2)
+            kx, vx = repeat_kv(k_full, v_full, H // Hkv)
             # token t may attend to cache positions <= start + t
             s_max = kx.shape[1]
             k_idx = jnp.arange(s_max)[None, :]
@@ -258,9 +263,7 @@ class LlamaAttention(nn.Module):
             out = ring_attention(q, k, v, causal=True, impl=cfg.attention_impl)
         elif cfg.sp_impl == "ulysses":
             # GQA: expand kv heads to match q heads
-            if Hkv != H:
-                k = jnp.repeat(k, H // Hkv, axis=2)
-                v = jnp.repeat(v, H // Hkv, axis=2)
+            k, v = repeat_kv(k, v, H // Hkv)
             # Ulysses: trade sequence shard for head shard around local attention
             q = seq_to_head_shard(q)
             k = seq_to_head_shard(k)

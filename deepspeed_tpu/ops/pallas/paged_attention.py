@@ -37,9 +37,8 @@ def xla_paged_attention(q, kc, vc, block_tables, token_pos, alibi_slopes=None):
     ks = kc[block_tables].reshape(T, -1, Hkv, Dh).astype(q.dtype)
     vs = vc[block_tables].reshape(T, -1, Hkv, Dh).astype(q.dtype)
     if Hkv != H:
-        rep = H // Hkv
-        ks = jnp.repeat(ks, rep, axis=2)
-        vs = jnp.repeat(vs, rep, axis=2)
+        from deepspeed_tpu.models.llama import repeat_kv
+        ks, vs = repeat_kv(ks, vs, H // Hkv)
     scale = 1.0 / np.sqrt(Dh)
     scores = jnp.einsum("thd,tchd->thc", q, ks).astype(jnp.float32) * scale
     k_idx = jnp.arange(ks.shape[1])

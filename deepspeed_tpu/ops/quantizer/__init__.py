@@ -1,0 +1,82 @@
+"""Integer quantization ops (reference ``deepspeed/ops/quantizer/``:
+``ds_quantizer`` over ``csrc/quantization``'s INT4/INT8 kernels).
+
+TPU form: symmetric per-group quantization built on the Pallas int8
+kernels (``ops/pallas/quantization.py``); INT4 packs two nibbles per
+int8 byte after the same per-group scaling (the reference's
+``quantize_intX`` layout). All functions are jittable.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.quantization import dequantize_int8, quantize_int8
+
+
+def quantize_int4(x, group_size=2048, stochastic=False, seed=0):
+    """Symmetric per-group INT4: → (packed uint8 [n/2], scales, shape).
+
+    Values are scaled to [-7, 7] per group and packed two-per-byte
+    (low nibble first)."""
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % group_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    g = flat.reshape(-1, group_size).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / 7.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = g / scale
+    if stochastic:
+        key = jax.random.PRNGKey(seed)
+        q = q + jax.random.uniform(key, q.shape, minval=-0.5, maxval=0.5)
+    q = jnp.clip(jnp.round(q), -7, 7).astype(jnp.int8).reshape(-1)
+    if q.shape[0] % 2:  # odd total (odd group_size): pad one nibble
+        q = jnp.concatenate([q, jnp.zeros((1,), q.dtype)])
+    # pack: two signed nibbles per byte (offset to [0, 14] first)
+    u = (q + 7).astype(jnp.uint8)
+    packed = (u[0::2] | (u[1::2] << 4)).astype(jnp.uint8)
+    return packed, scale[:, 0], orig_shape
+
+
+def dequantize_int4(packed, scales, orig_shape, group_size=2048, dtype=jnp.float32):
+    lo = (packed & 0xF).astype(jnp.int32) - 7
+    hi = (packed >> 4).astype(jnp.int32) - 7
+    q = jnp.stack([lo, hi], axis=1).reshape(-1).astype(jnp.float32)
+    total = scales.shape[0] * group_size  # drop the odd-length pack pad
+    g = q[:total].reshape(-1, group_size) * scales[:, None]
+    n = 1
+    for d in orig_shape:
+        n *= d
+    return g.reshape(-1)[:n].reshape(orig_shape).astype(dtype)
+
+
+def ds_quantizer(input, groups=1, bit_num=8, sr=False, asym=False, seed=None):
+    """Reference API shape (``deepspeed.ops.quantizer.ds_quantizer``):
+    quantize-dequantize ``input`` in ``groups`` row groups at
+    ``bit_num`` ∈ {4, 8} precision; symmetric only (``asym`` raises).
+    Returns the fake-quantized tensor (training-time QAT use).
+
+    ``sr`` (stochastic rounding) requires a STEP-VARYING ``seed`` — a
+    fixed seed would repeat the same rounding pattern every step,
+    turning zero-mean noise into a fixed bias."""
+    if asym:
+        raise NotImplementedError("asymmetric quantization is not supported; "
+                                  "use symmetric (asym=False)")
+    if bit_num not in (4, 8):
+        raise ValueError(f"bit_num must be 4 or 8, got {bit_num}")
+    if sr and seed is None:
+        raise ValueError("sr=True needs a step-varying seed= (e.g. the global step)")
+    seed = 0 if seed is None else seed
+    n = input.size
+    group_size = max(n // max(int(groups), 1), 1)
+    if bit_num == 8:
+        v, s, shape = quantize_int8(input, group_size=group_size, stochastic=sr, seed=seed)
+        return dequantize_int8(v, s, shape, dtype=input.dtype)
+    packed, s, shape = quantize_int4(input, group_size=group_size, stochastic=sr, seed=seed)
+    return dequantize_int4(packed, s, shape, group_size=group_size, dtype=input.dtype)
+
+
+__all__ = ["ds_quantizer", "quantize_int4", "dequantize_int4",
+           "quantize_int8", "dequantize_int8"]

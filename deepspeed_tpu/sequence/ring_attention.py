@@ -40,9 +40,8 @@ def _block_update(q, k, v, m, l, acc, q_pos, k_pos, causal, scale):
     if k.shape[2] != q.shape[2]:
         # GQA: blocks travel the ring with Hkv heads (H/Hkv less traffic);
         # expansion is shard-local, just-in-time for the score matmul
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        from deepspeed_tpu.models.llama import repeat_kv
+        k, v = repeat_kv(k, v, q.shape[2] // k.shape[2])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
@@ -103,11 +102,12 @@ def ring_attention(q, k, v, causal=True, sm_scale=None, axis="sequence", mesh=No
     mesh = mesh if mesh is not None else groups.get_mesh(required=False)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else {}
     if sizes.get(axis, 1) <= 1:
-        from deepspeed_tpu.models.llama import _local_attention
-        if k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        from deepspeed_tpu.models.llama import _local_attention, repeat_kv
+        k, v = repeat_kv(k, v, q.shape[2] // k.shape[2])
+        if sm_scale is not None:
+            # _local_attention hardcodes 1/sqrt(D); fold the caller's
+            # scale into q so both topologies compute the same scores
+            q = q * (sm_scale * np.sqrt(q.shape[-1]))
         return _local_attention(q, k, v, impl, causal=causal)
     from deepspeed_tpu.ops.pallas import current_manual_axes
     if current_manual_axes():

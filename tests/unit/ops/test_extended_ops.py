@@ -155,3 +155,39 @@ class TestModelPresets:
         assert "bias" not in attn["o_proj"]
         loss, _ = m.apply({"params": p}, ids, ids)
         assert np.isfinite(float(loss))
+
+
+class TestDsQuantizer:
+    """ops/quantizer parity (reference ds_quantizer over csrc/quantization
+    INT4/INT8): round-trip error bounded by the per-group step size."""
+
+    def test_int8_round_trip(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.quantizer import ds_quantizer
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 256).astype(np.float32))
+        y = ds_quantizer(x, groups=4, bit_num=8)
+        step = float(jnp.abs(x).max()) / 127
+        assert float(jnp.abs(y - x).max()) <= step * 1.01
+        assert y.shape == x.shape and y.dtype == x.dtype
+
+    def test_int4_round_trip_and_packing(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.quantizer import dequantize_int4, ds_quantizer, quantize_int4
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 256).astype(np.float32))
+        packed, scales, shape = quantize_int4(x, group_size=128)
+        assert packed.dtype == jnp.uint8 and packed.size == x.size // 2
+        y = dequantize_int4(packed, scales, shape, group_size=128)
+        step = float(jnp.abs(x).max()) / 7
+        assert float(jnp.abs(y - x).max()) <= step * 1.01
+        y2 = ds_quantizer(x, groups=4, bit_num=4)
+        assert float(jnp.abs(y2 - x).max()) <= step * 1.01
+
+    def test_asym_raises(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import pytest
+        from deepspeed_tpu.ops.quantizer import ds_quantizer
+        with pytest.raises(NotImplementedError):
+            ds_quantizer(jnp.zeros((4, 4)), asym=True)
