@@ -57,6 +57,7 @@ class GPTConfig:
     activation: str = "gelu"             # "gelu" | "gelu_new" | "relu"
     attention_bias: bool = True
     mlp_bias: bool = True
+    lm_head_bias: bool = False           # Phi: biased untied head
     tie_word_embeddings: bool = True
     attention_impl: str = "auto"
     remat: bool = True
@@ -345,7 +346,7 @@ class GPTForCausalLM(nn.Module):
         if cfg.tie_word_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, name="lm_head")(h)
         if decode:
             return logits, new_cache
         logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))

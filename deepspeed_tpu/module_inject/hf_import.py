@@ -306,7 +306,177 @@ def gpt_config_from_hf(hf_config, **overrides):
                          position_embedding="alibi", embedding_layernorm=True,
                          activation="gelu_new", layer_norm_eps=hf_config.layer_norm_epsilon,
                          **overrides)
+    if mt == "gpt_neox":
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                         intermediate_size=hf_config.intermediate_size,
+                         num_hidden_layers=hf_config.num_hidden_layers,
+                         num_attention_heads=hf_config.num_attention_heads,
+                         num_key_value_heads=hf_config.num_attention_heads,
+                         max_position_embeddings=hf_config.max_position_embeddings,
+                         position_embedding="rope", rotary_pct=hf_config.rotary_pct,
+                         rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+                         parallel_block=True, parallel_two_norms=True,
+                         activation="gelu" if hf_config.hidden_act == "gelu" else "gelu_new",
+                         tie_word_embeddings=False,
+                         layer_norm_eps=hf_config.layer_norm_eps, **overrides)
+    if mt == "falcon":
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                         intermediate_size=4 * hf_config.hidden_size,
+                         num_hidden_layers=hf_config.num_hidden_layers,
+                         num_attention_heads=hf_config.num_attention_heads,
+                         num_key_value_heads=1,
+                         max_position_embeddings=getattr(hf_config, "max_position_embeddings", 2048),
+                         position_embedding="rope",
+                         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+                         parallel_block=True, attention_bias=bool(hf_config.bias),
+                         mlp_bias=bool(hf_config.bias),
+                         tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)),
+                         layer_norm_eps=hf_config.layer_norm_epsilon, **overrides)
+    if mt == "phi":
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                         intermediate_size=hf_config.intermediate_size,
+                         num_hidden_layers=hf_config.num_hidden_layers,
+                         num_attention_heads=hf_config.num_attention_heads,
+                         num_key_value_heads=getattr(hf_config, "num_key_value_heads", None)
+                         or hf_config.num_attention_heads,
+                         max_position_embeddings=hf_config.max_position_embeddings,
+                         position_embedding="rope",
+                         rotary_pct=getattr(hf_config, "partial_rotary_factor", 1.0),
+                         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+                         parallel_block=True, activation="gelu_new",
+                         tie_word_embeddings=False, lm_head_bias=True,
+                         layer_norm_eps=hf_config.layer_norm_eps, **overrides)
     raise ValueError(f"unsupported GPT-family model_type {mt!r}")
+
+
+def import_gpt_neox(state, hf_config):
+    if not getattr(hf_config, "use_parallel_residual", True):
+        raise NotImplementedError(
+            "GPT-NeoX with use_parallel_residual=False does not map onto the "
+            "parallel-block native decoder")
+    L = hf_config.num_hidden_layers
+    D = hf_config.hidden_size
+    H = hf_config.num_attention_heads
+    Dh = D // H
+
+    def split_qkv(i):
+        # NeoX fuses QKV per head: weight [3D, D] viewed [H, 3*Dh, D]
+        w = _np(state[f"gpt_neox.layers.{i}.attention.query_key_value.weight"]).reshape(
+            H, 3 * Dh, D)
+        b = _np(state[f"gpt_neox.layers.{i}.attention.query_key_value.bias"]).reshape(
+            H, 3 * Dh)
+        ws = [w[:, j * Dh:(j + 1) * Dh, :].reshape(H * Dh, D).T.copy() for j in range(3)]
+        bs = [b[:, j * Dh:(j + 1) * Dh].reshape(H * Dh) for j in range(3)]
+        return ws, bs
+
+    qkv = [split_qkv(i) for i in range(L)]
+
+    def stack_ln(name):
+        return {"norm": {
+            "scale": _stack(state, "gpt_neox.layers.{}." + name + ".weight", L, _np),
+            "bias": _stack(state, "gpt_neox.layers.{}." + name + ".bias", L, _np)}}
+
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": np.stack([w[0] for w, _ in qkv]),
+                       "bias": np.stack([b[0] for _, b in qkv])},
+            "k_proj": {"kernel": np.stack([w[1] for w, _ in qkv]),
+                       "bias": np.stack([b[1] for _, b in qkv])},
+            "v_proj": {"kernel": np.stack([w[2] for w, _ in qkv]),
+                       "bias": np.stack([b[2] for _, b in qkv])},
+            "o_proj": {"kernel": _stack(state, "gpt_neox.layers.{}.attention.dense.weight", L),
+                       "bias": _stack(state, "gpt_neox.layers.{}.attention.dense.bias", L, _np)},
+        },
+        # parallel residual with separate norms: input_layernorm feeds
+        # attention, post_attention_layernorm feeds the MLP
+        "input_layernorm": stack_ln("input_layernorm"),
+        "mlp_layernorm": stack_ln("post_attention_layernorm"),
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "gpt_neox.layers.{}.mlp.dense_h_to_4h.weight", L),
+                      "bias": _stack(state, "gpt_neox.layers.{}.mlp.dense_h_to_4h.bias", L, _np)},
+            "fc_out": {"kernel": _stack(state, "gpt_neox.layers.{}.mlp.dense_4h_to_h.weight", L),
+                       "bias": _stack(state, "gpt_neox.layers.{}.mlp.dense_4h_to_h.bias", L, _np)},
+        },
+    }
+    return {"model": {
+        "embed_tokens": _np(state["gpt_neox.embed_in.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["gpt_neox.final_layer_norm.weight"]),
+                            "bias": _np(state["gpt_neox.final_layer_norm.bias"])},
+    }, "lm_head": {"kernel": _t(state["embed_out.weight"])}}
+
+
+def import_falcon(state, hf_config):
+    if getattr(hf_config, "new_decoder_architecture", False) or \
+            not getattr(hf_config, "multi_query", True) or \
+            not getattr(hf_config, "parallel_attn", True):
+        raise NotImplementedError(
+            "only the classic Falcon-7B architecture converts (multi_query=True, "
+            "parallel_attn=True, new_decoder_architecture=False); the 40B two-norm "
+            "GQA layout has no importer yet")
+    L = hf_config.num_hidden_layers
+    D = hf_config.hidden_size
+    H = hf_config.num_attention_heads
+    Dh = D // H
+
+    def split_qkv(i):
+        # MQA fusion: weight [(H+2)*Dh, D] viewed [H+2, Dh, D] — H query
+        # heads then one K and one V head
+        w = _np(state[f"transformer.h.{i}.self_attention.query_key_value.weight"]).reshape(
+            H + 2, Dh, D)
+        q = w[:H].reshape(H * Dh, D).T.copy()
+        k = w[H].reshape(Dh, D).T.copy()
+        v = w[H + 1].reshape(Dh, D).T.copy()
+        return q, k, v
+
+    qkv = [split_qkv(i) for i in range(L)]
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": np.stack([x[0] for x in qkv])},
+            "k_proj": {"kernel": np.stack([x[1] for x in qkv])},
+            "v_proj": {"kernel": np.stack([x[2] for x in qkv])},
+            "o_proj": {"kernel": _stack(state, "transformer.h.{}.self_attention.dense.weight", L)},
+        },
+        "input_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.input_layernorm.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.input_layernorm.bias", L, _np)}},
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_h_to_4h.weight", L)},
+            "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_4h_to_h.weight", L)},
+        },
+    }
+    return {"model": {
+        "embed_tokens": _np(state["transformer.word_embeddings.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
+                            "bias": _np(state["transformer.ln_f.bias"])},
+    }}
+
+
+def import_phi(state, hf_config):
+    L = hf_config.num_hidden_layers
+
+    def stack_lin(name):
+        return {"kernel": _stack(state, "model.layers.{}." + name + ".weight", L),
+                "bias": _stack(state, "model.layers.{}." + name + ".bias", L, _np)}
+
+    layers = {
+        "attn": {"q_proj": stack_lin("self_attn.q_proj"),
+                 "k_proj": stack_lin("self_attn.k_proj"),
+                 "v_proj": stack_lin("self_attn.v_proj"),
+                 "o_proj": stack_lin("self_attn.dense")},
+        "input_layernorm": {"norm": {
+            "scale": _stack(state, "model.layers.{}.input_layernorm.weight", L, _np),
+            "bias": _stack(state, "model.layers.{}.input_layernorm.bias", L, _np)}},
+        "mlp": {"fc_in": stack_lin("mlp.fc1"), "fc_out": stack_lin("mlp.fc2")},
+    }
+    return {"model": {
+        "embed_tokens": _np(state["model.embed_tokens.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["model.final_layernorm.weight"]),
+                            "bias": _np(state["model.final_layernorm.bias"])},
+    }, "lm_head": {"kernel": _t(state["lm_head.weight"]),
+                   "bias": _np(state["lm_head.bias"])}}
 
 
 # ---------------------------------------------------------------------------
@@ -398,6 +568,15 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "bloom":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_bloom(state, hf_config)
+    if mt == "gpt_neox":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt_neox(state, hf_config)
+    if mt == "falcon":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_falcon(state, hf_config)
+    if mt == "phi":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_phi(state, hf_config)
     if mt == "bert":
         if "cls.predictions.transform.dense.weight" not in state:
             raise NotImplementedError(
@@ -406,4 +585,5 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         from deepspeed_tpu.models.bert import BertForMaskedLM
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
-        f"unsupported model_type {mt!r}; supported: {_LLAMA_TYPES + ('gpt2', 'opt', 'bloom', 'bert')}")
+        f"unsupported model_type {mt!r}; supported: "
+        f"{_LLAMA_TYPES + ('gpt2', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert')}")

@@ -88,6 +88,25 @@ class TestHFImportParity:
             vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
         _check(transformers.BloomForCausalLM(cfg), IDS)
 
+    def test_gpt_neox_parallel_two_norms(self):
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64, rotary_pct=0.25)
+        _check(transformers.GPTNeoXForCausalLM(cfg), IDS)
+
+    def test_falcon_mqa(self):
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            new_decoder_architecture=False, multi_query=True, parallel_attn=True,
+            bias=False, max_position_embeddings=64)
+        _check(transformers.FalconForCausalLM(cfg), IDS)
+
+    def test_phi_partial_rotary(self):
+        cfg = transformers.PhiConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64, partial_rotary_factor=0.5)
+        _check(transformers.PhiForCausalLM(cfg), IDS)
+
     def test_bert_mlm(self):
         cfg = transformers.BertConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
