@@ -130,8 +130,17 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
 
 
 # ---------------------------------------------------------------------------
-# GPT family (gpt2 / opt / bloom)
+# GPT family (gpt2 / opt / bloom / gpt_neox / falcon / phi)
 # ---------------------------------------------------------------------------
+
+def _hf_activation(name: str) -> str:
+    """HF hidden_act → native activation name; refuse rather than
+    silently substitute a different function."""
+    table = {"gelu": "gelu", "gelu_new": "gelu_new",
+             "gelu_pytorch_tanh": "gelu_new", "relu": "relu"}
+    if name not in table:
+        raise NotImplementedError(f"hidden_act {name!r} has no exact native mapping")
+    return table[name]
 
 def import_gpt2(state, hf_config):
     L = hf_config.num_hidden_layers
@@ -316,7 +325,7 @@ def gpt_config_from_hf(hf_config, **overrides):
                          position_embedding="rope", rotary_pct=hf_config.rotary_pct,
                          rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
                          parallel_block=True, parallel_two_norms=True,
-                         activation="gelu" if hf_config.hidden_act == "gelu" else "gelu_new",
+                         activation=_hf_activation(hf_config.hidden_act),
                          tie_word_embeddings=False,
                          layer_norm_eps=hf_config.layer_norm_eps, **overrides)
     if mt == "falcon":
@@ -414,6 +423,13 @@ def import_falcon(state, hf_config):
             "only the classic Falcon-7B architecture converts (multi_query=True, "
             "parallel_attn=True, new_decoder_architecture=False); the 40B two-norm "
             "GQA layout has no importer yet")
+    if getattr(hf_config, "alibi", False):
+        raise NotImplementedError("Falcon with alibi=True is not supported (the "
+                                  "importer maps Falcon to rotary positions)")
+    if getattr(hf_config, "bias", False):
+        raise NotImplementedError("Falcon with bias=True is not supported: the fused "
+                                  "QKV bias split is not implemented — refusing rather "
+                                  "than dropping the bias tensors")
     L = hf_config.num_hidden_layers
     D = hf_config.hidden_size
     H = hf_config.num_attention_heads
@@ -445,15 +461,21 @@ def import_falcon(state, hf_config):
             "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_4h_to_h.weight", L)},
         },
     }
-    return {"model": {
+    params = {"model": {
         "embed_tokens": _np(state["transformer.word_embeddings.weight"]),
         "layers": layers,
         "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
                             "bias": _np(state["transformer.ln_f.bias"])},
     }}
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        params["lm_head"] = {"kernel": _t(state["lm_head.weight"])}
+    return params
 
 
 def import_phi(state, hf_config):
+    if getattr(hf_config, "qk_layernorm", False):
+        raise NotImplementedError("Phi with qk_layernorm=True is not supported — the "
+                                  "native attention has no per-head q/k norms")
     L = hf_config.num_hidden_layers
 
     def stack_lin(name):
