@@ -49,6 +49,8 @@ class GPTConfig:
     learned_pos_offset: int = 0          # OPT reserves the first 2 slots
     rotary_pct: float = 1.0              # partial rotary (GPT-J/NeoX/Phi)
     rope_theta: float = 10000.0
+    # GPT-J pairs adjacent dims (rotate_every_two); NeoX/Llama split halves
+    rope_interleaved: bool = False
     parallel_block: bool = False         # GPT-J/Falcon/Phi: attn ∥ mlp off one norm
     parallel_two_norms: bool = False     # GPT-NeoX/Falcon-40B: separate ln_attn/ln_mlp
     norm_type: str = "layernorm"         # "layernorm" | "rmsnorm"
@@ -103,12 +105,14 @@ GPT_CONFIGS = {
                             parallel_two_norms=True, tie_word_embeddings=False),
     "gptj-debug": GPTConfig(vocab_size=256, hidden_size=64, intermediate_size=256,
                             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
-                            position_embedding="rope", rotary_pct=0.5, parallel_block=True,
-                            activation="gelu_new", tie_word_embeddings=False),
+                            position_embedding="rope", rotary_pct=0.5, rope_interleaved=True,
+                            parallel_block=True, activation="gelu_new",
+                            attention_bias=False, lm_head_bias=True, tie_word_embeddings=False),
     "gptj-6b": GPTConfig(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
                          num_hidden_layers=28, num_attention_heads=16, num_key_value_heads=16,
-                         position_embedding="rope", rotary_pct=0.25, parallel_block=True,
-                         activation="gelu_new", tie_word_embeddings=False),
+                         position_embedding="rope", rotary_pct=0.25, rope_interleaved=True,
+                         parallel_block=True, activation="gelu_new",
+                         attention_bias=False, lm_head_bias=True, tie_word_embeddings=False),
     "gpt-neox-20b": GPTConfig(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
                               num_hidden_layers=44, num_attention_heads=64, num_key_value_heads=64,
                               position_embedding="rope", rotary_pct=0.25, parallel_block=True,
@@ -151,6 +155,19 @@ def alibi_bias(num_heads: int, q_positions, k_positions) -> jnp.ndarray:
     return slopes[None, :, None, None] * rel[None, None, :, :]
 
 
+def apply_rope_interleaved(x, cos, sin, positions):
+    """GPT-J-style rotary: adjacent dim PAIRS rotate together
+    (rotate_every_two), vs the half-split layout of ``apply_rope``.
+    x: [B, S, H, D]; cos/sin: [T, D/2]; positions: [1 or B, S]."""
+    c = jnp.asarray(cos)[positions][:, :, None, :]  # [B, S, 1, D/2]
+    s = jnp.asarray(sin)[positions][:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1 = x32[..., 0::2]
+    x2 = x32[..., 1::2]
+    out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 def _activation(name: str):
     return {"gelu": lambda x: nn.gelu(x, approximate=False),
             "gelu_new": lambda x: nn.gelu(x, approximate=True),
@@ -185,12 +202,13 @@ class GPTAttention(nn.Module):
         if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
             rd = cfg.rotary_dim
             cos, sin = rope_frequencies(rd, cfg.max_position_embeddings, cfg.rope_theta)
+            rope = apply_rope_interleaved if cfg.rope_interleaved else apply_rope
             if rd == Dh:
-                q = apply_rope(q, cos, sin, positions)
-                k = apply_rope(k, cos, sin, positions)
+                q = rope(q, cos, sin, positions)
+                k = rope(k, cos, sin, positions)
             else:  # partial rotary (GPT-J/NeoX/Phi): rotate the first rd dims
-                q = jnp.concatenate([apply_rope(q[..., :rd], cos, sin, positions), q[..., rd:]], -1)
-                k = jnp.concatenate([apply_rope(k[..., :rd], cos, sin, positions), k[..., rd:]], -1)
+                q = jnp.concatenate([rope(q[..., :rd], cos, sin, positions), q[..., rd:]], -1)
+                k = jnp.concatenate([rope(k[..., :rd], cos, sin, positions), k[..., rd:]], -1)
 
         if layer_cache is not None:
             start = positions[0, 0]

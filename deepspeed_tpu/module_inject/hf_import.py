@@ -11,8 +11,9 @@ flax layout and the native models (``models/llama.py``, ``models/gpt.py``,
 checkpoints across unchanged.
 
 Supported model types (``hf_config.model_type``): llama, mistral,
-mixtral*, qwen2 → Llama family; gpt2, opt, bloom → GPT family; bert
-(masked-LM checkpoints) → BERT family. Weights arrive as a ``state_dict()`` mapping
+mixtral*, qwen2 → Llama family; gpt2, gptj, opt, bloom, gpt_neox,
+falcon, phi → GPT family; bert (masked-LM checkpoints) → BERT family.
+Weights arrive as a ``state_dict()`` mapping
 or an in-memory HF model; per-layer tensors are stacked on the leading
 scan dim. (*mixtral routing weights are mapped onto the framework's MoE
 layer: w1/w3/w2 stacks + gate.)
@@ -130,7 +131,7 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
 
 
 # ---------------------------------------------------------------------------
-# GPT family (gpt2 / opt / bloom / gpt_neox / falcon / phi)
+# GPT family (gpt2 / gptj / opt / bloom / gpt_neox / falcon / phi)
 # ---------------------------------------------------------------------------
 
 def _hf_activation(name: str) -> str:
@@ -315,6 +316,20 @@ def gpt_config_from_hf(hf_config, **overrides):
                          position_embedding="alibi", embedding_layernorm=True,
                          activation="gelu_new", layer_norm_eps=hf_config.layer_norm_epsilon,
                          **overrides)
+    if mt == "gptj":
+        D, H = hf_config.n_embd, hf_config.n_head
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=D,
+                         intermediate_size=hf_config.n_inner or 4 * D,
+                         num_hidden_layers=hf_config.n_layer,
+                         num_attention_heads=H, num_key_value_heads=H,
+                         max_position_embeddings=hf_config.n_positions,
+                         position_embedding="rope",
+                         rotary_pct=(hf_config.rotary_dim or (D // H)) / (D // H),
+                         rope_interleaved=True, parallel_block=True,
+                         activation=_hf_activation(hf_config.activation_function),
+                         attention_bias=False, lm_head_bias=True,
+                         tie_word_embeddings=False,
+                         layer_norm_eps=hf_config.layer_norm_epsilon, **overrides)
     if mt == "gpt_neox":
         return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
                          intermediate_size=hf_config.intermediate_size,
@@ -356,6 +371,33 @@ def gpt_config_from_hf(hf_config, **overrides):
                          tie_word_embeddings=False, lm_head_bias=True,
                          layer_norm_eps=hf_config.layer_norm_eps, **overrides)
     raise ValueError(f"unsupported GPT-family model_type {mt!r}")
+
+
+def import_gptj(state, hf_config):
+    L = hf_config.n_layer
+
+    def stack_w(name):
+        return {"kernel": _stack(state, "transformer.h.{}." + name + ".weight", L)}
+
+    def stack_wb(name):
+        return {"kernel": _stack(state, "transformer.h.{}." + name + ".weight", L),
+                "bias": _stack(state, "transformer.h.{}." + name + ".bias", L, _np)}
+
+    layers = {
+        "attn": {"q_proj": stack_w("attn.q_proj"), "k_proj": stack_w("attn.k_proj"),
+                 "v_proj": stack_w("attn.v_proj"), "o_proj": stack_w("attn.out_proj")},
+        "input_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_1.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_1.bias", L, _np)}},
+        "mlp": {"fc_in": stack_wb("mlp.fc_in"), "fc_out": stack_wb("mlp.fc_out")},
+    }
+    return {"model": {
+        "embed_tokens": _np(state["transformer.wte.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
+                            "bias": _np(state["transformer.ln_f.bias"])},
+    }, "lm_head": {"kernel": _t(state["lm_head.weight"]),
+                   "bias": _np(state["lm_head.bias"])}}
 
 
 def import_gpt_neox(state, hf_config):
@@ -590,6 +632,9 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "bloom":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_bloom(state, hf_config)
+    if mt == "gptj":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gptj(state, hf_config)
     if mt == "gpt_neox":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt_neox(state, hf_config)
@@ -608,4 +653,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('gpt2', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert')}")
+        f"{_LLAMA_TYPES + ('gpt2', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert')}")

@@ -88,6 +88,12 @@ class TestHFImportParity:
             vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
         _check(transformers.BloomForCausalLM(cfg), IDS)
 
+    def test_gptj_interleaved_rotary(self):
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_embd=32, n_inner=64, n_layer=2, n_head=4, n_positions=64,
+            rotary_dim=4)
+        _check(transformers.GPTJForCausalLM(cfg), IDS)
+
     def test_gpt_neox_parallel_two_norms(self):
         cfg = transformers.GPTNeoXConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
