@@ -1,0 +1,87 @@
+"""Checkpoint round trips for the new model families (GPT / BERT /
+imported-HF weights) — the reference's checkpoint matrix covers many
+model shapes (tests/unit/checkpoint/), not just one fixture model."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_bert, build_gpt
+from deepspeed_tpu.parallel import groups
+
+
+def _make(model, stage=2):
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 8,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def _step(engine, seed=0):
+    ids = np.random.RandomState(seed).randint(0, 250, size=(8, 16)).astype(np.int32)
+    return float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+
+
+@pytest.mark.parametrize("family,builder", [
+    ("gpt", lambda: build_gpt("gpt2-debug")),
+    ("gptj", lambda: build_gpt("gptj-debug")),
+    ("bert", lambda: build_bert("bert-debug")),
+])
+def test_family_checkpoint_round_trip(family, builder):
+    """save → load into a fresh engine → identical params and identical
+    next-step loss (optimizer state restored)."""
+    with tempfile.TemporaryDirectory() as d:
+        e1 = _make(builder())
+        for s in range(3):
+            _step(e1, seed=s)
+        e1.save_checkpoint(d, tag="t")
+        ref_next = _step(e1, seed=99)
+
+        e2 = _make(builder())
+        e2.load_checkpoint(d, tag="t")
+        # e1 already stepped past the checkpoint, so compare via the
+        # next-step loss (covers params + optimizer state + scaler)
+        next2 = _step(e2, seed=99)
+        np.testing.assert_allclose(next2, ref_next, rtol=1e-5, atol=1e-6)
+
+
+def test_imported_hf_weights_checkpoint_round_trip():
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.module_inject import from_hf
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64)
+    model, params = from_hf(transformers.LlamaForCausalLM(cfg))
+    with tempfile.TemporaryDirectory() as d:
+        groups.destroy_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8, "train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3}})
+        ids = np.random.RandomState(0).randint(0, 128, size=(8, 16)).astype(np.int32)
+        engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+        engine.save_checkpoint(d, tag="hf")
+        want = float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+
+        groups.destroy_mesh()
+        engine2, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=jax.tree.map(np.copy, params),
+            config={"train_batch_size": 8, "train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3}})
+        engine2.load_checkpoint(d, tag="hf")
+        got = float(engine2.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
