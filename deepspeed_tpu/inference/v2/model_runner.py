@@ -61,6 +61,17 @@ def _rope_flat(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
+def _rope_flat_interleaved(x, cos, sin, positions):
+    """GPT-J layout: adjacent dim pairs rotate together."""
+    c = cos[positions][:, None, :]
+    s = sin[positions][:, None, :]
+    x32 = x.astype(jnp.float32)
+    x1 = x32[..., 0::2]
+    x2 = x32[..., 1::2]
+    out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None):
     """Scatter new K/V into the paged pool and attend over each token's
     block-tabled context. Pallas decode kernel on TPU, gather-based XLA
@@ -156,14 +167,15 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):
     v = _proj(x_attn, attn["v_proj"]).reshape(T, Hkv, Dh)
     if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
         rd = cfg.rotary_dim
+        rope = _rope_flat_interleaved if cfg.rope_interleaved else _rope_flat
         if rd == Dh:
-            q = _rope_flat(q, cos, sin, batch["token_pos"])
-            k = _rope_flat(k, cos, sin, batch["token_pos"])
+            q = rope(q, cos, sin, batch["token_pos"])
+            k = rope(k, cos, sin, batch["token_pos"])
         else:
             q = jnp.concatenate(
-                [_rope_flat(q[..., :rd], cos, sin, batch["token_pos"]), q[..., rd:]], -1)
+                [rope(q[..., :rd], cos, sin, batch["token_pos"]), q[..., rd:]], -1)
             k = jnp.concatenate(
-                [_rope_flat(k[..., :rd], cos, sin, batch["token_pos"]), k[..., rd:]], -1)
+                [rope(k[..., :rd], cos, sin, batch["token_pos"]), k[..., rd:]], -1)
 
     out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=alibi)
     attn_out = _proj(out.reshape(T, H * Dh), attn["o_proj"])
