@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.llama import LlamaConfig, rope_frequencies
+from deepspeed_tpu.models.llama import LlamaConfig, rope_frequencies, rope_scaling_of
 
 
 def _rms(x, scale, eps):
@@ -224,7 +224,8 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16):
             h = _layernorm(h, params["model"]["embed_layernorm"], cfg.layer_norm_eps)
         step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch)
     else:
-        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
+                                    scaling=rope_scaling_of(cfg))
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
         step = functools.partial(_layer_step, cfg, cos, sin, batch)
 

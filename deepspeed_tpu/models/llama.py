@@ -46,6 +46,12 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # RoPE frequency rescaling (Llama-3.x): "none" | "linear" | "llama3"
+    rope_scaling_type: str = "none"
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     tie_word_embeddings: bool = False
     # Qwen2-style QKV biases (Llama/Mistral/Mixtral: False)
     attention_bias: bool = False
@@ -146,11 +152,44 @@ class RMSNorm(nn.Module):
         return fused_rms_norm(x, scale, self.eps)
 
 
-def rope_frequencies(head_dim: int, max_len: int, theta: float):
+def rope_frequencies(head_dim: int, max_len: int, theta: float, scaling=None):
+    """cos/sin tables [T, D/2]. ``scaling``: None, ("linear", factor), or
+    ("llama3", factor, low_freq_factor, high_freq_factor, orig_max) —
+    the Llama-3.x wavelength-dependent inv_freq rescale (long wavelengths
+    divided by ``factor``, short kept, smooth ramp between)."""
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    if scaling is not None and scaling[0] != "none":
+        kind = scaling[0]
+        if kind == "linear":
+            inv_freq = inv_freq / scaling[1]
+        elif kind == "llama3":
+            _, factor, low_f, high_f, orig_max = scaling
+            wavelen = 2.0 * np.pi / inv_freq
+            low_wl = orig_max / low_f
+            high_wl = orig_max / high_f
+            scaled = np.where(wavelen > low_wl, inv_freq / factor, inv_freq)
+            smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+            mid = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+            inv_freq = np.where((wavelen <= low_wl) & (wavelen >= high_wl), mid, scaled)
+        else:
+            raise ValueError(f"unknown rope scaling {kind!r}")
     t = np.arange(max_len, dtype=np.float32)
     freqs = np.outer(t, inv_freq)  # [T, D/2]
     return np.cos(freqs), np.sin(freqs)
+
+
+def rope_scaling_of(cfg):
+    """Config → the ``scaling`` tuple ``rope_frequencies`` takes."""
+    kind = getattr(cfg, "rope_scaling_type", "none")
+    if kind == "none":
+        return None
+    if kind == "linear":
+        return ("linear", cfg.rope_scaling_factor)
+    if kind == "llama3":
+        return ("llama3", cfg.rope_scaling_factor, cfg.rope_low_freq_factor,
+                cfg.rope_high_freq_factor, cfg.rope_original_max_position)
+    raise ValueError(f"unknown rope_scaling_type {kind!r}: expected 'none', 'linear', "
+                     f"or 'llama3'")
 
 
 def apply_rope(x, cos, sin, positions):
@@ -234,7 +273,8 @@ class LlamaAttention(nn.Module):
         k = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
         v = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
 
-        cos, sin = rope_frequencies(Dh, cfg.max_position_embeddings, cfg.rope_theta)
+        cos, sin = rope_frequencies(Dh, cfg.max_position_embeddings, cfg.rope_theta,
+                                    scaling=rope_scaling_of(cfg))
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 

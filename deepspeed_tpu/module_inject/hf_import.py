@@ -97,12 +97,26 @@ def import_llama(state, hf_config):
 def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
     from deepspeed_tpu.models.llama import LlamaConfig
     moe = getattr(hf_config, "num_local_experts", 0) or 0
-    if getattr(hf_config, "rope_scaling", None):
-        # Llama-3.x rescales inv_freq; importing without it would produce
-        # silently wrong logits — refuse rather than diverge.
-        raise NotImplementedError(
-            f"rope_scaling={hf_config.rope_scaling!r} is not supported by the importer; "
-            f"only plain rope_theta checkpoints (Llama-2 family) convert exactly")
+    rope_kw = {}
+    rs = getattr(hf_config, "rope_scaling", None)
+    if rs:
+        kind = rs.get("rope_type", rs.get("type"))
+        if kind == "linear":
+            rope_kw = {"rope_scaling_type": "linear",
+                       "rope_scaling_factor": float(rs["factor"])}
+        elif kind == "llama3":
+            rope_kw = {"rope_scaling_type": "llama3",
+                       "rope_scaling_factor": float(rs["factor"]),
+                       "rope_low_freq_factor": float(rs["low_freq_factor"]),
+                       "rope_high_freq_factor": float(rs["high_freq_factor"]),
+                       "rope_original_max_position":
+                           int(rs["original_max_position_embeddings"])}
+        else:
+            # yarn/dynamic/longrope: importing without them would produce
+            # silently wrong logits — refuse rather than diverge.
+            raise NotImplementedError(
+                f"rope_scaling type {kind!r} is not supported by the importer "
+                f"(supported: linear, llama3)")
     sw = getattr(hf_config, "sliding_window", None)
     if not getattr(hf_config, "use_sliding_window", True):
         sw = None  # Qwen2-style configs carry a window but disable it
@@ -127,7 +141,7 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
         or hf_config.model_type == "qwen2",
         moe_num_experts=moe,
         moe_top_k=getattr(hf_config, "num_experts_per_tok", 2) if moe else 2,
-        **overrides)
+        **{**rope_kw, **overrides})
 
 
 # ---------------------------------------------------------------------------

@@ -120,11 +120,29 @@ class TestHFImportParity:
         hf = transformers.BertForMaskedLM(cfg)
         _check(hf, IDS)
 
+    def test_llama3_rope_scaling(self):
+        """Llama-3.x wavelength-dependent inv_freq rescale converts with
+        exact parity."""
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+                          "high_freq_factor": 4.0, "original_max_position_embeddings": 32})
+        _check(transformers.LlamaForCausalLM(cfg), IDS)
+
+    def test_linear_rope_scaling(self):
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            rope_scaling={"rope_type": "linear", "factor": 2.0})
+        _check(transformers.LlamaForCausalLM(cfg), IDS)
+
     def test_unsupported_variants_raise_clearly(self):
         cfg = transformers.LlamaConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=1,
             num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
-            rope_scaling={"rope_type": "linear", "factor": 2.0})
+            rope_scaling={"rope_type": "yarn", "factor": 2.0,
+                          "original_max_position_embeddings": 32})
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             from_hf(transformers.LlamaForCausalLM(cfg))
         cfg = transformers.MistralConfig(
