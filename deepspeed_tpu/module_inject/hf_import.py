@@ -358,15 +358,18 @@ def gpt_config_from_hf(hf_config, **overrides):
                          tie_word_embeddings=False,
                          layer_norm_eps=hf_config.layer_norm_eps, **overrides)
     if mt == "falcon":
+        new_arch = getattr(hf_config, "new_decoder_architecture", False)
         return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
-                         intermediate_size=4 * hf_config.hidden_size,
+                         intermediate_size=getattr(hf_config, "ffn_hidden_size", None)
+                         or 4 * hf_config.hidden_size,
                          num_hidden_layers=hf_config.num_hidden_layers,
                          num_attention_heads=hf_config.num_attention_heads,
-                         num_key_value_heads=1,
+                         num_key_value_heads=hf_config.num_kv_heads if new_arch else 1,
                          max_position_embeddings=getattr(hf_config, "max_position_embeddings", 2048),
                          position_embedding="rope",
                          rope_theta=getattr(hf_config, "rope_theta", 10000.0),
-                         parallel_block=True, attention_bias=bool(hf_config.bias),
+                         parallel_block=True, parallel_two_norms=new_arch,
+                         attention_bias=bool(hf_config.bias),
                          mlp_bias=bool(hf_config.bias),
                          tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)),
                          layer_norm_eps=hf_config.layer_norm_epsilon, **overrides)
@@ -472,13 +475,19 @@ def import_gpt_neox(state, hf_config):
 
 
 def import_falcon(state, hf_config):
-    if getattr(hf_config, "new_decoder_architecture", False) or \
-            not getattr(hf_config, "multi_query", True) or \
-            not getattr(hf_config, "parallel_attn", True):
+    new_arch = getattr(hf_config, "new_decoder_architecture", False)
+    if new_arch and getattr(hf_config, "num_ln_in_parallel_attn", 2) == 1:
         raise NotImplementedError(
-            "only the classic Falcon-7B architecture converts (multi_query=True, "
-            "parallel_attn=True, new_decoder_architecture=False); the 40B two-norm "
-            "GQA layout has no importer yet")
+            "new-arch Falcon with num_ln_in_parallel_attn=1 (single shared norm, "
+            "Falcon2-11B style) has no importer — only the two-norm ln_attn/ln_mlp "
+            "layout converts")
+    if not new_arch and not getattr(hf_config, "multi_query", True):
+        raise NotImplementedError(
+            "classic Falcon without multi_query has no importer (use the "
+            "new_decoder_architecture GQA layout or Falcon-7B MQA)")
+    if not new_arch and not getattr(hf_config, "parallel_attn", True):
+        raise NotImplementedError("Falcon with parallel_attn=False does not map onto "
+                                  "the parallel-block native decoder")
     if getattr(hf_config, "alibi", False):
         raise NotImplementedError("Falcon with alibi=True is not supported (the "
                                   "importer maps Falcon to rotary positions)")
@@ -490,16 +499,30 @@ def import_falcon(state, hf_config):
     D = hf_config.hidden_size
     H = hf_config.num_attention_heads
     Dh = D // H
+    Hkv = (hf_config.num_kv_heads if new_arch else 1)
+    rep = H // Hkv
 
     def split_qkv(i):
-        # MQA fusion: weight [(H+2)*Dh, D] viewed [H+2, Dh, D] — H query
-        # heads then one K and one V head
-        w = _np(state[f"transformer.h.{i}.self_attention.query_key_value.weight"]).reshape(
-            H + 2, Dh, D)
-        q = w[:H].reshape(H * Dh, D).T.copy()
-        k = w[H].reshape(Dh, D).T.copy()
-        v = w[H + 1].reshape(Dh, D).T.copy()
+        w = _np(state[f"transformer.h.{i}.self_attention.query_key_value.weight"])
+        if new_arch:
+            # 40B-style GQA fusion: [Hkv, rep q heads + K + V, Dh, D] —
+            # group-major q order, matching the native repeat_kv grouping
+            w = w.reshape(Hkv, rep + 2, Dh, D)
+            q = w[:, :rep].reshape(H * Dh, D).T.copy()
+            k = w[:, rep].reshape(Hkv * Dh, D).T.copy()
+            v = w[:, rep + 1].reshape(Hkv * Dh, D).T.copy()
+        else:
+            # MQA fusion: [H+2, Dh, D] — H query heads then one K, one V
+            w = w.reshape(H + 2, Dh, D)
+            q = w[:H].reshape(H * Dh, D).T.copy()
+            k = w[H].reshape(Dh, D).T.copy()
+            v = w[H + 1].reshape(Dh, D).T.copy()
         return q, k, v
+
+    def stack_ln(name):
+        return {"norm": {
+            "scale": _stack(state, "transformer.h.{}." + name + ".weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}." + name + ".bias", L, _np)}}
 
     qkv = [split_qkv(i) for i in range(L)]
     layers = {
@@ -509,14 +532,16 @@ def import_falcon(state, hf_config):
             "v_proj": {"kernel": np.stack([x[2] for x in qkv])},
             "o_proj": {"kernel": _stack(state, "transformer.h.{}.self_attention.dense.weight", L)},
         },
-        "input_layernorm": {"norm": {
-            "scale": _stack(state, "transformer.h.{}.input_layernorm.weight", L, _np),
-            "bias": _stack(state, "transformer.h.{}.input_layernorm.bias", L, _np)}},
         "mlp": {
             "fc_in": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_h_to_4h.weight", L)},
             "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.dense_4h_to_h.weight", L)},
         },
     }
+    if new_arch:  # two parallel norms: ln_attn feeds attention, ln_mlp the MLP
+        layers["input_layernorm"] = stack_ln("ln_attn")
+        layers["mlp_layernorm"] = stack_ln("ln_mlp")
+    else:
+        layers["input_layernorm"] = stack_ln("input_layernorm")
     params = {"model": {
         "embed_tokens": _np(state["transformer.word_embeddings.weight"]),
         "layers": layers,

@@ -107,6 +107,13 @@ class TestHFImportParity:
             bias=False, max_position_embeddings=64)
         _check(transformers.FalconForCausalLM(cfg), IDS)
 
+    def test_falcon_40b_style_new_arch_gqa(self):
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            num_kv_heads=2, new_decoder_architecture=True, bias=False,
+            max_position_embeddings=64)
+        _check(transformers.FalconForCausalLM(cfg), IDS)
+
     def test_phi_partial_rotary(self):
         cfg = transformers.PhiConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
