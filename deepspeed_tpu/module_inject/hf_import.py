@@ -12,7 +12,8 @@ checkpoints across unchanged.
 
 Supported model types (``hf_config.model_type``): llama, mistral,
 mixtral*, qwen2 → Llama family; gpt2, gptj, opt, bloom, gpt_neox,
-falcon, phi → GPT family; bert (masked-LM checkpoints) → BERT family.
+falcon, phi → GPT family; bert, distilbert (masked-LM checkpoints) →
+BERT family.
 Weights arrive as a ``state_dict()`` mapping
 or an in-memory HF model; per-layer tensors are stacked on the leading
 scan dim. (*mixtral routing weights are mapped onto the framework's MoE
@@ -627,6 +628,59 @@ def import_bert(state, hf_config):
     return params
 
 
+def import_distilbert(state, hf_config):
+    """``DistilBertForMaskedLM`` state_dict → BertForMaskedLM params
+    (same post-LN encoder, no token types; vocab_transform/projector map
+    onto the MLM head with the tied decoder)."""
+    L = hf_config.n_layers
+    pre = "distilbert."
+
+    def stack_lin(name):
+        return {"kernel": _stack(state, pre + "transformer.layer.{}." + name + ".weight", L),
+                "bias": _stack(state, pre + "transformer.layer.{}." + name + ".bias", L, _np)}
+
+    def stack_ln(name):
+        return {"scale": _stack(state, pre + "transformer.layer.{}." + name + ".weight", L, _np),
+                "bias": _stack(state, pre + "transformer.layer.{}." + name + ".bias", L, _np)}
+
+    layers = {
+        "q_proj": stack_lin("attention.q_lin"),
+        "k_proj": stack_lin("attention.k_lin"),
+        "v_proj": stack_lin("attention.v_lin"),
+        "o_proj": stack_lin("attention.out_lin"),
+        "attn_layernorm": stack_ln("sa_layer_norm"),
+        "fc_in": stack_lin("ffn.lin1"),
+        "fc_out": stack_lin("ffn.lin2"),
+        "ffn_layernorm": stack_ln("output_layer_norm"),
+    }
+    return {"model": {
+        "embed_tokens": _np(state[pre + "embeddings.word_embeddings.weight"]),
+        "embed_positions": _np(state[pre + "embeddings.position_embeddings.weight"]),
+        "embed_layernorm": {"scale": _np(state[pre + "embeddings.LayerNorm.weight"]),
+                            "bias": _np(state[pre + "embeddings.LayerNorm.bias"])},
+        "layers": layers,
+    },
+        "mlm_transform": {"kernel": _t(state["vocab_transform.weight"]),
+                          "bias": _np(state["vocab_transform.bias"])},
+        "mlm_layernorm": {"scale": _np(state["vocab_layer_norm.weight"]),
+                          "bias": _np(state["vocab_layer_norm.bias"])},
+        "mlm_bias": _np(state["vocab_projector.bias"]),
+    }
+
+
+def distilbert_config_from_hf(hf_config, **overrides):
+    from deepspeed_tpu.models.bert import BertConfig
+    if getattr(hf_config, "activation", "gelu") != "gelu":
+        raise NotImplementedError(
+            f"DistilBERT activation {hf_config.activation!r}: only 'gelu' maps exactly")
+    return BertConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.dim,
+                      intermediate_size=hf_config.hidden_dim,
+                      num_hidden_layers=hf_config.n_layers,
+                      num_attention_heads=hf_config.n_heads,
+                      max_position_embeddings=hf_config.max_position_embeddings,
+                      type_vocab_size=0, layer_norm_eps=1e-12, **overrides)
+
+
 def bert_config_from_hf(hf_config, **overrides):
     from deepspeed_tpu.models.bert import BertConfig
     return BertConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
@@ -683,6 +737,14 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "phi":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_phi(state, hf_config)
+    if mt == "distilbert":
+        if "vocab_transform.weight" not in state:
+            raise NotImplementedError(
+                "only DistilBertForMaskedLM checkpoints are supported (no "
+                "vocab_transform MLM head in the state_dict)")
+        from deepspeed_tpu.models.bert import BertForMaskedLM
+        return (BertForMaskedLM(distilbert_config_from_hf(hf_config)),
+                import_distilbert(state, hf_config))
     if mt == "bert":
         if "cls.predictions.transform.dense.weight" not in state:
             raise NotImplementedError(
@@ -692,4 +754,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('gpt2', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert')}")
+        f"{_LLAMA_TYPES + ('gpt2', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

@@ -173,6 +173,12 @@ class TestHFImportParity:
         with pytest.raises(NotImplementedError, match="MaskedLM"):
             from_hf(transformers.BertModel(cfg))
 
+    def test_distilbert_mlm(self):
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=32, hidden_dim=64, n_layers=2, n_heads=4,
+            max_position_embeddings=64)
+        _check(transformers.DistilBertForMaskedLM(cfg), IDS)
+
     def test_engine_trains_imported_model(self):
         """The imported (model, params) drop straight into initialize()."""
         import deepspeed_tpu
