@@ -93,8 +93,18 @@ class DSElasticAgent:
             if self._shutdown:
                 self._kill_child()
                 child.wait()
-                return child.returncode or 0
+                # intentional shutdown: only death by our own SIGTERM is a
+                # clean exit — a crash (SIGSEGV, OOM kill) or failing rc that
+                # raced with the shutdown still propagates
+                rc = child.returncode
+                if rc is None or rc == 0 or rc == -signal.SIGTERM:
+                    return 0
+                return 128 - rc if rc < 0 else rc
             rc = child.returncode
+            if rc is not None and rc < 0:
+                # died by signal N: report 128+N (shell convention) rather than
+                # letting sys.exit wrap the negative value modulo 256
+                rc = 128 - rc
             if rc == 0:
                 logger.info("[elastic] worker exited cleanly")
                 return 0

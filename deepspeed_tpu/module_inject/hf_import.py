@@ -28,7 +28,15 @@ import numpy as np
 
 def _np(t):
     if hasattr(t, "detach"):
-        t = t.detach().cpu().numpy()
+        t = t.detach().cpu()
+        if "bfloat16" in str(t.dtype):
+            # numpy has no native bf16; re-view the bits as ml_dtypes.bfloat16
+            # (ships with jax) instead of upcasting — no 2x host-memory blowup
+            # on multi-GB checkpoints
+            import torch
+            import ml_dtypes
+            return t.contiguous().view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+        t = t.numpy()
     return np.asarray(t)
 
 
@@ -237,7 +245,7 @@ def import_opt(state, hf_config):
         "post_attention_layernorm": stack_ln("final_layer_norm"),
         "mlp": {"fc_in": stack_lin("fc1"), "fc_out": stack_lin("fc2")},
     }
-    return {"model": {
+    params = {"model": {
         "embed_tokens": _np(state[pre + "embed_tokens.weight"]),
         # HF OPT's table already contains the 2 reserved offset rows
         "embed_positions": _np(state[pre + "embed_positions.weight"]),
@@ -245,6 +253,9 @@ def import_opt(state, hf_config):
         "final_layernorm": {"scale": _np(state[pre + "final_layer_norm.weight"]),
                             "bias": _np(state[pre + "final_layer_norm.bias"])},
     }}
+    if not getattr(hf_config, "tie_word_embeddings", True):  # e.g. Galactica
+        params["lm_head"] = {"kernel": _t(state["lm_head.weight"])}
+    return params
 
 
 def import_bloom(state, hf_config):
@@ -310,16 +321,22 @@ def gpt_config_from_hf(hf_config, **overrides):
                          num_attention_heads=hf_config.n_head,
                          num_key_value_heads=hf_config.n_head,
                          max_position_embeddings=hf_config.n_positions,
-                         activation="gelu_new", layer_norm_eps=hf_config.layer_norm_epsilon,
+                         activation=_hf_activation(hf_config.activation_function),
+                         layer_norm_eps=hf_config.layer_norm_epsilon,
                          **overrides)
     if mt == "opt":
+        # HF OPTConfig carries no layer-norm eps; torch.nn.LayerNorm's 1e-5
+        # default is what every OPT checkpoint ran with.
         return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
                          intermediate_size=hf_config.ffn_dim,
                          num_hidden_layers=hf_config.num_hidden_layers,
                          num_attention_heads=hf_config.num_attention_heads,
                          num_key_value_heads=hf_config.num_attention_heads,
                          max_position_embeddings=hf_config.max_position_embeddings,
-                         activation="relu", learned_pos_offset=2, layer_norm_eps=1e-5,
+                         activation=_hf_activation(hf_config.activation_function),
+                         tie_word_embeddings=bool(
+                             getattr(hf_config, "tie_word_embeddings", True)),
+                         learned_pos_offset=2, layer_norm_eps=1e-5,
                          **overrides)
     if mt == "bloom":
         return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
