@@ -73,6 +73,7 @@ class DSElasticAgent:
 
     def shutdown(self, sig=signal.SIGTERM):
         self._shutdown = True
+        self._shutdown_sig = sig
         self._kill_child(sig)
 
     # ------------------------------------------------------------------
@@ -93,11 +94,12 @@ class DSElasticAgent:
             if self._shutdown:
                 self._kill_child()
                 child.wait()
-                # intentional shutdown: only death by our own SIGTERM is a
+                # intentional shutdown: only death by the signal WE sent is a
                 # clean exit — a crash (SIGSEGV, OOM kill) or failing rc that
                 # raced with the shutdown still propagates
                 rc = child.returncode
-                if rc is None or rc == 0 or rc == -signal.SIGTERM:
+                clean = {-signal.SIGTERM, -getattr(self, "_shutdown_sig", signal.SIGTERM)}
+                if rc is None or rc == 0 or rc in clean:
                     return 0
                 return 128 - rc if rc < 0 else rc
             rc = child.returncode

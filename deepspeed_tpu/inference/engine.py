@@ -72,27 +72,10 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _param_sharding(self, path, x):
-        spec = self._tp_rule(path, np.shape(x))
-        # drop axes the mesh doesn't have >1 of
-        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-
-        def live(e):
-            if e is None:
-                return None
-            if isinstance(e, (tuple, list)):
-                kept = tuple(a for a in e if sizes.get(a, 1) > 1)
-                return kept if len(kept) > 1 else (kept[0] if kept else None)
-            return e if sizes.get(e, 1) > 1 else None
-
-        entries = [live(e) for e in spec]
-        # divisibility guard: fall back to replicated when a dim doesn't divide
-        for d, e in enumerate(entries):
-            if e is None:
-                continue
-            size = int(np.prod([sizes[a] for a in (e if isinstance(e, tuple) else (e,))]))
-            if np.shape(x)[d] % size != 0:
-                entries[d] = None
-        return NamedSharding(self.mesh, P(*entries))
+        # shared live-axis + divisibility resolution with the v2 ragged
+        # engine (inference/v2/sharding.py)
+        from deepspeed_tpu.inference.v2.sharding import param_sharding
+        return param_sharding(self.mesh, self._tp_rule, path, np.shape(x))
 
     def _set_params(self, params):
         """Cast to engine dtype and TP-shard over the mesh."""

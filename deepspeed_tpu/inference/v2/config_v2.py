@@ -23,6 +23,7 @@ class QuantizationConfig(DeepSpeedConfigModel):
 
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel_degree: int = 1
+    expert_parallel_degree: int = 1  # MoE expert sharding for serving
     kv_block_size: int = 16
     num_kv_blocks: int = 0  # 0 = derive from max_context * max sequences
     state_manager: DSStateManagerConfig = DSStateManagerConfig()

@@ -39,10 +39,31 @@ class InferenceEngineV2:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
             sample = jnp.zeros((1, 8), jnp.int32)
             params = model.init(rng, sample)["params"]
-        self.params = jax.tree.map(
-            lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
 
         cfg = self.model_config
+        # Serving mesh (reference engine_v2.py:30 builds the model over its
+        # TP group via model_implementations/sharding/): tensor- and, for
+        # MoE, expert-parallel. Params/KV-pool are placed sharded so models
+        # larger than one chip serve.
+        tp = int(self._config.tensor_parallel_degree)
+        ep = int(self._config.expert_parallel_degree)
+        if tp * ep > 1:
+            from deepspeed_tpu.parallel.topology import make_mesh_topology
+            assert tp * ep <= len(jax.devices()), \
+                f"tp={tp} x ep={ep} exceeds {len(jax.devices())} visible devices"
+            self.mesh = make_mesh_topology(tensor=tp, expert=ep, data=1,
+                                           devices=jax.devices()[:tp * ep])
+        else:
+            self.mesh = None
+
+        if self.mesh is not None:
+            from deepspeed_tpu.inference.v2.sharding import shard_params, tp_rule_for
+            self.params = shard_params(params, self.mesh, tp_rule_for(cfg), dtype=dtype)
+        else:
+            self.params = jax.tree.map(
+                lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params)
+
         self.max_tokens = int(sm.max_ragged_batch_size)
         self.max_seqs = int(sm.max_ragged_sequence_count)
         self.block_size = int(self._config.kv_block_size)
@@ -51,18 +72,28 @@ class InferenceEngineV2:
             1 + self.max_seqs * self.max_blocks_per_seq)
         self.kv_cache = BlockedKVCache(cfg.num_hidden_layers, num_blocks, self.block_size,
                                        cfg.num_key_value_heads, cfg.head_dim, dtype=dtype)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from deepspeed_tpu.inference.v2.sharding import kv_pool_spec
+            pool = NamedSharding(self.mesh, kv_pool_spec(self.mesh, cfg.num_key_value_heads))
+            self.kv_cache.k = jax.device_put(self.kv_cache.k, pool)
+            self.kv_cache.v = jax.device_put(self.kv_cache.v, pool)
         self.state_manager = DSStateManager(self.kv_cache, int(sm.max_tracked_sequences))
         # positions are bounded by BOTH the block table and the RoPE table
         self.max_ctx_tokens = min(self.max_blocks_per_seq * self.block_size,
                                   int(cfg.max_position_embeddings))
         self._batch = RaggedBatchWrapper(self.max_tokens, self.max_seqs,
                                          self.max_blocks_per_seq)
+        mesh = self.mesh
         self._step = jax.jit(
-            lambda p, kc, vc, b: ragged_forward(p, kc, vc, b, cfg, dtype),
+            lambda p, kc, vc, b: ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh),
             donate_argnums=(1, 2))
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            self._replicated = NamedSharding(self.mesh, _P())
         logger.info(f"InferenceEngineV2: max_tokens={self.max_tokens} "
                     f"max_seqs={self.max_seqs} kv_blocks={num_blocks} "
-                    f"block_size={self.block_size} "
+                    f"block_size={self.block_size} tp={tp} ep={ep} "
                     f"kv_bytes={self.kv_cache.bytes()/1e6:.1f}MB")
 
     # ------------------------------------------------------------------
@@ -116,6 +147,10 @@ class InferenceEngineV2:
             desc.advance(len(tokens))
             slots.append(desc.slot)
         arrays = self._batch.finalize()
+        if self.mesh is not None:
+            # batch metadata is replicated over the serving mesh (the flat
+            # token batch carries no sharding — only weights/KV do)
+            arrays = jax.device_put(arrays, self._replicated)
         logits, self.kv_cache.k, self.kv_cache.v = self._step(
             self.params, self.kv_cache.k, self.kv_cache.v, arrays)
         return np.asarray(logits)[np.asarray(slots)]

@@ -25,8 +25,23 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.llama import LlamaConfig, rope_frequencies, rope_scaling_of
+
+
+def _c(x, entries, mesh):
+    """Sharding constraint with dead-axis/divisibility fallback; no-op
+    when serving single-device (mesh None). These pin the Megatron
+    layout through the ragged step: replicated token batch, head- and
+    feature-sharded projections (reference
+    ``inference/v2/model_implementations/sharding/``)."""
+    if mesh is None:
+        return x
+    from deepspeed_tpu.inference.v2.sharding import live_entries
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*live_entries(mesh, entries, x.shape))))
 
 
 def _rms(x, scale, eps):
@@ -72,64 +87,91 @@ def _rope_flat_interleaved(x, cos, sin, positions):
     return out.reshape(x.shape).astype(x.dtype)
 
 
-def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None):
+def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None, mesh=None):
     """Scatter new K/V into the paged pool and attend over each token's
-    block-tabled context. Pallas decode kernel on TPU, gather-based XLA
-    path elsewhere (and always for ALiBi)."""
+    block-tabled context. Pallas decode kernel on TPU (per-shard under
+    a TP mesh via shard_map), gather-based XLA path elsewhere (and
+    always for ALiBi)."""
     bs = kc.shape[1]
     blk = batch["block_tables"][batch["token_seq"], batch["token_pos"] // bs]  # [T]
     off = batch["token_pos"] % bs
-    kc = kc.at[blk, off].set(k.astype(kc.dtype))
-    vc = vc.at[blk, off].set(v.astype(vc.dtype))
+    kc = _c(kc.at[blk, off].set(k.astype(kc.dtype)), (None, None, "tensor", None), mesh)
+    vc = _c(vc.at[blk, off].set(v.astype(vc.dtype)), (None, None, "tensor", None), mesh)
 
-    from deepspeed_tpu.ops.pallas import use_pallas
+    from deepspeed_tpu.ops.pallas import (kernel_dispatch, shard_map_kernel,
+                                          spec_divides, use_pallas)
     from deepspeed_tpu.ops.pallas.paged_attention import (kernel_supported,
                                                           paged_decode_attention,
                                                           xla_paged_attention)
     tab = batch["block_tables"][batch["token_seq"]]  # [T, MB]
+    pos = batch["token_pos"]
     if alibi is not None:
-        out = xla_paged_attention(q, kc, vc, tab, batch["token_pos"], alibi_slopes=alibi)
-    elif use_pallas() and kernel_supported(Dh, bs):
-        out = paged_decode_attention(q, kc, vc, tab, batch["token_pos"])
+        out = xla_paged_attention(q, kc, vc, tab, pos, alibi_slopes=alibi)
+    elif mesh is None or mesh.size == 1:
+        if use_pallas() and kernel_supported(Dh, bs):
+            out = paged_decode_attention(q, kc, vc, tab, pos)
+        else:
+            out = xla_paged_attention(q, kc, vc, tab, pos)
     else:
-        out = xla_paged_attention(q, kc, vc, tab, batch["token_pos"])
-    return out, kc, vc
+        q_spec = P(None, "tensor", None)
+        kv_spec = P(None, None, "tensor", None)
+        sharded_kernel = (kernel_dispatch(mesh) == "shard_map"
+                          and kernel_supported(Dh, bs)
+                          and spec_divides(mesh, q_spec, q.shape)
+                          and spec_divides(mesh, kv_spec, kc.shape)
+                          # per-shard GQA grouping needs whole KV-head groups
+                          and (q.shape[1] // kc.shape[2]) * kc.shape[2] == q.shape[1])
+        if sharded_kernel:
+            out = shard_map_kernel(
+                paged_decode_attention, mesh,
+                in_specs=(q_spec, kv_spec, kv_spec, P(), P()),
+                out_specs=q_spec)(q, kc, vc, tab, pos)
+        else:
+            out = xla_paged_attention(q, kc, vc, tab, pos)
+    return _c(out, (None, "tensor", None), mesh), kc, vc
 
 
-def _layer_step(cfg, cos, sin, batch, h, xs):
+def _layer_step(cfg, cos, sin, batch, mesh, h, xs):
     lp, kc, vc = xs
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     attn = lp["self_attn"]
 
     hn = _rms(h, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
-    q = _proj(hn, attn["q_proj"]).reshape(T, H, Dh)
-    k = _proj(hn, attn["k_proj"]).reshape(T, Hkv, Dh)
-    v = _proj(hn, attn["v_proj"]).reshape(T, Hkv, Dh)
+    q = _c(_proj(hn, attn["q_proj"]).reshape(T, H, Dh), (None, "tensor", None), mesh)
+    k = _c(_proj(hn, attn["k_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
+    v = _c(_proj(hn, attn["v_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
     q = _rope_flat(q, cos, sin, batch["token_pos"])
     k = _rope_flat(k, cos, sin, batch["token_pos"])
 
-    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh)
-    h = h + _proj(out.reshape(T, H * Dh), attn["o_proj"])
+    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, mesh=mesh)
+    h = _c(h + _proj(out.reshape(T, H * Dh), attn["o_proj"]), (None, None), mesh)
 
     hn2 = _rms(h, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
     if "moe_mlp" in lp:
-        h = h + _moe_mlp(hn2, lp["moe_mlp"]["deepspeed_moe"], cfg.moe_top_k)
+        h = h + _moe_mlp(hn2, lp["moe_mlp"]["deepspeed_moe"], cfg.moe_top_k, mesh)
     else:
         mlp = lp["mlp"]
-        gate = _proj(hn2, mlp["gate_proj"])
-        up = _proj(hn2, mlp["up_proj"])
-        h = h + _proj(jax.nn.silu(gate) * up, mlp["down_proj"])
+        gate = _c(_proj(hn2, mlp["gate_proj"]), (None, "tensor"), mesh)
+        up = _c(_proj(hn2, mlp["up_proj"]), (None, "tensor"), mesh)
+        h = _c(h + _proj(jax.nn.silu(gate) * up, mlp["down_proj"]), (None, None), mesh)
     return h, (kc, vc)
 
 
-def _moe_mlp(x, p, k):
+def _moe_mlp(x, p, k, mesh=None):
     """Dropless top-k MoE over the flat [T, D] batch (Mixtral serving —
     reference inference/v2 cutlass MoE gather/scatter). At serving time
     capacity dropping is undesirable, so every token reaches its full
     top-k: tokens are replicated k× and pushed through the grouped GEMM
     (``ops/grouped_gemm.py`` — ``lax.ragged_dot`` over expert-sorted
-    rows), then combined with the renormalized gate weights."""
+    rows), then combined with the renormalized gate weights.
+
+    Under a mesh with expert/tensor parallelism the grouped GEMM runs in
+    a manual shard_map: each shard holds ``E/ep`` experts (column/row
+    feature shards over 'tensor'), routes every token assignment but
+    masks the non-local ones, and a psum over ('expert', 'tensor')
+    combines — expert weights never leave their shard, the serving
+    analogue of training's expert-axis dispatch."""
     from deepspeed_tpu.ops.grouped_gemm import moe_grouped_mlp
     gates = jax.nn.softmax(
         (x.astype(jnp.float32) @ p["gate"]["wg"]["kernel"].astype(jnp.float32)), axis=-1)
@@ -138,15 +180,52 @@ def _moe_mlp(x, p, k):
         topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
     T, E = gates.shape
     w1, w3, w2 = p["experts_w1"], p["experts_w3"], p["experts_w2"]
-    x_rep = jnp.repeat(x, k, axis=0)                      # [T*k, D]
     idx_rep = topk_idx.reshape(-1)                        # [T*k]
+
+    if mesh is not None and mesh.size > 1:
+        from deepspeed_tpu.ops.pallas import spec_divides
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        ep = sizes.get("expert", 1)
+        col = P("expert", None, "tensor")
+        row = P("expert", "tensor", None)
+        psum_axes = ("expert", "tensor")
+        if not (spec_divides(mesh, col, w1.shape) and spec_divides(mesh, row, w2.shape)):
+            # features replicated over 'tensor': every tensor-shard computes
+            # the full output, so summing over 'tensor' would overcount
+            col = P("expert", None, None)
+            row = P("expert", None, None)
+            psum_axes = ("expert",)
+        if E % ep == 0:
+            def shard_body(x_full, idx, w1s, w3s, w2s):
+                e_local = E // ep
+                off = jax.lax.axis_index("expert") * e_local
+                local = (idx >= off) & (idx < off + e_local)
+                lidx = jnp.where(local, idx - off, 0)
+                x_rep = jnp.repeat(x_full, k, axis=0)
+                out = moe_grouped_mlp(x_rep, lidx, w1s.astype(x_full.dtype),
+                                      w3s.astype(x_full.dtype), w2s.astype(x_full.dtype),
+                                      num_experts=e_local)
+                out = jnp.where(local[:, None], out, 0)
+                # combine partial expert/feature sums in fp32 (also dodges an
+                # XLA:CPU CHECK-crash on bf16 all-reduce inside shard_map)
+                return jax.lax.psum(out.astype(jnp.float32),
+                                    psum_axes).astype(x_full.dtype)
+
+            out_rep = jax.shard_map(
+                shard_body, mesh=mesh, in_specs=(P(), P(), col, col, row),
+                out_specs=P(), axis_names={"expert", "tensor"},
+                check_vma=False)(x, idx_rep, w1, w3, w2)
+            out_k = out_rep.reshape(T, k, -1)
+            return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
+
+    x_rep = jnp.repeat(x, k, axis=0)                      # [T*k, D]
     out_rep = moe_grouped_mlp(x_rep, idx_rep, w1.astype(x.dtype), w3.astype(x.dtype),
                               w2.astype(x.dtype), num_experts=E)
     out_k = out_rep.reshape(T, k, -1)                     # [T, k, D]
     return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
 
 
-def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):
+def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, h, xs):
     """One GPT-family block over the flat ragged batch (sequential or
     parallel wiring, optional partial rotary / ALiBi, biased
     projections, LayerNorm or RMSNorm)."""
@@ -162,9 +241,9 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):
         return _layernorm(x, p, cfg.layer_norm_eps)
 
     x_attn = norm(lp["input_layernorm"], h)
-    q = _proj(x_attn, attn["q_proj"]).reshape(T, H, Dh)
-    k = _proj(x_attn, attn["k_proj"]).reshape(T, Hkv, Dh)
-    v = _proj(x_attn, attn["v_proj"]).reshape(T, Hkv, Dh)
+    q = _c(_proj(x_attn, attn["q_proj"]).reshape(T, H, Dh), (None, "tensor", None), mesh)
+    k = _c(_proj(x_attn, attn["k_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
+    v = _c(_proj(x_attn, attn["v_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
     if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
         rd = cfg.rotary_dim
         rope = _rope_flat_interleaved if cfg.rope_interleaved else _rope_flat
@@ -177,11 +256,11 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):
             k = jnp.concatenate(
                 [rope(k[..., :rd], cos, sin, batch["token_pos"]), k[..., rd:]], -1)
 
-    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=alibi)
+    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=alibi, mesh=mesh)
     attn_out = _proj(out.reshape(T, H * Dh), attn["o_proj"])
 
     def mlp(x):
-        inter = _proj(x, lp["mlp"]["fc_in"])
+        inter = _c(_proj(x, lp["mlp"]["fc_in"]), (None, "tensor"), mesh)
         if cfg.activation == "relu":
             inter = jax.nn.relu(inter)
         else:
@@ -190,22 +269,26 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, h, xs):
 
     if cfg.parallel_block:
         x_mlp = norm(lp["mlp_layernorm"], h) if cfg.parallel_two_norms else x_attn
-        h = h + attn_out + mlp(x_mlp)
+        h = _c(h + attn_out + mlp(x_mlp), (None, None), mesh)
     else:
-        h = h + attn_out
-        h = h + mlp(norm(lp["post_attention_layernorm"], h))
+        h = _c(h + attn_out, (None, None), mesh)
+        h = _c(h + mlp(norm(lp["post_attention_layernorm"], h)), (None, None), mesh)
     return h, (kc, vc)
 
 
-def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16):
+def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=None):
     """→ (last-token logits [max_seqs, vocab] fp32, new kcache, new vcache).
 
     ``kcache``/``vcache``: [L, NB, bs, Hkv, Dh]; ``batch``: the arrays
     of ``RaggedBatchWrapper.finalize()``. ``cfg`` is a ``LlamaConfig``
-    or ``GPTConfig``; the layer wiring follows it."""
+    or ``GPTConfig``; the layer wiring follows it. ``mesh``: an optional
+    serving mesh — params/KV arrive sharded per
+    ``inference/v2/sharding.py`` and the step pins the Megatron layout
+    (replicated tokens, head/feature-sharded projections) so GSPMD
+    inserts the TP all-reduces."""
     is_gpt = hasattr(cfg, "position_embedding")
     embed = params["model"]["embed_tokens"]
-    h = embed[batch["token_ids"]].astype(dtype)  # [T, D]
+    h = _c(embed[batch["token_ids"]].astype(dtype), (None, None), mesh)  # [T, D]
 
     if is_gpt:
         cos = sin = None
@@ -222,12 +305,12 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16):
             h = h + pos_table[batch["token_pos"] + cfg.learned_pos_offset].astype(dtype)
         if cfg.embedding_layernorm:
             h = _layernorm(h, params["model"]["embed_layernorm"], cfg.layer_norm_eps)
-        step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch)
+        step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch, mesh)
     else:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
                                     scaling=rope_scaling_of(cfg))
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
-        step = functools.partial(_layer_step, cfg, cos, sin, batch)
+        step = functools.partial(_layer_step, cfg, cos, sin, batch, mesh)
 
     h, (kc, vc) = jax.lax.scan(step, h, (params["model"]["layers"], kcache, vcache))
 
@@ -242,5 +325,6 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16):
         logits = h @ params["lm_head"]["kernel"].astype(h.dtype)
     else:  # tied embeddings
         logits = h @ embed.T.astype(h.dtype)
+    logits = _c(logits, (None, "tensor"), mesh)  # vocab-sharded head
     sel = logits[batch["last_index"]]  # [max_seqs, V]
     return sel.astype(jnp.float32), kc, vc

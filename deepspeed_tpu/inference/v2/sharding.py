@@ -1,0 +1,84 @@
+"""Tensor/expert-parallel sharding for v2 ragged serving.
+
+Capability match for the reference's
+``deepspeed/inference/v2/model_implementations/sharding/`` (attn.py:
+head sharding, mlp.py: column/row MLP sharding, embedding.py: vocab
+sharding) and the TP wiring in ``engine_v2.py:30``. TPU redesign:
+instead of slicing torch tensors per rank, every decision is a
+``PartitionSpec`` from the model family's ``tp_rule`` — parameters are
+``device_put`` once with those shardings, the flat token batch stays
+replicated, the blocked KV pool is sharded over its KV-head dim, and
+GSPMD inserts the Megatron all-reduces inside the jitted ragged step.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def tp_rule_for(model_config):
+    """The family tp_rule for a ``LlamaConfig`` or ``GPTConfig`` (the
+    same rules training's ZeRO sharding policy consumes)."""
+    if hasattr(model_config, "position_embedding"):  # GPT family
+        from deepspeed_tpu.models.gpt import gpt_tp_rule
+        return gpt_tp_rule
+    from deepspeed_tpu.models.llama import llama_tp_rule
+    return llama_tp_rule
+
+
+def live_entries(mesh, spec, shape):
+    """Resolve a PartitionSpec against a concrete mesh and shape: axes
+    of size 1 (or absent) are dropped, and any dim that does not divide
+    evenly over its axes falls back to replicated (the reference refuses
+    such configs per-shape in sharding/utils.py; serving correctness
+    must not depend on divisibility, so replicate instead)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def live(e):
+        if e is None:
+            return None
+        if isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if sizes.get(a, 1) > 1)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return e if sizes.get(e, 1) > 1 else None
+
+    entries = [live(e) for e in spec]
+    for d, e in enumerate(entries):
+        if e is None:
+            continue
+        n = int(np.prod([sizes[a] for a in (e if isinstance(e, tuple) else (e,))]))
+        if shape[d] % n != 0:
+            entries[d] = None
+    return entries
+
+
+def param_sharding(mesh, rule, path, shape) -> NamedSharding:
+    return NamedSharding(mesh, P(*live_entries(mesh, rule(path, shape), shape)))
+
+
+def shard_params(params, mesh, rule, dtype=None):
+    """Cast (optionally) and place a param tree over ``mesh`` per the
+    family ``rule``. Used by both the v1 engine and the v2 ragged
+    engine — one implementation of the reference's per-rank weight
+    slicing."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+
+    def place(path, x):
+        x = jnp.asarray(x)
+        if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(dtype)
+        return jax.device_put(x, param_sharding(mesh, rule, path, x.shape))
+
+    return path_tree_map(place, params)
+
+
+def kv_pool_spec(mesh, n_kv_heads) -> P:
+    """Blocked KV pool [L, NB, bs, Hkv, Dh]: shard the KV-head dim over
+    'tensor' (reference sharding/attn.py shards KV heads per rank; MQA
+    with Hkv < tp replicates, exactly as the reference replicates the
+    single KV head)."""
+    return P(*live_entries(mesh, P(None, None, None, "tensor", None),
+                           (1, 1, 1, n_kv_heads, 1)))

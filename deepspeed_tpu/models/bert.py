@@ -123,6 +123,9 @@ class BertModel(nn.Module):
         B, S = input_ids.shape
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size))
+        # re-gather the ZeRO-sharded D dim before the lookup (see
+        # models/llama.py)
+        embed = constrain(embed, ("tensor", None))
         h = jnp.take(embed, input_ids, axis=0)
         pos_table = self.param("embed_positions", nn.initializers.normal(0.02),
                                (cfg.max_position_embeddings + cfg.position_offset,

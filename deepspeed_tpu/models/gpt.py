@@ -305,6 +305,10 @@ class GPTModel(nn.Module):
         cfg = self.config
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size))
+        # re-gather the ZeRO-sharded D dim before the lookup (see
+        # models/llama.py — avoids an involuntary full rematerialization
+        # of the activation under ZeRO-3 + TP/SP meshes)
+        embed = constrain(embed, ("tensor", None))
         h = jnp.take(embed, input_ids, axis=0)
         decode = cache is not None
         positions = (start_pos + jnp.arange(input_ids.shape[1]))[None, :]

@@ -370,6 +370,13 @@ class LlamaModel(nn.Module):
         cfg = self.config
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size))
+        # ZeRO-3 shards the table's D dim over the zero axes; re-gather it
+        # before the lookup (the explicit form of ZeRO-3's pre-op
+        # all-gather) so the gather's output needs only a cheap
+        # dynamic-slice to reach the hidden layout — without this, XLA
+        # resorts to an involuntary full rematerialization of the
+        # activation on every step.
+        embed = constrain(embed, ("tensor", None))
         h = jnp.take(embed, input_ids, axis=0)
         decode = cache is not None
         if not decode:
