@@ -32,6 +32,9 @@ class QuantizedWeight:
         if self.scheme == "fp8":
             from deepspeed_tpu.ops.fp_quantizer.quantize import dequantize_fp8
             return dequantize_fp8(self.values, self.scales, self.shape, dtype=dtype)
+        if self.scheme == "fp6":
+            from deepspeed_tpu.ops.fp_quantizer.quantize import dequantize_fp6
+            return dequantize_fp6(self.values, self.scales, self.shape, dtype=dtype)
         from deepspeed_tpu.ops.pallas.quantization import dequantize_int8
         return dequantize_int8(self.values, self.scales, self.shape, dtype=dtype)
 
@@ -59,6 +62,9 @@ def _init_group_wise_weight_quantization(params, ds_config=None, num_bits=8,
         if scheme == "fp8":
             from deepspeed_tpu.ops.fp_quantizer.quantize import quantize_fp8
             v, s, shape = quantize_fp8(x, group_size=group_size)
+        elif scheme == "fp6":
+            from deepspeed_tpu.ops.fp_quantizer.quantize import quantize_fp6
+            v, s, shape = quantize_fp6(x, group_size=group_size)
         else:
             from deepspeed_tpu.ops.pallas.quantization import quantize_int8
             v, s, shape = quantize_int8(x, group_size=group_size)

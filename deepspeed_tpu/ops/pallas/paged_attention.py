@@ -130,8 +130,8 @@ def paged_decode_attention(q, kc, vc, block_tables, token_pos, interpret=None):
         grid=(T,),
         in_specs=[
             pl.BlockSpec((1, H, Dh), lambda t, tab, pos: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, H, Dh), lambda t, tab, pos: (t, 0, 0)),
         scratch_shapes=[

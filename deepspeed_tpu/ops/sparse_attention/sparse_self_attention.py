@@ -2,14 +2,17 @@
 
 Capability match for the reference's
 ``deepspeed/ops/sparse_attention/sparse_self_attention.py``
-(``SparseSelfAttention`` over the triton matmul/softmax kernels):
-attention restricted to the key blocks a :class:`SparsityConfig` layout
-admits. TPU form: the block layout expands to a score mask consumed by
-the fused XLA attention — on the MXU, computing a masked dense tile is
-the fast path (the triton kernels exist to skip SRAM tiles on GPUs;
-XLA's fusion + the mask achieve the memory effect of never writing
-masked scores, and a Pallas block-skipping variant remains open perf
-headroom, tracked in the module docstring)."""
+(``SparseSelfAttention`` over the triton matmul/softmax kernels in
+``matmul.py:819`` / ``softmax.py:296``): attention restricted to the
+key blocks a :class:`SparsityConfig` layout admits. Two TPU paths:
+
+- **Pallas block-skip kernels** (``ops/pallas/block_sparse_attention``)
+  — the layout compresses to admitted-block index lists and the grid
+  walks only those, so FLOPs/HBM traffic scale with layout density
+  like the reference's SDD/DSD kernels;
+- **masked dense** fallback — the layout expands to a score mask on
+  the fused XLA attention (used off-TPU, and when an element-wise key
+  padding mask makes block-granular skipping inapplicable)."""
 
 import numpy as np
 
@@ -30,25 +33,50 @@ def layout_to_mask(layout, block, seq_len):
 class SparseSelfAttention:
 
     def __init__(self, sparsity_config: SparsityConfig = None, key_padding_mask_mode="add",
-                 attn_mask_mode="mul", max_seq_length=2048):
+                 attn_mask_mode="mul", max_seq_length=2048, force_kernel=None):
         self.sparsity_config = sparsity_config or DenseSparsityConfig(num_heads=1)
         self.max_seq_length = max_seq_length
+        self.force_kernel = force_kernel  # None = auto (use_pallas), True/False pin
         self._mask_cache = {}
+        self._layout_cache = {}
+
+    def _layout(self, seq_len):
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
 
     def _mask(self, seq_len):
         if seq_len not in self._mask_cache:
-            layout = self.sparsity_config.make_layout(seq_len)
             self._mask_cache[seq_len] = layout_to_mask(
-                layout, self.sparsity_config.block, seq_len)
+                self._layout(seq_len), self.sparsity_config.block, seq_len)
         return self._mask_cache[seq_len]
+
+    def _use_kernel(self, seq_len):
+        if self.force_kernel is not None:
+            return self.force_kernel
+        if seq_len % self.sparsity_config.block != 0:
+            return False
+        from deepspeed_tpu.ops.pallas import use_pallas
+        return use_pallas()
 
     def __call__(self, q, k, v, key_padding_mask=None, attn_mask=None):
         """q/k/v: [B, S, H, D] → [B, S, H, D]; the layout mask composes
-        with an optional [B, S] key padding mask."""
+        with an optional [B, S] boolean key padding mask and an optional
+        [S, S] / [B, S, S] boolean attention mask. Element-wise masks
+        force the masked-dense path — padding/attn masks are not
+        block-granular, so the block-skip kernels cannot honor them."""
         B, S, H, D = q.shape
+        if key_padding_mask is None and attn_mask is None and self._use_kernel(S):
+            from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention
+            return block_sparse_attention(q, k, v, self._layout(S),
+                                          self.sparsity_config.block)
         mask = self._mask(S)  # [H or 1, S, S]
         mask = mask[None]  # [1, H, S, S]
         if key_padding_mask is not None:
             kp = jnp.asarray(key_padding_mask, bool)[:, None, None, :]  # [B, 1, 1, S]
             mask = jnp.logical_and(mask, kp)
+        if attn_mask is not None:
+            am = jnp.asarray(attn_mask, bool)
+            am = am[None, None] if am.ndim == 2 else am[:, None]  # → [B or 1, 1, S, S]
+            mask = jnp.logical_and(mask, am)
         return einsum_attention(q, k, v, causal=False, mask=mask)

@@ -99,14 +99,19 @@ class BigBirdSparsityConfig(SparsityConfig):
         layout = self.setup_layout(seq_len)
         n = layout.shape[1]
         w = self.num_sliding_window_blocks // 2
-        for q in range(n):
-            layout[0, q, max(0, q - w):min(n, q + w + 1)] = True  # sliding window
-            rand = self._rng.choice(n, size=min(self.num_random_blocks, n), replace=False)
-            layout[0, q, rand] = True  # random blocks
-        layout[0, :, :self.num_global_blocks] = True  # global columns
-        layout[0, :self.num_global_blocks, :] = True  # global rows
-        if self.attention == "unidirectional":
-            layout[0] &= np.tril(np.ones((n, n), bool))
+        # per-head random blocks when different_layout_per_head (reference
+        # loops over num_layout_heads, :439)
+        heads = self.num_heads if self.different_layout_per_head else 1
+        for h in range(heads):
+            for q in range(n):
+                layout[h, q, max(0, q - w):min(n, q + w + 1)] = True  # sliding window
+                rand = self._rng.choice(n, size=min(self.num_random_blocks, n),
+                                        replace=False)
+                layout[h, q, rand] = True  # random blocks
+            layout[h, :, :self.num_global_blocks] = True  # global columns
+            layout[h, :self.num_global_blocks, :] = True  # global rows
+            if self.attention == "unidirectional":
+                layout[h] &= np.tril(np.ones((n, n), bool))
         return self.check_and_propagate_first_head_layout(layout)
 
 

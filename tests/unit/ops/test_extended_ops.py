@@ -62,6 +62,55 @@ class TestFPQuantizer:
         back = dequantize_fp8(v, s, shape, dtype=jnp.float32)
         assert np.abs(np.asarray(back) - np.asarray(w)).max() < 0.5
 
+    def test_fp6_packing_is_6_bits(self):
+        """Real 6-bit packing (reference csrc/fp_quantizer/fp_quantize.cu):
+        4 values in 3 carrier bytes — storage must be exactly 0.75x the
+        FP8 path's, not a range-clamped fp8 byte per value."""
+        from deepspeed_tpu.ops.fp_quantizer import FP_Quantize
+        w = jnp.asarray(np.random.RandomState(0).randn(64, 64).astype(np.float32))
+        q = FP_Quantize(group_size=128)
+        v8, _ = q.quantize(w, q_bits=8)
+        v6, _ = q.quantize(w, q_bits=6)
+        assert v6.dtype == jnp.uint8
+        assert v6.size * v6.dtype.itemsize == (v8.size * v8.dtype.itemsize) * 3 // 4
+
+    def test_fp6_roundtrip_error_bounded(self):
+        from deepspeed_tpu.ops.fp_quantizer import FP_Quantize
+        rng = np.random.RandomState(2)
+        w = jnp.asarray(rng.randn(32, 256).astype(np.float32))
+        q = FP_Quantize(group_size=256)
+        v, s = q.quantize(w, q_bits=6)
+        back = q.dequantize(v, s, q_bits=6)
+        assert back.shape == w.shape
+        # e3m2 relative ulp is 2^-3 per group-scaled value
+        rel = np.abs(np.asarray(back) - np.asarray(w)).max() / np.abs(np.asarray(w)).max()
+        assert rel < 0.15, rel
+
+    def test_fp6_codes_roundtrip_exactly(self):
+        """Every representable e3m2 value must survive encode(decode(c))
+        unchanged, and encode must round to nearest (ties to even)."""
+        from deepspeed_tpu.ops.fp_quantizer.quantize import _decode_e3m2, _encode_e3m2
+        codes = jnp.arange(64, dtype=jnp.uint8)
+        vals = _decode_e3m2(codes)
+        # -0 (code 32) encodes to +0; all other codes round-trip exactly
+        re = np.asarray(_encode_e3m2(vals))
+        want = np.asarray(codes).copy()
+        want[32] = 0
+        np.testing.assert_array_equal(re, want)
+        # ties to even: 0.03125 sits between codes 0 and 1 → rounds to 0;
+        # 0.09375 sits between 1 and 2 → rounds to 2
+        assert int(_encode_e3m2(jnp.asarray([0.03125]))[0]) == 0
+        assert int(_encode_e3m2(jnp.asarray([0.09375]))[0]) == 2
+        # nearest: 27.0 is closer to 28 (code 31) than to 26 (code 30)
+        assert int(_encode_e3m2(jnp.asarray([27.1]))[0]) == 31
+
+    def test_fp6_pack_unpack_inverse(self):
+        from deepspeed_tpu.ops.fp_quantizer.quantize import pack_fp6, unpack_fp6
+        codes = jnp.asarray(np.random.RandomState(3).randint(0, 64, size=256), jnp.uint8)
+        packed = pack_fp6(codes)
+        assert packed.shape == (192,) and packed.dtype == jnp.uint8
+        np.testing.assert_array_equal(np.asarray(unpack_fp6(packed)), np.asarray(codes))
+
 
 class TestTransformerLayer:
 
@@ -116,6 +165,21 @@ class TestZeroInferenceQuant:
         qtree, dequant = _init_group_wise_weight_quantization(p, scheme="fp8")
         back = dequant(qtree, jnp.float32)["w"]
         assert np.abs(np.asarray(back) - np.asarray(p["w"])).max() < 0.3
+
+    def test_fp6_scheme(self):
+        """ZeRO-Inference can select real FP6 weight storage (reference
+        FP6-LLM path): 6 bits + scales on the wire, bounded error."""
+        from deepspeed_tpu.inference.quantization import (_init_group_wise_weight_quantization,
+                                                          quantized_bytes)
+        p = {"w": jnp.asarray(np.random.RandomState(0).randn(64, 64), jnp.float32)}
+        q8, _ = _init_group_wise_weight_quantization(p, scheme="fp8")
+        q6, dequant = _init_group_wise_weight_quantization(p, scheme="fp6")
+        w8 = quantized_bytes(q8)
+        w6 = quantized_bytes(q6)
+        scale_bytes = np.asarray(q8["w"].scales).nbytes
+        assert (w6 - scale_bytes) == (w8 - scale_bytes) * 3 // 4, (w6, w8)
+        back = dequant(q6, jnp.float32)["w"]
+        assert np.abs(np.asarray(back) - np.asarray(p["w"])).max() < 0.6
 
 
 class TestModelPresets:
