@@ -1,2 +1,4 @@
 from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW
+from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
+from deepspeed_tpu.ops.adam.zoadam import ZeroOneAdam

@@ -1,0 +1,162 @@
+"""0/1 Adam.
+
+Capability match for the reference's ``deepspeed/runtime/fp16/onebit/zoadam.py``
+(``ZeroOneAdam`` at zoadam.py:13, the 0/1 Adam paper
+https://arxiv.org/abs/2202.06009): adaptive variance-update intervals
+(the variance is refreshed at exponentially spaced steps — every
+``var_update_scaler`` refreshes the interval doubles — and frozen after
+``var_freeze_step``), with 1-bit compressed gradient exchange on every
+step that does not refresh the variance.
+
+TPU mapping, explicit where the architectures genuinely differ:
+
+- **Variance policy** — exact reference semantics (zoadam.py:209/270):
+  the interval/counter state machine lives in optimizer state, and the
+  engine mirrors it host-side (``wants_compressed``) to pick the exact
+  collective on refresh steps and the 1-bit error-feedback core on all
+  others.
+- **Local-step policy** (zoadam.py:247) — the reference lets per-rank
+  PARAM REPLICAS drift for ``local_step_interval`` steps and re-syncs
+  them by exchanging an accumulated momentum buffer. On a
+  single-controller SPMD mesh there are no per-rank replicas to drift:
+  parameters are one sharded logical array and every step's exchange is
+  an in-graph ICI collective that is ALREADY 1-bit compressed here —
+  per-step wire bytes match the reference's amortized budget without
+  the replica round-trip. ``local_step_scaler``/``local_step_clipper``
+  are accepted for config parity and recorded in state, but do not
+  skip synchronization.
+- The update rule matches the reference exactly: no bias correction,
+  decoupled weight decay (zoadam.py:245).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer, OptimizerTransform
+
+
+class ZeroOneAdam(DeepSpeedOptimizer):
+
+    def __init__(self, params=None, deepspeed=None, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False, weight_decay=0.0,
+                 max_grad_norm=0.0, var_freeze_step=100000, var_update_scaler=16,
+                 local_step_scaler=32678, local_step_clipper=16, amsgrad=False,
+                 cuda_aware=False, comm_backend_name="xla"):
+        if amsgrad:
+            raise RuntimeError("0/1 Adam does not support the AMSGrad variant.")
+        super().__init__(params=params, lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay, bias_correction=bias_correction)
+        self.var_freeze_step = int(var_freeze_step)
+        self.var_update_scaler = int(var_update_scaler)
+        self.local_step_scaler = int(local_step_scaler)
+        self.local_step_clipper = int(local_step_clipper)
+        self.comm_backend_name = comm_backend_name
+        # compression is active from step 0 (no warmup stage in 0/1 Adam);
+        # the engine consults wants_compressed() per step
+        self.freeze_step = 0
+        # host mirror of the in-state variance schedule (advanced lazily)
+        self._sched_step = 0
+        self._sched_interval = 1
+        self._sched_counter = 0
+
+    # ------------------------------------------------------------------
+    # Host-side schedule mirror (drives the engine's per-step choice of
+    # exact vs compressed gradient core)
+    # ------------------------------------------------------------------
+    def _advance_to(self, step):
+        """Replay the variance-interval state machine up to ``step``
+        (inclusive); cheap because it advances incrementally."""
+        if step < self._sched_step:  # resumed earlier: replay from scratch
+            self._sched_step, self._sched_interval, self._sched_counter = 0, 1, 0
+        while self._sched_step < step:
+            s = self._sched_step + 1
+            if s <= self.var_freeze_step and s % self._sched_interval == 0:
+                self._sched_counter += 1
+                if self._sched_counter == self.var_update_scaler:
+                    self._sched_counter = 0
+                    self._sched_interval *= 2
+            self._sched_step = s
+
+    def is_var_update_step(self, step):
+        """Does optimizer step ``step`` (1-based) refresh the variance?"""
+        if step > self.var_freeze_step:
+            return False
+        self._advance_to(step - 1)
+        return step % self._sched_interval == 0
+
+    def wants_compressed(self, global_steps):
+        """Engine protocol: should the NEXT step (``global_steps``
+        completed so far) use the 1-bit gradient core? Exact exchange
+        only on variance-refresh steps (reference
+        enable_backward_allreduce toggling, zoadam.py:275)."""
+        return not self.is_var_update_step(global_steps + 1)
+
+    # ------------------------------------------------------------------
+    def transform(self) -> OptimizerTransform:
+        group = self.param_groups[0]
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        wd = group["weight_decay"]
+        var_freeze_step = self.var_freeze_step
+        var_update_scaler = self.var_update_scaler
+        local_step_scaler = self.local_step_scaler
+        local_step_clipper = self.local_step_clipper
+
+        def init(params):
+            zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "exp_avg": jax.tree.map(zeros, params),
+                "exp_avg_sq": jax.tree.map(zeros, params),
+                # reference state-machine scalars (zoadam.py:180)
+                "var_interval": jnp.ones((), jnp.int32),
+                "var_counter": jnp.zeros((), jnp.int32),
+                "local_step_interval": jnp.ones((), jnp.int32),
+                "local_step_counter": jnp.zeros((), jnp.int32),
+            }
+
+        def update(grads, state, params, lr):
+            step = state["step"] + 1
+            var_interval = state["var_interval"]
+            do_var = jnp.logical_and(step <= var_freeze_step,
+                                     step % var_interval == 0)
+
+            def leaf(g, p, m, v):
+                g = g.astype(jnp.float32)
+                m_new = beta1 * m + (1.0 - beta1) * g
+                v_new = jnp.where(do_var, beta2 * v + (1.0 - beta2) * jnp.square(g), v)
+                # reference update: NO bias correction, decoupled wd
+                upd = m_new / (jnp.sqrt(v_new) + eps)
+                if wd != 0.0:
+                    upd = upd + wd * p
+                return p - lr * upd, m_new, v_new
+
+            out = jax.tree.map(leaf, grads, params, state["exp_avg"], state["exp_avg_sq"])
+            treedef = jax.tree.structure(params)
+            leaves = treedef.flatten_up_to(out)
+            p_new = treedef.unflatten([x[0] for x in leaves])
+            m_new = treedef.unflatten([x[1] for x in leaves])
+            v_new = treedef.unflatten([x[2] for x in leaves])
+
+            # variance-interval state machine (zoadam.py:270)
+            var_counter = jnp.where(do_var, state["var_counter"] + 1, state["var_counter"])
+            double = jnp.logical_and(do_var, var_counter == var_update_scaler)
+            var_interval = jnp.where(double, var_interval * 2, var_interval)
+            var_counter = jnp.where(double, 0, var_counter)
+            # local-step bookkeeping (parity state; see module docstring)
+            frozen = step > var_freeze_step
+            ls_counter = jnp.where(frozen, state["local_step_counter"] + 1,
+                                   state["local_step_counter"])
+            ls_double = jnp.logical_and(frozen, ls_counter == local_step_scaler)
+            ls_interval = jnp.where(
+                ls_double, jnp.minimum(local_step_clipper,
+                                       state["local_step_interval"] * 2),
+                state["local_step_interval"])
+            ls_counter = jnp.where(ls_double, 0, ls_counter)
+
+            return p_new, {"step": step, "exp_avg": m_new, "exp_avg_sq": v_new,
+                           "var_interval": var_interval, "var_counter": var_counter,
+                           "local_step_interval": ls_interval,
+                           "local_step_counter": ls_counter}
+
+        return OptimizerTransform(init, update)

@@ -1,1 +1,2 @@
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.ops.lamb.onebit_lamb import OnebitLamb

@@ -1,0 +1,138 @@
+"""1-bit LAMB.
+
+Capability match for the reference's ``deepspeed/runtime/fp16/onebit/lamb.py``
+(``OnebitLamb`` at lamb.py:15): baseline LAMB during warmup while an
+EMA of the observed trust ratios accumulates (``lamb_coeff_freeze``,
+lamb.py:247); after ``freeze_step`` the variance freezes, the exchange
+is 1-bit compressed, and each layer's step is scaled by the frozen
+coefficient times a live correction ``factor`` — the ratio between the
+frozen denominator and a "fresh" variance maintained from the synced
+gradients — clipped to [factor_min, factor_max] and rate-limited by
+``factor_threshold`` (lamb.py:350).
+
+Same gradient-domain compression design as ``OnebitAdam``: the engine's
+1-bit error-feedback core exchanges sign+scale GRADIENTS inside the
+manual-'data' region (the reference compresses the momentum and
+rescales it by per-tensor ``scaling_coeff``; with gradient-domain EF
+the momentum stays exact, so no scaling coefficients are needed and
+the wire format — 1 bit/value + one scale — is identical).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer, OptimizerTransform
+
+
+class OnebitLamb(DeepSpeedOptimizer):
+
+    def __init__(self, params=None, deepspeed=None, lr=1e-3, freeze_step=100000,
+                 bias_correction=True, betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0, min_coeff=0.01,
+                 amsgrad=False, cuda_aware=False, comm_backend_name="xla",
+                 coeff_beta=0.9, factor_max=4.0, factor_min=0.5, factor_threshold=0.1):
+        if amsgrad:
+            raise RuntimeError("1-bit LAMB does not support the AMSGrad variant.")
+        super().__init__(params=params, lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay, bias_correction=bias_correction,
+                         max_coeff=max_coeff, min_coeff=min_coeff)
+        self.freeze_step = int(freeze_step)
+        self.coeff_beta = float(coeff_beta)
+        self.factor_max = float(factor_max)
+        self.factor_min = float(factor_min)
+        self.factor_threshold = float(factor_threshold)
+        self.comm_backend_name = comm_backend_name
+
+    def transform(self) -> OptimizerTransform:
+        group = self.param_groups[0]
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        wd = group["weight_decay"]
+        max_coeff = group["max_coeff"]
+        min_coeff = group["min_coeff"]
+        freeze_step = self.freeze_step
+        coeff_beta = self.coeff_beta
+        factor_max = self.factor_max
+        factor_min = self.factor_min
+        factor_threshold = self.factor_threshold
+
+        def init(params):
+            zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+            scalar = lambda v: lambda p: jnp.full((), v, jnp.float32)
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "exp_avg": jax.tree.map(zeros, params),
+                "exp_avg_sq": jax.tree.map(zeros, params),
+                # fresh variance maintained during the compressed stage
+                # (reference exp_avg_sq_fresh, lamb.py:230)
+                "exp_avg_sq_fresh": jax.tree.map(zeros, params),
+                # wrapped one level so the engine's state-sharding logic
+                # does not mistake these scalar-per-leaf trees for
+                # param-shaped moments (treedef would match params')
+                "lamb_coeff_freeze": {"per_leaf": jax.tree.map(scalar(0.0), params)},
+                "last_factor": {"per_leaf": jax.tree.map(scalar(1.0), params)},
+            }
+
+        def update(grads, state, params, lr):
+            step = state["step"] + 1
+            frozen = step > freeze_step
+            at_freeze = step == freeze_step
+
+            def leaf(g, p, m, v, v_fresh, coeff_frz, last_factor):
+                g = g.astype(jnp.float32)
+                m_new = beta1 * m + (1.0 - beta1) * g
+                # warmup keeps one variance; it freezes at freeze_step and
+                # the fresh copy tracks the compressed-stage gradients
+                v_warm = beta2 * v + (1.0 - beta2) * jnp.square(g)
+                v_new = jnp.where(frozen, v, v_warm)
+                v_fresh_new = jnp.where(
+                    frozen, beta2 * v_fresh + (1.0 - beta2) * jnp.square(g),
+                    jnp.where(at_freeze, v_warm, v_fresh))
+
+                denom = jnp.sqrt(v_new) + eps
+                update_prelim = m_new / denom
+                upd = update_prelim + wd * p if wd != 0.0 else update_prelim
+
+                p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+                u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+                live_coeff = jnp.where((p_norm > 0) & (u_norm > 0),
+                                       jnp.clip(p_norm / jnp.maximum(u_norm, 1e-12),
+                                                min_coeff, max_coeff), 1.0)
+                # EMA of warmup coefficients -> the frozen coefficient
+                # (reference lamb.py:247: only non-1.0 coeffs update it)
+                coeff_frz_new = jnp.where(
+                    frozen, coeff_frz,
+                    jnp.where(live_coeff != 1.0,
+                              coeff_beta * coeff_frz + (1.0 - coeff_beta) * live_coeff,
+                              coeff_frz))
+
+                # compressed stage: frozen coeff x live factor from the
+                # frozen/fresh denominator ratio (lamb.py:350)
+                denom_real = jnp.sqrt(v_fresh_new) + eps
+                factor = jnp.max(denom / denom_real)
+                if wd != 0.0:
+                    un = jnp.sqrt(jnp.sum(jnp.square(update_prelim)))
+                    ratio = jnp.minimum(1.0, un / jnp.maximum(u_norm, 1e-12))
+                    factor = factor * ratio + (1.0 - ratio)
+                factor = jnp.clip(factor, factor_min, factor_max)
+                factor = jnp.clip(factor, last_factor * (1.0 - factor_threshold),
+                                  last_factor * (1.0 + factor_threshold))
+                last_factor_new = jnp.where(frozen, factor, last_factor)
+                lamb_coeff = jnp.where(frozen, coeff_frz_new * factor, live_coeff)
+
+                p_new = p - lr * lamb_coeff * upd
+                return p_new, m_new, v_new, v_fresh_new, coeff_frz_new, last_factor_new
+
+            out = jax.tree.map(leaf, grads, params, state["exp_avg"], state["exp_avg_sq"],
+                               state["exp_avg_sq_fresh"],
+                               state["lamb_coeff_freeze"]["per_leaf"],
+                               state["last_factor"]["per_leaf"])
+            treedef = jax.tree.structure(params)
+            leaves = treedef.flatten_up_to(out)
+            pick = lambda i: treedef.unflatten([x[i] for x in leaves])
+            return pick(0), {"step": step, "exp_avg": pick(1), "exp_avg_sq": pick(2),
+                             "exp_avg_sq_fresh": pick(3),
+                             "lamb_coeff_freeze": {"per_leaf": pick(4)},
+                             "last_factor": {"per_leaf": pick(5)}}
+
+        return OptimizerTransform(init, update)

@@ -34,12 +34,21 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+_INDICES_CACHE = {}
+
+
 def layout_to_indices(layout):
     """[H, nq, nk] bool → (k_idx [H, nq, A], k_nnz [H, nq],
     q_idx [H, nk, Aq], q_nnz [H, nk]) int32 numpy arrays: per-(head, row)
     admitted-column lists (zero-padded) and their true lengths; the
-    ``q_*`` pair is the transpose, for the dK/dV pass."""
+    ``q_*`` pair is the transpose, for the dK/dV pass. Results are
+    cached by layout content — the compression loops are pure functions
+    of the (static, reused-every-step) layout."""
     layout = np.asarray(layout, bool)
+    key = (layout.shape, layout.tobytes())
+    hit = _INDICES_CACHE.get(key)
+    if hit is not None:
+        return hit
 
     def compress(lay):  # [H, R, C] → idx [H, R, A], nnz [H, R]
         nnz = lay.sum(-1)
@@ -53,6 +62,9 @@ def layout_to_indices(layout):
 
     k_idx, k_nnz = compress(layout)
     q_idx, q_nnz = compress(layout.transpose(0, 2, 1))
+    if len(_INDICES_CACHE) > 64:  # layouts are few; guard pathological use
+        _INDICES_CACHE.clear()
+    _INDICES_CACHE[key] = (k_idx, k_nnz, q_idx, q_nnz)
     return k_idx, k_nnz, q_idx, q_nnz
 
 

@@ -36,9 +36,21 @@ class SparseSelfAttention:
                  attn_mask_mode="mul", max_seq_length=2048, force_kernel=None):
         self.sparsity_config = sparsity_config or DenseSparsityConfig(num_heads=1)
         self.max_seq_length = max_seq_length
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
         self.force_kernel = force_kernel  # None = auto (use_pallas), True/False pin
         self._mask_cache = {}
         self._layout_cache = {}
+
+    @staticmethod
+    def _as_keep_mask(mask, mode):
+        """Reference mask conventions → boolean keep-mask: 'mul' masks are
+        1/0 (or bool) multipliers; 'add' masks are 0 (keep) /
+        large-negative (drop) additive biases."""
+        mask = jnp.asarray(mask)
+        if mode == "add" and jnp.issubdtype(mask.dtype, jnp.floating):
+            return mask >= 0
+        return mask.astype(bool)
 
     def _layout(self, seq_len):
         if seq_len not in self._layout_cache:
@@ -73,10 +85,10 @@ class SparseSelfAttention:
         mask = self._mask(S)  # [H or 1, S, S]
         mask = mask[None]  # [1, H, S, S]
         if key_padding_mask is not None:
-            kp = jnp.asarray(key_padding_mask, bool)[:, None, None, :]  # [B, 1, 1, S]
-            mask = jnp.logical_and(mask, kp)
+            kp = self._as_keep_mask(key_padding_mask, self.key_padding_mask_mode)
+            mask = jnp.logical_and(mask, kp[:, None, None, :])  # [B, 1, 1, S]
         if attn_mask is not None:
-            am = jnp.asarray(attn_mask, bool)
+            am = self._as_keep_mask(attn_mask, self.attn_mask_mode)
             am = am[None, None] if am.ndim == 2 else am[:, None]  # → [B or 1, 1, S, S]
             mask = jnp.logical_and(mask, am)
         return einsum_attention(q, k, v, causal=False, mask=mask)

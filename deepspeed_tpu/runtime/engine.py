@@ -331,11 +331,12 @@ class DeepSpeedEngine:
         if name == "onebitadam":
             from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
             return OnebitAdam(**params)
-        if name in ("zerooneadam", "onebitlamb"):
-            raise NotImplementedError(
-                f"{name}: not implemented — OneBitAdam (type 'OneBitAdam') is the "
-                f"supported compressed optimizer; its gradient-domain error feedback "
-                f"covers the same wire format")
+        if name == "zerooneadam":
+            from deepspeed_tpu.ops.adam.zoadam import ZeroOneAdam
+            return ZeroOneAdam(**params)
+        if name == "onebitlamb":
+            from deepspeed_tpu.ops.lamb.onebit_lamb import OnebitLamb
+            return OnebitLamb(**params)
         raise ValueError(f"Unknown optimizer {name}")
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -585,6 +586,21 @@ class DeepSpeedEngine:
     def _onebit_enabled(self):
         return getattr(self.optimizer, "freeze_step", None) is not None and \
             dict(self.mesh.shape).get("data", 1) > 1
+
+    def _use_compressed_now(self):
+        """Should the NEXT step use the 1-bit gradient core? Optimizers
+        with a per-step schedule (0/1 Adam's variance-refresh steps use
+        exact exchange) expose ``wants_compressed``; the 1-bit Adam/LAMB
+        warmup follows ``freeze_step``."""
+        if not self._onebit_enabled():
+            return False
+        opt = self.optimizer
+        if hasattr(opt, "wants_compressed"):
+            # key on APPLIED optimizer steps: overflow-skipped steps advance
+            # global_steps but not the in-state variance machine, and the
+            # host mirror must stay in lockstep with it
+            return opt.wants_compressed(self.global_steps - self.skipped_steps)
+        return self.global_steps >= opt.freeze_step
 
     def _manual_data_specs(self):
         """Shared spec derivation for manual-'data' shard_map regions
@@ -862,7 +878,7 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self._dropout_rng, sub = jax.random.split(self._dropout_rng)
         scale = self.scaler_state["cur_scale"]
-        if self._onebit_enabled() and self.global_steps >= self.optimizer.freeze_step:
+        if self._use_compressed_now():
             # compressed stage: 1-bit grad exchange with error feedback
             if getattr(self, "_onebit_efb", None) is None:
                 self._onebit_efb = self._init_onebit_efb()
@@ -1194,7 +1210,7 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         self._dropout_rng, sub = jax.random.split(self._dropout_rng)
-        if self._onebit_enabled() and self.global_steps >= self.optimizer.freeze_step:
+        if self._use_compressed_now():
             # compressed stage threads error feedback through each micro
             # step: run the unfused forward/backward loop + one step()
             micro_losses = []

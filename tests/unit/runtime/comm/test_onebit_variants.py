@@ -1,0 +1,149 @@
+"""0/1 Adam and 1-bit LAMB (analogue of reference
+tests/unit/runtime/half_precision/onebit/ TestZeroOneAdam /
+TestOneBitLamb)."""
+
+import numpy as np
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.adam.zoadam import ZeroOneAdam
+from deepspeed_tpu.parallel import groups
+from unit.simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 32
+
+
+def make_engine(opt_type, opt_params, lr=1e-2):
+    groups.destroy_mesh()
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": opt_type, "params": {"lr": lr, **opt_params}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data_parallel_size": 8},
+    }
+    model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+class TestZeroOneAdam:
+
+    def test_var_schedule_state_machine(self):
+        """Variance-refresh intervals double every var_update_scaler
+        refreshes (reference zoadam.py:270)."""
+        opt = ZeroOneAdam(var_freeze_step=100, var_update_scaler=2)
+        # interval 1 for 2 refreshes (steps 1, 2) -> interval 2 for
+        # refreshes at steps 4, 6 -> interval 4 at steps 8, 12 ...
+        refresh = [s for s in range(1, 16) if opt.is_var_update_step(s)]
+        assert refresh == [1, 2, 4, 6, 8, 12], refresh
+        # frozen after var_freeze_step
+        assert not opt.is_var_update_step(101)
+        # engine protocol: exact exchange exactly on refresh steps
+        assert not opt.wants_compressed(0)   # next step = 1, refresh
+        assert opt.wants_compressed(2)       # next step = 3, no refresh
+        # replay after resume-from-earlier works
+        opt.is_var_update_step(50)
+        assert opt.is_var_update_step(1)
+
+    def test_trains_and_uses_compressed_steps(self):
+        engine = make_engine("ZeroOneAdam",
+                             {"var_freeze_step": 4, "var_update_scaler": 2})
+        x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+        losses = []
+        for _ in range(10):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+        # compressed steps really ran (error feedback materialized)
+        assert engine._onebit_efb is not None
+        # in-state schedule advanced in lockstep with the host mirror
+        st = engine.opt_state
+        assert int(st["step"]) == 10
+        assert int(st["var_interval"]) >= 2
+
+    def test_variance_frozen_after_freeze_step(self):
+        engine = make_engine("ZeroOneAdam",
+                             {"var_freeze_step": 2, "var_update_scaler": 16})
+        x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+        for _ in range(3):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+        v_after_freeze = np.concatenate(
+            [np.asarray(l).ravel() for l in jax.tree.leaves(engine.opt_state["exp_avg_sq"])])
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        v_next = np.concatenate(
+            [np.asarray(l).ravel() for l in jax.tree.leaves(engine.opt_state["exp_avg_sq"])])
+        assert np.array_equal(v_after_freeze, v_next)  # frozen exactly
+
+
+class TestOneBitLamb:
+
+    def test_warmup_matches_trust_ratio_lamb(self):
+        """During warmup the loss curve is LAMB-like and finite; the
+        frozen-coefficient EMA accumulates."""
+        engine = make_engine("OneBitLamb", {"freeze_step": 100}, lr=1e-2)
+        x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+        losses = []
+        for _ in range(5):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        coeffs = [float(c) for c in
+                  jax.tree.leaves(engine.opt_state["lamb_coeff_freeze"])]
+        assert any(c > 0 for c in coeffs)  # EMA moved off its 0 init
+
+    def test_compressed_stage_trains(self):
+        engine = make_engine("OneBitLamb", {"freeze_step": 3}, lr=1e-2)
+        x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+        losses = []
+        for _ in range(12):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[2], losses
+        assert engine._onebit_efb is not None  # 1-bit exchange ran
+        # frozen variance: exp_avg_sq stops moving, fresh one keeps moving
+        v = np.concatenate([np.asarray(l).ravel()
+                            for l in jax.tree.leaves(engine.opt_state["exp_avg_sq"])])
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        v2 = np.concatenate([np.asarray(l).ravel()
+                             for l in jax.tree.leaves(engine.opt_state["exp_avg_sq"])])
+        assert np.array_equal(v, v2)
+        factors = [float(f) for f in jax.tree.leaves(engine.opt_state["last_factor"])]
+        assert all(0.5 <= f <= 4.0 for f in factors)
+
+    def test_convergence_vs_uncompressed_lamb(self):
+        """Compressed 1-bit LAMB reaches a loss in the same ballpark as
+        uncompressed FusedLamb on the same stream (reference
+        TestOneBitLambExpAvgMask-style closeness, relaxed)."""
+        data = random_dataloader(None, 32, HIDDEN, batch_size=8)
+
+        def run(opt_type, params):
+            engine = make_engine(opt_type, params, lr=1e-2)
+            losses = []
+            for i in range(20):
+                x, y = data[i % len(data)]
+                loss = engine(x, y)
+                engine.backward(loss)
+                engine.step()
+                losses.append(float(loss))
+            return losses
+
+        base = run("Lamb", {})
+        onebit = run("OneBitLamb", {"freeze_step": 4})
+        assert onebit[-1] < base[0] * 0.9  # it genuinely optimizes
+        # same ballpark as the exact optimizer at the end of the run
+        assert onebit[-1] < base[-1] * 3 + 1e-3, (onebit[-1], base[-1])
