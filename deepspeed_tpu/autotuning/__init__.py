@@ -1,5 +1,8 @@
 """Autotuning (parity: deepspeed/autotuning/)."""
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune
+from deepspeed_tpu.autotuning.scheduler import (Node, Reservation, ResourceManager,
+                                                parse_hostfile)
 
-__all__ = ["Autotuner", "autotune"]
+__all__ = ["Autotuner", "autotune", "ResourceManager", "Node", "Reservation",
+           "parse_hostfile"]

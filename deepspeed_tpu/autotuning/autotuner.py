@@ -3,12 +3,22 @@
 Capability match for the reference's ``deepspeed/autotuning/autotuner.py``
 (``Autotuner`` at autotuner.py:42: builds an experiment grid over
 zero-stage/micro-batch tuning spaces, launches each config, ranks by a
-metric). TPU redesign: experiments run in-process — each candidate
-config builds an engine on the live mesh, times a few fused
-``train_batch`` steps (first step discarded: XLA compile), and the
-grid is pruned stage-first exactly like the reference's
-``tune_space`` fast mode. Results and the winning ds_config are
-written as JSON next to the experiment dir.
+metric). Two execution modes:
+
+- **in-process** (``tune()``): each candidate config builds an engine on
+  the live mesh, times a few fused ``train_batch`` steps (first step
+  discarded: XLA compile), and the grid is pruned stage-first exactly
+  like the reference's ``tune_space`` fast mode.
+- **distributed** (``tune_distributed()``): the grid is materialized as
+  a reference-style results tree (one dir per experiment with
+  ``exp.json`` / ``exp_result.json`` / logs) and the experiments run as
+  SUBPROCESSES scheduled over a hostfile by
+  ``autotuning/scheduler.ResourceManager`` (ssh to remote hosts, the
+  local interpreter for localhost) — the reference's
+  ``scheduler.py:32`` experiment scheduler.
+
+Results and the winning ds_config are written as JSON next to the
+experiment dirs either way.
 """
 
 import copy
@@ -43,10 +53,15 @@ class Autotuner:
 
     def __init__(self, model_fn, base_config, batch_fn, micro_batches=None,
                  zero_stages=None, steps=3, mesh=None, results_dir=None,
-                 metric="throughput", autotuning_config=None):
+                 metric="throughput", autotuning_config=None,
+                 model_spec=None, batch_spec=None):
         self.model_fn = model_fn
         self.base_config = base_config
         self.batch_fn = batch_fn
+        # JSON-able specs for the distributed mode's out-of-process
+        # workers (exp_runner.py schema)
+        self.model_spec = model_spec
+        self.batch_spec = batch_spec
         self.micro_batches = list(micro_batches or DEFAULT_MICRO_BATCHES)
         self.zero_stages = list(zero_stages or DEFAULT_ZERO_STAGES)
         self.steps = steps
@@ -138,6 +153,52 @@ class Autotuner:
         if self.results_dir:
             self.write_results()
         return self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"])
+
+    def tune_distributed(self, hosts=None, hostfile=None, env=None,
+                         slots_per_exp=1, timeout=None):
+        """Run the full stage x micro-batch grid as scheduled
+        subprocesses over ``hosts`` ({hostname: slots}) or a reference
+        hostfile; returns the winning ds_config. Requires ``model_spec``
+        (+ optional ``batch_spec``) — the out-of-process workers rebuild
+        the model from the JSON spec."""
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager, parse_hostfile
+        if self.model_spec is None:
+            raise ValueError("tune_distributed needs model_spec (a JSON-able "
+                             "exp_runner model description)")
+        if hosts is None:
+            hosts = parse_hostfile(hostfile) if hostfile else {"localhost": 1}
+        results_dir = self.results_dir or "autotuning_exps"
+        grid = []  # (stage, mbs, name, exp_dir)
+        for stage in self.zero_stages:
+            for mbs in sorted(self.micro_batches):
+                name = f"z{stage}_mbs{mbs}"
+                exp_dir = os.path.join(results_dir, name)
+                os.makedirs(exp_dir, exist_ok=True)
+                exp = {"name": name, "ds_config": self._experiment_config(stage, mbs),
+                       "model": self.model_spec, "batch": self.batch_spec or {},
+                       "steps": self.steps}
+                with open(os.path.join(exp_dir, "exp.json"), "w") as f:
+                    json.dump(exp, f, indent=1)
+                grid.append((stage, mbs, name, exp_dir))
+        rm = ResourceManager(hosts, results_dir, slots_per_exp=slots_per_exp,
+                             env=env, timeout=timeout)
+        rm.schedule_experiments([g[3] for g in grid])
+        finished = rm.run()
+        self.results = []
+        for stage, mbs, name, _ in grid:
+            r = finished.get(name, {"value": None, "error": "never ran"})
+            self.results.append({"zero_stage": stage, "micro_batch_size": mbs,
+                                 "metric": self.metric, "value": r.get("value"),
+                                 "error": r.get("error"),
+                                 "step_time_s": r.get("step_time_s")})
+        ok = [r for r in self.results if r["value"] is not None]
+        if not ok:
+            raise RuntimeError("autotuning: every experiment failed; see results")
+        self.best = max(ok, key=lambda r: r["value"])
+        self.results_dir = results_dir
+        self.write_results()
+        return self._experiment_config(self.best["zero_stage"],
+                                       self.best["micro_batch_size"])
 
     def write_results(self):
         os.makedirs(self.results_dir, exist_ok=True)

@@ -1,0 +1,117 @@
+"""Autotuning experiment worker: one out-of-process experiment.
+
+Capability match for the reference's per-experiment job the scheduler
+launches (``deepspeed/autotuning/scheduler.py:310`` ``run_experiment``:
+materialize the exp's ds_config, run the user script, harvest the
+metric file). TPU form: the experiment spec is a self-contained JSON
+(``exp.json``) naming a model family/preset + synthetic batch shape, so
+the worker needs no pickled callables — it builds the engine, times
+``train_batch`` steps, and writes ``exp_result.json`` next to the spec.
+
+Spec schema::
+
+    {"name": ..., "ds_config": {...},
+     "model": {"family": "llama"|"gpt"|"simple", "preset": ..., "overrides": {...}},
+     "batch": {"seq_len": 64},     # simple: {"hidden_dim": 32}
+     "steps": 3}
+
+Run: ``python -m deepspeed_tpu.autotuning.exp_runner --exp-dir DIR``.
+``DS_FORCE_PLATFORM`` (cpu/tpu) pins the JAX backend before first use
+(needed because a plugin backend can ignore ``JAX_PLATFORMS``).
+"""
+
+import argparse
+import json
+import os
+import time
+import traceback
+
+
+def run_experiment_dir(exp_dir):
+    platform = os.environ.get("DS_FORCE_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    import numpy as np
+
+    import deepspeed_tpu
+
+    with open(os.path.join(exp_dir, "exp.json")) as f:
+        exp = json.load(f)
+    model_spec = exp.get("model", {})
+    family = model_spec.get("family", "simple")
+    overrides = dict(model_spec.get("overrides", {}))
+    batch_spec = exp.get("batch", {})
+    steps = int(exp.get("steps", 3))
+    cfg = exp["ds_config"]
+    mbs = int(cfg.get("train_micro_batch_size_per_gpu", 1))
+    gas = int(cfg.get("gradient_accumulation_steps", 1))
+
+    if family == "llama":
+        from deepspeed_tpu.models import build_llama
+        model = build_llama(model_spec.get("preset", "debug"), **overrides)
+        seq = int(batch_spec.get("seq_len", 64))
+        ids = (np.arange(mbs * seq, dtype=np.int32).reshape(mbs, seq)
+               % model.config.vocab_size)
+        batch = (ids, ids)
+    elif family == "gpt":
+        from deepspeed_tpu.models import build_gpt
+        model = build_gpt(model_spec.get("preset", "gpt2-debug"), **overrides)
+        seq = int(batch_spec.get("seq_len", 64))
+        ids = (np.arange(mbs * seq, dtype=np.int32).reshape(mbs, seq)
+               % model.config.vocab_size)
+        batch = (ids, ids)
+    elif family == "simple":
+        # self-contained MLP classifier (no dependency on the tests/ tree)
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        hidden = int(batch_spec.get("hidden_dim", 32))
+        nlayers = int(overrides.get("nlayers", 2))
+
+        class _SimpleNet(nn.Module):
+            @nn.compact
+            def __call__(self, x, y):
+                for i in range(nlayers):
+                    x = nn.Dense(hidden, name=f"linear_{i}")(x)
+                logp = jax.nn.log_softmax(
+                    nn.Dense(hidden, name="classifier")(x).astype(jnp.float32), -1)
+                return -jnp.take_along_axis(
+                    logp, y.astype(jnp.int32)[..., None], axis=-1).mean()
+
+        model = _SimpleNet()
+        rng = np.random.RandomState(0)
+        batch = (rng.randn(mbs, hidden).astype(np.float32),
+                 rng.randint(0, hidden, size=(mbs,)).astype(np.int32))
+    else:
+        raise ValueError(f"unknown model family {family!r}")
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    stacked = tuple(np.stack([np.asarray(a)] * gas) for a in batch)
+    engine.train_batch(batch=stacked)  # compile step
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch=stacked)
+    dt = (time.perf_counter() - t0) / steps
+    return {"name": exp.get("name"), "value": (mbs * gas) / dt,
+            "metric": "throughput", "step_time_s": dt, "error": None}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--exp-dir", required=True)
+    args = parser.parse_args(argv)
+    result_path = os.path.join(args.exp_dir, "exp_result.json")
+    try:
+        result = run_experiment_dir(args.exp_dir)
+    except Exception as e:  # the scheduler prunes failed candidates
+        result = {"value": None, "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc()[-2000:]}
+    with open(result_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0 if result.get("error") is None else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

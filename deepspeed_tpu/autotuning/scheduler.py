@@ -1,0 +1,206 @@
+"""Distributed autotuning scheduler.
+
+Capability match for the reference's ``deepspeed/autotuning/scheduler.py``
+(``ResourceManager`` at scheduler.py:32 with its ``Node``/``Reservation``
+slot bookkeeping): experiments are materialized as directories
+(``exp.json``), scheduled onto hosts as slots free up, run
+OUT-OF-PROCESS (ssh for remote hosts, the current interpreter for
+localhost — the same transport split as ``launcher/multinode_runner``),
+and their ``exp_result.json`` metric files are harvested to pick the
+fastest config.
+"""
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+class Node:
+    """One host with a number of schedulable slots (reference :259)."""
+
+    def __init__(self, host, max_slots):
+        self.host = host
+        self.max_slots = max_slots
+        self.idle_slots = list(range(max_slots))
+
+    def reserve_slots(self, slot_request):
+        if len(self.idle_slots) >= slot_request:
+            return [self.idle_slots.pop(0) for _ in range(slot_request)]
+        return None
+
+    def restore_slots(self, slots):
+        self.idle_slots.extend(slots)
+
+
+class Reservation:
+    """Slots held by a running experiment (reference :274)."""
+
+    def __init__(self, node, slots):
+        self.node = node
+        self.slots = slots
+
+    def restore_slots(self):
+        self.node.restore_slots(self.slots)
+
+    def desc(self):
+        return f"{self.node.host}:{','.join(map(str, self.slots))}"
+
+
+class ResourceManager:
+    """Schedule experiment dirs over hosts (reference scheduler.py:32).
+
+    ``hosts``: ordered ``{hostname: slots}``; ``slots_per_exp``: how many
+    slots one experiment occupies on its host (1 = experiments may share
+    a host when it exposes multiple slots)."""
+
+    def __init__(self, hosts, results_dir, slots_per_exp=1, env=None,
+                 ssh_port=None, poll_interval=0.5, timeout=None):
+        self.nodes = [Node(h, s) for h, s in hosts.items()]
+        self.results_dir = results_dir
+        self.slots_per_exp = slots_per_exp
+        self.env = dict(env or {})
+        self.ssh_port = ssh_port
+        self.poll_interval = poll_interval
+        self.timeout = timeout
+        self.experiment_queue = []   # exp dicts waiting
+        self.running_experiments = {}  # exp_name -> (exp, proc, reservation, t0)
+        self.finished_experiments = {}  # exp_name -> result dict
+
+    # ------------------------------------------------------------------
+    def schedule_experiments(self, exp_paths):
+        for path in exp_paths:
+            with open(os.path.join(path, "exp.json")) as f:
+                exp = json.load(f)
+            exp["exp_dir"] = path
+            self.experiment_queue.append(exp)
+
+    def resource_request(self, exp):
+        """Reserve slots for one experiment, or None if nothing is free."""
+        if self.slots_per_exp > max(n.max_slots for n in self.nodes):
+            raise ValueError(
+                f"slots_per_exp={self.slots_per_exp} exceeds every node's slot "
+                f"count ({ {n.host: n.max_slots for n in self.nodes} }) — no "
+                f"experiment could ever be scheduled")
+        for node in self.nodes:
+            slots = node.reserve_slots(self.slots_per_exp)
+            if slots is not None:
+                return Reservation(node, slots)
+        return None
+
+    def _worker_cmd(self, exp):
+        worker = [sys.executable, "-m", "deepspeed_tpu.autotuning.exp_runner",
+                  "--exp-dir", exp["exp_dir"]]
+        return worker
+
+    def run_job(self, exp, reservation):
+        """Launch the experiment subprocess on the reserved host."""
+        host = reservation.node.host
+        # a stale result from a previous run must never be harvested as
+        # this run's outcome if the worker dies before writing
+        stale = os.path.join(exp["exp_dir"], "exp_result.json")
+        if os.path.exists(stale):
+            os.remove(stale)
+        env = {**os.environ, **self.env}
+        out = open(os.path.join(exp["exp_dir"], "stdout.log"), "w")
+        err = open(os.path.join(exp["exp_dir"], "stderr.log"), "w")
+        if host in _LOCAL_HOSTS:
+            proc = subprocess.Popen(self._worker_cmd(exp), env=env,
+                                    stdout=out, stderr=err)
+        else:
+            exports = " ".join(f"export {k}={shlex.quote(v)};"
+                               for k, v in self.env.items())
+            remote = (f"{exports} cd {shlex.quote(os.path.abspath('.'))}; "
+                      f"{shlex.join(self._worker_cmd(exp))}")
+            ssh = ["ssh"] + (["-p", str(self.ssh_port)] if self.ssh_port else [])
+            proc = subprocess.Popen(ssh + [host, remote], env=env,
+                                    stdout=out, stderr=err)
+        logger.info(f"autotune: launched {exp['name']} on {reservation.desc()} "
+                    f"(pid {proc.pid})")
+        self.running_experiments[exp["name"]] = (exp, proc, reservation,
+                                                 time.time())
+
+    def experiment_check(self):
+        """Reap finished experiments; restore their slots."""
+        done = []
+        for name, (exp, proc, reservation, t0) in self.running_experiments.items():
+            rc = proc.poll()
+            timed_out = self.timeout and (time.time() - t0) > self.timeout
+            if rc is None and not timed_out:
+                continue
+            if rc is None:
+                proc.kill()
+                proc.wait()
+                host = reservation.node.host
+                if host not in _LOCAL_HOSTS:
+                    # killing the local ssh client does not stop the remote
+                    # worker; best-effort remote kill so the freed slot is
+                    # not scheduled onto a still-busy host
+                    subprocess.run(
+                        ["ssh"] + (["-p", str(self.ssh_port)] if self.ssh_port else [])
+                        + [host, f"pkill -f {shlex.quote(exp['exp_dir'])}"],
+                        timeout=30, check=False)
+            reservation.restore_slots()
+            result_path = os.path.join(exp["exp_dir"], "exp_result.json")
+            if os.path.exists(result_path):
+                with open(result_path) as f:
+                    result = json.load(f)
+            else:
+                result = {"value": None,
+                          "error": "timeout" if timed_out else
+                          f"worker exited rc={proc.returncode} with no result"}
+            result["name"] = exp["name"]
+            self.finished_experiments[name] = result
+            done.append(name)
+        for name in done:
+            del self.running_experiments[name]
+
+    def run(self):
+        """Drain the queue: launch as slots free up, reap until all done."""
+        while self.experiment_queue or self.running_experiments:
+            while self.experiment_queue:
+                reservation = self.resource_request(self.experiment_queue[0])
+                if reservation is None:
+                    break
+                self.run_job(self.experiment_queue.pop(0), reservation)
+            self.experiment_check()
+            if self.running_experiments:
+                time.sleep(self.poll_interval)
+        return self.finished_experiments
+
+    def status(self):
+        return {"queued": len(self.experiment_queue),
+                "running": list(self.running_experiments.keys()),
+                "finished": len(self.finished_experiments)}
+
+    def parse_results(self, metric="throughput"):
+        """→ (best_exp_name, best_value); failed experiments excluded."""
+        ok = {n: r for n, r in self.finished_experiments.items()
+              if r.get("value") is not None}
+        if not ok:
+            return None, None
+        best = max(ok, key=lambda n: ok[n]["value"])
+        return best, ok[best]["value"]
+
+    def clear(self):
+        for _, proc, reservation, _ in self.running_experiments.values():
+            proc.kill()
+            reservation.restore_slots()
+        self.running_experiments.clear()
+        self.experiment_queue.clear()
+
+
+def parse_hostfile(path):
+    """Reference hostfile format: ``hostname slots=N`` per line — one
+    parser for the whole package (``launcher.runner.fetch_hostfile``)."""
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+    hosts = fetch_hostfile(path)
+    if hosts is None:
+        raise FileNotFoundError(path)
+    return hosts

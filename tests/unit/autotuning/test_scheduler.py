@@ -1,0 +1,104 @@
+"""Distributed autotuning scheduler (reference scheduler.py ResourceManager):
+slot bookkeeping, out-of-process experiment execution, results tree."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner, Node, Reservation, ResourceManager
+from deepspeed_tpu.autotuning.scheduler import parse_hostfile
+
+
+class TestSlotBookkeeping:
+
+    def test_node_reserve_restore(self):
+        node = Node("worker-0", 4)
+        slots = node.reserve_slots(3)
+        assert slots == [0, 1, 2] and node.idle_slots == [3]
+        assert node.reserve_slots(2) is None  # only 1 free
+        node.restore_slots(slots)
+        assert sorted(node.idle_slots) == [0, 1, 2, 3]
+
+    def test_reservation_desc_and_restore(self):
+        node = Node("h", 2)
+        res = Reservation(node, node.reserve_slots(2))
+        assert res.desc() == "h:0,1"
+        res.restore_slots()
+        assert len(node.idle_slots) == 2
+
+    def test_parse_hostfile(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 slots=4\n# comment\nworker-1 slots=2\nworker-2\n")
+        hosts = parse_hostfile(str(hf))
+        assert hosts == {"worker-0": 4, "worker-1": 2, "worker-2": 1}
+
+
+def _write_exp(results_dir, name, stage, mbs, steps=2):
+    exp_dir = os.path.join(results_dir, name)
+    os.makedirs(exp_dir, exist_ok=True)
+    exp = {"name": name,
+           "ds_config": {"train_micro_batch_size_per_gpu": mbs,
+                         "gradient_accumulation_steps": 1,
+                         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                         "zero_optimization": {"stage": stage}},
+           "model": {"family": "simple", "overrides": {"nlayers": 2}},
+           "batch": {"hidden_dim": 16},
+           "steps": steps}
+    with open(os.path.join(exp_dir, "exp.json"), "w") as f:
+        json.dump(exp, f)
+    return exp_dir
+
+
+class TestDistributedExperiments:
+
+    def test_subprocess_experiments_and_results_tree(self, tmp_path):
+        """>= 2 experiments run as real subprocesses on the localhost
+        'node' and write the reference-style results tree."""
+        results_dir = str(tmp_path / "exps")
+        paths = [_write_exp(results_dir, "z0_mbs4", 0, 4),
+                 _write_exp(results_dir, "z1_mbs8", 1, 8),
+                 _write_exp(results_dir, "zX_bad", 9, 4)]  # invalid stage → pruned
+        rm = ResourceManager({"localhost": 2}, results_dir,
+                             env={"DS_FORCE_PLATFORM": "cpu", "XLA_FLAGS": ""}, timeout=300)
+        rm.schedule_experiments(paths)
+        finished = rm.run()
+        assert rm.status() == {"queued": 0, "running": [], "finished": 3}
+        assert finished["z0_mbs4"]["value"] > 0
+        assert finished["z1_mbs8"]["value"] > 0
+        assert finished["zX_bad"]["value"] is None  # failure captured, not raised
+        best, val = rm.parse_results()
+        assert best in ("z0_mbs4", "z1_mbs8") and val > 0
+        # results tree: per-exp result + logs written by the WORKERS
+        for name in ("z0_mbs4", "z1_mbs8"):
+            d = os.path.join(results_dir, name)
+            assert os.path.exists(os.path.join(d, "exp_result.json"))
+            assert os.path.exists(os.path.join(d, "stdout.log"))
+        with open(os.path.join(results_dir, "zX_bad", "exp_result.json")) as f:
+            bad = json.load(f)
+        assert bad["error"]
+
+    def test_autotuner_distributed_mode(self, tmp_path):
+        """Autotuner.tune_distributed over a hosts dict: grid scheduled
+        as subprocesses, best ds_config returned + optimal config file."""
+        results_dir = str(tmp_path / "tune")
+        tuner = Autotuner(
+            model_fn=None, batch_fn=None,
+            base_config={"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+            micro_batches=[4, 8], zero_stages=[1], steps=2,
+            results_dir=results_dir,
+            model_spec={"family": "simple", "overrides": {"nlayers": 2}},
+            batch_spec={"hidden_dim": 16})
+        best_cfg = tuner.tune_distributed(hosts={"localhost": 2},
+                                          env={"DS_FORCE_PLATFORM": "cpu", "XLA_FLAGS": ""},
+                                          timeout=300)
+        assert best_cfg["zero_optimization"]["stage"] == 1
+        assert best_cfg["train_micro_batch_size_per_gpu"] in (4, 8)
+        assert len(tuner.results) == 2
+        assert os.path.exists(os.path.join(results_dir, "autotuning_results.json"))
+        assert os.path.exists(os.path.join(results_dir, "ds_config_optimal.json"))
+
+    def test_requires_model_spec(self):
+        tuner = Autotuner(model_fn=None, batch_fn=None, base_config={})
+        with pytest.raises(ValueError, match="model_spec"):
+            tuner.tune_distributed(hosts={"localhost": 1})
