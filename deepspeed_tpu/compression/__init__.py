@@ -1,10 +1,16 @@
 """Compression (parity: deepspeed/compression/): QAT, pruning, layer
-reduction as functional transforms over the params pytree."""
+reduction, activation quantization, and a staged scheduler as
+functional transforms over the params pytree."""
 
-from deepspeed_tpu.compression.basic_layer import (head_pruning_mask, row_pruning_mask,
+from deepspeed_tpu.compression.basic_layer import (bits_at_step, channel_pruning_mask,
+                                                    head_pruning_mask,
+                                                    quantize_activation, row_pruning_mask,
                                                     sparse_pruning_mask, ste_quantize)
 from deepspeed_tpu.compression.compress import (init_compression, layer_reduction,
                                                  redundancy_clean)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
 __all__ = ["init_compression", "redundancy_clean", "layer_reduction",
-           "ste_quantize", "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask"]
+           "ste_quantize", "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask",
+           "channel_pruning_mask", "quantize_activation", "bits_at_step",
+           "CompressionScheduler"]

@@ -75,3 +75,35 @@ def head_pruning_mask(w, dense_ratio: float, num_heads: int):
     threshold = jnp.sort(per_head)[-k]
     mask = (per_head >= threshold).astype(w.dtype)
     return jnp.repeat(mask, head_dim)[None, :]
+
+
+def channel_pruning_mask(w, dense_ratio: float):
+    """Structured output-channel mask by L1 column norm (reference
+    ChannelPruningMethod / col pruning in fix_row_col_pruning_helper);
+    channels are the OUTPUT dim of a [.., out] kernel."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    k = max(1, int(round(norms.shape[0] * dense_ratio)))
+    threshold = jnp.sort(norms)[-k]
+    mask = (norms >= threshold).astype(w.dtype)
+    return mask.reshape((1,) * (w.ndim - 1) + (-1,))
+
+
+def quantize_activation(x, bits: int = 8, quant_mode: str = "symmetric"):
+    """Activation fake-quantization with a straight-through gradient
+    (reference ``QuantAct``, basic_layer.py:17): dynamic per-tensor
+    range, symmetric or asymmetric. Models apply it to layer inputs
+    when the compression config enables activation_quantization."""
+    return ste_quantize(x, bits, quant_mode == "symmetric")
+
+
+def bits_at_step(start_bits: int, target_bits: int, period: int, steps_since: int):
+    """Annealed weight-quantization bit-width: every ``period`` steps
+    the width halves until ``target_bits`` (reference Embedding/Linear
+    ``enable_weight_quantization`` quantization_period semantics — XTC
+    recipes walk 8 -> 4 -> 2/1)."""
+    if steps_since < 0:
+        return None  # not yet active
+    if period <= 0:
+        return target_bits
+    n = steps_since // period
+    return max(target_bits, start_bits >> n)

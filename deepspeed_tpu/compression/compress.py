@@ -15,13 +15,9 @@ leaf-path regex —
   training and permanently by :func:`redundancy_clean`.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.compression.basic_layer import (head_pruning_mask, row_pruning_mask,
-                                                   sparse_pruning_mask, ste_quantize)
 from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
 
 
@@ -32,10 +28,6 @@ def _section(ds_config, *keys, default=None):
             return default
         node = node[k]
     return node
-
-
-def _match_any(path, patterns):
-    return any(re.search(p, path) for p in patterns)
 
 
 def layer_reduction(params, keep_layers, layer_key="layers"):
@@ -63,48 +55,17 @@ def init_compression(params, ds_config, num_heads=None):
         params = layer_reduction(params, lr_cfg["teacher_layer"],
                                  layer_key=lr_cfg.get("layer_name", "layers"))
 
-    def enabled(technique):
-        shared = _section(ds_config, technique, "shared_parameters", default={}) or {}
-        return shared.get("enabled", False)
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+    scheduler = CompressionScheduler(ds_config, num_heads=num_heads)
 
-    wq_groups = _section(ds_config, "weight_quantization", "different_groups", default={}) or {}
-    sp_groups = _section(ds_config, "sparse_pruning", "different_groups", default={}) or {}
-    rp_groups = _section(ds_config, "row_pruning", "different_groups", default={}) or {}
-    hp_groups = _section(ds_config, "head_pruning", "different_groups", default={}) or {}
-
-    def group_patterns(groups):
-        pats, cfgs = [], []
-        for g in groups.values():
-            mods = g.get("modules", ["*"])
-            pats.append([m.replace("*", ".*") for m in mods])
-            cfgs.append(g.get("params", {}))
-        return list(zip(pats, cfgs))
-
-    wq_rules = group_patterns(wq_groups) if enabled("weight_quantization") else []
-    sp_rules = group_patterns(sp_groups) if enabled("sparse_pruning") else []
-    rp_rules = group_patterns(rp_groups) if enabled("row_pruning") else []
-    hp_rules = group_patterns(hp_groups) if enabled("head_pruning") else []
-
-    def forward_transform(p):
-        def leaf(path, x):
-            if x.ndim < 2:
-                return x
-            for pats, cfg in sp_rules:
-                if _match_any(path, pats):
-                    x = x * sparse_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
-            for pats, cfg in rp_rules:
-                if _match_any(path, pats):
-                    x = x * row_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
-            for pats, cfg in hp_rules:
-                if _match_any(path, pats):
-                    x = x * head_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)),
-                                              int(cfg.get("num_heads", num_heads or 1)))
-            for pats, cfg in wq_rules:
-                if _match_any(path, pats):
-                    x = ste_quantize(x, int(cfg.get("start_bits", 8)), True)
-            return x
-
-        return path_tree_map(leaf, p)
+    def forward_transform(p, step=None):
+        """``step=None`` → every enabled technique fully active at its
+        final (target) bit-width; with ``step``, techniques respect
+        their schedule_offset / quantization_period (the reference's
+        ``compression_scheduler.check_all_modules`` behavior)."""
+        if step is None:
+            step = 1 << 60  # past every offset, fully annealed
+        return scheduler.params_transform(step)(p)
 
     return params, forward_transform
 

@@ -1,0 +1,143 @@
+"""Staged compression scheduler.
+
+Capability match for the reference's ``deepspeed/compression/scheduler.py``
+(``compression_scheduler`` at scheduler.py:12): each technique in the
+``compression_training`` config carries a ``schedule_offset`` (and
+weight quantization a ``quantization_period``); the scheduler decides,
+per global step, which techniques are LIVE and at what bit-width, and
+hands the engine/user a params transform for that step. The reference
+mutates module flags in ``check_all_modules``; here the same decisions
+parameterize a pure forward transform.
+"""
+
+import re
+
+from deepspeed_tpu.compression.basic_layer import (bits_at_step, channel_pruning_mask,
+                                                   head_pruning_mask, row_pruning_mask,
+                                                   sparse_pruning_mask, ste_quantize)
+from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+
+TECHNIQUES = ("weight_quantization", "activation_quantization", "sparse_pruning",
+              "row_pruning", "head_pruning", "channel_pruning")
+
+
+def _shared(ds_config, technique):
+    node = ds_config.get("compression_training", {}).get(technique, {})
+    return node.get("shared_parameters", {}) or {}
+
+
+def _groups(ds_config, technique):
+    node = ds_config.get("compression_training", {}).get(technique, {})
+    rules = []
+    for g in (node.get("different_groups", {}) or {}).values():
+        mods = g.get("modules", ["*"])
+        rules.append(([m.replace("*", ".*") for m in mods], g.get("params", {})))
+    return rules
+
+
+def _match_any(path, patterns):
+    return any(re.search(p, path) for p in patterns)
+
+
+class CompressionScheduler:
+    """Per-step technique activation (reference compression_scheduler)."""
+
+    def __init__(self, ds_config, num_heads=None):
+        self.ds_config = ds_config
+        self.num_heads = num_heads
+        self.shared = {t: _shared(ds_config, t) for t in TECHNIQUES}
+        self.rules = {t: _groups(ds_config, t) for t in TECHNIQUES}
+
+    def technique_active(self, technique, step):
+        sh = self.shared[technique]
+        if not sh.get("enabled", False):
+            return False
+        return step >= int(sh.get("schedule_offset", 0))
+
+    # reference check_* surface -----------------------------------------
+    def check_weight_quantization(self, step):
+        return self.technique_active("weight_quantization", step)
+
+    def check_activation_quantization(self, step):
+        return self.technique_active("activation_quantization", step)
+
+    def check_sparse_pruning(self, step):
+        return self.technique_active("sparse_pruning", step)
+
+    def check_row_pruning(self, step):
+        return self.technique_active("row_pruning", step)
+
+    def check_head_pruning(self, step):
+        return self.technique_active("head_pruning", step)
+
+    def check_channel_pruning(self, step):
+        return self.technique_active("channel_pruning", step)
+
+    def check_all_modules(self, step):
+        return {t: self.technique_active(t, step) for t in TECHNIQUES}
+
+    # --------------------------------------------------------------------
+    def wq_bits(self, step, cfg):
+        """Annealed bit-width for one weight-quantization group at
+        ``step`` (start_bits halving every quantization_period down to
+        target_bits), or None while inactive."""
+        sh = self.shared["weight_quantization"]
+        offset = int(sh.get("schedule_offset", 0))
+        if not sh.get("enabled", False) or step < offset:
+            return None
+        start = int(cfg.get("start_bits", 8))
+        target = int(cfg.get("target_bits", start))
+        period = int(cfg.get("quantization_period", 0))
+        return bits_at_step(start, target, period, step - offset)
+
+    def activation_bits(self, step, module_path=""):
+        """Bit-width for activation quantization at ``step`` for the
+        module at ``module_path`` — the first group whose patterns match
+        wins, like every other technique (None while inactive / no
+        group matches a non-empty path). Models pass the result to
+        ``quantize_activation``."""
+        if not self.check_activation_quantization(step):
+            return None
+        for pats, cfg in self.rules["activation_quantization"]:
+            if not module_path or _match_any(module_path, pats):
+                return int(cfg.get("bits", 8))
+        return None
+
+    def params_transform(self, step):
+        """The forward params transform for ``step``: every technique
+        past its schedule_offset applies, weight quantization at its
+        annealed width."""
+        num_heads = self.num_heads
+        live = self.check_all_modules(step)
+
+        def leaf(path, x):
+            if getattr(x, "ndim", 0) < 2:
+                return x
+            if live["sparse_pruning"]:
+                for pats, cfg in self.rules["sparse_pruning"]:
+                    if _match_any(path, pats):
+                        x = x * sparse_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
+            if live["row_pruning"]:
+                for pats, cfg in self.rules["row_pruning"]:
+                    if _match_any(path, pats):
+                        x = x * row_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
+            if live["channel_pruning"]:
+                for pats, cfg in self.rules["channel_pruning"]:
+                    if _match_any(path, pats):
+                        x = x * channel_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)))
+            if live["head_pruning"]:
+                for pats, cfg in self.rules["head_pruning"]:
+                    if _match_any(path, pats):
+                        x = x * head_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)),
+                                                  int(cfg.get("num_heads", num_heads or 1)))
+            if live["weight_quantization"]:
+                for pats, cfg in self.rules["weight_quantization"]:
+                    if _match_any(path, pats):
+                        bits = self.wq_bits(step, cfg)
+                        if bits is not None:
+                            x = ste_quantize(x, bits,
+                                             cfg.get("quantization_type", "symmetric")
+                                             == "symmetric")
+            return x
+
+        return lambda params: path_tree_map(leaf, params)

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+    BUILTIN_METRICS, DataAnalyzer, DistributedDataAnalyzer)  # noqa: F401
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import \
+    DeepSpeedDataSampler  # noqa: F401
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)  # noqa: F401

@@ -94,3 +94,92 @@ class DataAnalyzer:
         """→ mmap'd [N] metric array for DeepSpeedDataSampler."""
         return np.load(os.path.join(save_path, f"{metric_name}_index_to_metric.npy"),
                        mmap_mode="r")
+
+
+# ---------------------------------------------------------------------------
+# Multi-process analysis over an on-disk dataset
+# ---------------------------------------------------------------------------
+
+# Built-in sample metrics (picklable by name for the worker processes).
+BUILTIN_METRICS = {
+    "seq_length": lambda sample: float(np.asarray(sample).size),
+    "mean_token": lambda sample: float(np.asarray(sample, np.float64).mean()),
+    "vocab_max": lambda sample: float(np.asarray(sample, np.float64).max()),
+}
+
+
+def _resolve_metric(fn):
+    if isinstance(fn, str):
+        return BUILTIN_METRICS[fn]
+    return fn
+
+
+def _dda_worker(dataset_prefix, dataset_factory, metric_names, metric_functions,
+                save_path, worker_id, num_workers, batch_size):
+    """One analysis worker (its own process): reopens the mmap'd dataset
+    and computes its stride's metrics."""
+    if dataset_prefix is not None:
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import \
+            MMapIndexedDataset
+        dataset = MMapIndexedDataset(dataset_prefix)
+    else:
+        dataset = dataset_factory()
+    analyzer = DataAnalyzer(dataset, metric_names=metric_names,
+                            metric_functions=[_resolve_metric(f) for f in metric_functions],
+                            save_path=save_path, num_workers=num_workers,
+                            worker_id=worker_id, batch_size=batch_size)
+    return analyzer.run_map()
+
+
+class DistributedDataAnalyzer:
+    """Multi-process map + single reduce over an on-disk dataset.
+
+    Capability match for the reference's ``DistributedDataAnalyzer``
+    (data_analyzer.py:455 — rank-parallel analysis with a final merge):
+    here the workers are PROCESSES on the analysis host, each reopening
+    the ``MMapIndexedDataset`` (nothing is pickled or held in RAM), and
+    the parent runs the reduce. Pass ``dataset_prefix`` for an indexed
+    dataset on disk, or a picklable zero-arg ``dataset_factory``.
+    ``metric_functions`` may be names from ``BUILTIN_METRICS`` or
+    module-level callables (the spawn context requires picklability).
+    """
+
+    def __init__(self, dataset_prefix=None, dataset_factory=None, metric_names=None,
+                 metric_functions=None, save_path="./data_analysis", num_workers=2,
+                 batch_size=1024):
+        assert (dataset_prefix is None) != (dataset_factory is None), \
+            "pass exactly one of dataset_prefix / dataset_factory"
+        self.dataset_prefix = dataset_prefix
+        self.dataset_factory = dataset_factory
+        self.metric_names = list(metric_names or [])
+        self.metric_functions = list(metric_functions or [])
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.batch_size = batch_size
+
+    def _open_dataset(self):
+        if self.dataset_prefix is not None:
+            from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import \
+                MMapIndexedDataset
+            return MMapIndexedDataset(self.dataset_prefix)
+        return self.dataset_factory()
+
+    def run_map_reduce(self):
+        """Fan out the map over worker processes, reduce in this one;
+        → the summary dict, with the index→metric / metric→sample files
+        written under ``save_path``."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")  # fork is unsafe once JAX initialized
+        args = [(self.dataset_prefix, self.dataset_factory, self.metric_names,
+                 self.metric_functions, self.save_path, w, self.num_workers,
+                 self.batch_size) for w in range(self.num_workers)]
+        with ctx.Pool(self.num_workers) as pool:
+            counts = pool.starmap(_dda_worker, args)
+        dataset = self._open_dataset()
+        assert sum(counts) == len(dataset), (counts, len(dataset))
+        reducer = DataAnalyzer(dataset, metric_names=self.metric_names,
+                               metric_functions=[_resolve_metric(f)
+                                                 for f in self.metric_functions],
+                               save_path=self.save_path, num_workers=self.num_workers)
+        return reducer.run_reduce()

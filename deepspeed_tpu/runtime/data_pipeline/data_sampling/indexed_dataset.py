@@ -1,0 +1,136 @@
+"""Memory-mapped indexed dataset (Megatron/DeepSpeed binary format).
+
+Capability match for the reference's
+``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py``
+(``MMapIndexedDataset`` at indexed_dataset.py:1 — the Megatron-LM
+``.bin``/``.idx`` pair): token arrays live in one flat binary file and
+an index carries dtype/sizes/pointers, so a dataset of any size is
+served through ``np.memmap`` without residing in RAM. The on-disk
+layout matches the reference byte-for-byte (magic ``MMIDIDX``,
+version 1), so existing Megatron/DeepSpeed ``.bin``/``.idx`` corpora
+load unchanged.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+# reference dtype codes (indexed_dataset.py:101 dtypes table)
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.uint16, 7: np.uint32, 8: np.uint64}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` appends one sample's array to the
+    ``.bin``; ``finalize`` writes the ``.idx`` (reference
+    MMapIndexedDatasetBuilder)."""
+
+    def __init__(self, out_prefix, dtype=np.int32):
+        self._prefix = out_prefix
+        self._dtype = np.dtype(dtype)
+        self._data = open(data_file_path(out_prefix), "wb")
+        self._sizes = []
+        self._doc_idx = [0]
+
+    def add_item(self, tokens):
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._data.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self):
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self):
+        self._data.close()
+        sizes = np.asarray(self._sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        if len(sizes) > 1:
+            np.cumsum(sizes[:-1].astype(np.int64) * self._dtype.itemsize,
+                      out=pointers[1:])
+        if self._doc_idx[-1] != len(sizes):
+            self.end_document()
+        with open(index_file_path(self._prefix), "wb") as idx:
+            idx.write(_MAGIC)
+            idx.write(struct.pack("<Q", _VERSION))
+            idx.write(struct.pack("<B", _DTYPE_CODES[self._dtype]))
+            idx.write(struct.pack("<Q", len(sizes)))
+            idx.write(struct.pack("<Q", len(self._doc_idx)))
+            idx.write(sizes.tobytes(order="C"))
+            idx.write(pointers.tobytes(order="C"))
+            idx.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Read side: every access is a ``np.memmap`` view — nothing is
+    loaded eagerly (reference MMapIndexedDataset)."""
+
+    def __init__(self, prefix):
+        self._prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(9)
+            assert magic == _MAGIC, \
+                f"{index_file_path(prefix)}: not an MMIDIDX index (magic {magic!r})"
+            version, = struct.unpack("<Q", f.read(8))
+            assert version == _VERSION, f"unsupported index version {version}"
+            code, = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(_DTYPES[code])
+            n, = struct.unpack("<Q", f.read(8))
+            n_docs, = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        self._index = np.memmap(index_file_path(prefix), mode="r", offset=offset,
+                                dtype=np.uint8)
+        sz_bytes = n * 4
+        ptr_bytes = n * 8
+        self._sizes = self._index[:sz_bytes].view(np.int32)
+        self._pointers = self._index[sz_bytes:sz_bytes + ptr_bytes].view(np.int64)
+        self._doc_idx = self._index[sz_bytes + ptr_bytes:
+                                    sz_bytes + ptr_bytes + n_docs * 8].view(np.int64)
+        self._bin = np.memmap(data_file_path(prefix), mode="r", dtype=np.uint8)
+
+    def __len__(self):
+        return len(self._sizes)
+
+    @property
+    def sizes(self):
+        return self._sizes
+
+    @property
+    def doc_idx(self):
+        return self._doc_idx
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        ptr = int(self._pointers[i])
+        size = int(self._sizes[i])
+        return self._bin[ptr:ptr + size * self._dtype.itemsize].view(self._dtype)
+
+    def get(self, i, offset=0, length=None):
+        """Partial read of sample ``i`` (reference .get): avoids pulling
+        a long document when only a window is needed."""
+        size = int(self._sizes[i])
+        length = size - offset if length is None else min(length, size - offset)
+        ptr = int(self._pointers[i]) + offset * self._dtype.itemsize
+        return self._bin[ptr:ptr + length * self._dtype.itemsize].view(self._dtype)
+
+    @staticmethod
+    def exists(prefix):
+        return (os.path.exists(index_file_path(prefix))
+                and os.path.exists(data_file_path(prefix)))

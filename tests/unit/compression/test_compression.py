@@ -87,3 +87,109 @@ def test_init_compression_end_to_end():
     cleaned = redundancy_clean(params2, cfg)
     qc = np.asarray(cleaned["model"]["layers"]["self_attn"]["q_proj"]["kernel"])
     assert ((qc == 0).mean() > 0.4)
+
+
+def test_channel_pruning_mask():
+    from deepspeed_tpu.compression import channel_pruning_mask
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    cm = channel_pruning_mask(w, 0.25)
+    assert cm.shape == (1, 32)
+    assert int(np.asarray(cm).sum()) == 8
+    kept = np.abs(np.asarray(w)).sum(0)[np.asarray(cm)[0] > 0]
+    dropped = np.abs(np.asarray(w)).sum(0)[np.asarray(cm)[0] == 0]
+    assert kept.min() >= dropped.max()
+
+
+def test_activation_quantization():
+    from deepspeed_tpu.compression import quantize_activation
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(8, 32).astype(np.float32))
+    q = quantize_activation(x, 8, "symmetric")
+    assert float(jnp.abs(q - x).max()) < float(jnp.abs(x).max()) / 100
+    qa = quantize_activation(x, 4, "asymmetric")
+    assert len(np.unique(np.asarray(qa))) <= 16
+    g = jax.grad(lambda x: quantize_activation(x, 4).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)  # straight-through
+
+
+def test_bits_annealing_schedule():
+    from deepspeed_tpu.compression import bits_at_step
+    # 8 -> 4 -> 2 halving every 10 steps, floored at target
+    assert bits_at_step(8, 2, 10, 0) == 8
+    assert bits_at_step(8, 2, 10, 9) == 8
+    assert bits_at_step(8, 2, 10, 10) == 4
+    assert bits_at_step(8, 2, 10, 20) == 2
+    assert bits_at_step(8, 2, 10, 300) == 2
+    assert bits_at_step(8, 8, 0, 5) == 8
+
+
+def test_scheduler_offsets_and_annealing():
+    """Techniques activate at their schedule_offset; weight quantization
+    anneals by quantization_period (reference compression_scheduler)."""
+    from deepspeed_tpu.compression import CompressionScheduler
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"g": {"modules": ["kernel"],
+                                       "params": {"start_bits": 8, "target_bits": 2,
+                                                  "quantization_period": 10}}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 20},
+            "different_groups": {"g": {"modules": ["kernel"],
+                                       "params": {"dense_ratio": 0.5}}}},
+    }}
+    sched = CompressionScheduler(cfg)
+    assert not sched.check_weight_quantization(4)
+    assert sched.check_weight_quantization(5)
+    assert not sched.check_sparse_pruning(19) and sched.check_sparse_pruning(20)
+    wq_cfg = sched.rules["weight_quantization"][0][1]
+    assert sched.wq_bits(4, wq_cfg) is None
+    assert sched.wq_bits(5, wq_cfg) == 8
+    assert sched.wq_bits(15, wq_cfg) == 4
+    assert sched.wq_bits(25, wq_cfg) == 2
+
+    rng = np.random.RandomState(5)
+    p = {"dense": {"kernel": jnp.asarray(rng.randn(8, 8).astype(np.float32))}}
+    # before any offset: identity
+    np.testing.assert_array_equal(
+        np.asarray(sched.params_transform(0)(p)["dense"]["kernel"]),
+        np.asarray(p["dense"]["kernel"]))
+    # past the pruning offset: half the entries zeroed AND 2-bit quantized
+    out = sched.params_transform(40)(p)["dense"]["kernel"]
+    assert (np.asarray(out) == 0).mean() >= 0.5
+    assert len(np.unique(np.asarray(out))) <= 5  # 2-bit levels + 0
+
+
+def test_xtc_style_bert_quantize_then_prune():
+    """XTC recipe on a BERT encoder (reference compress.py:148 +
+    basic_layer LinearLayer_Compress): quantize-then-prune the encoder
+    kernels, clean up, and the MLM loss stays within tolerance."""
+    from deepspeed_tpu.models.bert import BERT_CONFIGS, BertForMaskedLM
+    model = BertForMaskedLM(BERT_CONFIGS["bert-debug"])
+    rng = np.random.RandomState(6)
+    ids = jnp.asarray(rng.randint(0, 250, size=(2, 16)), jnp.int32)
+    labels = jnp.where(ids % 5 == 0, ids, -100)
+    params = model.init(jax.random.PRNGKey(0), ids, labels)["params"]
+
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"q": {"modules": ["layers.*kernel"],
+                                       "params": {"start_bits": 8, "target_bits": 8}}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"p": {"modules": ["layers.*kernel"],
+                                       "params": {"dense_ratio": 0.9}}}},
+    }}
+    cleaned = redundancy_clean(params, cfg)
+    loss0 = model.apply({"params": params}, ids, labels)
+    loss1 = model.apply({"params": cleaned}, ids, labels)
+    if isinstance(loss0, tuple):
+        loss0, loss1 = loss0[0], loss1[0]
+    assert np.isfinite(float(loss1))
+    assert abs(float(loss1) - float(loss0)) < 0.35 * abs(float(loss0)) + 0.2, \
+        (float(loss0), float(loss1))
+    # the cleanup really pruned: encoder kernels carry ~10% zeros
+    k = cleaned["model"]["layers"]["fc_in"]["kernel"]
+    assert (np.asarray(k) == 0).mean() >= 0.08
