@@ -24,6 +24,9 @@ class QuantizationConfig(DeepSpeedConfigModel):
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel_degree: int = 1
     expert_parallel_degree: int = 1  # MoE expert sharding for serving
+    # pin a registry implementation by op, e.g. {"attention": "xla_gather"}
+    # (reference inference/v2/modules/heuristics.py config-driven selection)
+    implementation_overrides: dict = {}
     kv_block_size: int = 16
     num_kv_blocks: int = 0  # 0 = derive from max_context * max sequences
     state_manager: DSStateManagerConfig = DSStateManagerConfig()

@@ -85,8 +85,10 @@ class InferenceEngineV2:
         self._batch = RaggedBatchWrapper(self.max_tokens, self.max_seqs,
                                          self.max_blocks_per_seq)
         mesh = self.mesh
+        attn_impl = (self._config.implementation_overrides or {}).get("attention")
         self._step = jax.jit(
-            lambda p, kc, vc, b: ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh),
+            lambda p, kc, vc, b: ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
+                                                attn_impl=attn_impl),
             donate_argnums=(1, 2))
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P

@@ -87,51 +87,28 @@ def _rope_flat_interleaved(x, cos, sin, positions):
     return out.reshape(x.shape).astype(x.dtype)
 
 
-def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None, mesh=None):
+def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None, mesh=None, impl=None):
     """Scatter new K/V into the paged pool and attend over each token's
-    block-tabled context. Pallas decode kernel on TPU (per-shard under
-    a TP mesh via shard_map), gather-based XLA path elsewhere (and
-    always for ALiBi)."""
+    block-tabled context. The attention implementation comes from the
+    ``modules/heuristics`` registry (Pallas decode kernel single-device
+    or per-TP-shard, XLA gather fallback / ALiBi path), optionally
+    pinned by the engine config's ``implementation_overrides``."""
     bs = kc.shape[1]
     blk = batch["block_tables"][batch["token_seq"], batch["token_pos"] // bs]  # [T]
     off = batch["token_pos"] % bs
     kc = _c(kc.at[blk, off].set(k.astype(kc.dtype)), (None, None, "tensor", None), mesh)
     vc = _c(vc.at[blk, off].set(v.astype(vc.dtype)), (None, None, "tensor", None), mesh)
 
-    from deepspeed_tpu.ops.pallas import (kernel_dispatch, shard_map_kernel,
-                                          spec_divides, use_pallas)
-    from deepspeed_tpu.ops.pallas.paged_attention import (kernel_supported,
-                                                          paged_decode_attention,
-                                                          xla_paged_attention)
+    from deepspeed_tpu.inference.v2.modules.heuristics import instantiate_attn
     tab = batch["block_tables"][batch["token_seq"]]  # [T, MB]
     pos = batch["token_pos"]
-    if alibi is not None:
-        out = xla_paged_attention(q, kc, vc, tab, pos, alibi_slopes=alibi)
-    elif mesh is None or mesh.size == 1:
-        if use_pallas() and kernel_supported(Dh, bs):
-            out = paged_decode_attention(q, kc, vc, tab, pos)
-        else:
-            out = xla_paged_attention(q, kc, vc, tab, pos)
-    else:
-        q_spec = P(None, "tensor", None)
-        kv_spec = P(None, None, "tensor", None)
-        sharded_kernel = (kernel_dispatch(mesh) == "shard_map"
-                          and kernel_supported(Dh, bs)
-                          and spec_divides(mesh, q_spec, q.shape)
-                          and spec_divides(mesh, kv_spec, kc.shape)
-                          # per-shard GQA grouping needs whole KV-head groups
-                          and (q.shape[1] // kc.shape[2]) * kc.shape[2] == q.shape[1])
-        if sharded_kernel:
-            out = shard_map_kernel(
-                paged_decode_attention, mesh,
-                in_specs=(q_spec, kv_spec, kv_spec, P(), P()),
-                out_specs=q_spec)(q, kc, vc, tab, pos)
-        else:
-            out = xla_paged_attention(q, kc, vc, tab, pos)
+    _, attn_fn = instantiate_attn(mesh, Dh, bs, q.shape, kc.shape, alibi,
+                                  override=impl)
+    out = attn_fn(q, kc, vc, tab, pos)
     return _c(out, (None, "tensor", None), mesh), kc, vc
 
 
-def _layer_step(cfg, cos, sin, batch, mesh, h, xs):
+def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
     lp, kc, vc = xs
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -144,7 +121,8 @@ def _layer_step(cfg, cos, sin, batch, mesh, h, xs):
     q = _rope_flat(q, cos, sin, batch["token_pos"])
     k = _rope_flat(k, cos, sin, batch["token_pos"])
 
-    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, mesh=mesh)
+    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, mesh=mesh,
+                                impl=attn_impl)
     h = _c(h + _proj(out.reshape(T, H * Dh), attn["o_proj"]), (None, None), mesh)
 
     hn2 = _rms(h, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
@@ -225,7 +203,7 @@ def _moe_mlp(x, p, k, mesh=None):
     return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
 
 
-def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, h, xs):
+def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):
     """One GPT-family block over the flat ragged batch (sequential or
     parallel wiring, optional partial rotary / ALiBi, biased
     projections, LayerNorm or RMSNorm)."""
@@ -256,7 +234,8 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, h, xs):
             k = jnp.concatenate(
                 [rope(k[..., :rd], cos, sin, batch["token_pos"]), k[..., rd:]], -1)
 
-    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=alibi, mesh=mesh)
+    out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=alibi,
+                                mesh=mesh, impl=attn_impl)
     attn_out = _proj(out.reshape(T, H * Dh), attn["o_proj"])
 
     def mlp(x):
@@ -276,7 +255,8 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, h, xs):
     return h, (kc, vc)
 
 
-def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=None):
+def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=None,
+                   attn_impl=None):
     """→ (last-token logits [max_seqs, vocab] fp32, new kcache, new vcache).
 
     ``kcache``/``vcache``: [L, NB, bs, Hkv, Dh]; ``batch``: the arrays
@@ -305,12 +285,13 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=
             h = h + pos_table[batch["token_pos"] + cfg.learned_pos_offset].astype(dtype)
         if cfg.embedding_layernorm:
             h = _layernorm(h, params["model"]["embed_layernorm"], cfg.layer_norm_eps)
-        step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch, mesh)
+        step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch, mesh,
+                                 attn_impl)
     else:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
                                     scaling=rope_scaling_of(cfg))
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
-        step = functools.partial(_layer_step, cfg, cos, sin, batch, mesh)
+        step = functools.partial(_layer_step, cfg, cos, sin, batch, mesh, attn_impl)
 
     h, (kc, vc) = jax.lax.scan(step, h, (params["model"]["layers"], kcache, vcache))
 

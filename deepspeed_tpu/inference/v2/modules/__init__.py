@@ -1,0 +1,3 @@
+from deepspeed_tpu.inference.v2.modules.heuristics import (REGISTRY, implementations,
+                                                           instantiate_attn,
+                                                           register_implementation)  # noqa: F401

@@ -1,0 +1,122 @@
+"""Kernel-implementation selection for the v2 ragged engine.
+
+Capability match for the reference's
+``deepspeed/inference/v2/modules/heuristics.py`` (``instantiate_attn``
+etc. at heuristics.py:1 over the ``DSModuleRegistry``): each logical op
+has a REGISTRY of implementations with a ``supports`` predicate; the
+highest-priority supported one is chosen, and the engine config can pin
+a specific implementation by name
+(``RaggedInferenceEngineConfig.implementation_overrides``).
+
+Implementations registered for ``attention`` (the ragged decode op):
+
+- ``pallas_paged``          — single-device Pallas decode kernel
+  (``ops/pallas/paged_attention``); needs ``head_dim % 128 == 0`` and
+  ``block_size % 8 == 0`` (Mosaic lane alignment — 64-dim-head models
+  such as Bloom-560M take the XLA path; lane-packing two 64-dim heads
+  is possible but unimplemented).
+- ``pallas_paged_sharded``  — the same kernel per tensor-parallel shard
+  under ``shard_map`` (query/KV heads divide over 'tensor').
+- ``xla_gather``            — gather-based XLA reference; always
+  supported, and the only path for ALiBi models.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+REGISTRY = {"attention": []}
+
+
+def register_implementation(op, name):
+    """Decorator: register ``cls``-style factory with ``supports`` and
+    ``instantiate`` staticmethods under ``op``."""
+    def wrap(impl):
+        REGISTRY[op].append((name, impl))
+        return impl
+    return wrap
+
+
+def implementations(op):
+    return [name for name, _ in REGISTRY[op]]
+
+
+@register_implementation("attention", "pallas_paged")
+class _PallasPaged:
+
+    @staticmethod
+    def supports(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+        from deepspeed_tpu.ops.pallas import use_pallas
+        from deepspeed_tpu.ops.pallas.paged_attention import kernel_supported
+        return (alibi is None and (mesh is None or mesh.size == 1)
+                and use_pallas() and kernel_supported(head_dim, block_size))
+
+    @staticmethod
+    def instantiate(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+        from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+        return paged_decode_attention
+
+
+@register_implementation("attention", "pallas_paged_sharded")
+class _PallasPagedSharded:
+
+    Q_SPEC = P(None, "tensor", None)
+    KV_SPEC = P(None, None, "tensor", None)
+
+    @staticmethod
+    def supports(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+        from deepspeed_tpu.ops.pallas import kernel_dispatch, spec_divides
+        from deepspeed_tpu.ops.pallas.paged_attention import kernel_supported
+        if alibi is not None or mesh is None or mesh.size == 1:
+            return False
+        return (kernel_dispatch(mesh) == "shard_map"
+                and kernel_supported(head_dim, block_size)
+                and spec_divides(mesh, _PallasPagedSharded.Q_SPEC, q_shape)
+                and spec_divides(mesh, _PallasPagedSharded.KV_SPEC, kc_shape)
+                # per-shard GQA grouping needs whole KV-head groups
+                and (q_shape[1] // kc_shape[2]) * kc_shape[2] == q_shape[1])
+
+    @staticmethod
+    def instantiate(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+        from deepspeed_tpu.ops.pallas import shard_map_kernel
+        from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+        cls = _PallasPagedSharded
+        return shard_map_kernel(
+            paged_decode_attention, mesh,
+            in_specs=(cls.Q_SPEC, cls.KV_SPEC, cls.KV_SPEC, P(), P()),
+            out_specs=cls.Q_SPEC)
+
+
+@register_implementation("attention", "xla_gather")
+class _XlaGather:
+
+    @staticmethod
+    def supports(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+        return True
+
+    @staticmethod
+    def instantiate(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+        import functools
+
+        from deepspeed_tpu.ops.pallas.paged_attention import xla_paged_attention
+        return functools.partial(xla_paged_attention, alibi_slopes=alibi)
+
+
+def instantiate_attn(mesh, head_dim, block_size, q_shape, kc_shape, alibi,
+                     override=None):
+    """→ ``(impl_name, fn(q, kc, vc, tab, pos))`` — the first supported
+    implementation in registration (priority) order, or the named one
+    when the config pins ``override`` (reference
+    heuristics.instantiate_attn + config_bundle semantics)."""
+    for name, impl in REGISTRY["attention"]:
+        if override is not None and name != override:
+            continue
+        if impl.supports(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
+            return name, impl.instantiate(mesh, head_dim, block_size,
+                                          q_shape, kc_shape, alibi)
+        if override is not None:
+            raise ValueError(
+                f"implementation_overrides pinned attention={override!r}, but it "
+                f"does not support this config (head_dim={head_dim}, "
+                f"block_size={block_size}, mesh={mesh and mesh.shape}, "
+                f"alibi={alibi is not None})")
+    raise ValueError(f"no attention implementation named {override!r}; "
+                     f"available: {implementations('attention')}")

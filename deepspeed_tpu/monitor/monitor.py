@@ -1,13 +1,15 @@
-"""Monitor backends + rank-0 master.
+"""Experiment monitoring: TensorBoard / WandB / CSV / Comet backends.
 
-Analogue of the reference's ``deepspeed/monitor/monitor.py``
-(``Monitor`` ABC, ``MonitorMaster`` at monitor.py:30) with
-TensorBoard/WandB/CSV/Comet backends. Events are
-``(tag, value, global_step)`` tuples, written only from rank 0 of the
-control plane.
+Capability match for the reference's ``deepspeed/monitor/`` (the
+``Monitor`` ABC and ``MonitorMaster`` fan-out; one backend module per
+service there, one class each here). Events are ``(tag, value,
+global_step)`` tuples; only rank 0 of the control plane writes. Every
+backend degrades to disabled with a warning when its client library is
+absent — monitoring must never take down training.
 """
 
 import csv
+import numbers
 import os
 from abc import ABC, abstractmethod
 
@@ -15,15 +17,39 @@ from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig
 from deepspeed_tpu.utils.logging import logger
 
 
-class Monitor(ABC):
+def _control_rank():
+    try:
+        from deepspeed_tpu import comm as dist
+        return dist.get_rank()
+    except Exception:
+        return 0
 
-    @abstractmethod
+
+def _resolve_log_dir(output_path, job_name, default_root):
+    """<output_path or default_root>/<job_name>, created."""
+    root = output_path if output_path else default_root
+    log_dir = os.path.join(root, job_name)
+    os.makedirs(log_dir, exist_ok=True)
+    return log_dir
+
+
+class Monitor(ABC):
+    """One logging backend. Subclasses set ``self.enabled`` False when
+    their client library is missing; ``write_events`` is then a no-op."""
+
     def __init__(self, monitor_config):
         self.monitor_config = monitor_config
+        self.enabled = monitor_config.enabled and _control_rank() == 0
 
     @abstractmethod
     def write_events(self, event_list):
         ...
+
+    def _writes_here(self):
+        """Re-checked per write: a monitor constructed before distributed
+        init sees rank 0 everywhere; once the control plane is up, only
+        the real rank 0 keeps writing."""
+        return self.enabled and _control_rank() == 0
 
 
 class TensorBoardMonitor(Monitor):
@@ -31,14 +57,10 @@ class TensorBoardMonitor(Monitor):
     def __init__(self, tensorboard_config):
         super().__init__(tensorboard_config)
         self.summary_writer = None
-        self.enabled = tensorboard_config.enabled
-        self.output_path = tensorboard_config.output_path
-        self.job_name = tensorboard_config.job_name
-        self._get_rank = _control_rank
-        if self.enabled and self._get_rank() == 0:
+        if self.enabled:
             self.get_summary_writer()
 
-    def get_summary_writer(self, base=os.path.join(os.environ.get("DLWS_JOB_ID", ""), "logs")):
+    def get_summary_writer(self):
         if self.summary_writer is not None:
             return self.summary_writer
         try:
@@ -47,26 +69,25 @@ class TensorBoardMonitor(Monitor):
             try:
                 from tensorboardX import SummaryWriter
             except ImportError:
-                logger.warning("TensorBoard writer unavailable (no torch.utils.tensorboard/tensorboardX)")
+                logger.warning("TensorBoard writer unavailable "
+                               "(no torch.utils.tensorboard/tensorboardX)")
                 self.enabled = False
                 return None
-        if self.output_path is not None and len(self.output_path) > 0:
-            log_dir = os.path.join(self.output_path, self.job_name)
-        else:
-            log_dir = os.path.join("runs", self.job_name)
-        os.makedirs(log_dir, exist_ok=True)
+        cfg = self.monitor_config
+        log_dir = _resolve_log_dir(cfg.output_path, cfg.job_name, "runs")
         self.summary_writer = SummaryWriter(log_dir=log_dir)
         return self.summary_writer
 
     def write_events(self, event_list, flush=True):
-        if self.enabled and self.summary_writer is not None and self._get_rank() == 0:
-            for event in event_list:
-                self.summary_writer.add_scalar(*event)
-            if flush:
-                self.summary_writer.flush()
+        if not (self._writes_here() and self.summary_writer is not None):
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
 
     def flush(self):
-        if self.enabled and self.summary_writer is not None and self._get_rank() == 0:
+        if self._writes_here() and self.summary_writer is not None:
             self.summary_writer.flush()
 
 
@@ -74,31 +95,26 @@ class WandbMonitor(Monitor):
 
     def __init__(self, wandb_config):
         super().__init__(wandb_config)
-        self.enabled = wandb_config.enabled
-        self._get_rank = _control_rank
-        if self.enabled and self._get_rank() == 0:
+        if self.enabled:
             try:
                 import wandb
                 self.wandb = wandb
-                wandb.init(project=wandb_config.project, group=wandb_config.group, entity=wandb_config.team)
+                wandb.init(project=wandb_config.project, group=wandb_config.group,
+                           entity=wandb_config.team)
             except ImportError:
                 logger.warning("wandb not installed; disabling WandbMonitor")
                 self.enabled = False
 
     def log(self, data, step=None, commit=None, sync=None):
-        if self.enabled and self._get_rank() == 0:
+        if self._writes_here():
             self.wandb.log(data, step=step, commit=commit)
 
     def write_events(self, event_list):
-        if self.enabled and self._get_rank() == 0:
-            for event in event_list:
-                label = event[0]
-                value = event[1]
-                log_dict = {label: value}
-                if len(event) >= 3:
-                    self.log(log_dict, step=event[2])
-                else:
-                    self.log(log_dict)
+        if not self._writes_here():
+            return
+        for event in event_list:
+            step = event[2] if len(event) >= 3 else None
+            self.log({event[0]: event[1]}, step=step)
 
 
 class csvMonitor(Monitor):
@@ -106,62 +122,39 @@ class csvMonitor(Monitor):
     def __init__(self, csv_config):
         super().__init__(csv_config)
         self.filenames = []
-        self.enabled = csv_config.enabled
-        self.output_path = csv_config.output_path
-        self.job_name = csv_config.job_name
-        self._get_rank = _control_rank
         self.log_dir = None
-        if self.enabled and self._get_rank() == 0:
-            self.log_dir = self.setup_log_dir()
-
-    def setup_log_dir(self, base=os.path.join(os.environ.get("DLWS_JOB_ID", ""), "logs")):
-        if self.output_path is not None and len(self.output_path) > 0:
-            log_dir = os.path.join(self.output_path, self.job_name)
-        elif "DLWS_JOB_ID" in os.environ:
-            infra_job_id = os.environ["DLWS_JOB_ID"]
-            csv_monitor_dir_name = os.path.join(infra_job_id, "logs")
-            log_dir = os.path.join(csv_monitor_dir_name, self.job_name)
-        else:
-            log_dir = os.path.join("csv_monitor", self.job_name)
-        os.makedirs(log_dir, exist_ok=True)
-        return log_dir
+        if self.enabled:
+            self.log_dir = _resolve_log_dir(csv_config.output_path,
+                                            csv_config.job_name, "csv_monitor")
 
     def write_events(self, event_list):
-        if self.enabled and self._get_rank() == 0:
-            import numbers
-            for event in event_list:
-                log_name = event[0]
-                value = event[1]
-                step = event[2] if len(event) > 2 else None
-                # Set the header to the log_name
-                # Need this check because the deepspeed engine currently formats log strings to separate with '/'
-                if "/" in log_name:
-                    record_splits = log_name.split("/")
-                    header = record_splits[len(record_splits) - 1]
-                    log_name = log_name.replace("/", "_")
-                else:
-                    header = log_name
-                fname = os.path.join(self.log_dir, log_name + ".csv")
-                self.filenames.append(fname)
-                new_file = not os.path.exists(fname)
-                with open(fname, "a+", newline="") as csvfile:
-                    writer = csv.writer(csvfile)
-                    if new_file:
-                        writer.writerow(["step", header])
-                    if isinstance(value, numbers.Number):
-                        value = float(value)
-                    writer.writerow([step, value])
+        if not (self._writes_here() and self.log_dir is not None):
+            return
+        for event in event_list:
+            tag, value = event[0], event[1]
+            step = event[2] if len(event) > 2 else None
+            # engine tags are '/'-separated; the file is per-tag and the
+            # column header the last component
+            header = tag.rsplit("/", 1)[-1]
+            fname = os.path.join(self.log_dir, tag.replace("/", "_") + ".csv")
+            self.filenames.append(fname)
+            new_file = not os.path.exists(fname)
+            with open(fname, "a+", newline="") as csvfile:
+                writer = csv.writer(csvfile)
+                if new_file:
+                    writer.writerow(["step", header])
+                if isinstance(value, numbers.Number):
+                    value = float(value)
+                writer.writerow([step, value])
 
 
 class CometMonitor(Monitor):
 
     def __init__(self, comet_config):
         super().__init__(comet_config)
-        self.enabled = comet_config.enabled
         self._samples_log_interval = comet_config.samples_log_interval
-        self._get_rank = _control_rank
         self.experiment = None
-        if self.enabled and self._get_rank() == 0:
+        if self.enabled:
             try:
                 import comet_ml
                 self.experiment = comet_ml.start(
@@ -179,55 +172,45 @@ class CometMonitor(Monitor):
                 self.enabled = False
 
     def write_events(self, event_list):
-        if not (self.enabled and self.experiment is not None and self._get_rank() == 0):
+        if not (self._writes_here() and self.experiment is not None):
             return
         for event in event_list:
-            log_name = event[0]
-            value = event[1]
-            engine_step = event[2] if len(event) > 2 else None
-            if log_name.endswith("/samples") and engine_step is not None:
-                if engine_step % self._samples_log_interval != 0:
-                    continue
-            self.experiment.__internal_api__log_metric__(name=log_name, value=value, step=engine_step)
-
-
-def _control_rank():
-    try:
-        from deepspeed_tpu import comm as dist
-        return dist.get_rank()
-    except Exception:
-        return 0
+            tag, value = event[0], event[1]
+            step = event[2] if len(event) > 2 else None
+            if tag.endswith("/samples") and step is not None \
+                    and step % self._samples_log_interval != 0:
+                continue
+            self.experiment.log_metric(name=tag, value=value, step=step)
 
 
 class MonitorMaster(Monitor):
-    """Fans events out to all enabled backends from rank 0 (reference monitor.py:30)."""
+    """Fans events out to every enabled backend (reference monitor.py:30)."""
 
     def __init__(self, monitor_config: DeepSpeedMonitorConfig):
-        super().__init__(monitor_config)
+        self.monitor_config = monitor_config
+        self.backends = []
         self.tb_monitor = None
         self.wandb_monitor = None
         self.csv_monitor = None
         self.comet_monitor = None
         self.enabled = (monitor_config.tensorboard.enabled or monitor_config.wandb.enabled
                         or monitor_config.csv_monitor.enabled or monitor_config.comet.enabled)
-        if _control_rank() == 0:
-            if monitor_config.tensorboard.enabled:
-                self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
-            if monitor_config.wandb.enabled:
-                self.wandb_monitor = WandbMonitor(monitor_config.wandb)
-            if monitor_config.csv_monitor.enabled:
-                self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
-            if monitor_config.comet.enabled:
-                self.comet_monitor = CometMonitor(monitor_config.comet)
+        if _control_rank() != 0:
+            return
+        if monitor_config.tensorboard.enabled:
+            self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        if monitor_config.wandb.enabled:
+            self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        if monitor_config.csv_monitor.enabled:
+            self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+        if monitor_config.comet.enabled:
+            self.comet_monitor = CometMonitor(monitor_config.comet)
+        self.backends = [m for m in (self.tb_monitor, self.wandb_monitor,
+                                     self.csv_monitor, self.comet_monitor)
+                         if m is not None]
 
     def write_events(self, event_list):
         if _control_rank() != 0:
             return
-        if self.tb_monitor is not None:
-            self.tb_monitor.write_events(event_list)
-        if self.wandb_monitor is not None:
-            self.wandb_monitor.write_events(event_list)
-        if self.csv_monitor is not None:
-            self.csv_monitor.write_events(event_list)
-        if self.comet_monitor is not None:
-            self.comet_monitor.write_events(event_list)
+        for backend in self.backends:
+            backend.write_events(event_list)

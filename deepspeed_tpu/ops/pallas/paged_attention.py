@@ -53,8 +53,11 @@ def xla_paged_attention(q, kc, vc, block_tables, token_pos, alibi_slopes=None):
 
 def kernel_supported(head_dim, block_size):
     """Mosaic constraint: the per-block DMA slices the pool's last dim,
-    which must be 128-lane aligned — i.e. head_dim % 128 == 0 (true for
-    the production Llama family; tiny debug configs fall back to XLA)."""
+    which must be 128-lane aligned — i.e. head_dim % 128 == 0. True for
+    the Llama/Mistral/Falcon/GPT-J 128-dim-head families; 64-dim-head
+    models (e.g. Bloom-560M, GPT-2) and ALiBi models take the XLA gather
+    path (see ``inference/v2/modules/heuristics.py`` — lane-packing two
+    64-dim heads per register is possible but unimplemented)."""
     return head_dim % 128 == 0 and block_size % 8 == 0
 
 

@@ -1,0 +1,37 @@
+"""Monitor backends (analogue of reference tests/unit/monitor/)."""
+
+import csv
+import os
+
+from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig
+from deepspeed_tpu.monitor.monitor import MonitorMaster, csvMonitor
+
+
+def test_csv_monitor_writes_per_tag_files(tmp_path):
+    cfg = DeepSpeedMonitorConfig(**{"csv_monitor": {
+        "enabled": True, "output_path": str(tmp_path), "job_name": "job"}})
+    mon = csvMonitor(cfg.csv_monitor)
+    mon.write_events([("Train/loss", 1.5, 0), ("Train/loss", 1.2, 1),
+                      ("Train/lr", 0.1, 0)])
+    loss_file = tmp_path / "job" / "Train_loss.csv"
+    assert loss_file.exists()
+    rows = list(csv.reader(open(loss_file)))
+    assert rows[0] == ["step", "loss"]  # header = last tag component
+    assert rows[1] == ["0", "1.5"] and rows[2] == ["1", "1.2"]
+    assert (tmp_path / "job" / "Train_lr.csv").exists()
+
+
+def test_master_fans_out_to_enabled_backends(tmp_path):
+    cfg = DeepSpeedMonitorConfig(**{"csv_monitor": {
+        "enabled": True, "output_path": str(tmp_path), "job_name": "j2"}})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    assert len(master.backends) == 1  # only csv enabled
+    master.write_events([("x", 3.0, 7)])
+    assert os.path.exists(tmp_path / "j2" / "x.csv")
+
+
+def test_disabled_master_is_noop(tmp_path):
+    master = MonitorMaster(DeepSpeedMonitorConfig())
+    assert not master.enabled
+    master.write_events([("x", 1.0, 0)])  # no crash, nothing written
