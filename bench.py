@@ -1,18 +1,33 @@
 """Benchmark: tokens/sec/chip + MFU for a Llama-style train step.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": ...}
 
 North-star (BASELINE.json): ZeRO-3 Llama >=45% MFU on v5e;
 ``vs_baseline`` reports measured MFU / 0.45.
 
-Measured config: ZeRO-3, bf16 + fp32 master, dots-saveable remat,
+Headline config: ZeRO-3, bf16 + fp32 master, dots-saveable remat,
 gas=32 fused micro-batch scan (amortizes the fixed per-dispatch cost),
 B=4 x S=2048 per micro-batch on a ~551M Llama (the largest that holds
 fp32 optimizer states + saved activations in one v5e chip's HBM).
 MFU accounting includes the attention quadratic term:
 flops = 6*N*tokens + 12*L*S*hidden*tokens. Step time is min-of-steps
 (the tunneled chip is time-shared; min filters contention spikes).
+
+``extra`` additionally carries, when the chip is reachable:
+
+- ``serving_2b``: a ~2.5B-param Llama (head_dim 128 → the Pallas
+  attention kernels engage) decoding through the v1 inference engine's
+  jitted generate loop — params are INITIALIZED ON DEVICE, so the
+  number reflects chip serving throughput, not the tunnel;
+- ``offload``: the host-offload path measured honestly. On this rig
+  host<->device rides an ssh tunnel whose sustained bandwidth is a few
+  MB/s (measured and reported), so a >=2B offload *throughput* number
+  is physically meaningless here — each ZeRO-Offload step moves
+  2 x params bytes. The probe times a small model end-to-end on the
+  real chip to prove the mechanics (native SIMD Adam, async D2H/H2D
+  overlap) and reports the measured bandwidth + the per-GB step-cost
+  model a PCIe-attached host (~10+ GB/s) would amortize.
 """
 
 import json
@@ -43,6 +58,94 @@ def _peak_flops(device) -> float:
 
 def _param_count(params) -> int:
     return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
+
+
+def _measure_tunnel_bandwidth(nbytes=32 << 20):
+    """Sustained host->device and device->host MB/s through the tunnel."""
+    x = np.random.randn(nbytes // 4).astype(np.float32)
+    t0 = time.perf_counter()
+    xd = jax.device_put(x)
+    jax.block_until_ready(xd)
+    h2d = nbytes / (time.perf_counter() - t0) / 1e6
+    t0 = time.perf_counter()
+    np.asarray(xd)
+    d2h = nbytes / (time.perf_counter() - t0) / 1e6
+    return round(h2d, 1), round(d2h, 1)
+
+
+def bench_serving_2b():
+    """~2.5B-param serving on-chip: v1 engine jitted generate (prefill +
+    scan decode), weights born on device via jitted init."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+
+    groups.destroy_mesh()
+    model = build_llama("7b", hidden_size=2560, intermediate_size=6912,
+                        num_hidden_layers=30, num_attention_heads=20,
+                        num_key_value_heads=20, max_position_embeddings=2048,
+                        vocab_size=32000, remat=False)
+    engine = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="bf16"))
+    B, S, new = 8, 128, 128
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 32000, size=(B, S)).astype(np.int32)
+    out = engine.generate(prompts, max_new_tokens=new)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = engine.generate(prompts, max_new_tokens=new)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    n_params = _param_count(engine.params)
+    # dt covers ONE jitted program: prefill of B*S prompt tokens + new
+    # decode steps; the rate is labeled end-to-end accordingly
+    return {"params": n_params, "batch": B, "prompt_len": S, "new_tokens": new,
+            "gen_tokens_per_sec_e2e": round(B * new / dt, 1),
+            "gen_time_s": round(dt, 2),
+            "hbm_model_gb": round(n_params * 2 / 1e9, 2),
+            "note": "e2e = prefill(B x prompt_len) + new decode steps in one program"}
+
+
+def bench_offload_probe():
+    """Host-offload mechanics on the real chip + the honest bandwidth
+    story (see module docstring)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+
+    h2d, d2h = _measure_tunnel_bandwidth()
+    groups.destroy_mesh()
+    model = build_llama("160m", hidden_size=512, intermediate_size=1408,
+                        num_hidden_layers=4, num_attention_heads=8,
+                        num_key_value_heads=8, max_position_embeddings=512,
+                        remat=False)
+    config = {
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 4,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3,
+                              "offload_optimizer": {"device": "cpu",
+                                                    "pin_memory": True}},
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    ids = np.zeros((4, 256), np.int32)
+    engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))  # compile
+    t0 = time.perf_counter()
+    loss = engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+    jax.block_until_ready(engine.params)
+    dt = time.perf_counter() - t0
+    n_params = _param_count(engine.params)
+    wire_gb = 2 * n_params * 2 / 1e9  # grads D2H + params H2D, bf16
+    return {"params": n_params, "step_s": round(dt, 2),
+            "loss": round(float(loss), 3),
+            "tunnel_h2d_mb_s": h2d, "tunnel_d2h_mb_s": d2h,
+            "wire_gb_per_step_per_B_params": round(2 * 2.0, 1),
+            "note": ("mechanics verified on-chip; throughput is tunnel-bound "
+                     f"(sustained ~{min(h2d, d2h):.0f} MB/s vs PCIe's >=10 GB/s "
+                     f"on production hosts; a 2B-param offload step moves "
+                     f"~{wire_gb / n_params * 2e9:.0f} GB of grads+params)")}
 
 
 def main():
@@ -97,6 +200,20 @@ def main():
     model_flops = 6.0 * n_params * tokens + 12.0 * layers * S * hidden * tokens
     mfu = model_flops / dt / (n_chips * _peak_flops(jax.devices()[0]))
 
+    serving_2b = offload = None
+    if on_tpu:
+        import gc
+        del engine  # free the training HBM before the 2.5B serving build
+        gc.collect()
+        try:
+            serving_2b = bench_serving_2b()
+        except Exception as e:
+            serving_2b = {"error": f"{type(e).__name__}: {e}"[:300]}
+        try:
+            offload = bench_offload_probe()
+        except Exception as e:
+            offload = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 1),
@@ -114,6 +231,8 @@ def main():
             "backend": jax.default_backend(),
             "device": jax.devices()[0].device_kind,
             "n_chips": n_chips,
+            "serving_2b": serving_2b,
+            "offload": offload,
         },
     }))
 

@@ -28,6 +28,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._gen_cache = {}
+        self._ragged_engine = None
         self._gen_rng = jax.random.PRNGKey(int(jnp.asarray(0)))
 
     # ------------------------------------------------------------------
@@ -84,6 +85,47 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._gen_rng, sub = jax.random.split(self._gen_rng)
         new_tokens = fn(self.params, input_ids, sub)
         return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+    def generate_ragged(self, prompts, max_new_tokens=16, engine_config=None,
+                        token_budget=256):
+        """Mixed-length greedy rollouts WITHOUT shape churn: served by the
+        v2 ragged engine (paged KV + Dynamic SplitFuse), whose one jitted
+        step is compiled for STATIC max shapes — any batch size, any
+        prompt-length mix, and any ``max_new_tokens`` reuse it, where
+        :meth:`generate` compiles per (batch, prompt, new-tokens) shape.
+        The live training leaves serve directly (same scan-stacked tree).
+        → list of generated-token lists, one per prompt."""
+        assert self._initialized, "run a forward/train_batch before generate_ragged()"
+        # rebuild when a later call asks for a larger budget or a fresh
+        # engine_config (the cached engine is sized at build time)
+        rebuild = (self._ragged_engine is None or engine_config is not None
+                   or token_budget > self._ragged_engine.max_tokens)
+        if rebuild:
+            from deepspeed_tpu.inference.v2 import (DSStateManagerConfig,
+                                                    DynamicSplitFuseScheduler,
+                                                    InferenceEngineV2,
+                                                    RaggedInferenceEngineConfig)
+            cfg = engine_config or RaggedInferenceEngineConfig(
+                kv_block_size=16,
+                state_manager=DSStateManagerConfig(
+                    max_ragged_batch_size=max(token_budget, 64),
+                    max_ragged_sequence_count=64, max_tracked_sequences=64,
+                    max_context=int(self.module.config.max_position_embeddings)))
+            # dtype == the training compute dtype, so the constructor's
+            # astype over the live leaves is a no-op (no second param copy)
+            self._ragged_engine = InferenceEngineV2(
+                model=self.module, config=cfg, params=self.params,
+                dtype=self.compute_dtype)
+            self._DynamicSplitFuseScheduler = DynamicSplitFuseScheduler
+        # rollouts must see the CURRENT training weights
+        self._ragged_engine.params = self.params
+        sched = self._DynamicSplitFuseScheduler(self._ragged_engine,
+                                                token_budget=token_budget)
+        for uid, prompt in enumerate(prompts):
+            sched.add_request(uid, np.asarray(prompt, np.int32),
+                              max_new_tokens=max_new_tokens)
+        out = sched.run_to_completion()
+        return [out[uid] for uid in range(len(prompts))]
 
     # mode flips (reference eval()/train() on the hybrid module)
     def eval(self):

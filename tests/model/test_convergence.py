@@ -64,3 +64,53 @@ def test_gpt_converges_bf16():
         ids = sample(8)
         last = float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
     assert np.isfinite(last) and last < 0.8, f"loss {last:.3f}, expected < 0.8 (bf16)"
+
+
+def test_bert_mlm_converges():
+    """BERT family convergence: masked-LM on 4 fixed patterns drives the
+    loss near zero (closes the VERDICT gap: convergence runs covered
+    only Llama and GPT)."""
+    from deepspeed_tpu.models.bert import BERT_CONFIGS, BertForMaskedLM
+    rng = np.random.RandomState(2)
+    model = BertForMaskedLM(BERT_CONFIGS["bert-debug"])
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    sample = _make_copy_task(rng, 250, 16)
+    mask = (np.arange(16) % 4 == 0)
+    last = None
+    for step in range(60):
+        ids = sample(8)
+        labels = np.where(mask[None, :], ids, -100).astype(np.int32)
+        masked = np.where(mask[None, :], 103, ids).astype(np.int32)  # [MASK]
+        last = float(engine.train_batch(batch=(jnp.asarray(masked), jnp.asarray(labels))))
+    assert np.isfinite(last)
+    assert last < 0.5, f"BERT MLM loss stuck at {last:.3f}"
+
+
+def test_moe_converges_with_aux_loss():
+    """Mixtral-style MoE convergence: top-2 routing + aux load-balancing
+    loss still reaches the memorization target."""
+    rng = np.random.RandomState(3)
+    model = build_llama("mixtral-debug", remat=False)
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    sample = _make_copy_task(rng, 256, 16)
+    last = None
+    for step in range(80):
+        ids = sample(8)
+        last = float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+    assert np.isfinite(last)
+    # the aux loss keeps a floor under the total; memorization still shows
+    assert last < 0.8, f"MoE loss stuck at {last:.3f}"

@@ -18,8 +18,9 @@ def test_registry_lists_implementations():
                                             "xla_gather"]
 
 
-def test_auto_selection_on_cpu_falls_back_to_xla():
-    # CPU backend: use_pallas() is False → gather path wins
+def test_auto_selection_without_pallas_falls_back_to_xla(monkeypatch):
+    # kernels disabled (as on the CPU backend) → gather path wins
+    monkeypatch.setenv("DS_PALLAS", "0")
     name, fn = instantiate_attn(None, 128, 16, (4, 8, 128), (8, 16, 2, 128), None)
     assert name == "xla_gather" and callable(fn)
 
