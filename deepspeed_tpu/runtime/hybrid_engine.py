@@ -97,7 +97,10 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         → list of generated-token lists, one per prompt."""
         assert self._initialized, "run a forward/train_batch before generate_ragged()"
         # rebuild when a later call asks for a larger budget or a fresh
-        # engine_config (the cached engine is sized at build time)
+        # engine_config (the cached engine is sized at build time); a custom
+        # config sticks for later rebuilds instead of silently reverting
+        if engine_config is not None:
+            self._ragged_config = engine_config
         rebuild = (self._ragged_engine is None or engine_config is not None
                    or token_budget > self._ragged_engine.max_tokens)
         if rebuild:
@@ -105,7 +108,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                                                     DynamicSplitFuseScheduler,
                                                     InferenceEngineV2,
                                                     RaggedInferenceEngineConfig)
-            cfg = engine_config or RaggedInferenceEngineConfig(
+            cfg = getattr(self, "_ragged_config", None) or RaggedInferenceEngineConfig(
                 kv_block_size=16,
                 state_manager=DSStateManagerConfig(
                     max_ragged_batch_size=max(token_budget, 64),

@@ -142,15 +142,23 @@ class CPULionBuilder(OpBuilder):
 class AioHandle:
     """aio_handle parity object (reference py_ds_aio.cpp)."""
 
-    def __init__(self, cdll, num_threads=8):
+    def __init__(self, cdll, num_threads=8, queue_depth=128, block_bytes=1 << 20,
+                 use_uring=True, use_o_direct=False):
         self._c = cdll
-        cdll.ds_aio_create.restype = c_void_p
-        cdll.ds_aio_create.argtypes = [c_int]
+        cdll.ds_aio_create2.restype = c_void_p
+        cdll.ds_aio_create2.argtypes = [c_int, c_int, c_int64, c_int, c_int]
         cdll.ds_aio_destroy.argtypes = [c_void_p]
+        cdll.ds_aio_backend.argtypes = [c_void_p]
         for fn in ("ds_aio_submit_read", "ds_aio_submit_write", "ds_aio_pread", "ds_aio_pwrite"):
             getattr(cdll, fn).argtypes = [c_void_p, c_char_p, c_void_p, c_int64, c_int64]
         cdll.ds_aio_wait.argtypes = [c_void_p]
-        self._h = cdll.ds_aio_create(num_threads)
+        self._h = cdll.ds_aio_create2(num_threads, queue_depth, block_bytes,
+                                      1 if use_uring else 0, 1 if use_o_direct else 0)
+
+    @property
+    def backend(self):
+        """'io_uring' (kernel-async) or 'threads' (pread/pwrite fallback)."""
+        return "io_uring" if self._c.ds_aio_backend(self._h) else "threads"
 
     def close(self):
         if self._h is not None:
@@ -193,8 +201,11 @@ class _AioModule:
     def __init__(self, cdll):
         self._cdll = cdll
 
-    def aio_handle(self, num_threads=8, **_compat_kwargs):
-        return AioHandle(self._cdll, num_threads=num_threads)
+    def aio_handle(self, num_threads=8, queue_depth=128, block_bytes=1 << 20,
+                   use_uring=True, use_o_direct=False, **_compat_kwargs):
+        return AioHandle(self._cdll, num_threads=num_threads, queue_depth=queue_depth,
+                         block_bytes=block_bytes, use_uring=use_uring,
+                         use_o_direct=use_o_direct)
 
 
 class AsyncIOBuilder(OpBuilder):
