@@ -2,6 +2,8 @@
 
 from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (SparseSelfAttention,
                                                                        layout_to_mask)
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+    SparseAttentionUtils, build_sparse_self_attention, get_sparse_attention_config)
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import (BigBirdSparsityConfig,
                                                                  BSLongformerSparsityConfig,
                                                                  DenseSparsityConfig,
@@ -11,4 +13,5 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (BigBirdSparsityC
 
 __all__ = ["SparseSelfAttention", "layout_to_mask", "SparsityConfig", "DenseSparsityConfig",
            "FixedSparsityConfig", "VariableSparsityConfig", "BigBirdSparsityConfig",
-           "BSLongformerSparsityConfig"]
+           "BSLongformerSparsityConfig", "SparseAttentionUtils",
+           "get_sparse_attention_config", "build_sparse_self_attention"]

@@ -1,0 +1,119 @@
+"""Sparse-attention integration utilities + ds_config parsing.
+
+Capability match for the reference's
+``deepspeed/ops/sparse_attention/sparse_attention_utils.py``
+(``SparseAttentionUtils`` at :14) and the ``sparse_attention`` section
+parsing in ``deepspeed/runtime/config.py:296``: the ds_config names a
+sparsity mode (dense/fixed/variable/bigbird/bslongformer) plus its
+knobs; :func:`get_sparse_attention_config` builds the matching
+``SparsityConfig``, and the utils pad/unpad sequences to the block
+granularity and extend position tables for long-sequence fine-tuning.
+The reference's module-surgery helper
+(``replace_model_self_attention_with_sparse_self_attention``) has no
+torch-module counterpart here — models consume the built
+``SparseSelfAttention`` directly (a sharding/impl decision, not
+surgery).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import SparseSelfAttention
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (BigBirdSparsityConfig,
+                                                                BSLongformerSparsityConfig,
+                                                                DenseSparsityConfig,
+                                                                FixedSparsityConfig,
+                                                                VariableSparsityConfig)
+
+MODES = {"dense": DenseSparsityConfig, "fixed": FixedSparsityConfig,
+         "variable": VariableSparsityConfig, "bigbird": BigBirdSparsityConfig,
+         "bslongformer": BSLongformerSparsityConfig}
+
+
+def get_sparse_attention_config(ds_config, num_heads):
+    """``ds_config``: a full ds_config dict (with a ``sparse_attention``
+    section) or the section itself → a ``SparsityConfig`` instance, or
+    None when absent (reference runtime/config.py:296)."""
+    if not isinstance(ds_config, dict):
+        return None
+    if "sparse_attention" in ds_config:
+        # an enabled-but-empty section means fixed-mode defaults, exactly
+        # like the reference's get_scalar_param defaults — not "disabled"
+        section = dict(ds_config["sparse_attention"] or {})
+    elif "mode" in ds_config:
+        section = dict(ds_config)  # the section itself was passed
+    else:
+        return None
+    mode = section.pop("mode", "fixed")
+    if mode not in MODES:
+        raise NotImplementedError(f"sparsity mode {mode!r}: known modes {sorted(MODES)}")
+    return MODES[mode](num_heads=num_heads, **section)
+
+
+def build_sparse_self_attention(ds_config, num_heads, max_seq_length=2048):
+    """ds_config → ready ``SparseSelfAttention`` (or None)."""
+    cfg = get_sparse_attention_config(ds_config, num_heads)
+    return None if cfg is None else SparseSelfAttention(cfg, max_seq_length=max_seq_length)
+
+
+class SparseAttentionUtils:
+    """Reference-named helpers (sparse_attention_utils.py:14), functional
+    over arrays/params instead of torch modules."""
+
+    @staticmethod
+    def extend_position_embedding(params, max_position, table_key="embed_positions"):
+        """Tile a learned position table up to ``max_position`` rows
+        (reference :21: BERT/RoBERTa long-sequence fine-tuning init).
+        Walks the params tree, extending every matching table."""
+        def walk(node):
+            if not isinstance(node, dict):
+                return node
+            out = {}
+            for k, v in node.items():
+                if k == table_key and getattr(v, "ndim", 0) == 2:
+                    if max_position <= v.shape[0]:  # reference raises too:
+                        raise ValueError(  # never destroy learned positions
+                            f"extend_position_embedding: max_position "
+                            f"{max_position} must exceed the current table "
+                            f"({v.shape[0]} rows)")
+                    reps = -(-max_position // v.shape[0])
+                    out[k] = np.tile(np.asarray(v), (reps, 1))[:max_position]
+                else:
+                    out[k] = walk(v)
+            return out
+
+        return walk(params)
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Reference :64 parity — works with HF tokenizers unchanged."""
+        tokenizer.model_max_length = max_position
+        return tokenizer
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask=None,
+                          token_type_ids=None, position_ids=None, inputs_embeds=None,
+                          pad_token_id=0):
+        """Right-pad the sequence dim to a multiple of ``block_size``
+        (reference :143) → (pad_len, padded tensors...). The returned
+        attention_mask zeroes the padding so the masked-dense path (and
+        the layout, at block granularity) ignores it."""
+        seq_len = (input_ids if input_ids is not None else inputs_embeds).shape[1]
+        pad_len = (-seq_len) % block_size
+
+        def pad(x, value=0):
+            if x is None or pad_len == 0:
+                return x
+            widths = [(0, 0), (0, pad_len)] + [(0, 0)] * (np.asarray(x).ndim - 2)
+            return np.pad(np.asarray(x), widths, constant_values=value)
+
+        if attention_mask is None and pad_len and input_ids is not None:
+            attention_mask = np.ones_like(np.asarray(input_ids))
+        return (pad_len, pad(input_ids, pad_token_id), pad(attention_mask, 0),
+                pad(token_type_ids, 0), pad(position_ids, 0), pad(inputs_embeds, 0))
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Reference :193 — drop the padding rows again."""
+        if pad_len == 0:
+            return sequence_output
+        return sequence_output[:, :-pad_len]

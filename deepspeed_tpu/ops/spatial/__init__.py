@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.spatial.ops import (bias_add, bias_add_add, bias_add_bias_add,
+                                           fused_group_norm)  # noqa: F401

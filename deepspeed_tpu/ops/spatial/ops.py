@@ -1,0 +1,47 @@
+"""Spatial (diffusion UNet/VAE) fused ops.
+
+Capability match for the reference's ``csrc/spatial/`` CUDA kernels
+(``opt_bias_add`` / ``opt_bias_add_add`` / ``opt_bias_add_bias_add`` at
+csrc/spatial/csrc/opt_bias_add.cu:24 — the fused epilogues diffusers'
+conv/attention blocks need) and the GroupNorm the UNet interleaves.
+TPU form: pure jnp — these are exactly the elementwise/reduction
+patterns XLA fuses into the producing conv/matmul, so a hand kernel
+would only break fusion; the functions exist so the diffusion modules
+(and a reference user porting ``deepspeed.ops.spatial``) have the same
+named surface with fp32 statistics guaranteed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def bias_add(activation, bias):
+    """NHWC activation [N, H, W, C] (or any [..., C]) + per-channel bias."""
+    return activation + bias.astype(activation.dtype)
+
+
+def bias_add_add(activation, bias, other):
+    """(activation + bias) + other — the residual form (opt_bias_add_add)."""
+    return activation + bias.astype(activation.dtype) + other
+
+
+def bias_add_bias_add(activation, bias, other, other_bias):
+    """(activation + bias) + (other + other_bias) — both-branch biases
+    (opt_bias_add_bias_add)."""
+    return (activation + bias.astype(activation.dtype)
+            + other + other_bias.astype(activation.dtype))
+
+
+def fused_group_norm(x, num_groups, scale, bias, eps=1e-5):
+    """GroupNorm over the channel dim of [..., C] with fp32 statistics
+    (the UNet/VAE normalization between the spatial convs)."""
+    orig_dtype = x.dtype
+    c = x.shape[-1]
+    assert c % num_groups == 0, f"channels {c} not divisible by groups {num_groups}"
+    x32 = x.astype(jnp.float32).reshape(x.shape[:-1] + (num_groups, c // num_groups))
+    red = tuple(range(1, x.ndim - 1)) + (x.ndim,)  # spatial dims + within-group
+    mu = jnp.mean(x32, axis=red, keepdims=True)
+    var = jnp.var(x32, axis=red, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(x.shape)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(orig_dtype)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.transformer.inference.diffusers_attention import \
+    DeepSpeedDiffusersAttention  # noqa: F401

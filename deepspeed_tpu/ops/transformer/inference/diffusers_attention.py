@@ -1,0 +1,54 @@
+"""Diffusion cross/self-attention block.
+
+Capability match for the reference's
+``deepspeed/ops/transformer/inference/diffusers_attention.py``
+(``DeepSpeedDiffusersAttention``: the fused replacement
+``generic_injection`` swaps in for diffusers' CrossAttention) and
+``diffusers_transformer_block.py``. TPU form: a flax module over the
+Pallas flash-attention kernel — spatial tokens are the sequence, text
+conditioning (when given) is the key/value context, heads fold into
+the [B, S, H, D] kernel layout. The projection names mirror diffusers'
+(``to_q``/``to_k``/``to_v``/``to_out``) so UNet checkpoints map 1:1.
+"""
+
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+class DeepSpeedDiffusersAttention(nn.Module):
+    """query_dim: channel width of the spatial stream; context_dim: text
+    encoder width for cross-attention (None = self-attention)."""
+    query_dim: int
+    heads: int = 8
+    dim_head: int = 64
+    context_dim: int = None
+    out_bias: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, context=None):
+        """hidden_states: [B, S, query_dim] (flattened H*W spatial tokens);
+        context: optional [B, S_ctx, context_dim] → [B, S, query_dim]."""
+        B, S, _ = hidden_states.shape
+        inner = self.heads * self.dim_head
+        kv_src = hidden_states if context is None else context
+        q = nn.Dense(inner, use_bias=False, name="to_q")(hidden_states)
+        k = nn.Dense(inner, use_bias=False, name="to_k")(kv_src)
+        v = nn.Dense(inner, use_bias=False, name="to_v")(kv_src)
+        q = q.reshape(B, S, self.heads, self.dim_head)
+        k = k.reshape(B, kv_src.shape[1], self.heads, self.dim_head)
+        v = v.reshape(B, kv_src.shape[1], self.heads, self.dim_head)
+        if context is None:
+            out = flash_attention(q, k, v, causal=False)
+        else:
+            # cross-attention: S_q != S_kv; the flash kernel tiles square
+            # blocks, so use the reference math (still one fused softmax)
+            scale = 1.0 / np.sqrt(self.dim_head)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+            p = nn.softmax(s, axis=-1).astype(v.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        out = out.reshape(B, S, inner)
+        return nn.Dense(self.query_dim, use_bias=self.out_bias, name="to_out")(out)

@@ -140,3 +140,54 @@ def test_indices_structure():
     assert k_nnz[1].tolist() == [0, 0, 3]
     # transpose: head 1's key-block 0 admitted by query-block 2
     assert q_nnz[1].tolist() == [1, 1, 1] and q_idx[1, 0, 0] == 2
+
+
+class TestSparseAttentionUtils:
+    """ds_config parsing + pad/unpad + position extension (reference
+    sparse_attention_utils.py + runtime/config.py get_sparse_attention)."""
+
+    def test_config_modes(self):
+        from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                        get_sparse_attention_config)
+        ds = {"sparse_attention": {"mode": "bigbird", "block": 32,
+                                   "num_random_blocks": 2,
+                                   "num_sliding_window_blocks": 3,
+                                   "num_global_blocks": 1}}
+        cfg = get_sparse_attention_config(ds, num_heads=4)
+        assert isinstance(cfg, BigBirdSparsityConfig)
+        assert cfg.block == 32 and cfg.num_random_blocks == 2 and cfg.num_heads == 4
+        assert get_sparse_attention_config({}, num_heads=4) is None
+        with pytest.raises(NotImplementedError, match="sparsity mode"):
+            get_sparse_attention_config({"sparse_attention": {"mode": "nope"}}, 4)
+
+    def test_build_and_run_from_ds_config(self):
+        from deepspeed_tpu.ops.sparse_attention import build_sparse_self_attention
+        attn = build_sparse_self_attention(
+            {"sparse_attention": {"mode": "fixed", "block": 16,
+                                  "num_local_blocks": 2, "num_global_blocks": 1}},
+            num_heads=2)
+        q, k, v = _qkv(1, 64, 2, 16, seed=11)
+        out = attn(q, k, v)
+        assert out.shape == (1, 64, 2, 16)
+
+    def test_pad_unpad_roundtrip(self):
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+        ids = np.arange(2 * 45).reshape(2, 45)
+        pad_len, pids, mask, *_ = SparseAttentionUtils.pad_to_block_size(
+            16, ids, pad_token_id=9)
+        assert pad_len == 3 and pids.shape == (2, 48)
+        assert (pids[:, -3:] == 9).all() and (mask[:, -3:] == 0).all()
+        seq_out = np.random.RandomState(0).randn(2, 48, 8)
+        unp = SparseAttentionUtils.unpad_sequence_output(pad_len, seq_out)
+        assert unp.shape == (2, 45, 8)
+        assert SparseAttentionUtils.unpad_sequence_output(0, seq_out).shape == (2, 48, 8)
+
+    def test_extend_position_embedding(self):
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+        params = {"model": {"embed_positions": np.arange(12.0).reshape(6, 2),
+                            "layers": {"w": np.ones((2, 2))}}}
+        out = SparseAttentionUtils.extend_position_embedding(params, 15)
+        table = out["model"]["embed_positions"]
+        assert table.shape == (15, 2)
+        np.testing.assert_array_equal(table[6:12], table[:6])  # tiled
+        np.testing.assert_array_equal(out["model"]["layers"]["w"], np.ones((2, 2)))

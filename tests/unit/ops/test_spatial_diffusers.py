@@ -1,0 +1,120 @@
+"""Spatial ops + diffusers attention injection (reference csrc/spatial/,
+ops/transformer/inference/diffusers_attention.py, module_inject
+generic_injection)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.module_inject.replace_module import (attention_config_from_shapes,
+                                                        find_attention_blocks,
+                                                        generic_injection)
+from deepspeed_tpu.ops.spatial import (bias_add, bias_add_add, bias_add_bias_add,
+                                       fused_group_norm)
+from deepspeed_tpu.ops.transformer.inference import DeepSpeedDiffusersAttention
+
+
+class TestSpatialOps:
+
+    def test_bias_add_family(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+        b = jnp.asarray(rng.randn(8).astype(np.float32))
+        o = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+        ob = jnp.asarray(rng.randn(8).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(bias_add(x, b)), np.asarray(x) + np.asarray(b))
+        np.testing.assert_allclose(np.asarray(bias_add_add(x, b, o)),
+                                   np.asarray(x) + np.asarray(b) + np.asarray(o), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(bias_add_bias_add(x, b, o, ob)),
+            np.asarray(x) + np.asarray(b) + np.asarray(o) + np.asarray(ob), rtol=1e-6)
+
+    def test_group_norm_matches_torch(self):
+        import torch
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 6, 6, 32).astype(np.float32)  # NHWC
+        scale = rng.randn(32).astype(np.float32)
+        bias = rng.randn(32).astype(np.float32)
+        got = np.asarray(fused_group_norm(jnp.asarray(x), 8, jnp.asarray(scale),
+                                          jnp.asarray(bias)))
+        tx = torch.from_numpy(x).permute(0, 3, 1, 2)  # torch wants NCHW
+        want = torch.nn.functional.group_norm(
+            tx, 8, torch.from_numpy(scale), torch.from_numpy(bias))
+        want = want.permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def _torch_cross_attention(state, prefix, x, context, heads):
+    """Reference math exactly as diffusers CrossAttention computes it."""
+    import torch
+    with torch.no_grad():
+        g = lambda n: state[f"{prefix}.{n}" if prefix else n]
+        q = torch.from_numpy(x) @ g("to_q.weight").T
+        src = torch.from_numpy(context if context is not None else x)
+        k = src @ g("to_k.weight").T
+        v = src @ g("to_v.weight").T
+        B, S, inner = q.shape
+        dh = inner // heads
+        def split(t):
+            return t.reshape(t.shape[0], t.shape[1], heads, dh).permute(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+        s = (q @ k.transpose(-1, -2)) / np.sqrt(dh)
+        out = torch.softmax(s, dim=-1) @ v
+        out = out.permute(0, 2, 1, 3).reshape(B, S, inner)
+        out = out @ g("to_out.0.weight").T + g("to_out.0.bias")
+        return out.numpy()
+
+
+class TestDiffusersInjection:
+
+    @pytest.fixture(scope="class")
+    def unet_state(self):
+        import torch
+        torch.manual_seed(0)
+        state = {}
+        # self-attention block (attn1) + cross-attention block (attn2),
+        # nested like a diffusers UNet state_dict
+        for name, ctx_dim in (("down.0.attn1", 64), ("down.0.attn2", 96)):
+            state[f"{name}.to_q.weight"] = torch.randn(128, 64) * 0.05
+            state[f"{name}.to_k.weight"] = torch.randn(128, ctx_dim) * 0.05
+            state[f"{name}.to_v.weight"] = torch.randn(128, ctx_dim) * 0.05
+            state[f"{name}.to_out.0.weight"] = torch.randn(64, 128) * 0.05
+            state[f"{name}.to_out.0.bias"] = torch.randn(64) * 0.05
+        state["down.0.conv.weight"] = torch.randn(3, 3)  # non-attention noise
+        return state
+
+    def test_find_and_configure(self, unet_state):
+        prefixes = find_attention_blocks(unet_state)
+        assert sorted(prefixes) == ["down.0.attn1", "down.0.attn2"]
+        # the default split is diffusers' heads=8 (SD UNets)...
+        cfg_default = attention_config_from_shapes(unet_state, "down.0.attn1")
+        assert (cfg_default["heads"], cfg_default["dim_head"]) == (8, 16)
+        # ...and an explicit head count overrides it (the split is not
+        # recoverable from shapes)
+        cfg1 = attention_config_from_shapes(unet_state, "down.0.attn1", heads=2)
+        assert cfg1 == {"query_dim": 64, "heads": 2, "dim_head": 64,
+                        "context_dim": None, "out_bias": True}
+        cfg2 = attention_config_from_shapes(unet_state, "down.0.attn2", heads=2)
+        assert cfg2["context_dim"] == 96
+
+    def test_injected_attention_matches_diffusers_math(self, unet_state):
+        blocks = generic_injection(unet_state, heads=2)
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 16, 64).astype(np.float32)  # 4x4 spatial tokens
+        ctx = rng.randn(2, 7, 96).astype(np.float32)
+
+        # self-attention block
+        mod, params = blocks["down.0.attn1"]
+        got = np.asarray(mod.apply({"params": jax.tree.map(jnp.asarray, params)},
+                                   jnp.asarray(x)))
+        want = _torch_cross_attention(unet_state, "down.0.attn1", x, None, heads=2)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+        # cross-attention block with text context
+        mod2, params2 = blocks["down.0.attn2"]
+        got2 = np.asarray(mod2.apply({"params": jax.tree.map(jnp.asarray, params2)},
+                                     jnp.asarray(x), jnp.asarray(ctx)))
+        want2 = _torch_cross_attention(unet_state, "down.0.attn2", x, ctx, heads=2)
+        np.testing.assert_allclose(got2, want2, rtol=2e-4, atol=2e-4)
