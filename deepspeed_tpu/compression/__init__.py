@@ -7,10 +7,12 @@ from deepspeed_tpu.compression.basic_layer import (bits_at_step, channel_pruning
                                                     quantize_activation, row_pruning_mask,
                                                     sparse_pruning_mask, ste_quantize)
 from deepspeed_tpu.compression.compress import (init_compression, layer_reduction,
-                                                 redundancy_clean)
+                                                 redundancy_clean,
+                                                 structural_channel_prune)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
 __all__ = ["init_compression", "redundancy_clean", "layer_reduction",
+           "structural_channel_prune",
            "ste_quantize", "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask",
            "channel_pruning_mask", "quantize_activation", "bits_at_step",
            "CompressionScheduler"]

@@ -76,3 +76,64 @@ def redundancy_clean(params, ds_config, num_heads=None):
     pruning masks burned in, and weights quantize-dequantized once."""
     reduced, transform = init_compression(params, ds_config, num_heads=num_heads)
     return jax.tree.map(jax.lax.stop_gradient, transform(reduced))
+
+
+def structural_channel_prune(params, pairs, dense_ratio):
+    """True dimension reduction (reference ``LinearLayer_Compress.
+    fix_row_col_pruning_helper(dim_reduction=True)``, basic_layer.py:212):
+    for each ``(producer_pattern, consumer_pattern)`` pair of COUPLED
+    kernels — producer output channels feed consumer input rows — keep
+    the top ``dense_ratio`` channels by producer L1 norm and SLICE them
+    out of the producer kernel [..., D, C] + bias [..., C] and the
+    consumer kernel [..., C, D']. Scan-stacked layers ([L, ...] leading
+    dim) are sliced per layer with a uniform keep count, so the stacked
+    shape stays rectangular. Exact (not just masked) when the activation
+    between the pair maps 0 -> 0 (gelu/relu/silu) and biases ride along.
+    """
+    import re
+
+    import numpy as np
+
+    flat = {}
+
+    def collect(path, x):
+        flat[path] = x
+        return x
+
+    path_tree_map(collect, params)
+
+    def find_one(pattern, suffix):
+        hits = [p for p in flat if re.search(pattern, p) and p.endswith(suffix)]
+        if len(hits) != 1:
+            raise ValueError(f"structural prune: pattern {pattern!r} matched "
+                             f"{len(hits)} '{suffix}' leaves: {hits}")
+        return hits[0]
+
+    replacements = {}
+    for producer_pat, consumer_pat in pairs:
+        pk_path = find_one(producer_pat, "kernel")
+        ck_path = find_one(consumer_pat, "kernel")
+        pk = np.asarray(flat[pk_path])
+        ck = np.asarray(flat[ck_path])
+        c = pk.shape[-1]
+        keep = max(1, int(round(c * dense_ratio)))
+        lead = pk.shape[:-2]
+        norms = np.abs(pk).sum(axis=-2).reshape(-1, c)  # [prod(lead), C]
+        idx = np.sort(np.argsort(-norms, axis=-1)[:, :keep], axis=-1)  # [N, keep]
+        n = idx.shape[0]
+        pk2 = np.take_along_axis(pk.reshape(n, pk.shape[-2], c),
+                                 idx[:, None, :], axis=-1)
+        replacements[pk_path] = pk2.reshape(lead + (pk.shape[-2], keep))
+        ck2 = np.take_along_axis(ck.reshape(n, c, ck.shape[-1]),
+                                 idx[:, :, None], axis=-2)
+        replacements[ck_path] = ck2.reshape(lead + (keep, ck.shape[-1]))
+        pb_path = pk_path[:-len("kernel")] + "bias"
+        if pb_path in flat:
+            pb = np.asarray(flat[pb_path])
+            pb2 = np.take_along_axis(pb.reshape(n, c), idx, axis=-1)
+            replacements[pb_path] = pb2.reshape(lead + (keep,))
+
+    def replace(path, x):
+        return replacements.get(path, x)
+
+    return path_tree_map(replace, params)

@@ -55,10 +55,13 @@ def attention_config_from_shapes(state, prefix="", dim_head=None, heads=None):
     if heads is None and dim_head is None:
         heads = 8 if inner % 8 == 0 else 1  # diffusers CrossAttention default
     if heads is None:
+        if inner % dim_head != 0:
+            raise ValueError(f"{prefix}: dim_head={dim_head} does not divide "
+                             f"inner dim {inner}")
         heads = inner // dim_head
+    if inner % heads != 0:
+        raise ValueError(f"{prefix}: heads={heads} does not divide inner dim {inner}")
     dim_head = inner // heads
-    assert heads * dim_head == inner, \
-        f"{prefix}: inner dim {inner} does not split into heads={heads}"
     return {"query_dim": query_dim, "heads": heads, "dim_head": dim_head,
             "context_dim": None if context_dim == query_dim else context_dim,
             "out_bias": p + "to_out.0.bias" in state}

@@ -28,6 +28,14 @@ MODES = {"dense": DenseSparsityConfig, "fixed": FixedSparsityConfig,
          "variable": VariableSparsityConfig, "bigbird": BigBirdSparsityConfig,
          "bslongformer": BSLongformerSparsityConfig}
 
+# every knob any SparsityConfig constructor accepts — used to recognize a
+# bare section dict passed without the "sparse_attention" wrapper
+_SECTION_KEYS = {"mode", "block", "different_layout_per_head", "num_local_blocks",
+                 "num_global_blocks", "attention", "horizontal_global_attention",
+                 "num_different_global_patterns", "num_random_blocks",
+                 "num_sliding_window_blocks", "seed", "global_block_indices",
+                 "global_block_end_indices"}
+
 
 def get_sparse_attention_config(ds_config, num_heads):
     """``ds_config``: a full ds_config dict (with a ``sparse_attention``
@@ -39,8 +47,9 @@ def get_sparse_attention_config(ds_config, num_heads):
         # an enabled-but-empty section means fixed-mode defaults, exactly
         # like the reference's get_scalar_param defaults — not "disabled"
         section = dict(ds_config["sparse_attention"] or {})
-    elif "mode" in ds_config:
+    elif ds_config and set(ds_config) <= _SECTION_KEYS:
         section = dict(ds_config)  # the section itself was passed
+        # (mode-less sections count: mode defaults to "fixed" below)
     else:
         return None
     mode = section.pop("mode", "fixed")
@@ -63,25 +72,27 @@ class SparseAttentionUtils:
     def extend_position_embedding(params, max_position, table_key="embed_positions"):
         """Tile a learned position table up to ``max_position`` rows
         (reference :21: BERT/RoBERTa long-sequence fine-tuning init).
-        Walks the params tree, extending every matching table."""
-        def walk(node):
-            if not isinstance(node, dict):
-                return node
-            out = {}
-            for k, v in node.items():
-                if k == table_key and getattr(v, "ndim", 0) == 2:
-                    if max_position <= v.shape[0]:  # reference raises too:
-                        raise ValueError(  # never destroy learned positions
-                            f"extend_position_embedding: max_position "
-                            f"{max_position} must exceed the current table "
-                            f"({v.shape[0]} rows)")
-                    reps = -(-max_position // v.shape[0])
-                    out[k] = np.tile(np.asarray(v), (reps, 1))[:max_position]
-                else:
-                    out[k] = walk(v)
-            return out
+        Walks the params pytree (any registered container), extending
+        every matching table; raises when none exists or the request
+        would truncate learned positions."""
+        from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+        found = []
 
-        return walk(params)
+        def leaf(path, v):
+            if path.split("/")[-1] == table_key and getattr(v, "ndim", 0) == 2:
+                if max_position <= v.shape[0]:  # never destroy learned rows
+                    raise ValueError(
+                        f"extend_position_embedding: max_position {max_position} "
+                        f"must exceed the current table ({v.shape[0]} rows)")
+                found.append(path)
+                reps = -(-max_position // v.shape[0])
+                return np.tile(np.asarray(v), (reps, 1))[:max_position]
+            return v
+
+        out = path_tree_map(leaf, params)
+        if not found:
+            raise ValueError(f"no 2-D {table_key!r} table found in the params tree")
+        return out
 
     @staticmethod
     def update_tokenizer_model_max_length(tokenizer, max_position):
@@ -106,8 +117,9 @@ class SparseAttentionUtils:
             widths = [(0, 0), (0, pad_len)] + [(0, 0)] * (np.asarray(x).ndim - 2)
             return np.pad(np.asarray(x), widths, constant_values=value)
 
-        if attention_mask is None and pad_len and input_ids is not None:
-            attention_mask = np.ones_like(np.asarray(input_ids))
+        if attention_mask is None and pad_len:
+            ref = input_ids if input_ids is not None else inputs_embeds
+            attention_mask = np.ones(np.asarray(ref).shape[:2], np.int32)
         return (pad_len, pad(input_ids, pad_token_id), pad(attention_mask, 0),
                 pad(token_type_ids, 0), pad(position_ids, 0), pad(inputs_embeds, 0))
 

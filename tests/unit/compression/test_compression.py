@@ -193,3 +193,52 @@ def test_xtc_style_bert_quantize_then_prune():
     # the cleanup really pruned: encoder kernels carry ~10% zeros
     k = cleaned["model"]["layers"]["fc_in"]["kernel"]
     assert (np.asarray(k) == 0).mean() >= 0.08
+
+
+def test_structural_channel_prune_is_exact_and_shrinks():
+    """Dimension reduction (reference fix_row_col_pruning_helper with
+    dim_reduction=True): the fc_in/fc_out pair physically shrinks, and —
+    because gelu(0)=0 and the bias rides along — pruning channels whose
+    weights AND bias are zero is EXACT, not just masked."""
+    from deepspeed_tpu.compression import structural_channel_prune
+    from deepspeed_tpu.models.bert import BERT_CONFIGS, BertForMaskedLM
+    import dataclasses
+    model = BertForMaskedLM(BERT_CONFIGS["bert-debug"])
+    rng = np.random.RandomState(7)
+    ids = jnp.asarray(rng.randint(0, 250, size=(2, 16)), jnp.int32)
+    labels = jnp.where(ids % 5 == 0, ids, -100)
+    params = model.init(jax.random.PRNGKey(1), ids, labels)["params"]
+
+    # zero out a quarter of fc_in's output channels (kernel + bias) so the
+    # structural slice provably removes only dead channels
+    fc_in = params["model"]["layers"]["fc_in"]
+    L, D, I = fc_in["kernel"].shape
+    dead = np.arange(0, I, 4)
+    k = np.asarray(fc_in["kernel"]).copy(); k[:, :, dead] = 0
+    b = np.asarray(fc_in["bias"]).copy(); b[:, dead] = 0
+    params["model"]["layers"]["fc_in"] = {"kernel": jnp.asarray(k), "bias": jnp.asarray(b)}
+
+    pruned = structural_channel_prune(
+        params, [(r"layers/fc_in", r"layers/fc_out")], dense_ratio=0.75)
+    pk = pruned["model"]["layers"]["fc_in"]["kernel"]
+    ck = pruned["model"]["layers"]["fc_out"]["kernel"]
+    assert pk.shape == (L, D, int(I * 0.75))
+    assert ck.shape == (L, int(I * 0.75), D)
+    assert pruned["model"]["layers"]["fc_in"]["bias"].shape == (L, int(I * 0.75))
+
+    # the shrunk model computes the SAME loss (needs a config whose
+    # intermediate size matches the slice)
+    small = BertForMaskedLM(dataclasses.replace(
+        model.config, intermediate_size=int(I * 0.75)))
+    loss0 = model.apply({"params": params}, ids, labels)
+    loss1 = small.apply({"params": pruned}, ids, labels)
+    get = lambda l: float(l[0] if isinstance(l, tuple) else l)
+    np.testing.assert_allclose(get(loss1), get(loss0), rtol=1e-5)
+
+
+def test_structural_prune_ambiguous_pattern_raises():
+    from deepspeed_tpu.compression import structural_channel_prune
+    params = {"a": {"kernel": np.ones((4, 8))}, "b": {"kernel": np.ones((8, 4))},
+              "c": {"kernel": np.ones((4, 8))}}
+    with pytest.raises(ValueError, match="matched 2"):
+        structural_channel_prune(params, [(r"a|c", r"b")], 0.5)
