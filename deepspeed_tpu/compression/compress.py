@@ -103,7 +103,8 @@ def structural_channel_prune(params, pairs, dense_ratio):
     path_tree_map(collect, params)
 
     def find_one(pattern, suffix):
-        hits = [p for p in flat if re.search(pattern, p) and p.endswith(suffix)]
+        hits = [p for p in flat
+                if re.search(pattern, p) and p.split("/")[-1] == suffix]
         if len(hits) != 1:
             raise ValueError(f"structural prune: pattern {pattern!r} matched "
                              f"{len(hits)} '{suffix}' leaves: {hits}")

@@ -64,6 +64,23 @@ class InferenceEngineV2:
                 lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 params)
 
+        # ZeRO-Inference weight-only quantization for the ragged path
+        # (reference inference/v2 + FP6-LLM serving): quantized bytes live
+        # in HBM, the jitted step dequantizes per leaf and XLA fuses the
+        # decode into each consuming matmul.
+        self._dequant = None
+        qmode = getattr(self._config.quantization, "quantization_mode", "none")
+        if qmode and qmode != "none":
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "quantized weights + tensor/expert-parallel serving are not "
+                    "composable yet: quantization groups flatten each leaf, which "
+                    "breaks the per-dim shardings")
+            from deepspeed_tpu.inference.quantization import \
+                _init_group_wise_weight_quantization
+            self.params, self._dequant = _init_group_wise_weight_quantization(
+                self.params, scheme=qmode, modules=[r"kernel|embed|experts_w"])
+
         self.max_tokens = int(sm.max_ragged_batch_size)
         self.max_seqs = int(sm.max_ragged_sequence_count)
         self.block_size = int(self._config.kv_block_size)
@@ -86,10 +103,15 @@ class InferenceEngineV2:
                                          self.max_blocks_per_seq)
         mesh = self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
-        self._step = jax.jit(
-            lambda p, kc, vc, b: ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
-                                                attn_impl=attn_impl),
-            donate_argnums=(1, 2))
+        dequant = self._dequant
+
+        def step(p, kc, vc, b):
+            if dequant is not None:
+                p = dequant(p, dtype)  # fused into the consumers by XLA
+            return ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
+                                  attn_impl=attn_impl)
+
+        self._step = jax.jit(step, donate_argnums=(1, 2))
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P
             self._replicated = NamedSharding(self.mesh, _P())

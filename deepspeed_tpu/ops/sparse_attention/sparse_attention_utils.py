@@ -47,9 +47,11 @@ def get_sparse_attention_config(ds_config, num_heads):
         # an enabled-but-empty section means fixed-mode defaults, exactly
         # like the reference's get_scalar_param defaults — not "disabled"
         section = dict(ds_config["sparse_attention"] or {})
+    elif "mode" in ds_config:
+        section = dict(ds_config)  # unambiguously the section itself; a bad
+        # knob raises from the constructor rather than silently disabling
     elif ds_config and set(ds_config) <= _SECTION_KEYS:
-        section = dict(ds_config)  # the section itself was passed
-        # (mode-less sections count: mode defaults to "fixed" below)
+        section = dict(ds_config)  # mode-less section: fixed-mode defaults
     else:
         return None
     mode = section.pop("mode", "fixed")
