@@ -6,6 +6,11 @@ TPU-native analogue of the reference's ``deepspeed/runtime/pipe/topology.py``
 build one ``jax.sharding.Mesh`` whose named axes stand in for process
 groups; collectives address axes by name inside ``shard_map``/``pjit``.
 
+The rank bookkeeping here is array-based: ranks form an ndarray of shape
+``dims`` (row-major, so the last axis varies fastest, matching how
+``jax.sharding.Mesh`` linearises its device grid), and every query is an
+indexing or reduction over that array rather than a dict walk.
+
 Canonical axis order (outermost → innermost):
 
     ('pipe', 'data', 'expert', 'sequence', 'tensor')
@@ -21,9 +26,6 @@ Canonical axis order (outermost → innermost):
                  heavy collectives ride the fastest ICI dimension.
 """
 
-from collections import namedtuple
-from itertools import product as cartesian_product
-
 import numpy as np
 
 MESH_AXES = ("pipe", "data", "expert", "sequence", "tensor")
@@ -37,127 +39,122 @@ EXPERT_ZERO_AXES = ("data", "sequence")
 
 
 class ProcessTopology:
-    """Manages the mapping of n-dimensional Cartesian coordinates to linear
-    indices. This mapping is used to map the rank of processes to the grid
-    for various forms of parallelism.
+    """Named-axis coordinate system over a linear rank space.
 
-    Each axis of the tensor is accessed by its name. The provided ordering
-    of the axes defines the layout of the topology.
-    ProcessTopology(axes=['x', 'y'], dims=[2,2]) gives a mapping where
-    (x,y) = (0,0), (0,1), (1,0), (1,1) map to ranks 0, 1, 2, 3 respectively.
-    ``x`` is the fastest-changing... actually the last axis is.
+    ``ProcessTopology(axes=['x', 'y'], dims=[2, 2])`` arranges ranks 0..3 in
+    a row-major 2x2 grid: rank = x*2 + y, i.e. the trailing axis is the
+    fastest-varying one. All lookups go through ``self.grid``, an int ndarray
+    of shape ``dims`` holding the global rank at each coordinate.
     """
 
     def __init__(self, axes, dims):
-        self.axes = list(axes)  # names of each topology axis
-        self.dims = list(dims)  # length of each topology axis
+        if len(axes) != len(dims):
+            raise ValueError(f"axes {axes} and dims {dims} must have equal length")
+        self.axes = list(axes)
+        self.dims = list(int(d) for d in dims)
+        self.grid = np.arange(int(np.prod(self.dims))).reshape(self.dims)
 
-        # This is actually a class that lets us hash {'row':3, 'col':2} mappings
-        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+    def _axis_index(self, axis):
+        try:
+            return self.axes.index(axis)
+        except ValueError:
+            raise ValueError(f"unknown axis {axis!r}; topology axes are {self.axes}") from None
 
-        self.mapping = {}
-        ranges = [range(d) for d in self.dims]
-        for global_rank, coord in enumerate(cartesian_product(*ranges)):
-            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
-            key = self.ProcessCoord(**key)
-            # for example, {ProcessCoord(row=0, col=1) : 1}
-            self.mapping[key] = global_rank
+    def _index_for(self, coord_kwargs):
+        """Build an ndarray index tuple from axis->value kwargs, slice(None)
+        for unspecified axes."""
+        for name, val in coord_kwargs.items():
+            if name not in self.axes:
+                raise ValueError(f"unknown axis {name!r}; topology axes are {self.axes}")
+            dim = self.get_dim(name)
+            if not 0 <= int(val) < dim:
+                raise ValueError(f"coordinate {name}={val} out of range [0, {dim})")
+        return tuple(coord_kwargs.get(a, slice(None)) for a in self.axes)
 
     def get_rank(self, **coord_kwargs):
-        """Return the global rank of a process via its coordinates."""
+        """Global rank at a fully-specified coordinate."""
         if len(coord_kwargs) != len(self.axes):
-            raise ValueError("get_rank() does not support slices. Use filter_match())")
-        key = self.ProcessCoord(**coord_kwargs)
-        assert key in self.mapping, f"key {coord_kwargs} invalid"
-        return self.mapping[key]
+            missing = [a for a in self.axes if a not in coord_kwargs]
+            raise ValueError(f"get_rank needs every axis; missing {missing} (use filter_match for slices)")
+        return int(self.grid[self._index_for(coord_kwargs)])
 
     def get_axis_names(self):
-        """Return a list of the axis names in the ordering of the topology."""
-        return self.axes
-
-    def get_rank_repr(self, rank, omit_axes=["data", "pipe"], inner_sep="_", outer_sep="-"):
-        """Return a string representation of a rank (e.g. for checkpoint names)."""
-        omit_axes = frozenset(omit_axes)
-        axes = [a for a in self.get_axis_names() if a not in omit_axes]
-        names = []
-        for ax in axes:
-            ax_rank = getattr(self.get_coord(rank=rank), ax)
-            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
-        return outer_sep.join(names)
-
-    def get_dim(self, axis):
-        """Return the number of processes along the given axis."""
-        if axis not in self.axes:
-            return 0
-        return self.dims[self.axes.index(axis)]
+        return list(self.axes)
 
     def get_coord(self, rank):
-        """Return the coordinate owned by a process rank."""
-        for coord, idx in self.mapping.items():
-            if idx == rank:
-                return coord
-        raise ValueError(f"rank {rank} not found in topology.")
+        """Coordinate of ``rank`` as an attribute-accessible object."""
+        idx = np.unravel_index(int(rank), self.grid.shape)
+        return _Coord(self.axes, [int(i) for i in idx])
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_", outer_sep="-"):
+        """Stable string id for a rank, e.g. for checkpoint shard names."""
+        coord = self.get_coord(rank)
+        parts = [f"{a}{inner_sep}{getattr(coord, a):02d}" for a in self.axes if a not in set(omit_axes)]
+        return outer_sep.join(parts)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self._axis_index(axis)]
 
     def get_axis_comm_lists(self, axis):
-        """Construct lists suitable for a communicator group along axis ``axis``."""
+        """Rank groups that communicate along ``axis``: move that axis last,
+        flatten everything else — each row is one group."""
         if axis not in self.axes:
             return []
-
-        # Grab all axes but `axis`
-        other_axes = [a for a in self.axes if a != axis]
-
-        lists = []
-
-        # Construct all combinations of coords with other_axes
-        ranges = [range(self.get_dim(a)) for a in other_axes]
-        for coord in cartesian_product(*ranges):
-            other_keys = {a: coord[other_axes.index(a)] for a in other_axes}
-            # now go over all ranks in `axis`.
-            sub_list = []
-            for axis_key in range(self.get_dim(axis)):
-                key = self.ProcessCoord(**other_keys, **{axis: axis_key})
-                sub_list.append(self.mapping[key])
-            lists.append(sub_list)
-
-        return lists
+        rolled = np.moveaxis(self.grid, self._axis_index(axis), -1)
+        return rolled.reshape(-1, self.get_dim(axis)).tolist()
 
     def filter_match(self, **filter_kwargs):
-        """Return the list of ranks whose coordinates match the provided criteria."""
-
-        def _filter_helper(x):
-            for key, val in filter_kwargs.items():
-                if getattr(x, key) != val:
-                    return False
-            return True
-
-        coords = filter(_filter_helper, self.mapping.keys())
-        return [self.mapping[coord] for coord in coords]
+        """Ranks whose coordinates match every given axis=value constraint."""
+        sub = self.grid[self._index_for(filter_kwargs)]
+        return sorted(int(r) for r in np.asarray(sub).ravel())
 
     def get_axis_list(self, axis, idx):
-        """Returns the list of global ranks whose coordinate in an axis is idx."""
-        ranks = [self.mapping[k] for k in self.mapping.keys() if getattr(k, axis) == idx]
-        return sorted(ranks)
+        """Ranks whose coordinate along ``axis`` equals ``idx``."""
+        return self.filter_match(**{axis: idx})
 
     def world_size(self):
-        return len(self.mapping)
+        return int(self.grid.size)
 
     def __str__(self):
-        return str(self.mapping)
+        coords = ", ".join(f"{self.get_coord(r)}={r}" for r in range(self.world_size()))
+        return f"ProcessTopology({coords})"
 
 
-def _prime_factors(N):
-    """Returns the prime factorization of positive integer N."""
-    if N <= 0:
-        raise ValueError("Values must be greater than 0")
+class _Coord:
+    """Lightweight attribute bag for a topology coordinate."""
 
-    primes = []
-    while N != 1:
-        for candidate in range(2, N + 1):
-            if N % candidate == 0:
-                primes.append(candidate)
-                N //= candidate
-                break
-    return primes
+    __slots__ = ("_axes", "_values")
+
+    def __init__(self, axes, values):
+        object.__setattr__(self, "_axes", tuple(axes))
+        object.__setattr__(self, "_values", tuple(values))
+
+    def __getattr__(self, name):
+        try:
+            return self._values[self._axes.index(name)]
+        except ValueError:
+            raise AttributeError(name) from None
+
+    def _asdict(self):
+        return dict(zip(self._axes, self._values))
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __eq__(self, other):
+        try:
+            return tuple(self) == tuple(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self._values)
+
+    def __repr__(self):
+        inner = ", ".join(f"{a}={v}" for a, v in zip(self._axes, self._values))
+        return f"Coord({inner})"
 
 
 class PipeDataParallelTopology(ProcessTopology):
