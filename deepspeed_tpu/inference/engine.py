@@ -39,9 +39,21 @@ class InferenceEngine:
         self._config = config or DeepSpeedInferenceConfig()
         self.module = model
         self.dtype = self._config.jax_dtype
+        # Weight-only quantized serving (reference init_inference with
+        # dtype=torch.int8, or a quantized_initialization scheme): the
+        # params tree is stored in grouped-layout quantized carriers and
+        # each scanned block dequantizes its own layer slice at use.
+        self._weight_quant = None
         if self.dtype == jnp.int8:
-            # int8 engine dtype = weight-only quantized storage; compute in bf16
+            self._weight_quant = "int8"
             self.dtype = jnp.bfloat16
+        qinit = self._config.quant.weight.quantized_initialization
+        if qinit.get("scheme") in ("int8", "fp8", "fp6"):
+            self._weight_quant = qinit["scheme"]
+        # No module surgery needed: QuantizedWeight is a flax AxisMetadata
+        # box, so flax unboxes (= dequantizes) at each param ACCESS — for
+        # scanned layer stacks that is inside the scan body on the sliced
+        # carriers, keeping only O(1 layer) of dequantized weights live.
 
         tp = int(self._config.tensor_parallel.tp_size)
         self.mp_world_size = tp
@@ -75,8 +87,29 @@ class InferenceEngine:
         from deepspeed_tpu.inference.v2.sharding import param_sharding
         return param_sharding(self.mesh, self._tp_rule, path, np.shape(x))
 
+    def _place_tree(self, tree):
+        """TP-shard a (possibly quantized) tree over the mesh —
+        QuantizedWeight carriers take the original leaf's rule spec."""
+        from deepspeed_tpu.inference.v2.sharding import shard_params
+        return shard_params(tree, self.mesh, self._tp_rule, dtype=None)
+
     def _set_params(self, params):
-        """Cast to engine dtype and TP-shard over the mesh."""
+        """Cast to engine dtype and TP-shard over the mesh. Under weight
+        quantization, >=2-D float leaves become grouped-layout quantized
+        carriers first (the model's scanned blocks dequantize their own
+        slices at apply time). The caller's tree is left intact (no
+        donation — it may be shared); the no-fp32-spike path for LARGE
+        models is :meth:`_materialize`, which fuses init + quantization
+        in one program."""
+        if self._weight_quant:
+            from deepspeed_tpu.inference.quantization.quantization import \
+                quantize_params_tree
+            scheme, dtype = self._weight_quant, self.dtype
+            qtree = jax.jit(
+                lambda p: quantize_params_tree(p, scheme, dequant_dtype=dtype))(params)
+            self.params = self._place_tree(qtree)
+            return
+
         def place(path, x):
             x = jnp.asarray(x)
             if jnp.issubdtype(x.dtype, jnp.floating):
@@ -98,8 +131,23 @@ class InferenceEngine:
     def _materialize(self, input_ids):
         if self.params is not None:
             return
-        variables = self.module.init(self._rng, input_ids)
-        self._set_params(variables["params"])
+        if self._weight_quant:
+            # Fuse init + quantization into one program: the fp32 init
+            # tree exists only INSIDE XLA, which frees each leaf as its
+            # quantized carrier is formed — a 2.5B model materializes
+            # straight to int8 bytes without a 10GB fp32 spike.
+            from deepspeed_tpu.inference.quantization.quantization import \
+                quantize_params_tree
+            module, scheme, dtype = self.module, self._weight_quant, self.dtype
+
+            def init_q(rng):
+                p = module.init(rng, input_ids)["params"]
+                return quantize_params_tree(p, scheme, dequant_dtype=dtype)
+
+            self.params = self._place_tree(jax.jit(init_q)(self._rng))
+            return
+        variables = dict(self.module.init(self._rng, input_ids))
+        self._set_params(variables.pop("params"))
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, *args, **kwargs):
@@ -156,10 +204,13 @@ class InferenceEngine:
                 done = jnp.logical_or(done, nxt == eos_id)
                 return (cache, nxt, pos + 1, rng, done), nxt
 
-            (_, _, _, _, _), rest = jax.lax.scan(
+            (cache, _, _, _, _), rest = jax.lax.scan(
                 step, (cache, tok, jnp.asarray(S, jnp.int32), rng, done),
                 None, length=max_new_tokens - 1)
-            return jnp.concatenate([tok[:, None], rest.T], axis=1)
+            # the final cache is returned so the donated input buffer has
+            # a matching output to alias into (in-place KV updates; no
+            # "donated buffers were not usable" copy)
+            return jnp.concatenate([tok[:, None], rest.T], axis=1), cache
 
         return jax.jit(fn, donate_argnums=(2,))
 
@@ -192,8 +243,9 @@ class InferenceEngine:
             rng = jax.random.PRNGKey(seed)
         else:
             self._rng, rng = jax.random.split(self._rng)
-        new_tokens = self._jit_cache[key](self.params, input_ids, cache, rng,
-                                          jnp.asarray(eos_token_id, jnp.int32))
+        new_tokens, final_cache = self._jit_cache[key](
+            self.params, input_ids, cache, rng, jnp.asarray(eos_token_id, jnp.int32))
+        del final_cache  # aliased scratch; free immediately
         return jnp.concatenate([input_ids, new_tokens], axis=1)
 
     # ------------------------------------------------------------------

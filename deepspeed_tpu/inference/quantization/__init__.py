@@ -1,5 +1,10 @@
 from deepspeed_tpu.inference.quantization.quantization import (QuantizedWeight,
                                                                 _init_group_wise_weight_quantization,
+                                                                dequantize_tree,
+                                                                dequantize_tree_except,
+                                                                maybe_dequantize,
                                                                 quantized_bytes)
 
-__all__ = ["_init_group_wise_weight_quantization", "QuantizedWeight", "quantized_bytes"]
+__all__ = ["_init_group_wise_weight_quantization", "QuantizedWeight",
+           "dequantize_tree", "dequantize_tree_except", "maybe_dequantize",
+           "quantized_bytes"]

@@ -56,30 +56,31 @@ class InferenceEngineV2:
         else:
             self.mesh = None
 
+        # ZeRO-Inference weight-only quantization for the ragged path
+        # (reference inference/v2 + FP6-LLM serving, including its sharded
+        # TP2 headline): quantized bytes live in HBM, the jitted step
+        # dequantizes per leaf and XLA fuses the decode into each consuming
+        # matmul. Quantization happens BEFORE sharding in the grouped
+        # (structure-preserving) layout so each quantized carrier takes the
+        # leaf's own PartitionSpec.
+        qmode = getattr(self._config.quantization, "quantization_mode", "none")
+        self._quantized = bool(qmode and qmode != "none")
+        if self._quantized:
+            from deepspeed_tpu.inference.quantization import \
+                _init_group_wise_weight_quantization
+            params, _ = _init_group_wise_weight_quantization(
+                params, scheme=qmode, modules=[r"kernel|embed|experts_w"],
+                layout="grouped", dequant_dtype=dtype)
+
         if self.mesh is not None:
             from deepspeed_tpu.inference.v2.sharding import shard_params, tp_rule_for
             self.params = shard_params(params, self.mesh, tp_rule_for(cfg), dtype=dtype)
         else:
+            from deepspeed_tpu.inference.quantization import QuantizedWeight
             self.params = jax.tree.map(
-                lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params)
-
-        # ZeRO-Inference weight-only quantization for the ragged path
-        # (reference inference/v2 + FP6-LLM serving): quantized bytes live
-        # in HBM, the jitted step dequantizes per leaf and XLA fuses the
-        # decode into each consuming matmul.
-        self._dequant = None
-        qmode = getattr(self._config.quantization, "quantization_mode", "none")
-        if qmode and qmode != "none":
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "quantized weights + tensor/expert-parallel serving are not "
-                    "composable yet: quantization groups flatten each leaf, which "
-                    "breaks the per-dim shardings")
-            from deepspeed_tpu.inference.quantization import \
-                _init_group_wise_weight_quantization
-            self.params, self._dequant = _init_group_wise_weight_quantization(
-                self.params, scheme=qmode, modules=[r"kernel|embed|experts_w"])
+                lambda x: x if isinstance(x, QuantizedWeight)
+                else x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
 
         self.max_tokens = int(sm.max_ragged_batch_size)
         self.max_seqs = int(sm.max_ragged_sequence_count)
@@ -103,11 +104,17 @@ class InferenceEngineV2:
                                          self.max_blocks_per_seq)
         mesh = self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
-        dequant = self._dequant
+        quantized = self._quantized
 
         def step(p, kc, vc, b):
-            if dequant is not None:
-                p = dequant(p, dtype)  # fused into the consumers by XLA
+            if quantized:
+                # embed/head/norm leaves dequantize here; the scanned
+                # 'layers' stack stays quantized — each scan step
+                # dequantizes only its own slice (model_runner) so peak
+                # HBM holds the quantized stack + O(1 layer) transient.
+                from deepspeed_tpu.inference.quantization import \
+                    dequantize_tree_except
+                p = dequantize_tree_except(p, dtype)
             return ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
                                   attn_impl=attn_impl)
 

@@ -110,6 +110,11 @@ def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None, mesh=None, impl=None):
 
 def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
     lp, kc, vc = xs
+    # Weight-only quantized serving: the scan sliced this layer's
+    # quantized carriers; dequantize just the slice (transient, freed
+    # after the layer's matmuls). No-op for full-precision params.
+    from deepspeed_tpu.inference.quantization import dequantize_tree
+    lp = dequantize_tree(lp, h.dtype)
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     attn = lp["self_attn"]
@@ -208,6 +213,8 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):
     parallel wiring, optional partial rotary / ALiBi, biased
     projections, LayerNorm or RMSNorm)."""
     lp, kc, vc = xs
+    from deepspeed_tpu.inference.quantization import dequantize_tree
+    lp = dequantize_tree(lp, h.dtype)  # per-slice dequant (no-op if dense)
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     attn = lp["attn"]

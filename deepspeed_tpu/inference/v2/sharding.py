@@ -62,17 +62,37 @@ def shard_params(params, mesh, rule, dtype=None):
     """Cast (optionally) and place a param tree over ``mesh`` per the
     family ``rule``. Used by both the v1 engine and the v2 ragged
     engine — one implementation of the reference's per-rank weight
-    slicing."""
+    slicing.
+
+    ``QuantizedWeight`` leaves (layout='grouped') are placed by applying
+    the rule for the ORIGINAL leaf shape to both carriers: ``values``
+    keeps the leaf's dim structure (fp6 packs the last dim 4→3 bytes,
+    which shards positionally), ``scales`` takes the same spec with the
+    group-count dim in place of the last dim; any non-divisible dim
+    falls back to replicated via :func:`live_entries`."""
     import jax.numpy as jnp
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
     from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
 
     def place(path, x):
+        if isinstance(x, QuantizedWeight):
+            if x.layout != "grouped":
+                raise ValueError(
+                    f"cannot shard flat-layout quantized leaf {path}; quantize "
+                    "with layout='grouped' (structure-preserving) to compose "
+                    "with tensor/expert parallelism")
+            entries = live_entries(mesh, rule(path, x.shape), x.shape)
+            v = jax.device_put(x.values, NamedSharding(
+                mesh, P(*live_entries(mesh, P(*entries), x.values.shape))))
+            s = jax.device_put(x.scales, NamedSharding(
+                mesh, P(*live_entries(mesh, P(*entries), x.scales.shape))))
+            return QuantizedWeight(v, s, x.shape, x.scheme, x.layout, x.dequant_dtype)
         x = jnp.asarray(x)
         if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(dtype)
         return jax.device_put(x, param_sharding(mesh, rule, path, x.shape))
 
-    return path_tree_map(place, params)
+    return path_tree_map(place, params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
 
 
 def kv_pool_spec(mesh, n_kv_heads) -> P:

@@ -209,7 +209,7 @@ class ZeroShardingPolicy:
         return path_tree_map(lambda path, x: self.grad_spec(path, np.shape(x)), params)
 
 
-def path_tree_map(fn, tree):
+def path_tree_map(fn, tree, is_leaf=None):
     """tree_map passing a '/'-joined string path as first argument."""
 
     def keystr(kp):
@@ -225,7 +225,8 @@ def path_tree_map(fn, tree):
                 parts.append(str(k))
         return "/".join(parts)
 
-    return jax.tree_util.tree_map_with_path(lambda kp, x: fn(keystr(kp), x), tree)
+    return jax.tree_util.tree_map_with_path(lambda kp, x: fn(keystr(kp), x), tree,
+                                            is_leaf=is_leaf)
 
 
 def batch_spec(mesh: Mesh, extra_leading=0, shard_sequence=False):

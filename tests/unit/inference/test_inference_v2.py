@@ -270,13 +270,52 @@ class TestZeroInferenceQuantizedServing:
         got2 = quant.put([1], [[int(np.argmax(got[0]))]])  # decode step
         assert np.all(np.isfinite(got2))
 
-    def test_quantized_plus_tp_rejected(self):
+    @pytest.mark.parametrize("scheme", ["int8", "fp8", "fp6"])
+    def test_quantized_tp_matches_unsharded_quantized(self, scheme):
+        """Quantized weights composed with TP serving (the reference's
+        FP6-LLM TP2 headline): grouped-layout quantization preserves the
+        leaf dim structure, so the same quantization math runs sharded
+        and the logits match the single-device quantized engine."""
         model = build_llama("debug", remat=False)
         params = model.init(jax.random.PRNGKey(4), jnp.zeros((1, 8), jnp.int32))["params"]
-        cfg = RaggedInferenceEngineConfig(
-            kv_block_size=8, tensor_parallel_degree=2,
-            state_manager=CFG.state_manager,
-            quantization={"quantization_mode": "int8"})
-        with pytest.raises(NotImplementedError, match="not.*composable"):
-            InferenceEngineV2(model=model, config=cfg, params=params,
-                              dtype=jnp.float32)
+        ids = (np.arange(10, dtype=np.int32) * 3) % 250
+        qdict = {"quantization_mode": scheme}
+        ref = InferenceEngineV2(
+            model=model, params=params, dtype=jnp.float32,
+            config=RaggedInferenceEngineConfig(
+                kv_block_size=8, state_manager=CFG.state_manager, quantization=qdict))
+        want = ref.put([1], [ids])
+        eng = InferenceEngineV2(
+            model=model, params=params, dtype=jnp.float32,
+            config=RaggedInferenceEngineConfig(
+                kv_block_size=8, state_manager=CFG.state_manager,
+                tensor_parallel_degree=2, quantization=qdict))
+        got = eng.put([1], [ids])
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+        # the quantized carriers really are sharded over 'tensor'
+        qk = eng.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
+        assert qk.values.addressable_shards[0].data.shape[-1] == qk.values.shape[-1] // 2
+
+    def test_quantized_tp_ep_moe_serving(self):
+        """int8 weights + tensor=2 x expert=2 MoE serving: expert dim and
+        feature dims shard while the grouped quantization stays exact
+        per-leaf."""
+        model = build_llama("mixtral-debug", remat=False, moe_capacity_factor=64.0)
+        params = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))["params"]
+        ids = (np.arange(10, dtype=np.int32) * 13) % 250
+        qdict = {"quantization_mode": "int8"}
+        ref = InferenceEngineV2(
+            model=model, params=params, dtype=jnp.float32,
+            config=RaggedInferenceEngineConfig(
+                kv_block_size=8, state_manager=CFG.state_manager, quantization=qdict))
+        want = ref.put([1], [ids])
+        eng = InferenceEngineV2(
+            model=model, params=params, dtype=jnp.float32,
+            config=RaggedInferenceEngineConfig(
+                kv_block_size=8, state_manager=CFG.state_manager,
+                tensor_parallel_degree=2, expert_parallel_degree=2,
+                quantization=qdict))
+        got = eng.put([1], [ids])
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+        w1 = eng.params["model"]["layers"]["moe_mlp"]["deepspeed_moe"]["experts_w1"]
+        assert w1.values.addressable_shards[0].data.shape[1] == w1.values.shape[1] // 2
