@@ -217,6 +217,7 @@ class DeepSpeedEngine:
         self._jit_cache = {}
         self._grads_acc = None
         self._host_offload = None  # set by _materialize_state when offloading
+        self._param_swapper = None  # set when offload_param.device == nvme
         self._trainable_mask = None  # set by _materialize_state (frozen_parameters)
         self._pending = None  # (loss, grads) from the last forward
         self.global_grad_norm = 0.0
@@ -422,11 +423,15 @@ class DeepSpeedEngine:
         if self.zero_stage < 3:
             raise ValueError(
                 f"zero_optimization.offload_param requires stage 3 (got stage {self.zero_stage})")
-        if device != "cpu":
-            raise NotImplementedError(
-                "offload_param.device=nvme is not supported on TPU — the pinned_host "
-                "memory space is host RAM; use offload_optimizer.device=nvme for "
-                "NVMe-resident optimizer state")
+        self._param_nvme_path = None
+        if device == "nvme":
+            # Full ZeRO-Infinity: the scanned-layer leaves live in NVMe
+            # files between steps (swap_tensor/param_swapper.py) and are
+            # restored into pinned_host ahead of each dispatch, where the
+            # per-layer scan streaming takes over. Reference:
+            # swap_tensor/partitioned_param_swapper.py:36.
+            self._param_nvme_path = self._config.zero_config.offload_param.nvme_path
+            assert self._param_nvme_path, "offload_param.device=nvme requires nvme_path"
         if self._quantized_comm_enabled() or self._onebit_enabled():
             raise NotImplementedError(
                 "offload_param cannot combine with quantized/1-bit communication: the "
@@ -442,6 +447,53 @@ class DeepSpeedEngine:
         if not cfg.offload_params:
             import dataclasses as _dc
             self.module = self.module.clone(config=_dc.replace(cfg, offload_params=True))
+
+    def destroy(self):
+        """Release engine resources (reference engine.destroy): jit
+        caches, accumulated grads, and the NVMe param swap files."""
+        self._jit_cache.clear()
+        self._grads_acc = None
+        self._pending = None
+        if self._param_swapper is not None:
+            self._param_swapper.close()
+            self._param_swapper = None
+
+    def _nvme_offload_params(self):
+        """End-of-step half of NVMe param offload: write the streamed
+        subtree's leaves to their swap files (async) and replace them
+        with handles — between steps no array storage backs them."""
+        if self._param_swapper is None:
+            return
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import NVMeParamHandle
+        prefix = self.module.param_stream_prefix
+        swapper = self._param_swapper
+
+        def off(path, leaf):
+            if path.startswith(prefix) and not isinstance(leaf, NVMeParamHandle):
+                return swapper.offload(path, leaf)
+            return leaf
+
+        self.params = path_tree_map(off, self.params)
+
+    def _ensure_params_resident(self):
+        """Pre-dispatch half of NVMe param offload: stream swapped leaves
+        NVMe→host→pinned_host (concurrent preads) so the jitted step's
+        per-layer scan streaming finds them where the cpu-offload path
+        keeps them."""
+        if self._param_swapper is None:
+            return
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import NVMeParamHandle
+        flat_params, treedef = jax.tree_util.tree_flatten_with_path(
+            self.params, is_leaf=lambda x: isinstance(x, NVMeParamHandle))
+        flat_shard = jax.tree.leaves(self._param_shardings)
+        handles = [(leaf, flat_shard[i]) for i, (kp, leaf) in enumerate(flat_params)
+                   if isinstance(leaf, NVMeParamHandle)]
+        if not handles:
+            return
+        restored = self._param_swapper.restore(handles)
+        new_leaves = [restored.get(leaf.path, leaf) if isinstance(leaf, NVMeParamHandle)
+                      else leaf for kp, leaf in flat_params]
+        self.params = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def _enforce_param_memory_kinds(self):
         """Param-offload contract: offloaded leaves live in pinned_host
@@ -484,6 +536,11 @@ class DeepSpeedEngine:
                 lambda path, s: s.with_memory_kind("pinned_host")
                 if path.startswith(prefix) else s, self._param_shardings)
             self.params = jax.tree.map(jax.device_put, self.params, self._param_shardings)
+            if self._param_nvme_path:
+                from deepspeed_tpu.runtime.swap_tensor.param_swapper import AsyncParamSwapper
+                self._param_swapper = AsyncParamSwapper(
+                    self._param_nvme_path,
+                    aio_threads=int(self._config.zero_config.offload_param.buffer_count or 4))
 
         offload_device = self._config.zero_config.offload_optimizer_device().value
         if offload_device != "none" and self._config._param_dict.get("frozen_parameters"):
@@ -866,6 +923,7 @@ class DeepSpeedEngine:
         """Compute loss (and, when training, gradients in the same fused
         dispatch). Returns the unscaled loss."""
         self._materialize_state(*args, **kwargs)
+        self._ensure_params_resident()
         args = self._shard_batch(args)
         kwargs = self._shard_batch(kwargs)
         if self._is_training:
@@ -1056,6 +1114,7 @@ class DeepSpeedEngine:
             grads32, gnorm, overflow = self._offload_prep_fn()(self._grads_acc, self.scaler_state)
             self._offload_apply(grads32, gnorm, overflow)
         else:
+            self._ensure_params_resident()
             lr = jnp.asarray(self.get_lr()[0], jnp.float32)
             fn, tied = self._apply_update_fn()
             if tied:
@@ -1068,6 +1127,7 @@ class DeepSpeedEngine:
             self._enforce_param_memory_kinds()
             self.overflow = bool(overflow) if self.fp16_enabled() else False
             self.global_grad_norm = float(gnorm)
+        self._nvme_offload_params()
         self._grads_acc = None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
@@ -1203,6 +1263,7 @@ class DeepSpeedEngine:
                      jax.tree.map(trunc, batch[1]))
         self._materialize_state(*jax.tree.map(lambda x: x[0], batch[0]),
                                 **jax.tree.map(lambda x: x[0], batch[1]))
+        self._ensure_params_resident()
         batch = self._shard_batch(batch, extra_leading=1)
         self._maybe_flops_profile(jax.tree.map(lambda x: x[0], batch[0]),
                                   jax.tree.map(lambda x: x[0], batch[1]))
@@ -1241,6 +1302,7 @@ class DeepSpeedEngine:
                 out = fn(self.params, self.master_params, self.opt_state, self.scaler_state, lr, sub, batch)
                 self.params, self.master_params, self.opt_state, self.scaler_state, mean_loss, gnorm, overflow = out
             self._enforce_param_memory_kinds()
+        self._nvme_offload_params()
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
@@ -1331,6 +1393,7 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True, exclude_frozen_parameters=False):
         assert self._initialized, "cannot save before the first forward/train_batch"
+        self._ensure_params_resident()  # NVMe-swapped leaves back for serialization
         if tag is None:
             tag = f"global_step{self.global_steps}"
         tag = str(tag)

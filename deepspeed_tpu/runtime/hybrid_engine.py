@@ -79,6 +79,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """Rollout generation on the CURRENT training weights (the
         reference's inference-container forward, hybrid_engine.py:109)."""
         assert self._initialized, "run a forward/train_batch before generate()"
+        self._ensure_params_resident()
         input_ids = jnp.asarray(input_ids, jnp.int32)
         fn = self._decode_fn(input_ids.shape[1], int(max_new_tokens),
                              bool(do_sample), float(temperature))
@@ -96,6 +97,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         The live training leaves serve directly (same scan-stacked tree).
         → list of generated-token lists, one per prompt."""
         assert self._initialized, "run a forward/train_batch before generate_ragged()"
+        self._ensure_params_resident()
         # rebuild when a later call asks for a larger budget or a fresh
         # engine_config (the cached engine is sized at build time); a custom
         # config sticks for later rebuilds instead of silently reverting
@@ -114,6 +116,14 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                     max_ragged_batch_size=max(token_budget, 64),
                     max_ragged_sequence_count=64, max_tracked_sequences=64,
                     max_context=int(self.module.config.max_position_embeddings)))
+            if int(cfg.state_manager.max_ragged_batch_size) < token_budget:
+                # a sticky custom config smaller than the requested budget
+                # would rebuild every call and then overflow the scheduler;
+                # grow it once to honor the larger budget
+                sm = cfg.state_manager.model_copy(
+                    update={"max_ragged_batch_size": int(token_budget)})
+                cfg = cfg.model_copy(update={"state_manager": sm})
+                self._ragged_config = cfg
             # dtype == the training compute dtype, so the constructor's
             # astype over the live leaves is a no-op (no second param copy)
             self._ragged_engine = InferenceEngineV2(

@@ -1,0 +1,120 @@
+"""NVMe-resident model parameters (ZeRO-Infinity ``offload_param.device=nvme``).
+
+Capability match for the reference's ``AsyncPartitionedParameterSwapper``
+(``deepspeed/runtime/swap_tensor/partitioned_param_swapper.py:36``): model
+parameters live in NVMe files between steps and stream through host
+buffers to the accelerator for each step. TPU-native flow (composing with
+``runtime/zero/param_stream.py``):
+
+    NVMe file --aio pread--> host buffer --device_put--> pinned_host
+        --(scan body, per layer)--> HBM compute layout
+
+Between steps the offloaded leaves are *handles* (no array storage at
+all); ``restore`` materializes them in the device's ``pinned_host``
+memory space where the scanned blocks' per-layer streaming picks them
+up, and ``offload`` writes updated leaves back to NVMe asynchronously
+(the io_uring/thread-pool AIO engine in ``csrc/aio/ds_aio.cpp``) and
+drops the arrays. A restore issues every leaf's pread at once so the AIO
+engine (io_uring queue or thread pool) runs them concurrently, then
+uploads leaf by leaf.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class NVMeParamHandle:
+    """Placeholder leaf for a parameter whose bytes live on NVMe."""
+
+    __slots__ = ("path", "shape", "dtype", "nbytes")
+
+    def __init__(self, path, shape, dtype, nbytes):
+        self.path = path        # '/'-joined tree path (stable file key)
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.nbytes = int(nbytes)
+
+    def __repr__(self):
+        return f"NVMeParamHandle({self.path}, {self.shape}, {self.dtype})"
+
+
+class AsyncParamSwapper:
+    """Swap a params pytree's offloaded leaves to/from NVMe files.
+
+    One file per leaf (leaf counts are O(10) for scan-stacked models —
+    the stacked layer tensors are the big ones, and each is a single
+    contiguous read/write, which is exactly what NVMe sequential
+    bandwidth wants)."""
+
+    def __init__(self, nvme_path, aio_threads=4):
+        self.dir = os.path.join(nvme_path, "zero_stage_param_swap")
+        os.makedirs(self.dir, exist_ok=True)
+        from op_builder.tpu import AsyncIOBuilder
+        self.aio = AsyncIOBuilder().load().aio_handle(num_threads=max(2, int(aio_threads)))
+        self._buffers = {}        # tree path -> persistent host staging buffer
+        self._writes_pending = False
+
+    def _file(self, path):
+        return os.path.join(self.dir, path.replace("/", "__") + ".swp")
+
+    def _buffer(self, path, nbytes):
+        buf = self._buffers.get(path)
+        if buf is None or buf.nbytes < nbytes:
+            buf = np.empty(nbytes, np.uint8)
+            self._buffers[path] = buf
+        return buf[:nbytes]
+
+    # ------------------------------------------------------------------
+    def offload(self, path, leaf):
+        """Write one resident leaf to its NVMe file (async) and return
+        its handle. The caller drops the array reference; the bytes stay
+        valid in the persistent staging buffer until the next wait."""
+        host = np.ascontiguousarray(jax.device_get(leaf))
+        raw = host.view(np.uint8).reshape(-1)
+        buf = self._buffer(path, raw.nbytes)
+        np.copyto(buf, raw)
+        self.aio.async_pwrite(buf, self._file(path), offset=0)
+        self._writes_pending = True
+        return NVMeParamHandle(path, host.shape, host.dtype, raw.nbytes)
+
+    def restore(self, handles_with_shardings):
+        """[(handle, sharding)] → {tree path: jax array} placed at each
+        sharding. Every pread is issued up front so the AIO engine runs
+        them concurrently; uploads follow once the batch completes."""
+        self.flush()
+        staged = []
+        for handle, sharding in handles_with_shardings:
+            buf = self._buffer(handle.path, handle.nbytes)
+            self.aio.async_pread(buf, self._file(handle.path), offset=0)
+            staged.append((handle, sharding, buf))
+        self.aio.wait()
+        out = {}
+        for handle, sharding, buf in staged:
+            host = buf.view(handle.dtype).reshape(handle.shape)
+            out[handle.path] = jax.device_put(host, sharding)
+        return out
+
+    def flush(self):
+        if self._writes_pending:
+            self.aio.wait()
+            self._writes_pending = False
+
+    def bytes_on_nvme(self):
+        total = 0
+        for name in os.listdir(self.dir):
+            total += os.path.getsize(os.path.join(self.dir, name))
+        return total
+
+    def close(self):
+        self.flush()
+        for name in list(os.listdir(self.dir)):
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
+        logger.info(f"[param_swapper] cleared {self.dir}")

@@ -116,12 +116,68 @@ class TestParamOffload:
         with pytest.raises(ValueError, match="requires stage 3"):
             engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
 
-    def test_nvme_param_offload_raises(self):
+    def test_nvme_param_offload_trains_and_matches_cpu_offload(self, tmp_path):
+        """Full ZeRO-Infinity param path: between steps the scanned-layer
+        leaves are NVMe-file handles (no array storage), restored through
+        pinned_host ahead of each dispatch; loss trajectory identical to
+        the pinned_host-resident run (reference
+        partitioned_param_swapper.py:36)."""
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import NVMeParamHandle
+        ids = _ids()
+
+        def run(extra):
+            from deepspeed_tpu.parallel import groups
+            groups.destroy_mesh()
+            engine, _, _, _ = deepspeed_tpu.initialize(model=build_llama("debug"),
+                                                       config=_cfg(**extra))
+            losses = [float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+                      for _ in range(3)]
+            return engine, losses
+
+        _, cpu_losses = run({"offload_param": {"device": "cpu"}})
+        engine, nvme_losses = run({"offload_param": {"device": "nvme",
+                                                     "nvme_path": str(tmp_path)}})
+        np.testing.assert_allclose(cpu_losses, nvme_losses, rtol=1e-6)
+        # between steps the streamed subtree really is swapped out
+        k = engine.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
+        assert isinstance(k, NVMeParamHandle)
+        assert engine._param_swapper.bytes_on_nvme() > 0
+        # embeddings stay device-resident
+        assert engine.params["model"]["embed_tokens"].sharding.memory_kind == "device"
+        # a later step restores and re-offloads transparently
+        l4 = float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+        assert np.isfinite(l4) and l4 < nvme_losses[0]
+        assert isinstance(engine.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"],
+                          NVMeParamHandle)
+
+    def test_nvme_param_offload_checkpoint_and_generate(self, tmp_path):
+        """save_checkpoint and hybrid generate restore swapped leaves on
+        demand; separate fwd/bwd/step path keeps the swap cycle."""
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import NVMeParamHandle
+        cfg = _cfg(offload_param={"device": "nvme", "nvme_path": str(tmp_path / "swap")})
+        cfg["hybrid_engine"] = {"enabled": True}
+        cfg["train_micro_batch_size_per_gpu"] = 16
+        cfg["gradient_accumulation_steps"] = 1
+        engine, _, _, _ = deepspeed_tpu.initialize(model=build_llama("debug"), config=cfg)
+        ids = _ids()
+        loss = engine(jnp.asarray(ids), jnp.asarray(ids))
+        engine.backward(loss)
+        engine.step()
+        assert isinstance(engine.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"],
+                          NVMeParamHandle)
+        out = engine.generate(ids[:, :8], max_new_tokens=4)
+        assert out.shape == (16, 12)
+        engine.save_checkpoint(str(tmp_path / "ckpt"), tag="t0")
+        # another full step after checkpoint/generate restores cleanly
+        loss2 = float(engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids))))
+        assert np.isfinite(loss2)
+
+    def test_nvme_param_requires_path(self):
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=build_llama("debug"),
-            config=_cfg(offload_param={"device": "nvme", "nvme_path": "/tmp/x"}))
+            config=_cfg(offload_param={"device": "nvme"}))
         ids = _ids()
-        with pytest.raises(NotImplementedError, match="nvme"):
+        with pytest.raises(AssertionError, match="nvme_path"):
             engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
 
     def test_pipeline_engine_rejects_param_offload(self):
