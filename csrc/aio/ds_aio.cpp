@@ -326,7 +326,28 @@ private:
                 int r = sys_io_uring_enter(ring_fd_, submitted, 0, 0);
                 if (r < 0) {
                     if (errno == EINTR) continue;
-                    break;  // ring is broken; completions will error out
+                    if (errno == EAGAIN || errno == EBUSY) {
+                        // transient kernel backpressure: reap completions to
+                        // free async context, then retry the submit
+                        peek_cq();
+                        continue;
+                    }
+                    // Ring is broken: the last `submitted` SQEs were never
+                    // accepted by the kernel, so no CQE will ever arrive
+                    // for them. Unwind them (fail their ops, release their
+                    // chunks, rewind the SQ tail) or the GETEVENTS wait
+                    // below blocks forever on phantom inflight counts.
+                    unsigned tail = sq_tail_->load(std::memory_order_relaxed);
+                    for (unsigned k = 0; k < submitted; ++k) {
+                        unsigned idx = (tail - 1 - k) & sq_mask_;
+                        unsigned ci = (unsigned)sqes_[idx].user_data;
+                        Chunk& c = chunks_[ci];
+                        c.op->failed = true;
+                        --c.op->inflight;
+                        free_chunks_.push_back(ci);
+                    }
+                    sq_tail_->store(tail - submitted, std::memory_order_release);
+                    break;
                 }
                 submitted -= (unsigned)r;
             }

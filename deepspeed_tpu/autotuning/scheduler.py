@@ -108,19 +108,21 @@ class ResourceManager:
         if os.path.exists(stale):
             os.remove(stale)
         env = {**os.environ, **self.env}
-        out = open(os.path.join(exp["exp_dir"], "stdout.log"), "w")
-        err = open(os.path.join(exp["exp_dir"], "stderr.log"), "w")
-        if host in _LOCAL_HOSTS:
-            proc = subprocess.Popen(self._worker_cmd(exp), env=env,
-                                    stdout=out, stderr=err)
-        else:
-            exports = " ".join(f"export {k}={shlex.quote(v)};"
-                               for k, v in self.env.items())
-            remote = (f"{exports} cd {shlex.quote(os.path.abspath('.'))}; "
-                      f"{shlex.join(self._worker_cmd(exp))}")
-            ssh = ["ssh"] + (["-p", str(self.ssh_port)] if self.ssh_port else [])
-            proc = subprocess.Popen(ssh + [host, remote], env=env,
-                                    stdout=out, stderr=err)
+        # the child holds dups of the log fds; close the parent's copies
+        # right after Popen or a large grid leaks two fds per experiment
+        with open(os.path.join(exp["exp_dir"], "stdout.log"), "w") as out, \
+                open(os.path.join(exp["exp_dir"], "stderr.log"), "w") as err:
+            if host in _LOCAL_HOSTS:
+                proc = subprocess.Popen(self._worker_cmd(exp), env=env,
+                                        stdout=out, stderr=err)
+            else:
+                exports = " ".join(f"export {k}={shlex.quote(v)};"
+                                   for k, v in self.env.items())
+                remote = (f"{exports} cd {shlex.quote(os.path.abspath('.'))}; "
+                          f"{shlex.join(self._worker_cmd(exp))}")
+                ssh = ["ssh"] + (["-p", str(self.ssh_port)] if self.ssh_port else [])
+                proc = subprocess.Popen(ssh + [host, remote], env=env,
+                                        stdout=out, stderr=err)
         logger.info(f"autotune: launched {exp['name']} on {reservation.desc()} "
                     f"(pid {proc.pid})")
         self.running_experiments[exp["name"]] = (exp, proc, reservation,

@@ -50,9 +50,10 @@ def get_sparse_attention_config(ds_config, num_heads):
     elif "mode" in ds_config:
         section = dict(ds_config)  # unambiguously the section itself; a bad
         # knob raises from the constructor rather than silently disabling
-    elif ds_config and set(ds_config) <= _SECTION_KEYS:
-        section = dict(ds_config)  # mode-less section: fixed-mode defaults
     else:
+        # A bare dict without the 'sparse_attention' wrapper or a 'mode'
+        # key is ambiguous ({'seed': 1} is NOT a sparsity request) — only
+        # the explicit forms enable sparse attention.
         return None
     mode = section.pop("mode", "fixed")
     if mode not in MODES:
