@@ -79,6 +79,10 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # False = dropless routing (grouped GEMM; Mixtral-style training)
+    moe_drop_tokens: bool = True
+    # "" | "Jitter" (multiplicative input noise) | "RSample" (logit noise)
+    moe_noisy_gate_policy: str = ""
 
     @property
     def head_dim(self):
@@ -351,6 +355,8 @@ class LlamaBlock(nn.Module):
                                      num_experts=cfg.moe_num_experts,
                                      k=cfg.moe_top_k,
                                      capacity_factor=cfg.moe_capacity_factor,
+                                     drop_tokens=cfg.moe_drop_tokens,
+                                     noisy_gate_policy=cfg.moe_noisy_gate_policy,
                                      name="moe_mlp")(mlp_in)
             h = h + mlp_out
             aux_loss = aux_loss + layer_aux

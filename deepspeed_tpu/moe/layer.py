@@ -32,6 +32,12 @@ class MoE(nn.Module):
     min_capacity: int = 4
     use_residual: bool = False
     noisy_gate_policy: str = ""
+    # reference drop_tokens (layer.py MoE arg): False = dropless routing
+    # via the grouped GEMM — every token reaches its full top-k
+    drop_tokens: bool = True
+    # accepted for reference-config parity; capacity tie-breaking here is
+    # deterministic by token order (the reference's use_rts randomizes it)
+    use_rts: bool = True
 
     @nn.compact
     def __call__(self, hidden_states, train: bool = True):
@@ -44,6 +50,7 @@ class MoE(nn.Module):
                                  eval_capacity_factor=self.eval_capacity_factor,
                                  min_capacity=self.min_capacity,
                                  noisy_gate_policy=self.noisy_gate_policy or None,
+                                 drop_tokens=self.drop_tokens,
                                  name="deepspeed_moe")(hidden_states, train=train)
         if self.use_residual:
             # residual MoE (DeepSpeed-MoE): dense MLP branch + learned mixer

@@ -32,6 +32,26 @@ def _capacity(num_tokens: int, num_experts: int, k: int, capacity_factor: float,
     return max(cap, min_capacity)
 
 
+def multiplicative_jitter(x, rng, epsilon: float = 1e-2):
+    """Multiply by iid uniform noise in [1-eps, 1+eps] (reference
+    ``multiplicative_jitter``, sharded_moe.py:55 — applied to the gate's
+    input under ``noisy_gate_policy='Jitter'``)."""
+    if epsilon == 0.0:
+        return x
+    noise = jax.random.uniform(rng, x.shape, jnp.float32,
+                               minval=1.0 - epsilon, maxval=1.0 + epsilon)
+    return x * noise.astype(x.dtype)
+
+
+def gshard_aux_loss(gates, primary_mask):
+    """GShard load-balancing loss from the primary assignment:
+    sum(mean_prob * mean_routed_fraction) * E (reference sharded_moe
+    l_aux) — shared by the capacity and dropless gates."""
+    me = gates.mean(axis=0)
+    ce = primary_mask.astype(jnp.float32).mean(axis=0)
+    return jnp.sum(me * ce) * gates.shape[-1]
+
+
 def topkgating(logits, k: int, capacity_factor: float = 1.0,
                min_capacity: int = MIN_CAPACITY, normalize: bool = True):
     """Compute gating for top-k routing.
@@ -54,10 +74,7 @@ def topkgating(logits, k: int, capacity_factor: float = 1.0,
     for j in range(k):
         mask_j = jax.nn.one_hot(topk_idx[:, j], E, dtype=jnp.int32)  # [T, E]
         if j == 0:
-            # load-balancing loss from the primary assignment (GShard eq.)
-            me = gates.mean(axis=0)                     # mean gate prob per expert
-            ce = mask_j.astype(jnp.float32).mean(axis=0)  # fraction routed per expert
-            aux_loss = jnp.sum(me * ce) * E
+            aux_loss = gshard_aux_loss(gates, mask_j)
         # position of each token within its expert's capacity buffer
         loc_j = jnp.cumsum(mask_j, axis=0) - 1 + offset[None, :]  # [T, E]
         offset = offset + mask_j.sum(axis=0)
@@ -93,13 +110,21 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=MIN_CAPACITY):
 
 
 class TopKGate(nn.Module):
-    """Linear gate + top-k routing (reference ``TopKGate``, sharded_moe.py:372)."""
+    """Linear gate + top-k routing (reference ``TopKGate``, sharded_moe.py:372).
+
+    ``drop_tokens=True`` (default) → capacity-truncated einsum routing:
+    returns ``(aux_loss, combine [T, E, C], dispatch [T, E, C])``.
+    ``drop_tokens=False`` → dropless routing (reference
+    sharded_moe.py:186,212 no-drop gather; Mixtral-style training):
+    returns ``(aux_loss, topk_weights [T, k], topk_idx [T, k])`` for the
+    grouped-GEMM dispatch, where every token reaches its full top-k."""
     num_experts: int
     k: int = 1
     capacity_factor: float = 1.0
     eval_capacity_factor: float = 1.0
     min_capacity: int = MIN_CAPACITY
     noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -107,13 +132,26 @@ class TopKGate(nn.Module):
         # x may be [..., D]: the Dense runs on the un-reshaped activation
         # (reshaping the big multi-axis-sharded operand forces an XLA
         # reshard); only the small [T, E] logits are flattened.
+        x32 = x.astype(jnp.float32)
+        if self.noisy_gate_policy == "Jitter" and train:
+            rng = self.make_rng("dropout") if self.has_rng("dropout") else None
+            if rng is not None:
+                x32 = multiplicative_jitter(x32, rng)
         logits = nn.Dense(self.num_experts, use_bias=False, name="wg",
-                          dtype=jnp.float32)(x.astype(jnp.float32))
+                          dtype=jnp.float32)(x32)
         logits = logits.reshape(-1, self.num_experts)
         if self.noisy_gate_policy == "RSample" and train:
             rng = self.make_rng("dropout") if self.has_rng("dropout") else None
             if rng is not None:
                 logits = logits + jax.random.normal(rng, logits.shape) / self.num_experts
+        if not self.drop_tokens:
+            gates = jax.nn.softmax(logits, axis=-1)  # [T, E]
+            topk_vals, topk_idx = jax.lax.top_k(gates, self.k)
+            mask1 = jax.nn.one_hot(topk_idx[:, 0], self.num_experts, dtype=jnp.float32)
+            aux_loss = gshard_aux_loss(gates, mask1)
+            if self.k > 1:
+                topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
+            return aux_loss, topk_vals, topk_idx
         cf = self.capacity_factor if train else self.eval_capacity_factor
         return topkgating(logits, self.k, cf, self.min_capacity)
 
@@ -133,6 +171,7 @@ class MOELayer(nn.Module):
     eval_capacity_factor: float = 1.0
     min_capacity: int = MIN_CAPACITY
     noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -144,7 +183,41 @@ class MOELayer(nn.Module):
                                                eval_capacity_factor=self.eval_capacity_factor,
                                                min_capacity=self.min_capacity,
                                                noisy_gate_policy=self.noisy_gate_policy,
+                                               drop_tokens=self.drop_tokens,
                                                name="gate")(x, train=train)
+
+        if not self.drop_tokens:
+            # Dropless dispatch (reference drop_tokens=False no-drop
+            # gather): the serving grouped GEMM (lax.ragged_dot over
+            # expert-sorted rows) IS the training dispatch — every token
+            # reaches its full top-k and ragged_dot differentiates.
+            # Expert-axis (ep>1) training uses the capacity path; the
+            # einsum dispatch is what GSPMD turns into the a2a pair.
+            from deepspeed_tpu.ops.grouped_gemm import moe_grouped_mlp
+            from deepspeed_tpu.parallel import groups
+            mesh = groups.get_mesh(required=False)
+            if mesh is not None and dict(zip(mesh.axis_names,
+                                             mesh.devices.shape)).get("expert", 1) > 1:
+                raise NotImplementedError(
+                    "drop_tokens=False with an expert-parallel mesh axis is not "
+                    "supported in training yet — dropless needs data-dependent "
+                    "per-expert counts that the static a2a dispatch cannot carry; "
+                    "use drop_tokens=True (capacity routing) under expert "
+                    "parallelism, or ep=1 for dropless")
+            topk_w, topk_idx = combine, dispatch  # [T, k] each (gate's dropless form)
+            init = nn.initializers.lecun_normal()
+            E, I = self.num_experts, self.intermediate_size
+            w1 = self.param("experts_w1", init, (E, D, I))
+            w3 = self.param("experts_w3", init, (E, D, I))
+            w2 = self.param("experts_w2", init, (E, I, D))
+            flat = x.reshape(B * S, D)
+            x_rep = jnp.repeat(flat, self.k, axis=0)        # [T*k, D]
+            out_rep = moe_grouped_mlp(x_rep, topk_idx.reshape(-1),
+                                      w1.astype(x.dtype), w3.astype(x.dtype),
+                                      w2.astype(x.dtype), num_experts=E)
+            out_k = out_rep.reshape(B * S, self.k, D)
+            combined = jnp.einsum("tk,tkd->td", topk_w.astype(x.dtype), out_k)
+            return combined.reshape(B, S, D), aux_loss
 
         # [E, C, D] expert-major dispatch (XLA inserts token→expert a2a).
         # The big operand stays 3-D [B, S, D]: flattening it first would
