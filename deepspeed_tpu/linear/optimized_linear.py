@@ -89,3 +89,88 @@ def init_lora(params):
 
 def lora_frozen_patterns():
     return ["base_kernel", "base_kernel_q", "base_kernel_scales"]
+
+
+def _is_lora_site(d):
+    return isinstance(d, dict) and "lora_a" in d and "lora_b" in d
+
+
+def _site_scaling(a, lora_alpha, lora_r=None):
+    """alpha / r with r taken from the adapter's own shape (lora_a is
+    [in, r]) unless explicitly overridden — the rank is never guessed."""
+    r = int(lora_r) if lora_r is not None else int(a.shape[-1])
+    return float(lora_alpha) / float(r)
+
+
+def fuse_lora_tree(params, lora_alpha, lora_r=None):
+    """Fold every LoRA pair into its base (reference
+    ``hybrid_engine.py:138`` ``fuse_lora_weight``): per site,
+    ``base_kernel += (lora_a @ lora_b) * (alpha / r)`` and ``lora_b`` is
+    zeroed so the unchanged module forward computes exactly the fused
+    product once. The rank ``r`` is read from each site's ``lora_a``
+    shape (pass ``lora_r`` only to override). → ``(fused_tree, stash)``
+    where ``stash`` maps site path → original ``lora_b`` for
+    :func:`unfuse_lora_tree`. The delta is accumulated in fp32 and cast
+    back to the base dtype.
+
+    Quantized bases (``base_kernel_q``) refuse: re-quantizing the fused
+    weight would permanently lose bits on unfuse."""
+    stash = {}
+
+    def walk(d, path):
+        if not isinstance(d, dict):
+            return d
+        if _is_lora_site(d):
+            if "base_kernel_q" in d:
+                raise NotImplementedError(
+                    f"cannot fuse LoRA into the quantized base at {path}: "
+                    "re-quantization is lossy; dequantize the base first or "
+                    "generate unfused")
+            a, b, base = d["lora_a"], d["lora_b"], d["base_kernel"]
+            scaling = _site_scaling(a, lora_alpha, lora_r)
+            delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scaling
+            out = dict(d)
+            out["base_kernel"] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+            out["lora_b"] = jnp.zeros_like(b)
+            stash[path] = b
+            return out
+        return {k: walk(v, f"{path}/{k}" if path else k) for k, v in d.items()}
+
+    return walk(dict(params), ""), stash
+
+
+def unfuse_lora_tree(params, stash, lora_alpha, lora_r=None):
+    """Inverse of :func:`fuse_lora_tree`: restore ``lora_b`` and subtract
+    the delta from the base (same fp32 accumulation; one rounding step in
+    the base dtype, exactly the reference's unfuse arithmetic)."""
+
+    def walk(d, path):
+        if not isinstance(d, dict):
+            return d
+        if _is_lora_site(d) and path in stash:
+            b = stash[path]
+            a, base = d["lora_a"], d["base_kernel"]
+            scaling = _site_scaling(a, lora_alpha, lora_r)
+            delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scaling
+            out = dict(d)
+            out["base_kernel"] = (base.astype(jnp.float32) - delta).astype(base.dtype)
+            out["lora_b"] = b
+            return out
+        return {k: walk(v, f"{path}/{k}" if path else k) for k, v in d.items()}
+
+    return walk(dict(params), "")
+
+
+def has_lora_sites(params):
+    found = []
+
+    def walk(d):
+        if isinstance(d, dict):
+            if _is_lora_site(d):
+                found.append(True)
+                return
+            for v in d.values():
+                walk(v)
+
+    walk(params)
+    return bool(found)

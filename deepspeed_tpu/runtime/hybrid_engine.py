@@ -30,6 +30,50 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._gen_cache = {}
         self._ragged_engine = None
         self._gen_rng = jax.random.PRNGKey(int(jnp.asarray(0)))
+        self._lora_stash = None   # set while LoRA adapters are fused
+        self._lora_scaling = None
+        # model's adapter geometry for auto-fuse (ds_config
+        # hybrid_engine section; falls back to LoRAConfig defaults)
+        he = self._config._param_dict.get("hybrid_engine", {}) or {}
+        self._lora_r_default = he.get("lora_r")
+        self._lora_alpha_default = he.get("lora_alpha")
+
+    # ------------------------------------------------------------------
+    # LoRA fuse/unfuse around generation (reference hybrid_engine.py:138
+    # fuse_lora_weight / :146 unfuse_lora_weight): the DeepSpeed-Chat
+    # LoRA stage rolls out through FUSED weights — one GEMM per linear
+    # instead of base + two adapter matmuls.
+    # ------------------------------------------------------------------
+    def fuse_lora_weight(self, lora_r=None, lora_alpha=None):
+        """Fold ``base + a@b*(alpha/r)`` into every OptimizedLinear base
+        (no-op without LoRA sites or when already fused). The rank comes
+        from each adapter's own shape; alpha from the argument, the
+        ds_config ``hybrid_engine.lora_alpha``, or the LoRAConfig
+        default."""
+        from deepspeed_tpu.linear.config import LoRAConfig
+        from deepspeed_tpu.linear.optimized_linear import (fuse_lora_tree,
+                                                           has_lora_sites)
+        if self._lora_stash is not None or not has_lora_sites(self.params):
+            return
+        if lora_alpha is None:
+            lora_alpha = self._lora_alpha_default
+        if lora_alpha is None:
+            lora_alpha = LoRAConfig().lora_alpha
+        if lora_r is None:
+            lora_r = self._lora_r_default  # None → per-site from lora_a shape
+        self._ensure_params_resident()
+        self.params, self._lora_stash = fuse_lora_tree(self.params, lora_alpha, lora_r)
+        self._lora_scaling = (float(lora_alpha), lora_r)
+
+    def unfuse_lora_weight(self):
+        """Restore the adapters and subtract the fused delta."""
+        from deepspeed_tpu.linear.optimized_linear import unfuse_lora_tree
+        if self._lora_stash is None:
+            return
+        alpha, r = self._lora_scaling
+        self.params = unfuse_lora_tree(self.params, self._lora_stash, alpha, r)
+        self._lora_stash = None
+        self._lora_scaling = None
 
     # ------------------------------------------------------------------
     def _decode_fn(self, prompt_len, max_new_tokens, do_sample, temperature):
@@ -84,7 +128,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         fn = self._decode_fn(input_ids.shape[1], int(max_new_tokens),
                              bool(do_sample), float(temperature))
         self._gen_rng, sub = jax.random.split(self._gen_rng)
-        new_tokens = fn(self.params, input_ids, sub)
+        fused_here = self._lora_stash is None
+        self.fuse_lora_weight()  # rollout through fused adapters (no-op sans LoRA)
+        try:
+            new_tokens = fn(self.params, input_ids, sub)
+        finally:
+            if fused_here:
+                self.unfuse_lora_weight()
         return jnp.concatenate([input_ids, new_tokens], axis=1)
 
     def generate_ragged(self, prompts, max_new_tokens=16, engine_config=None,
@@ -130,21 +180,32 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 model=self.module, config=cfg, params=self.params,
                 dtype=self.compute_dtype)
             self._DynamicSplitFuseScheduler = DynamicSplitFuseScheduler
-        # rollouts must see the CURRENT training weights
-        self._ragged_engine.params = self.params
-        sched = self._DynamicSplitFuseScheduler(self._ragged_engine,
-                                                token_budget=token_budget)
-        for uid, prompt in enumerate(prompts):
-            sched.add_request(uid, np.asarray(prompt, np.int32),
-                              max_new_tokens=max_new_tokens)
-        out = sched.run_to_completion()
+        fused_here = self._lora_stash is None
+        self.fuse_lora_weight()  # ragged rollout through fused adapters
+        try:
+            # rollouts must see the CURRENT (possibly fused) weights
+            self._ragged_engine.params = self.params
+            sched = self._DynamicSplitFuseScheduler(self._ragged_engine,
+                                                    token_budget=token_budget)
+            for uid, prompt in enumerate(prompts):
+                sched.add_request(uid, np.asarray(prompt, np.int32),
+                                  max_new_tokens=max_new_tokens)
+            out = sched.run_to_completion()
+        finally:
+            if fused_here:
+                self.unfuse_lora_weight()
         return [out[uid] for uid in range(len(prompts))]
 
-    # mode flips (reference eval()/train() on the hybrid module)
+    # mode flips (reference eval()/train() on the hybrid module; the
+    # reference fuses LoRA for the eval/rollout phase and unfuses when
+    # training resumes — hybrid_engine.py:138-146)
     def eval(self):
         self._is_training = False
+        self.fuse_lora_weight()
         return self
 
     def train(self, mode=True):
         self._is_training = bool(mode)
+        if self._is_training:
+            self.unfuse_lora_weight()
         return self
