@@ -2,10 +2,14 @@
 reduction, activation quantization, and a staged scheduler as
 functional transforms over the params pytree."""
 
-from deepspeed_tpu.compression.basic_layer import (bits_at_step, channel_pruning_mask,
+from deepspeed_tpu.compression.basic_layer import (binary_quantize, bits_at_step,
+                                                    channel_pruning_mask,
                                                     head_pruning_mask,
-                                                    quantize_activation, row_pruning_mask,
-                                                    sparse_pruning_mask, ste_quantize)
+                                                    quantize_activation,
+                                                    quantize_weight_at_bits,
+                                                    row_pruning_mask,
+                                                    sparse_pruning_mask, ste_quantize,
+                                                    ternary_quantize)
 from deepspeed_tpu.compression.compress import (init_compression, layer_reduction,
                                                  redundancy_clean,
                                                  structural_channel_prune)
@@ -13,6 +17,8 @@ from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
 __all__ = ["init_compression", "redundancy_clean", "layer_reduction",
            "structural_channel_prune",
-           "ste_quantize", "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask",
+           "ste_quantize", "ternary_quantize", "binary_quantize",
+           "quantize_weight_at_bits",
+           "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask",
            "channel_pruning_mask", "quantize_activation", "bits_at_step",
            "CompressionScheduler"]

@@ -15,31 +15,34 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def ste_quantize(x, bits: int = 8, symmetric: bool = True):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def ste_quantize(x, bits: int = 8, symmetric: bool = True, num_groups: int = 1):
     """Fake-quantize with a straight-through gradient (reference
-    Quantizer forward + STE backward)."""
-    return _quantize_value(x, bits, symmetric)
+    Quantizer forward + STE backward); ``num_groups`` gives per-group
+    ranges (reference q_groups; per-tensor when it does not divide)."""
+    return _quantize_value(x, bits, symmetric, num_groups)
 
 
-def _quantize_value(x, bits, symmetric):
-    x32 = x.astype(jnp.float32)
+def _quantize_value(x, bits, symmetric, num_groups=1):
+    ng = num_groups if num_groups > 0 and x.size % num_groups == 0 else 1
+    x32 = x.astype(jnp.float32).reshape(ng, -1)
     qmax = 2.0 ** (bits - 1) - 1 if symmetric else 2.0 ** bits - 1
     if symmetric:
-        scale = jnp.maximum(jnp.max(jnp.abs(x32)), 1e-8) / qmax
+        scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=1, keepdims=True), 1e-8) / qmax
         q = jnp.clip(jnp.round(x32 / scale), -qmax - 1, qmax)
-        return (q * scale).astype(x.dtype)
-    lo, hi = jnp.min(x32), jnp.max(x32)
+        return (q * scale).reshape(x.shape).astype(x.dtype)
+    lo = jnp.min(x32, axis=1, keepdims=True)
+    hi = jnp.max(x32, axis=1, keepdims=True)
     scale = jnp.maximum(hi - lo, 1e-8) / qmax
     q = jnp.clip(jnp.round((x32 - lo) / scale), 0, qmax)
-    return (q * scale + lo).astype(x.dtype)
+    return (q * scale + lo).reshape(x.shape).astype(x.dtype)
 
 
-def _ste_fwd(x, bits, symmetric):
-    return _quantize_value(x, bits, symmetric), None
+def _ste_fwd(x, bits, symmetric, num_groups):
+    return _quantize_value(x, bits, symmetric, num_groups), None
 
 
-def _ste_bwd(bits, symmetric, _res, g):
+def _ste_bwd(bits, symmetric, num_groups, _res, g):
     return (g,)  # straight through
 
 
@@ -88,6 +91,67 @@ def channel_pruning_mask(w, dense_ratio: float):
     return mask.reshape((1,) * (w.ndim - 1) + (-1,))
 
 
+def _effective_groups(x, num_groups):
+    """Per-tensor fallback when the group count does not divide the leaf
+    (the reference's view(num_groups, -1) would throw; a matched bias or
+    odd-shaped kernel must not crash a whole training run)."""
+    return num_groups if num_groups > 0 and x.size % num_groups == 0 else 1
+
+
+def _ternary_value(x, num_groups):
+    """XTC ternary: per-group threshold 0.7*mean|w|, scale = mean|w| over
+    the surviving entries (reference ``TernaryQuantizer``,
+    compression/utils.py / basic_layer.py:96-99)."""
+    num_groups = _effective_groups(x, num_groups)
+    x32 = x.astype(jnp.float32).reshape(num_groups, -1)
+    absx = jnp.abs(x32)
+    thres = 0.7 * jnp.mean(absx, axis=1, keepdims=True)
+    mask = (absx > thres).astype(jnp.float32)
+    alpha = jnp.sum(absx * mask, axis=1, keepdims=True) / \
+        jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    return (alpha * jnp.sign(x32) * mask).reshape(x.shape).astype(x.dtype)
+
+
+def _binary_value(x, num_groups):
+    """XTC binary: per-group scale mean|w| times sign (reference
+    ``BinaryQuantizer``)."""
+    num_groups = _effective_groups(x, num_groups)
+    x32 = x.astype(jnp.float32).reshape(num_groups, -1)
+    alpha = jnp.mean(jnp.abs(x32), axis=1, keepdims=True)
+    return (alpha * jnp.sign(x32)).reshape(x.shape).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ternary_quantize(x, num_groups: int = 1):
+    """XTC ternary fake-quantization with straight-through gradient."""
+    return _ternary_value(x, num_groups)
+
+
+ternary_quantize.defvjp(lambda x, g: (_ternary_value(x, g), None),
+                        lambda g, _res, ct: (ct,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def binary_quantize(x, num_groups: int = 1):
+    """XTC binary fake-quantization with straight-through gradient."""
+    return _binary_value(x, num_groups)
+
+
+binary_quantize.defvjp(lambda x, g: (_binary_value(x, g), None),
+                       lambda g, _res, ct: (ct,))
+
+
+def quantize_weight_at_bits(x, bits: int, symmetric: bool = True, num_groups: int = 1):
+    """Bit-width dispatch matching the reference's quantizer selection
+    (basic_layer.py:96-99): 1 bit → BinaryQuantizer, 2 bits →
+    TernaryQuantizer, else uniform STE quantization."""
+    if bits <= 1:
+        return binary_quantize(x, num_groups)
+    if bits == 2:
+        return ternary_quantize(x, num_groups)
+    return ste_quantize(x, bits, symmetric, num_groups)
+
+
 def quantize_activation(x, bits: int = 8, quant_mode: str = "symmetric"):
     """Activation fake-quantization with a straight-through gradient
     (reference ``QuantAct``, basic_layer.py:17): dynamic per-tensor
@@ -97,13 +161,18 @@ def quantize_activation(x, bits: int = 8, quant_mode: str = "symmetric"):
 
 
 def bits_at_step(start_bits: int, target_bits: int, period: int, steps_since: int):
-    """Annealed weight-quantization bit-width: every ``period`` steps
-    the width halves until ``target_bits`` (reference Embedding/Linear
-    ``enable_weight_quantization`` quantization_period semantics — XTC
-    recipes walk 8 -> 4 -> 2/1)."""
+    """Annealed weight-quantization bit-width with the reference's
+    quantization_period semantics (runtime/quantize.py:136-141): the
+    period is an absolute step threshold that DOUBLES after each 1-bit
+    reduction (``q_period <<= 1; start_bits -= 1``), so reductions land
+    at steps period, 2*period, 4*period, ... until ``target_bits``. XTC
+    recipes walk 8 → ... → 2/1 on this schedule."""
     if steps_since < 0:
         return None  # not yet active
     if period <= 0:
         return target_bits
-    n = steps_since // period
-    return max(target_bits, start_bits >> n)
+    bits, boundary = start_bits, period
+    while bits > target_bits and steps_since >= boundary:
+        bits -= 1
+        boundary <<= 1
+    return max(target_bits, bits)

@@ -131,13 +131,19 @@ class CompressionScheduler:
                         x = x * head_pruning_mask(x, float(cfg.get("dense_ratio", 0.5)),
                                                   int(cfg.get("num_heads", num_heads or 1)))
             if live["weight_quantization"]:
+                from deepspeed_tpu.compression.basic_layer import quantize_weight_at_bits
                 for pats, cfg in self.rules["weight_quantization"]:
                     if _match_any(path, pats):
                         bits = self.wq_bits(step, cfg)
                         if bits is not None:
-                            x = ste_quantize(x, bits,
-                                             cfg.get("quantization_type", "symmetric")
-                                             == "symmetric")
+                            # 1 bit → XTC binary, 2 bits → XTC ternary,
+                            # else uniform STE (reference quantizer pick,
+                            # basic_layer.py:96-99)
+                            x = quantize_weight_at_bits(
+                                x, bits,
+                                symmetric=cfg.get("quantization_type",
+                                                  "symmetric") == "symmetric",
+                                num_groups=int(cfg.get("quantize_groups", 1)))
             return x
 
         return lambda params: path_tree_map(leaf, params)

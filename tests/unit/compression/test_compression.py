@@ -115,12 +115,18 @@ def test_activation_quantization():
 
 def test_bits_annealing_schedule():
     from deepspeed_tpu.compression import bits_at_step
-    # 8 -> 4 -> 2 halving every 10 steps, floored at target
+    # reference runtime/quantize.py:136-141: -1 bit at each threshold,
+    # threshold doubling after every reduction (10, 20, 40, 80, ...)
     assert bits_at_step(8, 2, 10, 0) == 8
     assert bits_at_step(8, 2, 10, 9) == 8
-    assert bits_at_step(8, 2, 10, 10) == 4
-    assert bits_at_step(8, 2, 10, 20) == 2
-    assert bits_at_step(8, 2, 10, 300) == 2
+    assert bits_at_step(8, 2, 10, 10) == 7
+    assert bits_at_step(8, 2, 10, 19) == 7
+    assert bits_at_step(8, 2, 10, 20) == 6
+    assert bits_at_step(8, 2, 10, 40) == 5
+    assert bits_at_step(8, 2, 10, 80) == 4
+    assert bits_at_step(8, 2, 10, 160) == 3
+    assert bits_at_step(8, 2, 10, 320) == 2
+    assert bits_at_step(8, 2, 10, 100000) == 2
     assert bits_at_step(8, 8, 0, 5) == 8
 
 
@@ -146,8 +152,9 @@ def test_scheduler_offsets_and_annealing():
     wq_cfg = sched.rules["weight_quantization"][0][1]
     assert sched.wq_bits(4, wq_cfg) is None
     assert sched.wq_bits(5, wq_cfg) == 8
-    assert sched.wq_bits(15, wq_cfg) == 4
-    assert sched.wq_bits(25, wq_cfg) == 2
+    assert sched.wq_bits(15, wq_cfg) == 7   # first -1 at since=10
+    assert sched.wq_bits(25, wq_cfg) == 6   # second at since=20
+    assert sched.wq_bits(330, wq_cfg) == 2  # floor at target
 
     rng = np.random.RandomState(5)
     p = {"dense": {"kernel": jnp.asarray(rng.randn(8, 8).astype(np.float32))}}
@@ -155,10 +162,11 @@ def test_scheduler_offsets_and_annealing():
     np.testing.assert_array_equal(
         np.asarray(sched.params_transform(0)(p)["dense"]["kernel"]),
         np.asarray(p["dense"]["kernel"]))
-    # past the pruning offset: half the entries zeroed AND 2-bit quantized
-    out = sched.params_transform(40)(p)["dense"]["kernel"]
+    # deep into the schedule: half the entries pruned AND at the 2-bit
+    # target, which dispatches to the XTC TernaryQuantizer (<=3 levels)
+    out = sched.params_transform(400)(p)["dense"]["kernel"]
     assert (np.asarray(out) == 0).mean() >= 0.5
-    assert len(np.unique(np.asarray(out))) <= 5  # 2-bit levels + 0
+    assert len(np.unique(np.asarray(out))) <= 3  # {-alpha, 0, +alpha}
 
 
 def test_xtc_style_bert_quantize_then_prune():
@@ -242,3 +250,92 @@ def test_structural_prune_ambiguous_pattern_raises():
               "c": {"kernel": np.ones((4, 8))}}
     with pytest.raises(ValueError, match="matched 2"):
         structural_channel_prune(params, [(r"a|c", r"b")], 0.5)
+
+
+def test_ternary_quantizer_xtc():
+    """XTC TernaryQuantizer (reference basic_layer.py:96-99 /
+    compression utils TernaryQuantizer): per-group {-alpha, 0, +alpha}
+    with threshold 0.7*mean|w| and alpha from the surviving entries."""
+    from deepspeed_tpu.compression import ternary_quantize
+    rng = np.random.RandomState(7)
+    w = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    q = np.asarray(ternary_quantize(w, 1))
+    vals = np.unique(q)
+    assert len(vals) == 3 and np.isclose(vals[0], -vals[2]) and vals[1] == 0
+    # threshold semantics: small entries zero, sign preserved for the rest
+    thres = 0.7 * np.abs(np.asarray(w)).mean()
+    assert np.all(q[np.abs(np.asarray(w)) <= thres] == 0)
+    nz = np.abs(np.asarray(w)) > thres
+    assert np.all(np.sign(q[nz]) == np.sign(np.asarray(w)[nz]))
+    # per-group scales differ with multiple groups
+    q4 = np.asarray(ternary_quantize(w, 4))
+    assert len(np.unique(np.abs(q4[q4 != 0]))) == 4
+    # straight-through gradient
+    g = jax.grad(lambda w: ternary_quantize(w, 1).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_binary_quantizer_xtc():
+    """XTC BinaryQuantizer: per-group mean|w| * sign(w)."""
+    from deepspeed_tpu.compression import binary_quantize
+    rng = np.random.RandomState(8)
+    w = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    q = np.asarray(binary_quantize(w, 1))
+    alpha = np.abs(np.asarray(w)).mean()
+    np.testing.assert_allclose(np.abs(q), alpha, rtol=1e-6)
+    np.testing.assert_array_equal(np.sign(q)[np.asarray(w) != 0],
+                                  np.sign(np.asarray(w))[np.asarray(w) != 0])
+    g = jax.grad(lambda w: binary_quantize(w, 1).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_quantize_weight_dispatch():
+    from deepspeed_tpu.compression import quantize_weight_at_bits
+    rng = np.random.RandomState(9)
+    w = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    assert len(np.unique(np.asarray(quantize_weight_at_bits(w, 1)))) == 2
+    assert len(np.unique(np.asarray(quantize_weight_at_bits(w, 2)))) == 3
+    assert len(np.unique(np.asarray(quantize_weight_at_bits(w, 4)))) > 3
+
+
+def test_xtc_ternary_recovery_training():
+    """XTC extreme-compression recipe: anneal a tiny regression model to
+    ternary weights under STE training; the ternary-forward loss recovers
+    close to the dense loss (the XTC paper's core claim in miniature)."""
+    import optax
+    from deepspeed_tpu.compression import CompressionScheduler
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+    # ternary-representable ground truth: {-0.5, 0, +0.5}
+    true_w = (0.5 * np.sign(rng.randn(16, 8)) *
+              (rng.rand(16, 8) > 0.4)).astype(np.float32)
+    y = jnp.asarray(x @ true_w)
+
+    cfg = {"compression_training": {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"g": {"modules": ["kernel"],
+                                   "params": {"start_bits": 2, "target_bits": 2,
+                                              "quantization_period": 0}}}}}}
+    sched = CompressionScheduler(cfg)
+    params = {"dense": {"kernel": jnp.asarray(rng.randn(16, 8).astype(np.float32) * 0.1)}}
+    opt = optax.adam(5e-2)
+    st = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        def loss_fn(p):
+            q = sched.params_transform(1)(p)
+            return jnp.mean((x @ q["dense"]["kernel"] - y) ** 2)
+        l, g = jax.value_and_grad(loss_fn)(p)
+        u, s = opt.update(g, s)
+        return optax.apply_updates(p, u), s, l
+
+    first = None
+    for i in range(150):
+        params, st, loss = step(params, st)
+        if first is None:
+            first = float(loss)
+    # ternary forward trained with STE: large recovery vs where it started
+    assert float(loss) < first * 0.2, (first, float(loss))
+    q = np.asarray(sched.params_transform(1)(params)["dense"]["kernel"])
+    assert len(np.unique(q)) <= 3
