@@ -543,10 +543,6 @@ class DeepSpeedEngine:
                     aio_threads=int(self._config.zero_config.offload_param.buffer_count or 4))
 
         offload_device = self._config.zero_config.offload_optimizer_device().value
-        if offload_device != "none" and self._config._param_dict.get("frozen_parameters"):
-            raise NotImplementedError(
-                "frozen_parameters with offload_optimizer is not supported yet: the host "
-                "SIMD update path has no per-leaf mask — unfreeze or disable offload")
         if offload_device != "none":
             # ZeRO-Offload: fp32 master + moments on host (RAM or NVMe),
             # update on host SIMD (runtime/zero/offload.py). The device
@@ -559,7 +555,9 @@ class DeepSpeedEngine:
             self._host_offload = HostOffloadOptimizer(
                 self.optimizer, self.params, self._param_shardings, self.compute_dtype,
                 nvme_path=nvme_path,
-                aio_threads=int(self._config.zero_config.offload_optimizer.buffer_count or 4))
+                aio_threads=int(self._config.zero_config.offload_optimizer.buffer_count or 4),
+                trainable_mask=(jax.tree.leaves(self._trainable_mask)
+                                if self._trainable_mask is not None else None))
             self.master_params = None
             self.opt_state = None
         else:
@@ -1076,7 +1074,7 @@ class DeepSpeedEngine:
         """Host half of the offload step + shared bookkeeping."""
         self.overflow = bool(overflow) if self.fp16_enabled() else False
         if not self.overflow:
-            self.params = self._host_offload.step(grads32)
+            self.params = self._host_offload.step(grads32, prev_params=self.params)
         self.scaler_state = update_scale(self.scaler_state, overflow, **dict(self._scaler_kwargs))
         self.global_grad_norm = float(gnorm)
 

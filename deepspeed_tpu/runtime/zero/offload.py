@@ -59,13 +59,19 @@ class HostOffloadOptimizer:
     }
 
     def __init__(self, optimizer, params, param_shardings, compute_dtype,
-                 nvme_path: Optional[str] = None, aio_threads: int = 4):
+                 nvme_path: Optional[str] = None, aio_threads: int = 4,
+                 trainable_mask=None):
         self.optimizer = optimizer
         self.kind = self._infer_kind(optimizer)
         self.compute_dtype = compute_dtype
         self._param_shardings = param_shardings
         self._treedef = jax.tree.structure(params)
         self._shardings_flat = jax.tree.leaves(param_shardings)
+        # per-leaf frozen mask (reference stage_1_and_2 partitions only
+        # trainable params): frozen leaves skip the SIMD update and their
+        # master region stays coherent with the untouched device leaf
+        self.trainable = (list(trainable_mask) if trainable_mask is not None
+                          else None)
 
         leaves = jax.tree.leaves(params)
         meta = _leaf_paths_and_shapes(params)
@@ -224,15 +230,20 @@ class HostOffloadOptimizer:
             self.swapper.commit(i, state)
         return new_p
 
-    def step(self, grads_tree):
+    def step(self, grads_tree, prev_params=None):
         """One optimizer step. ``grads_tree`` are unscaled, clipped fp32 (or
         bf16) device gradients. Returns the new compute-dtype param tree,
-        placed with the engine's parameter shardings."""
+        placed with the engine's parameter shardings. ``prev_params``
+        (optional) lets frozen leaves be returned as-is — no transfer,
+        no update."""
         self.step_count += 1
         grads_flat = jax.tree.leaves(grads_tree)
+        prev_flat = jax.tree.leaves(prev_params) if prev_params is not None else None
         # Kick off ALL device->host copies up front; jax overlaps them with
         # the host-side SIMD work below.
-        for g in grads_flat:
+        for i, g in enumerate(grads_flat):
+            if self.trainable is not None and not self.trainable[i]:
+                continue
             try:
                 g.copy_to_host_async()
             except Exception:
@@ -242,6 +253,17 @@ class HostOffloadOptimizer:
         new_leaves = []
         for i, g in enumerate(grads_flat):
             size = self.sizes[i]
+            if self.trainable is not None and not self.trainable[i]:
+                if prev_flat is not None:
+                    new_leaves.append(prev_flat[i])
+                else:
+                    o = int(self.offsets[i])
+                    np_dtype = (ml_dtypes.bfloat16 if want_bf16 else
+                                np.dtype(jnp.dtype(self.compute_dtype).name))
+                    host_val = self.master_flat[o:o + size].reshape(
+                        self.shapes[i]).astype(np_dtype)
+                    new_leaves.append(jax.device_put(host_val, self._shardings_flat[i]))
+                continue
             g_np = np.asarray(jax.device_get(g))
             grad_f32 = self._grad_to_fp32(g_np, size)
             new_p = self._update_region(i, grad_f32, want_bf16)

@@ -262,3 +262,42 @@ def test_gathered_parameters_roundtrip_writeback():
     after = np.asarray(jax.device_get(engine.params["linear_0"]["kernel"]), np.float32)
     assert np.abs(after).max() < 0.05, "stale master reverted the surgery"
     assert np.isfinite(float(loss))
+
+
+def test_frozen_parameters_with_offload_optimizer():
+    """Frozen subsets train under ZeRO-Offload (reference stage_1_and_2
+    partitions only trainable params): the host SIMD update skips frozen
+    leaves, which match the non-offload frozen run exactly."""
+    groups.destroy_mesh()
+
+    def run(offload):
+        groups.destroy_mesh()
+        cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "zero_optimization": {"stage": 2 if not offload else 3},
+               "frozen_parameters": ["linear_0"]}
+        if offload:
+            cfg["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+        model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        x, y = random_dataloader(None, 8, HIDDEN, batch_size=8)[0]
+        losses = []
+        for _ in range(3):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return engine, losses
+
+    base_engine, base = run(False)
+    off_engine, off = run(True)
+    np.testing.assert_allclose(base, off, rtol=2e-2)
+    frozen0 = np.asarray(jax.device_get(base_engine.params["linear_0"]["kernel"]), np.float32)
+    frozen1 = np.asarray(jax.device_get(off_engine.params["linear_0"]["kernel"]), np.float32)
+    np.testing.assert_allclose(frozen0, frozen1, rtol=1e-6)  # both untouched inits
+    # trainable leaves moved under offload too
+    t0 = np.asarray(jax.device_get(off_engine.params["classifier"]["kernel"]), np.float32)
+    loss = off_engine(*random_dataloader(None, 8, HIDDEN, batch_size=8)[0])
+    off_engine.backward(loss)
+    off_engine.step()
+    t1 = np.asarray(jax.device_get(off_engine.params["classifier"]["kernel"]), np.float32)
+    assert not np.array_equal(t0, t1)
