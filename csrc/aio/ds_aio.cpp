@@ -328,8 +328,9 @@ private:
                     if (errno == EINTR) continue;
                     if (errno == EAGAIN || errno == EBUSY) {
                         // transient kernel backpressure: reap completions to
-                        // free async context, then retry the submit
-                        peek_cq();
+                        // free async context, then retry the submit; back
+                        // off when nothing completed or this busy-spins
+                        if (peek_cq() == 0) ::usleep(1000);
                         continue;
                     }
                     // Ring is broken: the last `submitted` SQEs were never

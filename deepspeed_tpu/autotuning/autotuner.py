@@ -54,10 +54,27 @@ class Autotuner:
     def __init__(self, model_fn, base_config, batch_fn, micro_batches=None,
                  zero_stages=None, steps=3, mesh=None, results_dir=None,
                  metric="throughput", autotuning_config=None,
-                 model_spec=None, batch_spec=None):
+                 model_spec=None, batch_spec=None,
+                 gas_candidates=None, offload_candidates=None,
+                 memory_budget_bytes=None, world_size=None):
         self.model_fn = model_fn
         self.base_config = base_config
         self.batch_fn = batch_fn
+        # extra search dims (reference tuning space includes gradient
+        # accumulation and offload configs): defaults keep the classic
+        # stage x micro-batch grid. offload=None means "leave base_config
+        # alone"; searching [False, True] explicitly strips/adds it.
+        self.gas_candidates = list(gas_candidates) if gas_candidates else [None]
+        self.offload_candidates = (list(offload_candidates)
+                                   if offload_candidates else [None])
+        # HBM budget for the pre-prune memory model (reference
+        # autotuner.py:663 profiles model info to prune the space;
+        # mem_model.py estimates from eval_shape + jaxpr walk instead)
+        self.memory_budget_bytes = memory_budget_bytes
+        if world_size is None:
+            import jax as _jax
+            world_size = len(_jax.devices())
+        self.world_size = int(world_size)
         # JSON-able specs for the distributed mode's out-of-process
         # workers (exp_runner.py schema)
         self.model_spec = model_spec
@@ -88,25 +105,68 @@ class Autotuner:
         self.best = None
 
     # ------------------------------------------------------------------
-    def _experiment_config(self, stage, mbs):
+    def _experiment_config(self, stage, mbs, gas=None, offload=None):
         cfg = copy.deepcopy(self.base_config)
         cfg["train_micro_batch_size_per_gpu"] = mbs
-        cfg.setdefault("gradient_accumulation_steps", 1)
-        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        if gas is not None:
+            cfg["gradient_accumulation_steps"] = gas
+        else:
+            cfg.setdefault("gradient_accumulation_steps", 1)
+        zc = cfg.setdefault("zero_optimization", {})
+        zc["stage"] = stage
+        if offload is True:
+            zc["offload_optimizer"] = {"device": "cpu"}
+        elif offload is False:
+            # the non-offload lane must really run non-offloaded even when
+            # base_config carries an offload_optimizer section
+            zc.pop("offload_optimizer", None)
         # the config triangulation derives train_batch_size from
         # micro×gas×world — setting it here would double-specify and can
         # silently inflate gradient accumulation
         cfg.pop("train_batch_size", None)
         return cfg
 
-    def run_experiment(self, stage, mbs):
+    def estimate_memory(self, stage, mbs, gas=None, offload=None):
+        """Per-device HBM estimate for a candidate (mem_model.py)."""
+        from deepspeed_tpu.autotuning.mem_model import estimate_experiment_memory
+        return estimate_experiment_memory(
+            self.model_fn, self.batch_fn,
+            self._experiment_config(stage, mbs, gas, offload), mbs,
+            world_size=self.world_size)
+
+    def _prune_by_memory(self, stage, mbs, gas, offload):
+        """→ record dict if the estimator rejects the candidate (recorded
+        WITHOUT running it — no compile, no OOM), else None."""
+        if self.memory_budget_bytes is None:
+            return None
+        try:
+            est = self.estimate_memory(stage, mbs, gas, offload)
+        except Exception as e:  # estimator must never block tuning
+            logger.warning(f"autotune: memory estimate failed ({e}); running anyway")
+            return None
+        if est["total_bytes"] <= self.memory_budget_bytes:
+            return None
+        rec = {"zero_stage": stage, "micro_batch_size": mbs,
+               "gas": gas, "offload": offload,
+               "metric": self.metric, "value": None,
+               "error": (f"estimated OOM: {est['total_bytes'] / 1e9:.2f} GB "
+                         f"> budget {self.memory_budget_bytes / 1e9:.2f} GB "
+                         f"(pruned without running)"),
+               "memory_estimate": est}
+        self.results.append(rec)
+        logger.info(f"autotune: pruned stage={stage} mbs={mbs} gas={gas} "
+                    f"offload={offload}: {rec['error']}")
+        return rec
+
+    def run_experiment(self, stage, mbs, gas=None, offload=None):
         """One candidate: build a fresh engine, time train_batch."""
         import deepspeed_tpu
         from deepspeed_tpu.parallel import groups
 
         record = {"zero_stage": stage, "micro_batch_size": mbs,
+                  "gas": gas, "offload": offload,
                   "metric": self.metric, "value": None, "error": None}
-        cfg = self._experiment_config(stage, mbs)
+        cfg = self._experiment_config(stage, mbs, gas, offload)
         try:
             if self.mesh is None:
                 groups.destroy_mesh()
@@ -134,25 +194,35 @@ class Autotuner:
         return record
 
     def tune(self):
-        """Stage-major sweep with micro-batch hill-climb: within a stage,
-        stop growing the micro-batch after the first failure or regression
-        (the reference's fast tuning-space pruning)."""
+        """Stage-major sweep (x offload x gas dims when configured) with
+        micro-batch hill-climb: within a lane, stop growing the
+        micro-batch after the first failure or regression (the
+        reference's fast tuning-space pruning). Candidates the memory
+        model rejects are recorded as pruned without ever running —
+        no compile, no OOM (crash-prune remains the backstop)."""
         for stage in self.zero_stages:
-            prev = None
-            for mbs in sorted(self.micro_batches):
-                rec = self.run_experiment(stage, mbs)
-                if rec["error"] is not None:
-                    break
-                if prev is not None and rec["value"] is not None and rec["value"] < prev * 0.98:
-                    break
-                prev = rec["value"]
+            for offload in self.offload_candidates:
+                for gas in self.gas_candidates:
+                    prev = None
+                    for mbs in sorted(self.micro_batches):
+                        pruned = self._prune_by_memory(stage, mbs, gas, offload)
+                        if pruned is not None:
+                            break  # larger mbs only estimates bigger
+                        rec = self.run_experiment(stage, mbs, gas, offload)
+                        if rec["error"] is not None:
+                            break
+                        if prev is not None and rec["value"] is not None and \
+                                rec["value"] < prev * 0.98:
+                            break
+                        prev = rec["value"]
         ok = [r for r in self.results if r["value"] is not None]
         if not ok:
             raise RuntimeError("autotuning: every experiment failed; see results")
         self.best = max(ok, key=lambda r: r["value"])
         if self.results_dir:
             self.write_results()
-        return self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"])
+        return self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"],
+                                       self.best.get("gas"), self.best.get("offload"))
 
     def tune_distributed(self, hosts=None, hostfile=None, env=None,
                          slots_per_exp=1, timeout=None):
@@ -204,7 +274,8 @@ class Autotuner:
         os.makedirs(self.results_dir, exist_ok=True)
         with open(os.path.join(self.results_dir, "autotuning_results.json"), "w") as f:
             json.dump(self.results, f, indent=1)
-        best_cfg = self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"])
+        best_cfg = self._experiment_config(self.best["zero_stage"], self.best["micro_batch_size"],
+                                           self.best.get("gas"), self.best.get("offload"))
         with open(os.path.join(self.results_dir, "ds_config_optimal.json"), "w") as f:
             json.dump(best_cfg, f, indent=1)
 

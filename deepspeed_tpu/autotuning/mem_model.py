@@ -1,0 +1,108 @@
+"""Autotuning memory model: estimate per-config HBM before running.
+
+Capability match for the reference's model-info profiling + cost model
+(``deepspeed/autotuning/autotuner.py:663`` ``model_info_profile_run``,
+``tuner/cost_model.py``): the reference runs a profiling job to learn
+parameter counts and activation memory, then prunes infeasible configs
+from the tuning space. TPU-native form — no profiling JOB is needed:
+
+- parameter/gradient/optimizer-state bytes follow exactly from
+  ``jax.eval_shape`` of the model init (zero device memory touched) and
+  the ZeRO stage partitioning arithmetic;
+- activation bytes come from a jaxpr walk of the abstract forward (the
+  same machinery as the flops profiler): the sum of equation output
+  bytes, with ``scan`` bodies scaled by trip count — an upper-style
+  proxy for saved activations that is exact enough to reject configs an
+  order of magnitude over budget without paying a compile + OOM.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_STATE_COUNTS = {"adam": 2, "adamw": 2, "adagrad": 1, "lion": 1, "sgd": 0}
+
+
+def _abstract_size_bytes(x):
+    return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize if x.shape else \
+        jnp.dtype(x.dtype).itemsize
+
+
+def activation_bytes_estimate(fn, *args, **kwargs):
+    """Walk the jaxpr of ``fn(*args)`` (abstract values fine) summing
+    every equation's output bytes; scan bodies scale by length. A proxy
+    for forward-saved activations — liveness-free, so an upper bound."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+
+    def walk(j, scale):
+        total = 0
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name == "scan":
+                inner = eqn.params["jaxpr"]
+                total += walk(inner.jaxpr if hasattr(inner, "jaxpr") else inner,
+                              scale * eqn.params.get("length", 1))
+                continue
+            for key in ("jaxpr", "call_jaxpr", "body_jaxpr"):
+                if key in eqn.params:
+                    inner = eqn.params[key]
+                    total += walk(inner.jaxpr if hasattr(inner, "jaxpr") else inner, scale)
+                    break
+            else:
+                for v in eqn.outvars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        total += _abstract_size_bytes(aval) * scale
+        return total
+
+    return int(walk(jaxpr.jaxpr, 1.0))
+
+
+def estimate_experiment_memory(model_fn, batch_fn, cfg, mbs, world_size=1,
+                               remat_factor=0.25):
+    """→ dict with per-device byte estimates for one candidate config.
+
+    ``remat_factor`` discounts the activation proxy for rematerialized
+    models (activation checkpointing re-computes instead of saving most
+    of the forward; 1.0 = everything saved).
+    """
+    model = model_fn()
+    batch = batch_fn(mbs)
+    abstract_batch = tuple(jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+                           for a in batch)
+    aparams = jax.eval_shape(lambda rng, *b: model.init(rng, *b),
+                             jax.random.PRNGKey(0), *abstract_batch)
+    aparams = aparams["params"] if "params" in aparams else aparams
+    n_params = int(sum(np.prod(x.shape) for x in jax.tree.leaves(aparams)))
+
+    zc = cfg.get("zero_optimization", {}) or {}
+    stage = int(zc.get("stage", 0))
+    off_opt = bool((zc.get("offload_optimizer") or {}).get("device", "none") != "none"
+                   if isinstance(zc.get("offload_optimizer"), dict) else False)
+    off_param = bool((zc.get("offload_param") or {}).get("device", "none") != "none"
+                     if isinstance(zc.get("offload_param"), dict) else False)
+    bf16 = bool((cfg.get("bf16") or {}).get("enabled")) or \
+        bool((cfg.get("fp16") or {}).get("enabled"))
+    cb = 2 if bf16 else 4
+
+    opt_name = str(((cfg.get("optimizer") or {}).get("type", "adam"))).lower()
+    n_states = _STATE_COUNTS.get(opt_name, 2)
+
+    params_b = n_params * cb // (world_size if (stage >= 3 and not off_param) else 1)
+    if off_param:
+        params_b = 0  # pinned_host / NVMe resident; HBM holds one layer transient
+    grads_b = n_params * 4 // (world_size if stage >= 2 else 1)
+    if off_opt:
+        opt_b = 0  # fp32 master + moments live on host
+    else:
+        # fp32 master + optimizer moments, ZeRO-1 partitioned from stage 1
+        opt_b = n_params * 4 * (1 + n_states) // (world_size if stage >= 1 else 1)
+
+    act_b = int(activation_bytes_estimate(
+        lambda p, *a: model.apply({"params": p}, *a), aparams, *abstract_batch)
+        * remat_factor)
+
+    total = params_b + grads_b + opt_b + act_b
+    return {"n_params": n_params, "params_bytes": params_b, "grads_bytes": grads_b,
+            "optimizer_bytes": opt_b, "activation_bytes": act_b, "total_bytes": total}

@@ -35,6 +35,10 @@ _SECTION_KEYS = {"mode", "block", "different_layout_per_head", "num_local_blocks
                  "num_different_global_patterns", "num_random_blocks",
                  "num_sliding_window_blocks", "seed", "global_block_indices",
                  "global_block_end_indices"}
+# knobs that only a sparse-attention section would carry — a bare dict
+# needs at least one of these (generic keys like 'seed'/'block' alone
+# must not silently enable sparse attention)
+_UNAMBIGUOUS_KEYS = _SECTION_KEYS - {"mode", "block", "seed", "attention"}
 
 
 def get_sparse_attention_config(ds_config, num_heads):
@@ -50,10 +54,14 @@ def get_sparse_attention_config(ds_config, num_heads):
     elif "mode" in ds_config:
         section = dict(ds_config)  # unambiguously the section itself; a bad
         # knob raises from the constructor rather than silently disabling
+    elif (ds_config and set(ds_config) <= _SECTION_KEYS
+          and set(ds_config) & _UNAMBIGUOUS_KEYS):
+        # mode-less bare section with at least one knob only a sparsity
+        # section would carry (e.g. num_local_blocks) → fixed-mode defaults
+        section = dict(ds_config)
     else:
-        # A bare dict without the 'sparse_attention' wrapper or a 'mode'
-        # key is ambiguous ({'seed': 1} is NOT a sparsity request) — only
-        # the explicit forms enable sparse attention.
+        # {'seed': 1} or {'block': 8} alone is NOT a sparsity request —
+        # only explicit forms enable sparse attention.
         return None
     mode = section.pop("mode", "fixed")
     if mode not in MODES:

@@ -89,3 +89,75 @@ def test_autotune_convenience():
     cfg = autotune(lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=1), BASE, batch_fn,
                    micro_batches=[8], zero_stages=[0], steps=1)
     assert cfg["train_micro_batch_size_per_gpu"] == 8
+
+
+def test_memory_model_estimates_scale_with_stage_and_offload():
+    """mem_model.py (reference autotuner.py:663 model-info profiling +
+    cost_model.py): params/grads/opt-state bytes follow the ZeRO stage
+    partitioning arithmetic; offload zeroes the optimizer term."""
+    groups.destroy_mesh()
+    tuner = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        base_config=BASE, batch_fn=batch_fn, world_size=8,
+    )
+    e0 = tuner.estimate_memory(0, 8)
+    e1 = tuner.estimate_memory(1, 8)
+    e3 = tuner.estimate_memory(3, 8)
+    eoff = tuner.estimate_memory(2, 8, offload=True)
+    assert e0["n_params"] > 0
+    # stage 1 shards optimizer state 8-way; stage 3 also shards params
+    assert e1["optimizer_bytes"] == e0["optimizer_bytes"] // 8
+    assert e3["params_bytes"] == e0["params_bytes"] // 8
+    assert e3["total_bytes"] < e1["total_bytes"] < e0["total_bytes"]
+    assert eoff["optimizer_bytes"] == 0
+    # activations grow with micro-batch
+    assert tuner.estimate_memory(0, 16)["activation_bytes"] > e0["activation_bytes"]
+
+
+def test_memory_budget_prunes_without_running():
+    """The done-criterion for the memory model: a config the estimator
+    rejects is recorded as pruned and the experiment NEVER runs."""
+    groups.destroy_mesh()
+    ran = []
+
+    tuner = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        base_config=BASE, batch_fn=batch_fn,
+        micro_batches=[8, 16], zero_stages=[0, 3], steps=1,
+        memory_budget_bytes=1,  # nothing fits → everything pruned...
+    )
+    orig = tuner.run_experiment
+    tuner.run_experiment = lambda *a, **k: ran.append(a) or orig(*a, **k)
+    with pytest.raises(RuntimeError, match="every experiment failed"):
+        tuner.tune()
+    assert ran == []  # nothing ever executed
+    assert all("estimated OOM" in r["error"] for r in tuner.results)
+    assert all("pruned without running" in r["error"] for r in tuner.results)
+
+    # a sane budget lets small configs through and prunes none
+    groups.destroy_mesh()
+    tuner2 = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        base_config=BASE, batch_fn=batch_fn,
+        micro_batches=[8], zero_stages=[1], steps=1,
+        memory_budget_bytes=10 << 30,
+    )
+    cfg = tuner2.tune()
+    assert cfg["train_micro_batch_size_per_gpu"] == 8
+    assert all(r["value"] is not None for r in tuner2.results)
+
+
+def test_gas_and_offload_search_dims():
+    """The grid extends over gradient-accumulation and offload when
+    configured (reference tuning space covers both)."""
+    groups.destroy_mesh()
+    tuner = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=1),
+        base_config=BASE, batch_fn=batch_fn,
+        micro_batches=[8], zero_stages=[1], steps=1,
+        gas_candidates=[1, 2],
+    )
+    cfg = tuner.tune()
+    combos = {(r["zero_stage"], r["gas"]) for r in tuner.results}
+    assert combos == {(1, 1), (1, 2)}
+    assert cfg["gradient_accumulation_steps"] in (1, 2)
