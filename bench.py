@@ -7,9 +7,11 @@ North-star (BASELINE.json): ZeRO-3 Llama >=45% MFU on v5e;
 ``vs_baseline`` reports measured MFU / 0.45.
 
 Headline config: ZeRO-3, bf16 + fp32 master, dots-saveable remat,
-gas=32 fused micro-batch scan (amortizes the fixed per-dispatch cost),
-B=4 x S=2048 per micro-batch on a ~551M Llama (the largest that holds
-fp32 optimizer states + saved activations in one v5e chip's HBM).
+gas=128 fused micro-batch scan (the r4 sweep measured the fused-scan
+dispatch amortization still paying past gas=32: 0.548 -> 0.563 @64 ->
+0.568 @128 MFU), B=4 x S=2048 per micro-batch on a ~551M Llama (the
+largest that holds fp32 optimizer states + saved activations in one
+v5e chip's HBM).
 MFU accounting includes the attention quadratic term:
 flops = 6*N*tokens + 12*L*S*hidden*tokens. Step time is min-of-steps
 (the tunneled chip is time-shared; min filters contention spikes).
@@ -171,7 +173,7 @@ def main():
                             num_hidden_layers=layers, num_attention_heads=16,
                             num_key_value_heads=16, max_position_embeddings=2048,
                             remat_policy="dots")
-        B, S, gas, steps, warmup = 4, 2048, 32, 3, 1
+        B, S, gas, steps, warmup = 4, 2048, 128, 3, 1
     else:
         model = build_llama("debug")
         layers, hidden = model.config.num_hidden_layers, model.config.hidden_size

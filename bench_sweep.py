@@ -85,3 +85,13 @@ if __name__ == "__main__":
             stage=3, remat_policy="dots")
     elif which == "einsum":
         run("G: einsum attention dots", remat_policy="dots", attention_impl="einsum")
+    elif which == "gas":
+        # r4 finding: the fused-scan dispatch amortization keeps paying
+        # past gas=32 (0.548 @32 -> 0.563 @64 -> 0.568 @128); S=4096
+        # regressed (0.536 — flash runs the longer rows less efficiently)
+        run("H0: B4 S2048 gas32 dots z3", stage=3, remat_policy="dots",
+            B=4, S=2048, gas=32, steps=3, warmup=1)
+        run("H3: B4 S2048 gas64 dots z3", stage=3, remat_policy="dots",
+            B=4, S=2048, gas=64, steps=3, warmup=1)
+        run("H5: B4 S2048 gas128 dots z3", stage=3, remat_policy="dots",
+            B=4, S=2048, gas=128, steps=2, warmup=1)

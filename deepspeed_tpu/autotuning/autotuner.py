@@ -127,12 +127,16 @@ class Autotuner:
         return cfg
 
     def estimate_memory(self, stage, mbs, gas=None, offload=None):
-        """Per-device HBM estimate for a candidate (mem_model.py)."""
+        """Per-device HBM estimate for a candidate (mem_model.py). The
+        forward trace is cached per micro-batch size — sweeping
+        stage/gas/offload costs integer arithmetic only."""
         from deepspeed_tpu.autotuning.mem_model import estimate_experiment_memory
+        if not hasattr(self, "_mem_trace_cache"):
+            self._mem_trace_cache = {}
         return estimate_experiment_memory(
             self.model_fn, self.batch_fn,
             self._experiment_config(stage, mbs, gas, offload), mbs,
-            world_size=self.world_size)
+            world_size=self.world_size, _trace_cache=self._mem_trace_cache)
 
     def _prune_by_memory(self, stage, mbs, gas, offload):
         """→ record dict if the estimator rejects the candidate (recorded
@@ -226,11 +230,14 @@ class Autotuner:
 
     def tune_distributed(self, hosts=None, hostfile=None, env=None,
                          slots_per_exp=1, timeout=None):
-        """Run the full stage x micro-batch grid as scheduled
-        subprocesses over ``hosts`` ({hostname: slots}) or a reference
-        hostfile; returns the winning ds_config. Requires ``model_spec``
-        (+ optional ``batch_spec``) — the out-of-process workers rebuild
-        the model from the JSON spec."""
+        """Run the stage x micro-batch (x gas x offload) grid as
+        scheduled subprocesses over ``hosts`` ({hostname: slots}) or a
+        reference hostfile; returns the winning ds_config. The same
+        search dims and memory-budget pruning as :meth:`tune` apply —
+        estimator-rejected candidates are recorded without being
+        scheduled. Requires ``model_spec`` (+ optional ``batch_spec``)
+        — the out-of-process workers rebuild the model from the JSON
+        spec."""
         from deepspeed_tpu.autotuning.scheduler import ResourceManager, parse_hostfile
         if self.model_spec is None:
             raise ValueError("tune_distributed needs model_spec (a JSON-able "
@@ -238,26 +245,37 @@ class Autotuner:
         if hosts is None:
             hosts = parse_hostfile(hostfile) if hostfile else {"localhost": 1}
         results_dir = self.results_dir or "autotuning_exps"
-        grid = []  # (stage, mbs, name, exp_dir)
+        self.results = []
+        grid = []  # (stage, mbs, gas, offload, name, exp_dir)
         for stage in self.zero_stages:
-            for mbs in sorted(self.micro_batches):
-                name = f"z{stage}_mbs{mbs}"
-                exp_dir = os.path.join(results_dir, name)
-                os.makedirs(exp_dir, exist_ok=True)
-                exp = {"name": name, "ds_config": self._experiment_config(stage, mbs),
-                       "model": self.model_spec, "batch": self.batch_spec or {},
-                       "steps": self.steps}
-                with open(os.path.join(exp_dir, "exp.json"), "w") as f:
-                    json.dump(exp, f, indent=1)
-                grid.append((stage, mbs, name, exp_dir))
+            for offload in self.offload_candidates:
+                for gas in self.gas_candidates:
+                    for mbs in sorted(self.micro_batches):
+                        if self.memory_budget_bytes is not None and \
+                                self._prune_by_memory(stage, mbs, gas, offload) is not None:
+                            continue
+                        name = f"z{stage}_mbs{mbs}"
+                        if gas is not None:
+                            name += f"_gas{gas}"
+                        if offload is not None:
+                            name += f"_off{int(bool(offload))}"
+                        exp_dir = os.path.join(results_dir, name)
+                        os.makedirs(exp_dir, exist_ok=True)
+                        exp = {"name": name,
+                               "ds_config": self._experiment_config(stage, mbs, gas, offload),
+                               "model": self.model_spec, "batch": self.batch_spec or {},
+                               "steps": self.steps}
+                        with open(os.path.join(exp_dir, "exp.json"), "w") as f:
+                            json.dump(exp, f, indent=1)
+                        grid.append((stage, mbs, gas, offload, name, exp_dir))
         rm = ResourceManager(hosts, results_dir, slots_per_exp=slots_per_exp,
                              env=env, timeout=timeout)
-        rm.schedule_experiments([g[3] for g in grid])
+        rm.schedule_experiments([g[5] for g in grid])
         finished = rm.run()
-        self.results = []
-        for stage, mbs, name, _ in grid:
+        for stage, mbs, gas, offload, name, _ in grid:
             r = finished.get(name, {"value": None, "error": "never ran"})
             self.results.append({"zero_stage": stage, "micro_batch_size": mbs,
+                                 "gas": gas, "offload": offload,
                                  "metric": self.metric, "value": r.get("value"),
                                  "error": r.get("error"),
                                  "step_time_s": r.get("step_time_s")})
@@ -268,7 +286,8 @@ class Autotuner:
         self.results_dir = results_dir
         self.write_results()
         return self._experiment_config(self.best["zero_stage"],
-                                       self.best["micro_batch_size"])
+                                       self.best["micro_batch_size"],
+                                       self.best.get("gas"), self.best.get("offload"))
 
     def write_results(self):
         os.makedirs(self.results_dir, exist_ok=True)

@@ -60,21 +60,33 @@ def activation_bytes_estimate(fn, *args, **kwargs):
 
 
 def estimate_experiment_memory(model_fn, batch_fn, cfg, mbs, world_size=1,
-                               remat_factor=0.25):
+                               remat_factor=0.25, _trace_cache=None):
     """→ dict with per-device byte estimates for one candidate config.
 
     ``remat_factor`` discounts the activation proxy for rematerialized
     models (activation checkpointing re-computes instead of saving most
-    of the forward; 1.0 = everything saved).
-    """
-    model = model_fn()
-    batch = batch_fn(mbs)
-    abstract_batch = tuple(jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
-                           for a in batch)
-    aparams = jax.eval_shape(lambda rng, *b: model.init(rng, *b),
-                             jax.random.PRNGKey(0), *abstract_batch)
-    aparams = aparams["params"] if "params" in aparams else aparams
-    n_params = int(sum(np.prod(x.shape) for x in jax.tree.leaves(aparams)))
+    of the forward; 1.0 = everything saved). ``_trace_cache``: optional
+    dict — (n_params, per-micro activation bytes) are functions of mbs
+    only, so callers sweeping stage/gas/offload should share one cache
+    instead of re-tracing the forward per candidate."""
+    cache_key = mbs
+    cached = _trace_cache.get(cache_key) if _trace_cache is not None else None
+    if cached is not None:
+        n_params, act_per_micro = cached
+    else:
+        model = model_fn()
+        batch = batch_fn(mbs)
+        abstract_batch = tuple(jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+                               for a in batch)
+        aparams = jax.eval_shape(lambda rng, *b: model.init(rng, *b),
+                                 jax.random.PRNGKey(0), *abstract_batch)
+        aparams = aparams["params"] if "params" in aparams else aparams
+        n_params = int(sum(np.prod(x.shape) for x in jax.tree.leaves(aparams)))
+        act_per_micro = int(activation_bytes_estimate(
+            lambda p, *a: model.apply({"params": p}, *a), aparams, *abstract_batch)
+            * remat_factor)
+        if _trace_cache is not None:
+            _trace_cache[cache_key] = (n_params, act_per_micro)
 
     zc = cfg.get("zero_optimization", {}) or {}
     stage = int(zc.get("stage", 0))
@@ -99,9 +111,11 @@ def estimate_experiment_memory(model_fn, batch_fn, cfg, mbs, world_size=1,
         # fp32 master + optimizer moments, ZeRO-1 partitioned from stage 1
         opt_b = n_params * 4 * (1 + n_states) // (world_size if stage >= 1 else 1)
 
-    act_b = int(activation_bytes_estimate(
-        lambda p, *a: model.apply({"params": p}, *a), aparams, *abstract_batch)
-        * remat_factor)
+    # The fused train_batch scans over gas micro-steps; the differentiated
+    # scan saves residuals per micro-step, so saved activations scale
+    # roughly linearly with gradient accumulation.
+    gas = int(cfg.get("gradient_accumulation_steps", 1) or 1)
+    act_b = act_per_micro * gas
 
     total = params_b + grads_b + opt_b + act_b
     return {"n_params": n_params, "params_bytes": params_b, "grads_bytes": grads_b,

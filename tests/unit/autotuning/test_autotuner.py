@@ -161,3 +161,19 @@ def test_gas_and_offload_search_dims():
     combos = {(r["zero_stage"], r["gas"]) for r in tuner.results}
     assert combos == {(1, 1), (1, 2)}
     assert cfg["gradient_accumulation_steps"] in (1, 2)
+
+
+def test_memory_estimate_scales_with_gas_and_caches_traces():
+    """The fused train_batch saves residuals per micro-step, so the
+    activation estimate scales with gradient accumulation; traces are
+    cached per mbs so the sweep costs arithmetic only."""
+    groups.destroy_mesh()
+    tuner = Autotuner(
+        model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        base_config=BASE, batch_fn=batch_fn, world_size=8,
+    )
+    e1 = tuner.estimate_memory(1, 8, gas=1)
+    e8 = tuner.estimate_memory(1, 8, gas=8)
+    assert e8["activation_bytes"] == 8 * e1["activation_bytes"]
+    assert e8["total_bytes"] > e1["total_bytes"]
+    assert list(tuner._mem_trace_cache.keys()) == [8]  # one trace per mbs
