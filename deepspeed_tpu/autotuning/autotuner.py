@@ -197,28 +197,52 @@ class Autotuner:
         self.results.append(record)
         return record
 
-    def tune(self):
-        """Stage-major sweep (x offload x gas dims when configured) with
-        micro-batch hill-climb: within a lane, stop growing the
-        micro-batch after the first failure or regression (the
-        reference's fast tuning-space pruning). Candidates the memory
-        model rejects are recorded as pruned without ever running —
-        no compile, no OOM (crash-prune remains the backstop)."""
-        for stage in self.zero_stages:
-            for offload in self.offload_candidates:
-                for gas in self.gas_candidates:
-                    prev = None
-                    for mbs in sorted(self.micro_batches):
-                        pruned = self._prune_by_memory(stage, mbs, gas, offload)
-                        if pruned is not None:
-                            break  # larger mbs only estimates bigger
-                        rec = self.run_experiment(stage, mbs, gas, offload)
-                        if rec["error"] is not None:
-                            break
-                        if prev is not None and rec["value"] is not None and \
-                                rec["value"] < prev * 0.98:
-                            break
-                        prev = rec["value"]
+    def tune(self, strategy="hillclimb", num_trials=None, seed=0):
+        """Search the stage (x offload x gas) x micro-batch space.
+
+        ``strategy`` mirrors the reference ``tuner/`` package:
+
+        - ``"hillclimb"`` (default; the reference's fast mode): within a
+          lane, stop growing the micro-batch after the first failure or
+          regression.
+        - ``"grid"`` (GridSearchTuner): every candidate runs.
+        - ``"random"`` (RandomTuner): ``num_trials`` candidates sampled
+          without replacement from the full product.
+
+        Candidates the memory model rejects are recorded as pruned
+        without ever running — no compile, no OOM (crash-prune remains
+        the backstop)."""
+        import itertools
+        import random as _random
+        space = [(stage, offload, gas)
+                 for stage in self.zero_stages
+                 for offload in self.offload_candidates
+                 for gas in self.gas_candidates]
+        if strategy in ("grid", "random"):
+            candidates = [(s, m, g, o) for (s, o, g) in space
+                          for m in sorted(self.micro_batches)]
+            if strategy == "random":
+                k = min(num_trials or len(candidates), len(candidates))
+                candidates = _random.Random(seed).sample(candidates, k)
+            for stage, mbs, gas, offload in candidates:
+                if self._prune_by_memory(stage, mbs, gas, offload) is None:
+                    self.run_experiment(stage, mbs, gas, offload)
+        elif strategy == "hillclimb":
+            for stage, offload, gas in space:
+                prev = None
+                for mbs in sorted(self.micro_batches):
+                    pruned = self._prune_by_memory(stage, mbs, gas, offload)
+                    if pruned is not None:
+                        break  # larger mbs only estimates bigger
+                    rec = self.run_experiment(stage, mbs, gas, offload)
+                    if rec["error"] is not None:
+                        break
+                    if prev is not None and rec["value"] is not None and \
+                            rec["value"] < prev * 0.98:
+                        break
+                    prev = rec["value"]
+        else:
+            raise ValueError(f"unknown strategy {strategy!r}: hillclimb | grid | random")
         ok = [r for r in self.results if r["value"] is not None]
         if not ok:
             raise RuntimeError("autotuning: every experiment failed; see results")

@@ -257,13 +257,7 @@ def _init_group_wise_weight_quantization(params, ds_config=None, num_bits=8,
         return QuantizedWeight(v, s, shape, scheme)
 
     qtree = path_tree_map(q_leaf, params)
-
-    def dequant(tree, dtype=jnp.bfloat16):
-        return jax.tree.map(
-            lambda x: x.dequantized(dtype) if isinstance(x, QuantizedWeight) else x,
-            tree, is_leaf=lambda x: isinstance(x, QuantizedWeight))
-
-    return qtree, dequant
+    return qtree, dequantize_tree
 
 
 def quantized_bytes(qtree):

@@ -35,7 +35,8 @@ class InferenceEngineV2:
         if model_config is None:
             model_config = model.config
         self.model_config = model_config
-        if params is None:
+        engine_owns_params = params is None
+        if engine_owns_params:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
             sample = jnp.zeros((1, 8), jnp.int32)
             params = model.init(rng, sample)["params"]
@@ -66,11 +67,15 @@ class InferenceEngineV2:
         qmode = getattr(self._config.quantization, "quantization_mode", "none")
         self._quantized = bool(qmode and qmode != "none")
         if self._quantized:
-            from deepspeed_tpu.inference.quantization import \
-                _init_group_wise_weight_quantization
-            params, _ = _init_group_wise_weight_quantization(
-                params, scheme=qmode, modules=[r"kernel|embed|experts_w"],
-                layout="grouped", dequant_dtype=dtype)
+            # One jitted program (source donated when the engine built the
+            # params itself) so XLA frees each full-precision leaf as its
+            # carrier forms — no full-tree + carriers memory spike.
+            from deepspeed_tpu.inference.quantization.quantization import \
+                quantize_params_tree
+            params = jax.tree.map(jnp.asarray, params)
+            params = jax.jit(
+                lambda p: quantize_params_tree(p, qmode, dequant_dtype=dtype),
+                donate_argnums=(0,) if engine_owns_params else ())(params)
 
         if self.mesh is not None:
             from deepspeed_tpu.inference.v2.sharding import shard_params, tp_rule_for

@@ -41,6 +41,11 @@ class BertConfig:
     position_offset: int = 0          # RoBERTa reserves pad+1 slots
     attention_impl: str = "auto"
     remat: bool = False
+    # ds_config sparse_attention section, frozen to (key, value) tuples so
+    # the config stays hashable (set via SparseAttentionUtils.
+    # replace_model_self_attention_with_sparse_self_attention — the TPU
+    # form of the reference's BERT module surgery)
+    sparse_attention: tuple = None
 
     @property
     def head_dim(self):
@@ -62,11 +67,22 @@ BERT_CONFIGS = {
 }
 
 
-def _attention(q, k, v, attention_mask, impl):
+def _attention(q, k, v, attention_mask, impl, sparse_section=None, max_seq=2048):
     """Bidirectional attention with a [B, S] validity mask. The flash
     path encodes padding as segment ids (pad tokens get their own
-    segment, so valid keys never attend across)."""
+    segment, so valid keys never attend across). With a
+    ``sparse_attention`` section the layout-sparse path runs instead
+    (reference BertSparseSelfAttention, sparse_attention_utils.py:81)."""
     B, S, H, D = q.shape
+    if sparse_section is not None:
+        from deepspeed_tpu.ops.sparse_attention import build_sparse_self_attention
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import thaw_section
+        ssa = build_sparse_self_attention(thaw_section(sparse_section), H,
+                                          max_seq_length=max_seq)
+        kpm = None
+        if attention_mask is not None:
+            kpm = jnp.asarray(attention_mask).reshape(B, S) > 0
+        return ssa(q, k, v, key_padding_mask=kpm)
     from deepspeed_tpu.ops.pallas import use_pallas
     if impl == "auto":
         impl = "flash" if use_pallas() and S >= 256 else "einsum"
@@ -98,7 +114,9 @@ class BertBlock(nn.Module):
         q = nn.Dense(H * Dh, name="q_proj")(h).reshape(B, S, H, Dh)
         k = nn.Dense(H * Dh, name="k_proj")(h).reshape(B, S, H, Dh)
         v = nn.Dense(H * Dh, name="v_proj")(h).reshape(B, S, H, Dh)
-        ctx = _attention(q, k, v, attention_mask, cfg.attention_impl).reshape(B, S, H * Dh)
+        ctx = _attention(q, k, v, attention_mask, cfg.attention_impl,
+                         sparse_section=cfg.sparse_attention,
+                         max_seq=cfg.max_position_embeddings).reshape(B, S, H * Dh)
         ctx = nn.Dense(D, name="o_proj")(ctx)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="attn_layernorm")(h + ctx)
         h = constrain_hidden(h)

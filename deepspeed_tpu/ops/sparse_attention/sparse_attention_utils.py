@@ -75,9 +75,67 @@ def build_sparse_self_attention(ds_config, num_heads, max_seq_length=2048):
     return None if cfg is None else SparseSelfAttention(cfg, max_seq_length=max_seq_length)
 
 
+def freeze_section(section):
+    """ds_config section dict → hashable ``((key, value), ...)`` form
+    (lists become tuples) for storage on frozen model configs."""
+    return tuple(sorted(
+        (k, tuple(v) if isinstance(v, list) else v) for k, v in dict(section).items()))
+
+
+def thaw_section(frozen):
+    """Inverse of :func:`freeze_section`."""
+    return {k: (list(v) if isinstance(v, tuple) else v) for k, v in frozen}
+
+
 class SparseAttentionUtils:
     """Reference-named helpers (sparse_attention_utils.py:14), functional
     over arrays/params instead of torch modules."""
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, max_position=None, sparsity_config=None, ds_config=None):
+        """→ a new model whose encoder blocks run layout-sparse attention
+        (reference sparse_attention_utils.py:81 — BERT/RoBERTa module
+        surgery; on TPU the swap is a config decision the blocks read).
+        Pass either a ``SparsityConfig``-style section dict/``ds_config``
+        or a constructed ``sparsity_config`` (its constructor kwargs are
+        recovered from the instance). Only the bidirectional BERT family
+        is supported, like the reference (block-sparse attention is
+        bidirectional within admitted blocks)."""
+        import dataclasses
+
+        from deepspeed_tpu.models.bert import BertConfig
+        cfg = getattr(model, "config", None)
+        if not isinstance(cfg, BertConfig):
+            raise NotImplementedError(
+                f"sparse self-attention replacement supports the BERT family "
+                f"(bidirectional); got {type(model).__name__} — the reference "
+                f"util is equally BERT-only (sparse_attention_utils.py:86)")
+        if ds_config is not None:
+            # validate (raises on unknown mode/knobs), then keep the RAW
+            # section — instances don't round-trip (BigBird's rng state).
+            # Normalize so the stored form re-parses at apply time: an
+            # enabled-but-empty / mode-less section means fixed defaults.
+            if get_sparse_attention_config(ds_config, cfg.num_attention_heads) is None:
+                raise ValueError("ds_config carries no sparse_attention section")
+            section = dict(ds_config.get("sparse_attention", ds_config) or {})
+            section.setdefault("mode", "fixed")
+        elif sparsity_config is not None:
+            mode = next((m for m, c in MODES.items() if type(sparsity_config) is c), None)
+            if mode is None:
+                raise ValueError(
+                    f"unrecognized sparsity config {type(sparsity_config).__name__}; "
+                    f"pass an instance of one of {sorted(c.__name__ for c in MODES.values())} "
+                    f"or the ds_config section form")
+            section = {"mode": mode,
+                       **{k: v for k, v in vars(sparsity_config).items()
+                          if k != "num_heads" and not k.startswith("_")}}
+        else:
+            raise ValueError("pass sparsity_config or ds_config")
+        new_cfg = dataclasses.replace(
+            cfg, sparse_attention=freeze_section(section),
+            **({"max_position_embeddings": int(max_position)} if max_position else {}))
+        return type(model)(config=new_cfg)
 
     @staticmethod
     def extend_position_embedding(params, max_position, table_key="embed_positions"):

@@ -196,6 +196,18 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 self.unfuse_lora_weight()
         return [out[uid] for uid in range(len(prompts))]
 
+    def save_checkpoint(self, *args, **kwargs):
+        """Checkpoints always persist the UNFUSED view: saving while
+        fused (eval mode) would bake the adapter delta into the frozen
+        base and zero lora_b — silent corruption on resume."""
+        was_fused = self._lora_stash is not None
+        self.unfuse_lora_weight()
+        try:
+            return super().save_checkpoint(*args, **kwargs)
+        finally:
+            if was_fused:
+                self.fuse_lora_weight()
+
     # mode flips (reference eval()/train() on the hybrid module; the
     # reference fuses LoRA for the eval/rollout phase and unfuses when
     # training resumes — hybrid_engine.py:138-146)

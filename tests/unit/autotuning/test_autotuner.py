@@ -177,3 +177,24 @@ def test_memory_estimate_scales_with_gas_and_caches_traces():
     assert e8["activation_bytes"] == 8 * e1["activation_bytes"]
     assert e8["total_bytes"] > e1["total_bytes"]
     assert list(tuner._mem_trace_cache.keys()) == [8]  # one trace per mbs
+
+
+def test_tuner_strategies_grid_and_random():
+    """Strategy parity with the reference tuner/ package: grid runs every
+    candidate; random samples num_trials without the hill-climb."""
+    groups.destroy_mesh()
+    t = Autotuner(model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=1),
+                  base_config=BASE, batch_fn=batch_fn,
+                  micro_batches=[8, 16], zero_stages=[0, 1], steps=1)
+    t.tune(strategy="grid")
+    assert len(t.results) == 4  # full product, no early stop
+
+    groups.destroy_mesh()
+    t2 = Autotuner(model_fn=lambda: SimpleModel(hidden_dim=HIDDEN, nlayers=1),
+                   base_config=BASE, batch_fn=batch_fn,
+                   micro_batches=[8, 16], zero_stages=[0, 1], steps=1)
+    t2.tune(strategy="random", num_trials=2, seed=1)
+    assert len(t2.results) == 2
+
+    with pytest.raises(ValueError, match="unknown strategy"):
+        t2.tune(strategy="nope")

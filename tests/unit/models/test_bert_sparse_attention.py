@@ -1,0 +1,77 @@
+"""Sparse attention wired into the BERT family via ds_config (reference
+sparse_attention_utils.py:81 replace_model_self_attention_with_
+sparse_self_attention — BERT/RoBERTa module surgery; on TPU the swap is
+a config decision the encoder blocks read)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.bert import BERT_CONFIGS, BertForMaskedLM
+from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import FixedSparsityConfig
+
+
+def _data(S=64, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 250, size=(2, S)).astype(np.int32)
+    mask = np.ones((2, S), np.int32)
+    mask[1, S - 10:] = 0  # padded tail on row 1
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+def test_dense_mode_matches_plain_attention():
+    """mode='dense' admits every block: logits equal the einsum path."""
+    model = BertForMaskedLM(BERT_CONFIGS["bert-debug"])
+    ids, mask = _data()
+    params = model.init(jax.random.PRNGKey(0), ids, attention_mask=mask)["params"]
+    want = model.apply({"params": params}, ids, attention_mask=mask)
+
+    sparse = SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, ds_config={"sparse_attention": {"mode": "dense", "block": 16}})
+    assert sparse.config.sparse_attention is not None
+    got = sparse.apply({"params": params}, ids, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_bigbird_mode_runs_and_trains():
+    model = BertForMaskedLM(BERT_CONFIGS["bert-debug"])
+    ids, mask = _data()
+    sparse = SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, ds_config={"sparse_attention": {
+            "mode": "bigbird", "block": 16, "num_random_blocks": 1,
+            "num_sliding_window_blocks": 1, "num_global_blocks": 1}})
+    params = sparse.init(jax.random.PRNGKey(0), ids, attention_mask=mask)["params"]
+    dense_logits = model.apply({"params": params}, ids, attention_mask=mask)
+    sparse_logits = sparse.apply({"params": params}, ids, attention_mask=mask)
+    assert not np.allclose(np.asarray(sparse_logits), np.asarray(dense_logits))
+    labels = jnp.where(ids % 5 == 0, ids, -100)
+
+    def loss_fn(p):
+        return sparse.apply({"params": p}, ids, attention_mask=mask, labels=labels)[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_sparsity_config_instance_and_family_guard():
+    model = BertForMaskedLM(BERT_CONFIGS["bert-debug"])
+    sparse = SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, sparsity_config=FixedSparsityConfig(num_heads=4, block=16,
+                                                   num_local_blocks=2))
+    section = dict(sparse.config.sparse_attention)
+    assert section["mode"] == "fixed" and section["num_local_blocks"] == 2
+    ids, mask = _data()
+    out = sparse.apply({"params": sparse.init(jax.random.PRNGKey(1), ids,
+                                              attention_mask=mask)["params"]},
+                       ids, attention_mask=mask)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+    from deepspeed_tpu.models import build_llama
+    with pytest.raises(NotImplementedError, match="BERT family"):
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            build_llama("debug"), ds_config={"sparse_attention": {"mode": "dense"}})

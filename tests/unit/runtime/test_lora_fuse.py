@@ -136,3 +136,28 @@ class TestHybridEngineLoraFuse:
         np.testing.assert_array_equal(base1, np.asarray(engine.params["up"]["base_kernel"]))
         engine.unfuse_lora_weight()
         engine.unfuse_lora_weight()  # second call is a no-op
+
+    def test_save_checkpoint_while_fused_persists_unfused(self, tmp_path):
+        """eval() fuses; a checkpoint taken then must still hold the
+        UNFUSED view (nonzero lora_b) or resume silently loses adapters."""
+        from deepspeed_tpu.parallel import groups
+        groups.destroy_mesh()
+        engine = self._engine()
+        x, y = _data()
+        loss = engine(jnp.asarray(x), jnp.asarray(y))
+        engine.backward(loss)
+        engine.step()
+        unfused_b = np.asarray(engine.params["up"]["lora_b"])
+        engine.eval()  # fused now
+        assert float(jnp.abs(engine.params["up"]["lora_b"]).max()) == 0.0
+        engine.save_checkpoint(str(tmp_path), tag="f")
+        # still fused after the save (eval mode preserved)
+        assert engine._lora_stash is not None
+        groups.destroy_mesh()
+        e2 = self._engine()
+        l2 = e2(jnp.asarray(x), jnp.asarray(y))
+        e2.backward(l2)
+        e2.step()
+        e2.load_checkpoint(str(tmp_path), tag="f")
+        np.testing.assert_allclose(np.asarray(e2.params["up"]["lora_b"]), unfused_b,
+                                   rtol=1e-6, atol=1e-7)
