@@ -212,7 +212,6 @@ class Autotuner:
         Candidates the memory model rejects are recorded as pruned
         without ever running — no compile, no OOM (crash-prune remains
         the backstop)."""
-        import itertools
         import random as _random
         space = [(stage, offload, gas)
                  for stage in self.zero_stages

@@ -12,11 +12,12 @@ from deepspeed_tpu.compression.basic_layer import (binary_quantize, bits_at_step
                                                     ternary_quantize)
 from deepspeed_tpu.compression.compress import (init_compression, layer_reduction,
                                                  redundancy_clean,
-                                                 structural_channel_prune)
+                                                 structural_channel_prune,
+                                                 structural_head_prune)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
 __all__ = ["init_compression", "redundancy_clean", "layer_reduction",
-           "structural_channel_prune",
+           "structural_channel_prune", "structural_head_prune",
            "ste_quantize", "ternary_quantize", "binary_quantize",
            "quantize_weight_at_bits",
            "sparse_pruning_mask", "row_pruning_mask", "head_pruning_mask",

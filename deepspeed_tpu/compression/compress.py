@@ -78,6 +78,30 @@ def redundancy_clean(params, ds_config, num_heads=None):
     return jax.tree.map(jax.lax.stop_gradient, transform(reduced))
 
 
+def _flat_by_path(params):
+    """{'/'-joined path: leaf} view of a params tree."""
+    flat = {}
+
+    def collect(path, x):
+        flat[path] = x
+        return x
+
+    path_tree_map(collect, params)
+    return flat
+
+
+def _find_one(flat, pattern, suffix):
+    """The single leaf whose path matches ``pattern`` and ends in
+    ``suffix`` — ambiguity is an error, not a guess."""
+    import re
+    hits = [p for p in flat
+            if re.search(pattern, p) and p.split("/")[-1] == suffix]
+    if len(hits) != 1:
+        raise ValueError(f"structural prune: pattern {pattern!r} matched "
+                         f"{len(hits)} '{suffix}' leaves: {hits}")
+    return hits[0]
+
+
 def structural_channel_prune(params, pairs, dense_ratio):
     """True dimension reduction (reference ``LinearLayer_Compress.
     fix_row_col_pruning_helper(dim_reduction=True)``, basic_layer.py:212):
@@ -90,30 +114,14 @@ def structural_channel_prune(params, pairs, dense_ratio):
     shape stays rectangular. Exact (not just masked) when the activation
     between the pair maps 0 -> 0 (gelu/relu/silu) and biases ride along.
     """
-    import re
-
     import numpy as np
 
-    flat = {}
-
-    def collect(path, x):
-        flat[path] = x
-        return x
-
-    path_tree_map(collect, params)
-
-    def find_one(pattern, suffix):
-        hits = [p for p in flat
-                if re.search(pattern, p) and p.split("/")[-1] == suffix]
-        if len(hits) != 1:
-            raise ValueError(f"structural prune: pattern {pattern!r} matched "
-                             f"{len(hits)} '{suffix}' leaves: {hits}")
-        return hits[0]
+    flat = _flat_by_path(params)
 
     replacements = {}
     for producer_pat, consumer_pat in pairs:
-        pk_path = find_one(producer_pat, "kernel")
-        ck_path = find_one(consumer_pat, "kernel")
+        pk_path = _find_one(flat, producer_pat, "kernel")
+        ck_path = _find_one(flat, consumer_pat, "kernel")
         pk = np.asarray(flat[pk_path])
         ck = np.asarray(flat[ck_path])
         c = pk.shape[-1]
@@ -138,3 +146,53 @@ def structural_channel_prune(params, pairs, dense_ratio):
         return replacements.get(path, x)
 
     return path_tree_map(replace, params)
+
+
+def structural_head_prune(params, attention_pattern, num_heads, dense_ratio):
+    """True attention-head reduction (reference
+    ``LinearLayer_Compress.fix_head_pruning_helper(dim_reduction=True)``):
+    score heads by the L1 norm of their o-projection input rows, keep the
+    top ``dense_ratio`` fraction, and SLICE them out of the q/k/v kernels
+    (+ biases) [..., D, H*Dh] and the o kernel [..., H*Dh, D]. Heads are
+    chosen per scan layer with a uniform keep count so stacked shapes stay
+    rectangular. → ``(pruned_params, kept_heads)`` — rebuild the model
+    with ``num_attention_heads=kept_heads`` to consume the tree. Exact
+    (matches the head-masked forward) because heads are independent up to
+    the o-projection. MQA/GQA trees (separate kv head count) are refused:
+    slicing query heads out of a shared kv group changes the grouping."""
+    import numpy as np
+
+    flat = _flat_by_path(params)
+    qk, kk, vk, ok = (_find_one(flat, f"{attention_pattern}.*{n}_proj", "kernel")
+                      for n in ("q", "k", "v", "o"))
+    H = int(num_heads)
+    o = np.asarray(flat[ok])
+    D_out = o.shape[-1]
+    if np.asarray(flat[kk]).shape[-1] != np.asarray(flat[qk]).shape[-1]:
+        raise NotImplementedError(
+            "structural head pruning requires H == Hkv (MHA); GQA/MQA key-value "
+            "grouping would change under query-head slicing")
+    Dh = o.shape[-2] // H
+    keep = max(1, int(round(H * dense_ratio)))
+    lead = o.shape[:-2]
+    n = int(np.prod(lead)) if lead else 1
+    # per-head score from the o-projection input rows (reference attn_ow)
+    per_head = np.abs(o.reshape(n, H, Dh, D_out)).sum(axis=(2, 3))  # [n, H]
+    idx = np.sort(np.argsort(-per_head, axis=-1)[:, :keep], axis=-1)  # [n, keep]
+
+    replacements = {}
+    for path in (qk, kk, vk):
+        w = np.asarray(flat[path])
+        D_in = w.shape[-2]
+        w4 = w.reshape(n, D_in, H, Dh)
+        w4 = np.take_along_axis(w4, idx[:, None, :, None], axis=2)
+        replacements[path] = w4.reshape(lead + (D_in, keep * Dh))
+        b_path = path[:-len("kernel")] + "bias"
+        if b_path in flat:
+            b = np.asarray(flat[b_path]).reshape(n, H, Dh)
+            b = np.take_along_axis(b, idx[:, :, None], axis=1)
+            replacements[b_path] = b.reshape(lead + (keep * Dh,))
+    o4 = np.take_along_axis(o.reshape(n, H, Dh, D_out), idx[:, :, None, None], axis=1)
+    replacements[ok] = o4.reshape(lead + (keep * Dh, D_out))
+
+    return path_tree_map(lambda path, x: replacements.get(path, x), params), keep

@@ -67,15 +67,21 @@ class InferenceEngineV2:
         qmode = getattr(self._config.quantization, "quantization_mode", "none")
         self._quantized = bool(qmode and qmode != "none")
         if self._quantized:
-            # One jitted program (source donated when the engine built the
-            # params itself) so XLA frees each full-precision leaf as its
-            # carrier forms — no full-tree + carriers memory spike.
+            # One jitted program with the source donated so XLA frees each
+            # full-precision leaf as its carrier forms — no full-tree +
+            # carriers memory spike. Donation is safe when the engine owns
+            # the tree: it built the params itself, or every caller leaf is
+            # a host array whose jnp.asarray device copy is exclusively
+            # ours (an existing jax.Array would be returned as-is and must
+            # not be deleted out from under the caller).
             from deepspeed_tpu.inference.quantization.quantization import \
                 quantize_params_tree
+            owns = engine_owns_params or all(
+                not isinstance(leaf, jax.Array) for leaf in jax.tree.leaves(params))
             params = jax.tree.map(jnp.asarray, params)
             params = jax.jit(
                 lambda p: quantize_params_tree(p, qmode, dequant_dtype=dtype),
-                donate_argnums=(0,) if engine_owns_params else ())(params)
+                donate_argnums=(0,) if owns else ())(params)
 
         if self.mesh is not None:
             from deepspeed_tpu.inference.v2.sharding import shard_params, tp_rule_for

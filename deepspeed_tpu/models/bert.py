@@ -46,10 +46,13 @@ class BertConfig:
     # replace_model_self_attention_with_sparse_self_attention — the TPU
     # form of the reference's BERT module surgery)
     sparse_attention: tuple = None
+    # nonzero after structural head pruning: the per-head width no longer
+    # equals hidden_size // num_attention_heads once heads are sliced out
+    head_dim_override: int = 0
 
     @property
     def head_dim(self):
-        return self.hidden_size // self.num_attention_heads
+        return self.head_dim_override or self.hidden_size // self.num_attention_heads
 
 
 BERT_CONFIGS = {

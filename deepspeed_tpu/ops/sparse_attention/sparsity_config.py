@@ -93,6 +93,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
         self.attention = attention
+        self.seed = seed  # kept public so instances round-trip to sections
         self._rng = np.random.RandomState(seed)
 
     def make_layout(self, seq_len):

@@ -200,13 +200,16 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """Checkpoints always persist the UNFUSED view: saving while
         fused (eval mode) would bake the adapter delta into the frozen
         base and zero lora_b — silent corruption on resume."""
-        was_fused = self._lora_stash is not None
+        fused_scaling = self._lora_scaling  # (alpha, r) or None
         self.unfuse_lora_weight()
         try:
             return super().save_checkpoint(*args, **kwargs)
         finally:
-            if was_fused:
-                self.fuse_lora_weight()
+            if fused_scaling is not None:
+                # re-fuse with the SAME scaling the live fuse used, not
+                # the config defaults
+                alpha, r = fused_scaling
+                self.fuse_lora_weight(lora_r=r, lora_alpha=alpha)
 
     # mode flips (reference eval()/train() on the hybrid module; the
     # reference fuses LoRA for the eval/rollout phase and unfuses when

@@ -339,3 +339,51 @@ def test_xtc_ternary_recovery_training():
     assert float(loss) < first * 0.2, (first, float(loss))
     q = np.asarray(sched.params_transform(1)(params)["dense"]["kernel"])
     assert len(np.unique(q)) <= 3
+
+
+def test_structural_head_prune_matches_masked_forward():
+    """Head slicing is exact: the reduced model (fewer heads) equals the
+    head-masked dense forward, on the MHA BERT encoder."""
+    import dataclasses
+    from deepspeed_tpu.compression import structural_head_prune
+    from deepspeed_tpu.models.bert import BERT_CONFIGS, BertForMaskedLM
+    cfg = BERT_CONFIGS["bert-debug"]
+    model = BertForMaskedLM(cfg)
+    rng = np.random.RandomState(11)
+    ids = jnp.asarray(rng.randint(0, 250, size=(2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    pruned, kept = structural_head_prune(params, r"layers", cfg.num_attention_heads,
+                                         dense_ratio=0.5)
+    assert kept == 2
+    qk = pruned["model"]["layers"]["q_proj"]["kernel"]
+    ok = pruned["model"]["layers"]["o_proj"]["kernel"]
+    assert qk.shape[-1] == kept * cfg.head_dim
+    assert ok.shape[-2] == kept * cfg.head_dim
+
+    small = BertForMaskedLM(dataclasses.replace(cfg, num_attention_heads=kept,
+                                            head_dim_override=cfg.head_dim))
+    got = small.apply({"params": pruned}, ids)
+
+    # reference check: dense forward with dropped heads' o-rows zeroed
+    import copy
+    masked = jax.tree.map(lambda x: np.array(x, copy=True), params)
+    o = masked["model"]["layers"]["o_proj"]["kernel"]  # [L, H*Dh, D]
+    L, HD, D = o.shape
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    per_head = np.abs(o.reshape(L, H, Dh, D)).sum(axis=(2, 3))
+    for l in range(L):
+        drop = np.argsort(-per_head[l])[2:]
+        o_l = o[l].reshape(H, Dh, D)
+        o_l[drop] = 0.0
+    want = model.apply({"params": masked}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_structural_head_prune_refuses_gqa():
+    from deepspeed_tpu.compression import structural_head_prune
+    from deepspeed_tpu.models import build_llama
+    model = build_llama("debug", remat=False)  # GQA: H=4, Hkv=2
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(NotImplementedError, match="GQA"):
+        structural_head_prune(params, r"self_attn", 4, 0.5)
