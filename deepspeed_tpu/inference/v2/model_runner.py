@@ -155,57 +155,15 @@ def _moe_mlp(x, p, k, mesh=None):
     masks the non-local ones, and a psum over ('expert', 'tensor')
     combines — expert weights never leave their shard, the serving
     analogue of training's expert-axis dispatch."""
-    from deepspeed_tpu.ops.grouped_gemm import moe_grouped_mlp
+    from deepspeed_tpu.ops.grouped_gemm import dropless_moe_ffn
     gates = jax.nn.softmax(
         (x.astype(jnp.float32) @ p["gate"]["wg"]["kernel"].astype(jnp.float32)), axis=-1)
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
     if k > 1:
         topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
-    T, E = gates.shape
-    w1, w3, w2 = p["experts_w1"], p["experts_w3"], p["experts_w2"]
-    idx_rep = topk_idx.reshape(-1)                        # [T*k]
-
-    if mesh is not None and mesh.size > 1:
-        from deepspeed_tpu.ops.pallas import spec_divides
-        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        ep = sizes.get("expert", 1)
-        col = P("expert", None, "tensor")
-        row = P("expert", "tensor", None)
-        psum_axes = ("expert", "tensor")
-        if not (spec_divides(mesh, col, w1.shape) and spec_divides(mesh, row, w2.shape)):
-            # features replicated over 'tensor': every tensor-shard computes
-            # the full output, so summing over 'tensor' would overcount
-            col = P("expert", None, None)
-            row = P("expert", None, None)
-            psum_axes = ("expert",)
-        if E % ep == 0:
-            def shard_body(x_full, idx, w1s, w3s, w2s):
-                e_local = E // ep
-                off = jax.lax.axis_index("expert") * e_local
-                local = (idx >= off) & (idx < off + e_local)
-                lidx = jnp.where(local, idx - off, 0)
-                x_rep = jnp.repeat(x_full, k, axis=0)
-                out = moe_grouped_mlp(x_rep, lidx, w1s.astype(x_full.dtype),
-                                      w3s.astype(x_full.dtype), w2s.astype(x_full.dtype),
-                                      num_experts=e_local)
-                out = jnp.where(local[:, None], out, 0)
-                # combine partial expert/feature sums in fp32 (also dodges an
-                # XLA:CPU CHECK-crash on bf16 all-reduce inside shard_map)
-                return jax.lax.psum(out.astype(jnp.float32),
-                                    psum_axes).astype(x_full.dtype)
-
-            out_rep = jax.shard_map(
-                shard_body, mesh=mesh, in_specs=(P(), P(), col, col, row),
-                out_specs=P(), axis_names={"expert", "tensor"},
-                check_vma=False)(x, idx_rep, w1, w3, w2)
-            out_k = out_rep.reshape(T, k, -1)
-            return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
-
-    x_rep = jnp.repeat(x, k, axis=0)                      # [T*k, D]
-    out_rep = moe_grouped_mlp(x_rep, idx_rep, w1.astype(x.dtype), w3.astype(x.dtype),
-                              w2.astype(x.dtype), num_experts=E)
-    out_k = out_rep.reshape(T, k, -1)                     # [T, k, D]
-    return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
+    return dropless_moe_ffn(x, topk_idx, topk_vals,
+                            p["experts_w1"], p["experts_w3"], p["experts_w2"],
+                            num_experts=gates.shape[-1], mesh=mesh)
 
 
 def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):

@@ -190,33 +190,24 @@ class MOELayer(nn.Module):
             # Dropless dispatch (reference drop_tokens=False no-drop
             # gather): the serving grouped GEMM (lax.ragged_dot over
             # expert-sorted rows) IS the training dispatch — every token
-            # reaches its full top-k and ragged_dot differentiates.
-            # Expert-axis (ep>1) training uses the capacity path; the
-            # einsum dispatch is what GSPMD turns into the a2a pair.
-            from deepspeed_tpu.ops.grouped_gemm import moe_grouped_mlp
+            # reaches its full top-k and ragged_dot differentiates. Under
+            # an expert-parallel axis the same manual shard_map as v2
+            # serving runs: experts stay on their shard, each shard masks
+            # non-local assignments, psum combines (the gather implied by
+            # the replicated in_spec is over the expert axis only — batch
+            # sharding on data/sequence stays automatic).
+            from deepspeed_tpu.ops.grouped_gemm import dropless_moe_ffn
             from deepspeed_tpu.parallel import groups
             mesh = groups.get_mesh(required=False)
-            if mesh is not None and dict(zip(mesh.axis_names,
-                                             mesh.devices.shape)).get("expert", 1) > 1:
-                raise NotImplementedError(
-                    "drop_tokens=False with an expert-parallel mesh axis is not "
-                    "supported in training yet — dropless needs data-dependent "
-                    "per-expert counts that the static a2a dispatch cannot carry; "
-                    "use drop_tokens=True (capacity routing) under expert "
-                    "parallelism, or ep=1 for dropless")
             topk_w, topk_idx = combine, dispatch  # [T, k] each (gate's dropless form)
             init = nn.initializers.lecun_normal()
             E, I = self.num_experts, self.intermediate_size
             w1 = self.param("experts_w1", init, (E, D, I))
             w3 = self.param("experts_w3", init, (E, D, I))
             w2 = self.param("experts_w2", init, (E, I, D))
-            flat = x.reshape(B * S, D)
-            x_rep = jnp.repeat(flat, self.k, axis=0)        # [T*k, D]
-            out_rep = moe_grouped_mlp(x_rep, topk_idx.reshape(-1),
-                                      w1.astype(x.dtype), w3.astype(x.dtype),
-                                      w2.astype(x.dtype), num_experts=E)
-            out_k = out_rep.reshape(B * S, self.k, D)
-            combined = jnp.einsum("tk,tkd->td", topk_w.astype(x.dtype), out_k)
+            combined = dropless_moe_ffn(x.reshape(B * S, D), topk_idx,
+                                        topk_w.astype(x.dtype),
+                                        w1, w3, w2, num_experts=E, mesh=mesh)
             return combined.reshape(B, S, D), aux_loss
 
         # [E, C, D] expert-major dispatch (XLA inserts token→expert a2a).

@@ -49,6 +49,75 @@ def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation
     return jnp.take(out, unsort, axis=0)
 
 
+def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None):
+    """Post-gate dropless MoE FFN over flat tokens — the one
+    implementation behind BOTH v2 ragged serving and dropless training.
+
+    ``x`` [T, D]; ``topk_idx``/``topk_vals`` [T, k] (weights already
+    renormalized); ``w1``/``w3`` [E, D, I], ``w2`` [E, I, D] → [T, D].
+
+    Without a mesh (or expert/tensor axes of size 1): tokens replicate
+    k×, sort by expert, and ride one grouped GEMM (``lax.ragged_dot``).
+    With expert/tensor axes: a shard_map manual over ONLY those axes —
+    each shard routes every token it holds but masks non-local expert
+    assignments, and a psum over ('expert', 'tensor') combines; expert
+    weights never leave their shard. Other mesh axes (data/sequence
+    batch sharding in training) stay under automatic partitioning, so
+    the gather implied by the replicated in_spec is over the expert
+    axis only. Differentiable end-to-end (ragged_dot has grad rules;
+    psum transposes), so the same dispatch trains Mixtral-style
+    dropless models."""
+    T, k = topk_idx.shape
+    idx_rep = topk_idx.reshape(-1)  # [T*k]
+
+    if mesh is not None and mesh.size > 1:
+        from deepspeed_tpu.ops.pallas import spec_divides
+        from jax.sharding import PartitionSpec as P
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        ep = sizes.get("expert", 1)
+        if ep > 1 or sizes.get("tensor", 1) > 1:
+            E = num_experts
+            col = P("expert", None, "tensor")
+            row = P("expert", "tensor", None)
+            psum_axes = ("expert", "tensor")
+            if not (spec_divides(mesh, col, w1.shape) and spec_divides(mesh, row, w2.shape)):
+                # features replicated over 'tensor': every tensor-shard
+                # computes the full output; summing over it would overcount
+                col = P("expert", None, None)
+                row = P("expert", None, None)
+                psum_axes = ("expert",)
+            if E % ep == 0:
+                def shard_body(x_full, idx, w1s, w3s, w2s):
+                    e_local = E // ep
+                    off = jax.lax.axis_index("expert") * e_local
+                    local = (idx >= off) & (idx < off + e_local)
+                    lidx = jnp.where(local, idx - off, 0)
+                    x_rep = jnp.repeat(x_full, k, axis=0)
+                    out = moe_grouped_mlp(x_rep, lidx, w1s.astype(x_full.dtype),
+                                          w3s.astype(x_full.dtype),
+                                          w2s.astype(x_full.dtype),
+                                          num_experts=e_local)
+                    out = jnp.where(local[:, None], out, 0)
+                    # combine partial expert/feature sums in fp32 (also
+                    # dodges an XLA:CPU CHECK-crash on bf16 all-reduce
+                    # inside shard_map)
+                    return jax.lax.psum(out.astype(jnp.float32),
+                                        psum_axes).astype(x_full.dtype)
+
+                out_rep = jax.shard_map(
+                    shard_body, mesh=mesh, in_specs=(P(), P(), col, col, row),
+                    out_specs=P(), axis_names={"expert", "tensor"},
+                    check_vma=False)(x, idx_rep, w1, w3, w2)
+                out_k = out_rep.reshape(T, k, -1)
+                return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
+
+    x_rep = jnp.repeat(x, k, axis=0)  # [T*k, D]
+    out_rep = moe_grouped_mlp(x_rep, idx_rep, w1.astype(x.dtype), w3.astype(x.dtype),
+                              w2.astype(x.dtype), num_experts=num_experts)
+    out_k = out_rep.reshape(T, k, -1)
+    return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
+
+
 def dense_reference_mlp(x, expert_idx, w_gate, w_up, w_down, activation=jax.nn.silu):
     """O(T*E) dense check: every token through every expert, select own."""
     gate = jnp.einsum("td,edf->tef", x, w_gate)

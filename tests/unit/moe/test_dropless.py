@@ -95,18 +95,35 @@ class TestDroplessRouting:
         dropless = train(False, capacity_factor=0.25)
         assert dropless <= dropped * 1.02, (dropless, dropped)
 
-    def test_expert_parallel_mesh_rejected(self):
+    def test_expert_parallel_dropless_matches_single_shard(self):
+        """Dropless training under an expert-parallel axis: the manual
+        shard_map dispatch (experts stay on their shard, masked local
+        routing, psum combine — the serving mechanism) reproduces the
+        unsharded dropless layer, forward AND gradients."""
         from deepspeed_tpu.parallel import groups
         from deepspeed_tpu.parallel.topology import make_mesh_topology
+        x = _x()
+        layer = MOELayer(num_experts=4, hidden_size=16, intermediate_size=32,
+                         k=2, drop_tokens=False)
         groups.destroy_mesh()
-        mesh = make_mesh_topology(expert=2, data=-1)
-        groups.set_mesh(mesh)
+        params = layer.init(jax.random.PRNGKey(0), x)["params"]
+
+        def loss_fn(p):
+            out, aux = layer.apply({"params": p}, x)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        want_loss, want_grads = jax.value_and_grad(loss_fn)(params)
+        groups.destroy_mesh()
+        groups.set_mesh(make_mesh_topology(expert=2, data=-1))
         try:
-            x = _x()
-            layer = MOELayer(num_experts=4, hidden_size=16, intermediate_size=32,
-                             k=2, drop_tokens=False)
-            with pytest.raises(NotImplementedError, match="drop_tokens=False"):
-                layer.init(jax.random.PRNGKey(0), x)
+            got_loss, got_grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+            np.testing.assert_allclose(float(got_loss), float(want_loss),
+                                       rtol=1e-5, atol=1e-5)
+            for (ka, a), (kb, b) in zip(
+                    jax.tree_util.tree_leaves_with_path(want_grads),
+                    jax.tree_util.tree_leaves_with_path(got_grads)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-4, atol=2e-4, err_msg=str(ka))
         finally:
             groups.destroy_mesh()
 
@@ -146,3 +163,26 @@ class TestGateJitter:
         t2, _ = layer.apply({"params": params}, x, train=True,
                             rngs={"dropout": jax.random.PRNGKey(2)})
         assert not np.allclose(np.asarray(t1), np.asarray(t2))
+
+
+def test_engine_refuses_dropless_with_expert_axis():
+    """The TRAINING engine composition (batch sharded over 'expert')
+    CHECK-crashes XLA when differentiating the dropless shard_map — the
+    engine refuses up front instead of aborting the process; sharded
+    dropless serving and layer-level jit remain supported."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+    groups.destroy_mesh()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_llama("mixtral-debug", moe_drop_tokens=False),
+        config={"train_batch_size": 16, "train_micro_batch_size_per_gpu": 16,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}, "bf16": {"enabled": True},
+                "mesh": {"expert_parallel_size": 2, "data_parallel_size": 4}})
+    ids = np.random.RandomState(0).randint(0, 256, size=(16, 16)).astype(np.int32)
+    try:
+        with pytest.raises(NotImplementedError, match="dropless MoE training"):
+            engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+    finally:
+        groups.destroy_mesh()
