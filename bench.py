@@ -179,32 +179,49 @@ def main():
         layers, hidden = model.config.num_hidden_layers, model.config.hidden_size
         B, S, gas, steps, warmup = 4, 64, 2, 3, 1
 
-    config = {
-        "train_batch_size": B * gas,
-        "train_micro_batch_size_per_gpu": B,
-        "gradient_accumulation_steps": gas,
-        "bf16": {"enabled": True},
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-        "zero_optimization": {"stage": 3},
-        "steps_per_print": 1000000,
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
-
-    rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(0, model.config.vocab_size,
-                                  size=(B * gas, S)).astype(np.int32))
-
-    for _ in range(warmup):
-        engine.train_batch(batch=(ids, ids))
-    jax.block_until_ready(engine.params)
-
-    times = []
-    for _ in range(steps):
-        t0 = time.perf_counter()
-        loss = engine.train_batch(batch=(ids, ids))
+    def run_train_bench(gas):
+        from deepspeed_tpu.parallel import groups
+        groups.destroy_mesh()
+        config = {
+            "train_batch_size": B * gas,
+            "train_micro_batch_size_per_gpu": B,
+            "gradient_accumulation_steps": gas,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 3},
+            "steps_per_print": 1000000,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, model.config.vocab_size,
+                                      size=(B * gas, S)).astype(np.int32))
+        for _ in range(warmup):
+            engine.train_batch(batch=(ids, ids))
         jax.block_until_ready(engine.params)
-        times.append(time.perf_counter() - t0)
-    dt = min(times)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            loss = engine.train_batch(batch=(ids, ids))
+            jax.block_until_ready(engine.params)
+            times.append(time.perf_counter() - t0)
+        return engine, loss, min(times), gas
+
+    oom = False
+    try:
+        engine, loss, dt, gas = run_train_bench(gas)
+    except Exception as e:
+        # retry OUTSIDE the except block: the active exception's traceback
+        # pins run_train_bench's frame (engine + optimizer state) and gc
+        # could not reclaim the failed attempt's HBM before the retry
+        if not (on_tpu and "RESOURCE_EXHAUSTED" in str(e)):
+            raise
+        oom = True
+    if oom:
+        # gas=128 sits near the HBM edge (saved dots stack over gas);
+        # fall back to the wide-margin config rather than losing the run
+        import gc
+        gc.collect()
+        engine, loss, dt, gas = run_train_bench(64)
 
     n_chips = jax.device_count()
     tokens = B * gas * S

@@ -1,0 +1,14 @@
+# Shared sys.path bootstrap for the uninstalled bins, exec'd by each
+# `bin/<tool>` (it cannot be IMPORTED — the whole point is that the repo
+# root is not importable yet; __file__ under exec is the CALLING bin's
+# path, symlink-resolved below). `python bin/<tool>` puts bin/ (not the
+# repo root) on sys.path; this inserts the real repo root and exports it
+# on PYTHONPATH so launcher worker subprocesses
+# (`python -m deepspeed_tpu...`) and remote launches inherit it too.
+import os as _os
+import sys as _sys
+
+_root = _os.path.dirname(_os.path.dirname(_os.path.realpath(__file__)))
+_sys.path.insert(0, _root)
+_os.environ["PYTHONPATH"] = (_root + _os.pathsep + _os.environ["PYTHONPATH"]
+                             if _os.environ.get("PYTHONPATH") else _root)
