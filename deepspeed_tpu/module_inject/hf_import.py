@@ -11,9 +11,9 @@ flax layout and the native models (``models/llama.py``, ``models/gpt.py``,
 checkpoints across unchanged.
 
 Supported model types (``hf_config.model_type``): llama, mistral,
-mixtral*, qwen2 → Llama family; gpt2, gptj, opt, bloom, gpt_neox,
-falcon, phi → GPT family; bert, distilbert (masked-LM checkpoints) →
-BERT family.
+mixtral*, qwen (v1, fused-QKV trust_remote_code layout), qwen2 → Llama
+family; gpt2, gptj, opt, bloom, gpt_neox, falcon, phi → GPT family;
+bert, distilbert (masked-LM checkpoints) → BERT family.
 Weights arrive as a ``state_dict()`` mapping
 or an in-memory HF model; per-layer tensors are stacked on the leading
 scan dim. (*mixtral routing weights are mapped onto the framework's MoE
@@ -101,6 +101,101 @@ def import_llama(state, hf_config):
     if not getattr(hf_config, "tie_word_embeddings", False):
         params["lm_head"] = {"kernel": _t(state["lm_head.weight"])}
     return params
+
+
+def import_qwen(state, hf_config):
+    """HF ``QWenLMHeadModel`` (Qwen v1, trust_remote_code) state_dict →
+    params for :class:`deepspeed_tpu.models.llama.LlamaForCausalLM`.
+
+    Qwen v1 is Llama-shaped with a fused ``attn.c_attn`` QKV (rows
+    ordered q,k,v; bias on QKV only) and a gated MLP where ``w2`` feeds
+    SiLU (the gate) and ``w1`` is the up projection — the reference maps
+    it the same way (``inference/v2/model_implementations/qwen/
+    container.py``: ``mlp.w1→up``, ``mlp.w2→gate``).
+    """
+    L = hf_config.num_hidden_layers
+    H = hf_config.hidden_size
+
+    def split_qkv(i, part):
+        w = _np(state[f"transformer.h.{i}.attn.c_attn.weight"])  # [3H, H]
+        b = _np(state[f"transformer.h.{i}.attn.c_attn.bias"])    # [3H]
+        if w.shape[0] != 3 * H:
+            raise NotImplementedError(
+                f"Qwen c_attn rows {w.shape[0]} != 3*hidden ({3 * H}): projection_size "
+                f"differs from hidden_size, so the row split would silently straddle "
+                f"q/k/v boundaries")
+        j = {"q": 0, "k": 1, "v": 2}[part]
+        return w[j * H:(j + 1) * H].T.copy(), b[j * H:(j + 1) * H]
+
+    attn = {}
+    for name, part in (("q_proj", "q"), ("k_proj", "k"), ("v_proj", "v")):
+        pairs = [split_qkv(i, part) for i in range(L)]
+        attn[name] = {"kernel": np.stack([w for w, _ in pairs]),
+                      "bias": np.stack([b for _, b in pairs])}
+    attn["o_proj"] = {"kernel": _stack(state, "transformer.h.{}.attn.c_proj.weight", L)}
+
+    layers = {
+        "self_attn": attn,
+        "input_layernorm": {"scale": _stack(state, "transformer.h.{}.ln_1.weight", L, _np)},
+        "post_attention_layernorm": {
+            "scale": _stack(state, "transformer.h.{}.ln_2.weight", L, _np)},
+        "mlp": {
+            # HF Qwen MLP: c_proj(w1(x) * silu(w2(x))) — w2 is the gate
+            "gate_proj": {"kernel": _stack(state, "transformer.h.{}.mlp.w2.weight", L)},
+            "up_proj": {"kernel": _stack(state, "transformer.h.{}.mlp.w1.weight", L)},
+            "down_proj": {"kernel": _stack(state, "transformer.h.{}.mlp.c_proj.weight", L)},
+        },
+    }
+    return {
+        "model": {
+            "embed_tokens": _np(state["transformer.wte.weight"]),
+            "layers": layers,
+            "norm": {"scale": _np(state["transformer.ln_f.weight"])},
+        },
+        "lm_head": {"kernel": _t(state["lm_head.weight"])},
+    }
+
+
+def qwen_config_from_hf(hf_config, **overrides):
+    """Qwen-v1 HF config → LlamaConfig. Notes: Qwen counts BOTH gated-MLP
+    halves in ``intermediate_size`` (the reference halves it too,
+    ``qwen/model.py:71``); KV heads derive from ``kv_channels``; rotary
+    base lives in ``rotary_emb_base``. Exact for sequences within
+    ``seq_length`` — beyond it HF Qwen switches on dynamic-NTK/logn-attn
+    scaling, which only activates past that boundary."""
+    from deepspeed_tpu.models.llama import LlamaConfig
+    if getattr(hf_config, "no_bias", True) is False:
+        raise NotImplementedError(
+            "Qwen with no_bias=False (biases on all projections) does not map onto "
+            "the native Llama layout (bias on QKV only)")
+    if getattr(hf_config, "rotary_pct", 1.0) != 1.0:
+        raise NotImplementedError(
+            f"Qwen with rotary_pct={hf_config.rotary_pct} (partial rotary) has no "
+            f"exact native mapping — logits would diverge at every position")
+    max_pos = getattr(hf_config, "seq_length", None) or \
+        getattr(hf_config, "max_position_embeddings", 2048)
+    kv_channels = getattr(hf_config, "kv_channels",
+                          hf_config.hidden_size // hf_config.num_attention_heads)
+    if kv_channels * hf_config.num_attention_heads != hf_config.hidden_size:
+        # Qwen v1 is MHA by construction; anything else also breaks the
+        # fused c_attn row split above — refuse loudly.
+        raise NotImplementedError(
+            f"Qwen with kv_channels*heads != hidden_size "
+            f"({kv_channels}*{hf_config.num_attention_heads} != "
+            f"{hf_config.hidden_size}) does not map onto the MHA layout")
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size // 2,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=hf_config.num_attention_heads,
+        max_position_embeddings=max_pos,
+        rms_norm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-6),
+        rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+        tie_word_embeddings=False,
+        attention_bias=True,
+        **overrides)
 
 
 def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
@@ -733,6 +828,9 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         from deepspeed_tpu.models.llama import LlamaForCausalLM
         cfg = llama_config_from_hf(hf_config, ignore_sliding_window=ignore_sliding_window)
         return LlamaForCausalLM(cfg), import_llama(state, hf_config)
+    if mt == "qwen":
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        return LlamaForCausalLM(qwen_config_from_hf(hf_config)), import_qwen(state, hf_config)
     if mt == "gpt2":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt2(state, hf_config)
@@ -771,4 +869,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('gpt2', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")
+        f"{_LLAMA_TYPES + ('qwen', 'gpt2', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

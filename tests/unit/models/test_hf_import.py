@@ -179,6 +179,80 @@ class TestHFImportParity:
             max_position_embeddings=64)
         _check(transformers.DistilBertForMaskedLM(cfg), IDS)
 
+    def test_qwen_v1_fused_qkv_layout(self):
+        """Qwen v1 (trust_remote_code — not constructible via transformers):
+        verify the fused c_attn split and the w1/w2 up-gate assignment
+        structurally against the known-good unfused llama import, then run
+        the imported model forward."""
+        from deepspeed_tpu.module_inject.hf_import import (
+            import_qwen, qwen_config_from_hf, import_llama)
+
+        rng = np.random.RandomState(7)
+        L, H, F2, V = 2, 32, 128, 120  # F2 = BOTH gated halves (Qwen convention)
+        F = F2 // 2
+
+        def r(*shape):
+            return rng.randn(*shape).astype(np.float32) * 0.05
+
+        qwen_state, llama_state = {}, {}
+        qwen_state["transformer.wte.weight"] = llama_state["model.embed_tokens.weight"] = r(V, H)
+        qwen_state["transformer.ln_f.weight"] = llama_state["model.norm.weight"] = r(H)
+        qwen_state["lm_head.weight"] = llama_state["lm_head.weight"] = r(V, H)
+        for i in range(L):
+            q, k, v = r(H, H), r(H, H), r(H, H)
+            qb, kb, vb = r(H), r(H), r(H)
+            qwen_state[f"transformer.h.{i}.attn.c_attn.weight"] = np.concatenate([q, k, v])
+            qwen_state[f"transformer.h.{i}.attn.c_attn.bias"] = np.concatenate([qb, kb, vb])
+            for n, w, b in (("q", q, qb), ("k", k, kb), ("v", v, vb)):
+                llama_state[f"model.layers.{i}.self_attn.{n}_proj.weight"] = w
+                llama_state[f"model.layers.{i}.self_attn.{n}_proj.bias"] = b
+            o = r(H, H)
+            qwen_state[f"transformer.h.{i}.attn.c_proj.weight"] = o
+            llama_state[f"model.layers.{i}.self_attn.o_proj.weight"] = o
+            ln1, ln2 = r(H), r(H)
+            qwen_state[f"transformer.h.{i}.ln_1.weight"] = ln1
+            qwen_state[f"transformer.h.{i}.ln_2.weight"] = ln2
+            llama_state[f"model.layers.{i}.input_layernorm.weight"] = ln1
+            llama_state[f"model.layers.{i}.post_attention_layernorm.weight"] = ln2
+            up, gate, down = r(F, H), r(F, H), r(H, F)
+            qwen_state[f"transformer.h.{i}.mlp.w1.weight"] = up      # w1 = up
+            qwen_state[f"transformer.h.{i}.mlp.w2.weight"] = gate    # w2 = gate (SiLU side)
+            qwen_state[f"transformer.h.{i}.mlp.c_proj.weight"] = down
+            llama_state[f"model.layers.{i}.mlp.up_proj.weight"] = up
+            llama_state[f"model.layers.{i}.mlp.gate_proj.weight"] = gate
+            llama_state[f"model.layers.{i}.mlp.down_proj.weight"] = down
+
+        class QwenCfg:
+            model_type = "qwen"
+            vocab_size, hidden_size, intermediate_size = V, H, F2
+            num_hidden_layers, num_attention_heads = L, 4
+            kv_channels = H // 4
+            seq_length = 64
+            layer_norm_epsilon = 1e-6
+            rotary_emb_base = 10000.0
+            no_bias = True
+
+        class LlamaCfg:
+            model_type = "llama"
+            vocab_size, hidden_size, intermediate_size = V, H, F
+            num_hidden_layers, num_attention_heads = L, 4
+            num_key_value_heads = 4
+            max_position_embeddings = 64
+            rms_norm_eps = 1e-6
+            rope_theta = 10000.0
+            tie_word_embeddings = False
+            attention_bias = True
+
+        got = import_qwen(qwen_state, QwenCfg)
+        want = import_llama(llama_state, LlamaCfg)
+        jax.tree.map(np.testing.assert_array_equal, got, want)
+
+        cfg = qwen_config_from_hf(QwenCfg)
+        assert cfg.intermediate_size == F and cfg.num_key_value_heads == 4
+        model, params = from_hf(qwen_state, hf_config=QwenCfg)
+        logits = _ours_logits(model, params, IDS)
+        assert np.isfinite(logits).all() and logits.shape == (2, 12, V)
+
     def test_engine_trains_imported_model(self):
         """The imported (model, params) drop straight into initialize()."""
         import deepspeed_tpu
