@@ -116,7 +116,7 @@ def import_qwen(state, hf_config):
     L = hf_config.num_hidden_layers
     H = hf_config.hidden_size
 
-    def split_qkv(i, part):
+    def split_qkv(i):
         w = _np(state[f"transformer.h.{i}.attn.c_attn.weight"])  # [3H, H]
         b = _np(state[f"transformer.h.{i}.attn.c_attn.bias"])    # [3H]
         if w.shape[0] != 3 * H:
@@ -124,14 +124,12 @@ def import_qwen(state, hf_config):
                 f"Qwen c_attn rows {w.shape[0]} != 3*hidden ({3 * H}): projection_size "
                 f"differs from hidden_size, so the row split would silently straddle "
                 f"q/k/v boundaries")
-        j = {"q": 0, "k": 1, "v": 2}[part]
-        return w[j * H:(j + 1) * H].T.copy(), b[j * H:(j + 1) * H]
+        return [(w[j * H:(j + 1) * H].T.copy(), b[j * H:(j + 1) * H]) for j in range(3)]
 
-    attn = {}
-    for name, part in (("q_proj", "q"), ("k_proj", "k"), ("v_proj", "v")):
-        pairs = [split_qkv(i, part) for i in range(L)]
-        attn[name] = {"kernel": np.stack([w for w, _ in pairs]),
-                      "bias": np.stack([b for _, b in pairs])}
+    per_layer = [split_qkv(i) for i in range(L)]
+    attn = {name: {"kernel": np.stack([per_layer[i][j][0] for i in range(L)]),
+                   "bias": np.stack([per_layer[i][j][1] for i in range(L)])}
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj"))}
     attn["o_proj"] = {"kernel": _stack(state, "transformer.h.{}.attn.c_proj.weight", L)}
 
     layers = {

@@ -1637,11 +1637,10 @@ class DeepSpeedEngine:
                     cur = self.scaler_state[k]
                     self.scaler_state[k] = jnp.asarray(v, getattr(cur, "dtype", jnp.float32))
 
-    def _apply_universal(self, udir):
-        from deepspeed_tpu.checkpoint.universal import load_universal_metadata, read_universal_param
-        if self._host_offload is not None:
-            raise NotImplementedError("universal checkpoint load with offload_optimizer is not "
-                                      "supported yet; load the sharded checkpoint directly")
+    def _load_universal_index(self, udir):
+        """Shared universal-load prologue: read + apply metadata, then
+        validate the param index covers the model with matching shapes."""
+        from deepspeed_tpu.checkpoint.universal import load_universal_metadata
         meta = load_universal_metadata(udir)
         self._apply_universal_metadata(meta)
         index = meta.get("params", {})
@@ -1649,6 +1648,17 @@ class DeepSpeedEngine:
         missing = [p for p in named if p not in index]
         if missing:
             raise KeyError(f"universal checkpoint missing {len(missing)} params (e.g. {missing[:5]})")
+        for p, cur in named.items():
+            if tuple(index[p]["shape"]) != tuple(cur.shape):
+                raise ValueError(f"universal param {p}: checkpoint shape {index[p]['shape']} "
+                                 f"!= model shape {tuple(cur.shape)}")
+        return meta, index, named
+
+    def _apply_universal(self, udir):
+        from deepspeed_tpu.checkpoint.universal import read_universal_param
+        if self._host_offload is not None:
+            return self._apply_universal_offload(udir)
+        meta, index, named = self._load_universal_index(udir)
 
         mixed = self.master_params is not self.params
         params_treedef = jax.tree.structure(self.params)
@@ -1686,6 +1696,33 @@ class DeepSpeedEngine:
                     cur = self.opt_state[k]
                     self.opt_state[k] = jax.device_put(
                         np.asarray(scalars[k]).astype(cur.dtype), cur.sharding)
+
+    def _apply_universal_offload(self, udir):
+        """Universal checkpoint → host-offload optimizer state: the fp32
+        consolidated params become the host master copy, moments refill
+        the flat host (or NVMe-swapped) state regions, and compute-dtype
+        device params are rebuilt from the master (reference loads
+        universal hp state into stage_1_and_2's CPU partitions the same
+        way, universal_checkpoint.py:22 load_hp_checkpoint_state)."""
+        from deepspeed_tpu.checkpoint.universal import read_universal_param
+        ho = self._host_offload
+        meta, index, named = self._load_universal_index(udir)
+
+        master = {p: read_universal_param(udir, p) for p in named}
+        ho.load_master(match_named_tree(master, self.params))
+
+        state = {"step": np.asarray(
+            meta.get("optimizer_scalars", {}).get("step", ho.step_count), np.int32)}
+        for mk in ho.state_names:
+            vals = {}
+            for p, cur in named.items():
+                if mk in index[p].get("moments", []):
+                    vals[p] = read_universal_param(udir, p, name=mk)
+                else:
+                    vals[p] = np.zeros(tuple(cur.shape), np.float32)
+            state[mk] = match_named_tree(vals, self.params)
+        ho.load_state(state)
+        self.params = ho.current_params()
 
     def compile(self, backend=None, compile_kwargs=None):
         """torch.compile parity (reference engine.py:3612 ``compile``):

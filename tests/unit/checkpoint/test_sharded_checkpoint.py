@@ -196,3 +196,43 @@ def test_zero_to_fp32_lazy(tmp_path):
     leaf = lazy["classifier"]["bias"]
     assert callable(leaf)
     assert leaf().shape == (HIDDEN,)
+
+
+def test_universal_checkpoint_offload_both_directions(tmp_path):
+    """Universal checkpoints cross the offload boundary: a plain run's
+    universal loads into an offload_optimizer engine (master + moments
+    refill the host flat regions) and an offload run's universal loads
+    into a plain engine — trajectories continue identically either way
+    (reference loads universal hp state into stage_1_and_2's partitions,
+    universal_checkpoint.py:22)."""
+    e1 = make_engine(stage=2)
+    train(e1, 3)
+    e1.save_checkpoint(str(tmp_path / "ck"), tag="u")
+    cont1 = train(e1, 3)
+    udir = str(tmp_path / "universal")
+    ds_to_universal(str(tmp_path / "ck"), udir, tag="u")
+
+    # plain → offload
+    e2 = make_engine(extra_cfg={
+        "checkpoint": {"load_universal": True},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}}})
+    train(e2, 1)  # materialize (overwritten by load)
+    load_path, _ = e2.load_checkpoint(udir)
+    assert load_path is not None
+    assert e2._host_offload is not None
+    assert int(e2._host_offload.step_count) == 3
+    cont2 = train(e2, 3)
+    assert np.allclose(cont1, cont2, rtol=1e-4, atol=1e-5), f"{cont1} vs {cont2}"
+
+    # offload → plain
+    e2.save_checkpoint(str(tmp_path / "ck2"), tag="w")
+    cont3 = train(e2, 2)
+    udir2 = str(tmp_path / "universal2")
+    ds_to_universal(str(tmp_path / "ck2"), udir2, tag="w")
+    e3 = make_engine(stage=3, extra_cfg={"checkpoint": {"load_universal": True}})
+    train(e3, 1)
+    load_path, _ = e3.load_checkpoint(udir2)
+    assert load_path is not None
+    cont4 = train(e3, 2)
+    assert np.allclose(cont3, cont4, rtol=1e-4, atol=1e-5), f"{cont3} vs {cont4}"
