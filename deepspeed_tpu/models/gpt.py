@@ -58,6 +58,10 @@ class GPTConfig:
     embedding_layernorm: bool = False    # Bloom: LN right after the embedding
     activation: str = "gelu"             # "gelu" | "gelu_new" | "relu"
     attention_bias: bool = True
+    # GPT-Neo: bias-free q/k/v with a biased out_proj. None → attention_bias.
+    attention_qkv_bias: "bool | None" = None
+    # softmax scale override; None → 1/sqrt(head_dim). GPT-Neo: 1.0 (unscaled).
+    attention_softmax_scale: "float | None" = None
     mlp_bias: bool = True
     lm_head_bias: bool = False           # Phi: biased untied head
     tie_word_embeddings: bool = True
@@ -195,9 +199,14 @@ class GPTAttention(nn.Module):
         B, S, D = h.shape
         H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
-        q = nn.Dense(H * Dh, use_bias=cfg.attention_bias, name="q_proj")(h).reshape(B, S, H, Dh)
-        k = nn.Dense(Hkv * Dh, use_bias=cfg.attention_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
-        v = nn.Dense(Hkv * Dh, use_bias=cfg.attention_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
+        qkv_bias = cfg.attention_bias if cfg.attention_qkv_bias is None else cfg.attention_qkv_bias
+        q = nn.Dense(H * Dh, use_bias=qkv_bias, name="q_proj")(h).reshape(B, S, H, Dh)
+        k = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
+        v = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
+        if cfg.attention_softmax_scale is not None:
+            # every attention impl divides by sqrt(head_dim); pre-scaling q
+            # realises any other softmax scale without touching the kernels
+            q = q * jnp.asarray(cfg.attention_softmax_scale * math.sqrt(Dh), q.dtype)
 
         if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
             rd = cfg.rotary_dim

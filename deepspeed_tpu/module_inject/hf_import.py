@@ -302,6 +302,44 @@ def import_gpt2(state, hf_config):
     }}
 
 
+def import_gpt_neo(state, hf_config):
+    """HF ``GPTNeoForCausalLM`` state_dict → params for the native GPT
+    family: gpt2-shaped (learned positions, pre-LN) but with unfused
+    bias-free q/k/v ``nn.Linear`` projections (out_proj keeps its bias)
+    and unscaled attention (reference container:
+    ``module_inject/containers/gptneo.py``)."""
+    L = hf_config.num_layers
+
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": _stack(state, "transformer.h.{}.attn.attention.q_proj.weight", L)},
+            "k_proj": {"kernel": _stack(state, "transformer.h.{}.attn.attention.k_proj.weight", L)},
+            "v_proj": {"kernel": _stack(state, "transformer.h.{}.attn.attention.v_proj.weight", L)},
+            "o_proj": {"kernel": _stack(state, "transformer.h.{}.attn.attention.out_proj.weight", L),
+                       "bias": _stack(state, "transformer.h.{}.attn.attention.out_proj.bias", L, _np)},
+        },
+        "input_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_1.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_1.bias", L, _np)}},
+        "post_attention_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_2.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_2.bias", L, _np)}},
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "transformer.h.{}.mlp.c_fc.weight", L),
+                      "bias": _stack(state, "transformer.h.{}.mlp.c_fc.bias", L, _np)},
+            "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.c_proj.weight", L),
+                       "bias": _stack(state, "transformer.h.{}.mlp.c_proj.bias", L, _np)},
+        },
+    }
+    return {"model": {
+        "embed_tokens": _np(state["transformer.wte.weight"]),
+        "embed_positions": _np(state["transformer.wpe.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
+                            "bias": _np(state["transformer.ln_f.bias"])},
+    }}
+
+
 def import_opt(state, hf_config):
     if hf_config.word_embed_proj_dim != hf_config.hidden_size:
         raise NotImplementedError(
@@ -404,9 +442,29 @@ def import_bloom(state, hf_config):
     }}
 
 
-def gpt_config_from_hf(hf_config, **overrides):
+def gpt_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
     from deepspeed_tpu.models.gpt import GPTConfig
     mt = hf_config.model_type
+    if mt == "gpt_neo":
+        att_layers = list(getattr(hf_config, "attention_layers", []))
+        window = getattr(hf_config, "window_size", 256)
+        if "local" in att_layers and not ignore_sliding_window:
+            raise NotImplementedError(
+                f"GPT-Neo local attention layers (window_size={window}): the native "
+                f"model attends fully causally, so logits diverge past the window. "
+                f"Pass ignore_sliding_window=True to accept full-attention semantics "
+                f"(exact for sequences <= {window} tokens)")
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                         intermediate_size=hf_config.intermediate_size or 4 * hf_config.hidden_size,
+                         num_hidden_layers=hf_config.num_layers,
+                         num_attention_heads=hf_config.num_heads,
+                         num_key_value_heads=hf_config.num_heads,
+                         max_position_embeddings=hf_config.max_position_embeddings,
+                         activation=_hf_activation(hf_config.activation_function),
+                         layer_norm_eps=hf_config.layer_norm_epsilon,
+                         attention_qkv_bias=False,
+                         attention_softmax_scale=1.0,
+                         **overrides)
     if mt == "gpt2":
         return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
                          intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
@@ -832,6 +890,10 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "gpt2":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt2(state, hf_config)
+    if mt == "gpt_neo":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        cfg = gpt_config_from_hf(hf_config, ignore_sliding_window=ignore_sliding_window)
+        return GPTForCausalLM(cfg), import_gpt_neo(state, hf_config)
     if mt == "opt":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_opt(state, hf_config)
@@ -867,4 +929,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('qwen', 'gpt2', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")
+        f"{_LLAMA_TYPES + ('qwen', 'gpt2', 'gpt_neo', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

@@ -1703,25 +1703,21 @@ class DeepSpeedEngine:
         the flat host (or NVMe-swapped) state regions, and compute-dtype
         device params are rebuilt from the master (reference loads
         universal hp state into stage_1_and_2's CPU partitions the same
-        way, universal_checkpoint.py:22 load_hp_checkpoint_state)."""
-        from deepspeed_tpu.checkpoint.universal import read_universal_param
+        way, universal_checkpoint.py:22 load_hp_checkpoint_state). State
+        streams into the flat host regions one parameter at a time — no
+        second full-model host copy for exactly the engines sized to
+        need offloading."""
+        from deepspeed_tpu.checkpoint.universal import read_universal_param, ZERO_FP32
         ho = self._host_offload
         meta, index, named = self._load_universal_index(udir)
-
-        master = {p: read_universal_param(udir, p) for p in named}
-        ho.load_master(match_named_tree(master, self.params))
-
-        state = {"step": np.asarray(
-            meta.get("optimizer_scalars", {}).get("step", ho.step_count), np.int32)}
-        for mk in ho.state_names:
-            vals = {}
-            for p, cur in named.items():
-                if mk in index[p].get("moments", []):
-                    vals[p] = read_universal_param(udir, p, name=mk)
-                else:
-                    vals[p] = np.zeros(tuple(cur.shape), np.float32)
-            state[mk] = match_named_tree(vals, self.params)
-        ho.load_state(state)
+        unmapped = [p for p in named if p not in set(ho.paths)]
+        if unmapped:
+            raise KeyError(f"universal load: {len(unmapped)} params have no offload "
+                           f"region (e.g. {unmapped[:3]})")
+        ho.load_from_reader(
+            read=lambda p, mk: read_universal_param(udir, p, name=mk or ZERO_FP32),
+            moments_of=lambda p: index[p].get("moments", []),
+            step=meta.get("optimizer_scalars", {}).get("step"))
         self.params = ho.current_params()
 
     def compile(self, backend=None, compile_kwargs=None):

@@ -313,6 +313,31 @@ class HostOffloadOptimizer:
             else:
                 self.state_flat[name][:] = flat
 
+    def load_from_reader(self, read, moments_of, step=None):
+        """Stream checkpoint state into the flat regions one parameter at
+        a time: ``read(path, name)`` returns the fp32 array for a param's
+        master (``name=None``) or moment; ``moments_of(path)`` lists the
+        moment names the checkpoint has for it (absent moments zero-fill).
+        Peak host memory = one parameter (plus one flat buffer when the
+        moments are NVMe-swapped), never a second full model copy."""
+        if step is not None:
+            self.step_count = int(step)
+        pos = {p: i for i, p in enumerate(self.paths)}
+        for p, i in pos.items():
+            region = self.master_flat[self.offsets[i]:self.offsets[i + 1]]
+            region[:] = np.asarray(read(p, None), np.float32).ravel()
+        buf = np.empty(self.numel, np.float32) if self.swapper else None
+        for mk in self.state_names:
+            dst = buf if self.swapper else self.state_flat[mk]
+            for p, i in pos.items():
+                region = dst[self.offsets[i]:self.offsets[i + 1]]
+                if mk in moments_of(p):
+                    region[:] = np.asarray(read(p, mk), np.float32).ravel()
+                else:
+                    region[:] = 0.0
+            if self.swapper:
+                self.swapper.write_full(mk, dst)
+
     def load_master(self, master_tree):
         flat = np.concatenate([np.asarray(x, np.float32).ravel() for x in jax.tree.leaves(master_tree)])
         assert flat.size == self.numel

@@ -179,6 +179,30 @@ class TestHFImportParity:
             max_position_embeddings=64)
         _check(transformers.DistilBertForMaskedLM(cfg), IDS)
 
+    def test_gpt_neo_unscaled_attention(self):
+        """GPT-Neo: bias-free q/k/v, biased out_proj, NO 1/sqrt(d) softmax
+        scale — exact logit parity against transformers."""
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position_embeddings=64,
+            attention_types=[[["global"], 2]])
+        _check(transformers.GPTNeoForCausalLM(cfg), IDS)
+
+    def test_gpt_neo_local_attention_window_gate(self):
+        """Alternating local layers refuse without ignore_sliding_window;
+        with it, logits are exact for sequences within the window."""
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position_embeddings=64,
+            attention_types=[[["global", "local"], 1]], window_size=16)
+        hf = transformers.GPTNeoForCausalLM(cfg)
+        hf.eval()
+        with pytest.raises(NotImplementedError, match="local attention"):
+            from_hf(hf)
+        model, params = from_hf(hf, ignore_sliding_window=True)
+        np.testing.assert_allclose(_ours_logits(model, params, IDS),
+                                   _hf_logits(hf, IDS), **TOL)
+
     def test_qwen_v1_fused_qkv_layout(self):
         """Qwen v1 (trust_remote_code — not constructible via transformers):
         verify the fused c_attn split and the w1/w2 up-gate assignment
