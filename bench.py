@@ -119,6 +119,71 @@ def bench_serving_2b(dtype="bf16"):
             "note": "e2e = prefill(B x prompt_len) + new decode steps in one program"}
 
 
+def bench_serving_v2_ragged():
+    """v2 ragged continuous-batching throughput on the same ~2.5B model
+    (reference FastGen headline surface): Dynamic SplitFuse schedules
+    mixed prefill-chunk + decode batches into one compiled ragged step;
+    greedy sampling runs on device so each step ships one int32 per
+    sequence to the host. Per-step host scheduling crosses the tunnel
+    once per step — on a production host that dispatch is local."""
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, DynamicSplitFuseScheduler,
+                                            InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+
+    groups.destroy_mesh()
+    # GQA shape (24 q heads / 8 KV heads): the modern serving layout, and
+    # 8-sublane-aligned so the Pallas paged-decode kernel engages (20-head
+    # MHA pools fall back to the XLA gather path — see
+    # ops/pallas/paged_attention.kernel_supported)
+    model = build_llama("7b", hidden_size=3072, intermediate_size=8192,
+                        num_hidden_layers=22, num_attention_heads=24,
+                        num_key_value_heads=8, max_position_embeddings=2048,
+                        vocab_size=32000, remat=False)
+    n_req, prompt_len, new_tokens, budget = 16, 128, 64, 512
+    cfg = RaggedInferenceEngineConfig(
+        kv_block_size=32,
+        state_manager=DSStateManagerConfig(
+            max_ragged_batch_size=budget,
+            max_ragged_sequence_count=n_req,
+            max_tracked_sequences=n_req,
+            max_context=prompt_len + new_tokens))
+    engine = InferenceEngineV2(model=model, config=cfg)
+    rng = np.random.RandomState(0)
+
+    def run(n, plen, ntok):
+        sched = DynamicSplitFuseScheduler(engine, token_budget=budget, max_burst=16)
+        for uid in range(n):
+            sched.add_request(uid, rng.randint(0, 32000, size=plen).astype(np.int32),
+                              max_new_tokens=ntok)
+        steps = 0
+        while sched.has_work:
+            sched.step()  # finished sequences are flushed by the scheduler
+            steps += 1
+        return steps
+
+    # compile both padded put shapes + the power-of-two burst programs
+    # (16/8/4/2) the timed run will use, and warm the pool
+    run(2, 16, 32)
+    t0 = time.perf_counter()
+    steps = run(n_req, prompt_len, new_tokens)
+    dt = time.perf_counter() - t0
+    gen = n_req * new_tokens
+    total = n_req * (prompt_len + new_tokens)
+    n_params = _param_count(engine.params)
+    if hasattr(engine, "destroy"):
+        engine.destroy()
+    return {"params": n_params, "requests": n_req, "prompt_len": prompt_len,
+            "new_tokens": new_tokens, "token_budget": budget, "steps": steps,
+            "gen_tokens_per_sec": round(gen / dt, 1),
+            "total_tokens_per_sec": round(total / dt, 1),
+            "time_s": round(dt, 2),
+            "note": "continuous batching via Dynamic SplitFuse; greedy sampled on "
+                    "device; 16-step decode bursts (one compiled scan per burst) "
+                    "cut host syncs 16x — each remaining sync still crosses the "
+                    "~70ms tunnel RTT, which a production PCIe host does not pay"}
+
+
 def bench_offload_probe():
     """Host-offload mechanics on the real chip + the honest bandwidth
     story (see module docstring)."""
@@ -230,7 +295,7 @@ def main():
     model_flops = 6.0 * n_params * tokens + 12.0 * layers * S * hidden * tokens
     mfu = model_flops / dt / (n_chips * _peak_flops(jax.devices()[0]))
 
-    serving_2b = serving_2b_int8 = offload = None
+    serving_2b = serving_2b_int8 = serving_v2 = offload = None
     if on_tpu:
         import gc
         del engine  # free the training HBM before the 2.5B serving build
@@ -244,6 +309,12 @@ def main():
             serving_2b_int8 = bench_serving_2b(dtype="int8")
         except Exception as e:
             serving_2b_int8 = {"error": f"{type(e).__name__}: {e}"[:300]}
+        gc.collect()
+        try:
+            serving_v2 = bench_serving_v2_ragged()
+        except Exception as e:
+            serving_v2 = {"error": f"{type(e).__name__}: {e}"[:300]}
+        gc.collect()
         try:
             offload = bench_offload_probe()
         except Exception as e:
@@ -268,6 +339,7 @@ def main():
             "n_chips": n_chips,
             "serving_2b": serving_2b,
             "serving_2b_int8": serving_2b_int8,
+            "serving_v2_ragged": serving_v2,
             "offload": offload,
         },
     }))

@@ -117,7 +117,15 @@ class InferenceEngineV2:
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
         quantized = self._quantized
 
-        def step(p, kc, vc, b):
+        ms, mb = self.max_seqs, self.max_blocks_per_seq
+
+        def step(p, kc, vc, packed):
+            # one flat int32 metadata vector per step (single host→device
+            # transfer); static slices rebuild the batch dict on device.
+            # The vector's length IS the token bucket, so decode-sized
+            # and budget-sized batches compile separate specializations.
+            from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import unpack_batch
+            b = unpack_batch(packed, ms, mb)
             if quantized:
                 # embed/head/norm leaves dequantize here; the scanned
                 # 'layers' stack stays quantized — each scan step
@@ -130,6 +138,18 @@ class InferenceEngineV2:
                                   attn_impl=attn_impl)
 
         self._step = jax.jit(step, donate_argnums=(1, 2))
+
+        def step_greedy(p, kc, vc, b):
+            logits, kc, vc = step(p, kc, vc, b)
+            # On-device greedy sampling: ship [n_seqs] int32 tokens to the
+            # host instead of [n_seqs, vocab] fp32 logits — vocab-factor
+            # less PCIe traffic per decode step (servers sample on-device
+            # for the same reason; reference FastGen returns logits only
+            # because torch keeps them resident).
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
+
+        self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
+        self._burst_fns = {}  # k -> jitted multi-step decode program
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P
             self._replicated = NamedSharding(self.mesh, _P())
@@ -139,15 +159,20 @@ class InferenceEngineV2:
                     f"kv_bytes={self.kv_cache.bytes()/1e6:.1f}MB")
 
     # ------------------------------------------------------------------
-    def put(self, batch_uids, batch_tokens, do_checks=True):
+    def put(self, batch_uids, batch_tokens, do_checks=True, sample=None):
         """Run one ragged batch: ``batch_tokens[i]`` are the NEW tokens
         (full prompt, a prefill chunk, or one decode token) for
         ``batch_uids[i]``. Returns fp32 logits ``[len(uids), vocab]``
-        for each sequence's last scheduled token.
+        for each sequence's last scheduled token — or, with
+        ``sample="greedy"``, int32 argmax token ids ``[len(uids)]``
+        sampled on device (vocab-factor less host traffic per step).
 
         ``do_checks`` exists for reference API parity but is ignored:
         validation is what keeps sequence state consistent with the KV
         pool, so it always runs."""
+        if sample not in (None, "greedy"):
+            raise ValueError(f"sample={sample!r}: supported modes are None (logits) "
+                             f"and 'greedy' (on-device argmax)")
         batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]
         # Validate the WHOLE batch before touching any sequence state: a
         # mid-loop failure after allocate/advance would leave earlier
@@ -188,14 +213,110 @@ class InferenceEngineV2:
             self._batch.insert_sequence(desc, tokens)
             desc.advance(len(tokens))
             slots.append(desc.slot)
-        arrays = self._batch.finalize()
+        # decode bucket: a batch of ≤ max_seqs tokens (pure decode round)
+        # runs the small compiled step; prefill chunks run the full-budget
+        # one. Two programs total — shapes stay static per bucket.
+        bucket = self.max_seqs if total <= self.max_seqs else self.max_tokens
+        arrays = self._batch.finalize_packed(bucket=bucket)
         if self.mesh is not None:
             # batch metadata is replicated over the serving mesh (the flat
             # token batch carries no sharding — only weights/KV do)
             arrays = jax.device_put(arrays, self._replicated)
-        logits, self.kv_cache.k, self.kv_cache.v = self._step(
+        fn = self._step_greedy if sample == "greedy" else self._step
+        out, self.kv_cache.k, self.kv_cache.v = fn(
             self.params, self.kv_cache.k, self.kv_cache.v, arrays)
-        return np.asarray(logits)[np.asarray(slots)]
+        return np.asarray(out)[np.asarray(slots)]
+
+    def decode_burst(self, batch_uids, batch_tokens, k):
+        """Run ``k`` greedy decode steps for one current token per uid in
+        ONE compiled program: on-device argmax feeds the next step inside
+        a ``lax.scan``, so the host syncs once per ``k`` generated tokens
+        instead of every token (multi-step scheduling — ~70 ms/step of
+        transport round-trip in tunneled environments, and scheduler CPU
+        on production hosts). Returns int32 tokens ``[k, len(uids)]``.
+
+        KV blocks for all ``k`` tokens are reserved up front, so the
+        block tables are static across the burst."""
+        k = int(k)
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if len(batch_uids) != len(batch_tokens):
+            raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} tokens")
+        if len(batch_uids) > self.max_seqs:
+            raise ValueError(f"{len(batch_uids)} sequences > "
+                             f"max_ragged_sequence_count={self.max_seqs}")
+        from deepspeed_tpu.inference.v2.ragged.kv_cache import NULL_BLOCK
+        ms = self.max_seqs
+        descs = []
+        blocks_needed = 0
+        for uid in batch_uids:
+            desc = self.state_manager.query(uid)
+            if desc is None or desc.seen_tokens == 0:
+                raise ValueError(f"sequence {uid} has no prefilled context — "
+                                 f"decode_burst continues existing sequences only")
+            if desc.seen_tokens + k > self.max_ctx_tokens:
+                raise ValueError(f"sequence {uid}: {desc.seen_tokens}+{k} tokens exceed "
+                                 f"max_context={self.max_ctx_tokens}")
+            blocks_needed += desc.blocks_needed(k)
+            descs.append(desc)
+        if blocks_needed > self.kv_cache.free_blocks:
+            raise RuntimeError(f"KV pool exhausted: need {blocks_needed} blocks, "
+                               f"{self.kv_cache.free_blocks} free — flush() sequences first")
+
+        tokens0 = np.zeros(ms, np.int32)
+        token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
+        pos0 = np.zeros(ms, np.int32)
+        tables = np.full((ms + 1, self.max_blocks_per_seq), NULL_BLOCK, np.int32)
+        for i, (desc, tok) in enumerate(zip(descs, batch_tokens)):
+            desc.slot = i
+            self.state_manager.allocate_for(desc, k)
+            tokens0[i] = int(np.asarray(tok).reshape(-1)[-1])
+            token_seq[i] = i
+            pos0[i] = desc.seen_tokens
+            tables[i, :len(desc.blocks)] = desc.blocks
+            desc.advance(k)
+        meta = np.concatenate([tokens0, token_seq, pos0, tables.ravel()])
+        if self.mesh is not None:
+            meta = jax.device_put(meta, self._replicated)
+        fn = self._burst_fns.get(k)
+        if fn is None:
+            fn = self._burst_fns[k] = self._make_burst_fn(k)
+        out, self.kv_cache.k, self.kv_cache.v = fn(
+            self.params, self.kv_cache.k, self.kv_cache.v, meta)
+        return np.asarray(out)[:, :len(batch_uids)]
+
+    def _make_burst_fn(self, k):
+        from deepspeed_tpu.inference.v2.model_runner import ragged_forward
+        cfg, dtype, mesh = self.model_config, self.dtype, self.mesh
+        attn_impl = (self._config.implementation_overrides or {}).get("attention")
+        quantized = self._quantized
+        ms, mb = self.max_seqs, self.max_blocks_per_seq
+
+        def burst(p, kc, vc, meta):
+            if quantized:
+                from deepspeed_tpu.inference.quantization import dequantize_tree_except
+                p = dequantize_tree_except(p, dtype)  # once per burst, not per step
+            tokens0 = meta[:ms]
+            token_seq = meta[ms:2 * ms]
+            pos0 = meta[2 * ms:3 * ms]
+            tables = meta[3 * ms:].reshape(ms + 1, mb)
+            last = jnp.arange(ms, dtype=jnp.int32)
+
+            def one(carry, i):
+                kc, vc, toks = carry
+                b = {"token_ids": toks, "token_seq": token_seq,
+                     "token_pos": pos0 + i, "block_tables": tables,
+                     "last_index": last}
+                sel, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
+                                             attn_impl=attn_impl)
+                nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+                return (kc, vc, nxt), nxt
+
+            (kc, vc, _), out = jax.lax.scan(one, (kc, vc, tokens0),
+                                            jnp.arange(k, dtype=jnp.int32))
+            return out, kc, vc
+
+        return jax.jit(burst, donate_argnums=(1, 2))
 
     def query(self, uid):
         """→ (seen_tokens, max_new_before_realloc) parity surface."""
@@ -207,6 +328,15 @@ class InferenceEngineV2:
 
     def flush(self, uid):
         self.state_manager.flush_sequence(uid)
+
+    def destroy(self):
+        """Release engine HBM (params, KV pool) and jit caches — v1
+        engine.destroy parity for back-to-back engine builds."""
+        self.params = None
+        self.kv_cache = None
+        self.state_manager = None
+        self._step = self._step_greedy = None
+        self._burst_fns = {}
 
     @property
     def free_blocks(self):

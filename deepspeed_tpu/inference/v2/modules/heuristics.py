@@ -47,7 +47,8 @@ class _PallasPaged:
         from deepspeed_tpu.ops.pallas import use_pallas
         from deepspeed_tpu.ops.pallas.paged_attention import kernel_supported
         return (alibi is None and (mesh is None or mesh.size == 1)
-                and use_pallas() and kernel_supported(head_dim, block_size))
+                and use_pallas()
+                and kernel_supported(head_dim, block_size, kc_shape[2]))
 
     @staticmethod
     def instantiate(mesh, head_dim, block_size, q_shape, kc_shape, alibi):
@@ -67,8 +68,10 @@ class _PallasPagedSharded:
         from deepspeed_tpu.ops.pallas.paged_attention import kernel_supported
         if alibi is not None or mesh is None or mesh.size == 1:
             return False
+        tp = dict(mesh.shape).get("tensor", 1)
         return (kernel_dispatch(mesh) == "shard_map"
-                and kernel_supported(head_dim, block_size)
+                and kernel_supported(head_dim, block_size,
+                                     max(kc_shape[2] // tp, 1))
                 and spec_divides(mesh, _PallasPagedSharded.Q_SPEC, q_shape)
                 and spec_divides(mesh, _PallasPagedSharded.KV_SPEC, kc_shape)
                 # per-shard GQA grouping needs whole KV-head groups

@@ -73,5 +73,44 @@ class RaggedBatchWrapper:
             "num_tokens": np.int32(self._cursor),
         }
 
+    def finalize_packed(self, bucket=None):
+        """→ ONE flat int32 vector holding the whole batch's metadata —
+        a single host→device transfer per step instead of six (the
+        reference keeps its metadata in a pinned host struct copied as
+        one buffer, ragged_wrapper.py:292 / csrc fast host descriptors;
+        this is the same idea for an RPC/PCIe hop). Unpack on device
+        with :func:`unpack_batch`.
+
+        ``bucket`` pads the token arrays to that length instead of
+        ``max_tokens`` — shape bucketing: a pure-decode step (≤ max_seqs
+        real tokens) compiles to a program ~max_tokens/max_seqs× smaller
+        than the prefill-chunk program, so decode rounds don't pay the
+        full token budget in MLP flops and KV-gather traffic."""
+        bucket = self.max_tokens if bucket is None else int(bucket)
+        assert self._cursor <= bucket <= self.max_tokens
+        return np.concatenate([
+            self.token_ids[:bucket], self.token_seq[:bucket], self.token_pos[:bucket],
+            self.block_tables.ravel(), self.last_index,
+            np.asarray([self._cursor], np.int32)])
+
     def slots_in_order(self):
         return list(self._order)
+
+
+def unpack_batch(packed, max_seqs, max_blocks):
+    """Inverse of :meth:`RaggedBatchWrapper.finalize_packed` in traced
+    code: static slices of the flat vector back into the step's dict.
+    The token-bucket length is derived from the vector's static size, so
+    each bucket traces (and compiles) its own specialization."""
+    ms, mb = max_seqs, max_blocks
+    mt = (packed.shape[0] - (ms + 1) * mb - ms - 1) // 3
+    o = 0
+    token_ids = packed[o:o + mt]; o += mt
+    token_seq = packed[o:o + mt]; o += mt
+    token_pos = packed[o:o + mt]; o += mt
+    block_tables = packed[o:o + (ms + 1) * mb].reshape(ms + 1, mb); o += (ms + 1) * mb
+    last_index = packed[o:o + ms]; o += ms
+    num_tokens = packed[o]
+    return {"token_ids": token_ids, "token_seq": token_seq, "token_pos": token_pos,
+            "block_tables": block_tables, "last_index": last_index,
+            "num_tokens": num_tokens}

@@ -35,11 +35,22 @@ class DynamicSplitFuseScheduler:
     (default greedy argmax); generation stops at ``eos_token_id`` or
     ``max_new_tokens``."""
 
-    def __init__(self, engine, token_budget=None, sample_fn=None, eos_token_id=None):
+    def __init__(self, engine, token_budget=None, sample_fn=None, eos_token_id=None,
+                 max_burst=8):
         self.engine = engine
         self.budget = int(token_budget or engine.max_tokens)
         if self.budget > engine.max_tokens:
             raise ValueError(f"budget {self.budget} > engine max_tokens {engine.max_tokens}")
+        # default greedy sampling runs ON DEVICE (engine.put sample="greedy"):
+        # one int32 per sequence crosses to the host instead of a vocab-wide
+        # logits row. A custom sample_fn needs the logits, so it opts out.
+        self._device_greedy = sample_fn is None
+        # multi-step decode: when every live request is decoding, run up
+        # to max_burst greedy steps in one compiled program (on-device
+        # argmax feeds the next step) — one host sync per burst instead of
+        # per token. 1 disables bursting. Only for device greedy: a custom
+        # sample_fn needs each step's logits on the host.
+        self.max_burst = max(1, int(max_burst)) if self._device_greedy else 1
         self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
         self.eos_token_id = eos_token_id
         self.requests = OrderedDict()  # uid -> Request
@@ -83,24 +94,63 @@ class DynamicSplitFuseScheduler:
                 budget -= take
         return uids, chunks
 
+    def _try_burst(self):
+        """All live requests decoding → run a k-step decode burst; None
+        when the burst path doesn't apply this round."""
+        live = [r for r in self.requests.values() if not r.done]
+        if (self.max_burst < 2 or not live or len(live) > self.engine.max_seqs
+                or any(r.next_token is None for r in live)):
+            return None
+        k = min(self.max_burst,
+                min(r.max_new_tokens - len(r.generated) for r in live),
+                min(self.engine.max_ctx_tokens - self.engine.query(r.uid)[0]
+                    for r in live))
+        if k < 2:
+            return None
+        k = 1 << (k.bit_length() - 1)  # power-of-two bursts: each distinct
+        # k compiles its own scan program, so an arbitrary tail (15, 14,
+        # 13...) would compile once per value; rounding down bounds the
+        # set to log2(max_burst) programs
+        uids = [r.uid for r in live]
+        toks = self.engine.decode_burst(uids, [r.next_token for r in live], k)
+        for r in live:
+            r.next_token = None
+        for step_i in range(k):
+            for j, r in enumerate(live):
+                if r.done:
+                    continue  # hit EOS mid-burst; later rows are discarded
+                self._accept_token(r, int(toks[step_i, j]))
+        return uids
+
+    def _accept_token(self, r, tok):
+        """Record a generated token; finish + flush on EOS/max_new_tokens
+        (single copy of the completion semantics for both the stepwise
+        and burst paths)."""
+        r.generated.append(tok)
+        if (self.eos_token_id is not None and tok == self.eos_token_id) \
+                or len(r.generated) >= r.max_new_tokens:
+            r.done = True
+            self.engine.flush(r.uid)
+        else:
+            r.next_token = tok
+
     def step(self):
         """Schedule + run one engine step; returns the uids stepped."""
+        burst = self._try_burst()
+        if burst is not None:
+            return burst
         uids, chunks = self._plan()
         if not uids:
             return []
-        logits = self.engine.put(uids, chunks)
-        for uid, row in zip(uids, logits):
+        if self._device_greedy:
+            out = self.engine.put(uids, chunks, sample="greedy")
+        else:
+            out = self.engine.put(uids, chunks)
+        for uid, row in zip(uids, out):
             r = self.requests[uid]
             if r.prefilling:
                 continue  # mid-prompt chunk: its last-token logits are unused
-            tok = self.sample_fn(row)
-            r.generated.append(tok)
-            if (self.eos_token_id is not None and tok == self.eos_token_id) \
-                    or len(r.generated) >= r.max_new_tokens:
-                r.done = True
-                self.engine.flush(uid)
-            else:
-                r.next_token = tok
+            self._accept_token(r, int(row) if self._device_greedy else self.sample_fn(row))
         return uids
 
     def run_to_completion(self, max_steps=10000):

@@ -51,14 +51,22 @@ def xla_paged_attention(q, kc, vc, block_tables, token_pos, alibi_slopes=None):
     return jnp.einsum("thc,tchd->thd", probs, vs)
 
 
-def kernel_supported(head_dim, block_size):
+def kernel_supported(head_dim, block_size, n_kv_heads=None):
     """Mosaic constraint: the per-block DMA slices the pool's last dim,
     which must be 128-lane aligned — i.e. head_dim % 128 == 0. True for
     the Llama/Mistral/Falcon/GPT-J 128-dim-head families; 64-dim-head
     models (e.g. Bloom-560M, GPT-2) and ALiBi models take the XLA gather
     path (see ``inference/v2/modules/heuristics.py`` — lane-packing two
-    64-dim heads per register is possible but unimplemented)."""
-    return head_dim % 128 == 0 and block_size % 8 == 0
+    64-dim heads per register is possible but unimplemented).
+
+    ``n_kv_heads`` (the pool's second-minor dim) must be 8-sublane
+    aligned for the same slice: Mosaic pads the pool allocation to a
+    sublane multiple but cannot slice a 20-head [1, bs, 20, 128] window
+    out of the padded tile (observed INTERNAL Mosaic failure); GQA pools
+    (4/8/16/32 KV heads) are all aligned, MHA with e.g. 20 heads falls
+    back to the XLA gather path."""
+    return (head_dim % 128 == 0 and block_size % 8 == 0
+            and (n_kv_heads is None or n_kv_heads % 8 == 0))
 
 
 def _kernel(tab_ref, pos_ref, q_ref, kc_ref, vc_ref, o_ref,
@@ -125,7 +133,7 @@ def paged_decode_attention(q, kc, vc, block_tables, token_pos, interpret=None):
     NB, bs, Hkv, _ = kc.shape
     MB = block_tables.shape[1]
     groups = H // Hkv
-    if not interpret and not kernel_supported(Dh, bs):
+    if not interpret and not kernel_supported(Dh, bs, Hkv):
         return xla_paged_attention(q, kc, vc, block_tables, token_pos)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -127,6 +127,41 @@ class TestEngineV2Correctness:
         engine.flush(5)
         assert engine.free_blocks == free0
 
+    def test_on_device_greedy_matches_host_argmax(self, setup):
+        """put(sample='greedy') returns exactly argmax of the logits the
+        plain put would have produced, as int32 token ids."""
+        _, _, engine = setup
+        ids = (np.arange(12, dtype=np.int32) * 5) % 250
+        logits = engine.put([81], [ids])
+        engine.flush(81)
+        toks = engine.put([82], [ids], sample="greedy")
+        engine.flush(82)
+        assert toks.dtype == np.int32 and toks.shape == (1,)
+        assert int(toks[0]) == int(np.argmax(logits[0]))
+        with pytest.raises(ValueError, match="sample"):
+            engine.put([83], [ids], sample="top_p")
+
+    def test_decode_burst_matches_stepwise(self, setup):
+        """k-step on-device burst == k separate greedy put() steps."""
+        _, _, engine = setup
+        prompt = (np.arange(10, dtype=np.int32) * 11) % 250
+        # stepwise reference
+        tok = int(engine.put([91], [prompt], sample="greedy")[0])
+        ref = []
+        for _ in range(4):
+            ref.append(tok)
+            tok = int(engine.put([91], [[tok]], sample="greedy")[0])
+        engine.flush(91)
+        # burst path: prefill, then one 4-step burst continuing from the
+        # first sampled token
+        first = int(engine.put([92], [prompt], sample="greedy")[0])
+        out = engine.decode_burst([92], [first], 4)
+        engine.flush(92)
+        assert out.shape == (4, 1)
+        assert [first] + [int(t) for t in out[:-1, 0]] == ref
+        with pytest.raises(ValueError, match="no prefilled context"):
+            engine.decode_burst([93], [5], 2)
+
     def test_budget_enforced(self, setup):
         _, _, engine = setup
         with pytest.raises(ValueError, match="max_ragged_batch_size"):
