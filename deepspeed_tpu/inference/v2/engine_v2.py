@@ -21,6 +21,19 @@ from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.utils.logging import logger
 
 
+def _burst_layout(ms, mb):
+    """Single source for the decode-burst metadata wire format: field →
+    (start, end) offsets into the flat int32 vector. Both the host pack
+    (``decode_burst``) and the traced unpack (``_make_burst_fn``) read
+    this, so the layout cannot silently diverge."""
+    o, lay = 0, {}
+    for name, size in (("tokens0", ms), ("token_seq", ms), ("pos0", ms),
+                       ("tables", (ms + 1) * mb)):
+        lay[name] = (o, o + size)
+        o += size
+    return lay
+
+
 class InferenceEngineV2:
 
     def __init__(self, model=None, config: RaggedInferenceEngineConfig = None,
@@ -276,6 +289,7 @@ class InferenceEngineV2:
             tables[i, :len(desc.blocks)] = desc.blocks
             desc.advance(k)
         meta = np.concatenate([tokens0, token_seq, pos0, tables.ravel()])
+        assert meta.shape[0] == sum(e - s for s, e in _burst_layout(ms, self.max_blocks_per_seq).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
         fn = self._burst_fns.get(k)
@@ -296,10 +310,11 @@ class InferenceEngineV2:
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)  # once per burst, not per step
-            tokens0 = meta[:ms]
-            token_seq = meta[ms:2 * ms]
-            pos0 = meta[2 * ms:3 * ms]
-            tables = meta[3 * ms:].reshape(ms + 1, mb)
+            lay = _burst_layout(ms, mb)
+            tokens0 = meta[slice(*lay["tokens0"])]
+            token_seq = meta[slice(*lay["token_seq"])]
+            pos0 = meta[slice(*lay["pos0"])]
+            tables = meta[slice(*lay["tables"])].reshape(ms + 1, mb)
             last = jnp.arange(ms, dtype=jnp.int32)
 
             def one(carry, i):

@@ -87,7 +87,10 @@ class RaggedBatchWrapper:
         than the prefill-chunk program, so decode rounds don't pay the
         full token budget in MLP flops and KV-gather traffic."""
         bucket = self.max_tokens if bucket is None else int(bucket)
-        assert self._cursor <= bucket <= self.max_tokens
+        if not self._cursor <= bucket <= self.max_tokens:
+            raise ValueError(f"bucket {bucket} must cover the {self._cursor} batched "
+                             f"tokens and not exceed max_tokens={self.max_tokens} — "
+                             f"a smaller bucket would silently truncate the batch")
         return np.concatenate([
             self.token_ids[:bucket], self.token_seq[:bucket], self.token_pos[:bucket],
             self.block_tables.ravel(), self.last_index,

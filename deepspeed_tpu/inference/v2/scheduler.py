@@ -112,7 +112,14 @@ class DynamicSplitFuseScheduler:
         # 13...) would compile once per value; rounding down bounds the
         # set to log2(max_burst) programs
         uids = [r.uid for r in live]
-        toks = self.engine.decode_burst(uids, [r.next_token for r in live], k)
+        try:
+            toks = self.engine.decode_burst(uids, [r.next_token for r in live], k)
+        except RuntimeError:
+            # KV pool too tight to reserve k tokens per sequence up front
+            # (decode_burst validates before touching any state). The
+            # stepwise path needs at most one block per sequence per step
+            # and EOS flushes free blocks between steps, so fall back.
+            return None
         for r in live:
             r.next_token = None
         for step_i in range(k):

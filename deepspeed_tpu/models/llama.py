@@ -55,6 +55,9 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     # Qwen2-style QKV biases (Llama/Mistral/Mixtral: False)
     attention_bias: bool = False
+    # InternLM-style o_proj bias (with attention_bias=True: biases on all
+    # four attention projections, reference containers/internlm.py)
+    attention_out_bias: bool = False
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash"
     # sequence parallelism: "ulysses" trades seq shards for head shards
     # around local attention (bounded by head count); "ring" keeps the
@@ -297,7 +300,7 @@ class LlamaAttention(nn.Module):
             mask = (k_idx <= q_pos)[None, None, :, :]  # [1, 1, T, S_max]
             out = einsum_attention(q, kx, vx, mask=mask)
             out = out.reshape(B, S, H * Dh)
-            return nn.Dense(D, use_bias=False, name="o_proj")(out), new_cache
+            return nn.Dense(D, use_bias=cfg.attention_out_bias, name="o_proj")(out), new_cache
 
         if cfg.sp_impl == "ring":
             # Ring context parallelism: stay sequence-sharded; K/V blocks
@@ -318,7 +321,7 @@ class LlamaAttention(nn.Module):
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}: expected 'ulysses' or 'ring'")
 
         out = out.reshape(B, S, H * Dh)
-        return nn.Dense(D, use_bias=False, name="o_proj")(out), None
+        return nn.Dense(D, use_bias=cfg.attention_out_bias, name="o_proj")(out), None
 
 
 class LlamaMLP(nn.Module):

@@ -64,9 +64,9 @@ def import_llama(state, hf_config):
         "v_proj": {"kernel": _stack(state, "model.layers.{}.self_attn.v_proj.weight", L)},
         "o_proj": {"kernel": _stack(state, "model.layers.{}.self_attn.o_proj.weight", L)},
     }
-    for p in ("q_proj", "k_proj", "v_proj"):
+    for p in ("q_proj", "k_proj", "v_proj", "o_proj"):  # Qwen2: qkv; InternLM: all four
         bias_key = f"model.layers.0.self_attn.{p}.bias"
-        if bias_key in state:  # Qwen2
+        if bias_key in state:
             attn[p]["bias"] = _stack(state, f"model.layers.{{}}.self_attn.{p}.bias", L, _np)
 
     layers = {
@@ -240,7 +240,16 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         attention_bias=getattr(hf_config, "attention_bias", False)
-        or hf_config.model_type == "qwen2",
+        or hf_config.model_type == "qwen2"
+        or (hf_config.model_type == "internlm" and getattr(hf_config, "bias", True)),
+        # o_proj bias per HF semantics: LlamaAttention builds o_proj with
+        # bias=config.attention_bias; Qwen2 is qkv-bias-only (o_proj
+        # bias=False always); InternLM biases all four projections
+        # (reference containers/internlm.py maps o_proj.bias as dense_b)
+        attention_out_bias=(
+            (hf_config.model_type == "internlm" and getattr(hf_config, "bias", True))
+            or (hf_config.model_type != "qwen2"
+                and getattr(hf_config, "attention_bias", False))),
         moe_num_experts=moe,
         moe_top_k=getattr(hf_config, "num_experts_per_tok", 2) if moe else 2,
         **{**rope_kw, **overrides})
@@ -864,7 +873,7 @@ def bert_config_from_hf(hf_config, **overrides):
 # Dispatch
 # ---------------------------------------------------------------------------
 
-_LLAMA_TYPES = ("llama", "mistral", "mixtral", "qwen2")
+_LLAMA_TYPES = ("llama", "mistral", "mixtral", "qwen2", "internlm")
 
 
 def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):

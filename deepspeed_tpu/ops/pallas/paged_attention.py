@@ -59,14 +59,15 @@ def kernel_supported(head_dim, block_size, n_kv_heads=None):
     path (see ``inference/v2/modules/heuristics.py`` — lane-packing two
     64-dim heads per register is possible but unimplemented).
 
-    ``n_kv_heads`` (the pool's second-minor dim) must be 8-sublane
-    aligned for the same slice: Mosaic pads the pool allocation to a
-    sublane multiple but cannot slice a 20-head [1, bs, 20, 128] window
-    out of the padded tile (observed INTERNAL Mosaic failure); GQA pools
-    (4/8/16/32 KV heads) are all aligned, MHA with e.g. 20 heads falls
-    back to the XLA gather path."""
+    ``n_kv_heads`` (the pool's second-minor dim) must tile the 8-sublane
+    granule for the per-block slice. Measured on v5e Mosaic
+    (2026-07-31): multiples of 8 compile, and so do 2 and 4 (they divide
+    the sublane tile); 1, 6, 12, and 20 are INTERNAL Mosaic failures.
+    Common GQA pools (2/4/8/16/32 KV heads) all pass; odd MHA counts
+    (e.g. 20) fall back to the XLA gather path."""
     return (head_dim % 128 == 0 and block_size % 8 == 0
-            and (n_kv_heads is None or n_kv_heads % 8 == 0))
+            and (n_kv_heads is None or n_kv_heads % 8 == 0
+                 or n_kv_heads in (2, 4)))
 
 
 def _kernel(tab_ref, pos_ref, q_ref, kc_ref, vc_ref, o_ref,

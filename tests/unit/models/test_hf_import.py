@@ -57,6 +57,15 @@ class TestHFImportParity:
             num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64)
         _check(transformers.Qwen2ForCausalLM(cfg), IDS)
 
+    def test_llama_attention_bias_all_projections(self):
+        """HF LlamaAttention with attention_bias=True biases o_proj too;
+        the import must carry all four biases (exact logit parity)."""
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            attention_bias=True)
+        _check(transformers.LlamaForCausalLM(cfg), IDS)
+
     def test_mixtral_moe(self):
         cfg = transformers.MixtralConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
@@ -178,6 +187,60 @@ class TestHFImportParity:
             vocab_size=128, dim=32, hidden_dim=64, n_layers=2, n_heads=4,
             max_position_embeddings=64)
         _check(transformers.DistilBertForMaskedLM(cfg), IDS)
+
+    def test_internlm_out_proj_bias(self):
+        """InternLM (trust_remote_code): llama layout + biases on all four
+        attention projections. With o_proj bias zeroed the model must
+        equal the qkv-bias-only import of the same weights; with it
+        nonzero, logits must move — proving the bias lands on o_proj
+        exactly and changes nothing else."""
+        rng = np.random.RandomState(3)
+        L, H, F, V = 2, 32, 64, 120
+
+        def r(*shape):
+            return rng.randn(*shape).astype(np.float32) * 0.05
+
+        state = {"model.embed_tokens.weight": r(V, H),
+                 "model.norm.weight": 1 + r(H), "lm_head.weight": r(V, H)}
+        for i in range(L):
+            for n in ("q", "k", "v", "o"):
+                state[f"model.layers.{i}.self_attn.{n}_proj.weight"] = r(H, H)
+                state[f"model.layers.{i}.self_attn.{n}_proj.bias"] = r(H)
+            state[f"model.layers.{i}.input_layernorm.weight"] = 1 + r(H)
+            state[f"model.layers.{i}.post_attention_layernorm.weight"] = 1 + r(H)
+            state[f"model.layers.{i}.mlp.gate_proj.weight"] = r(F, H)
+            state[f"model.layers.{i}.mlp.up_proj.weight"] = r(F, H)
+            state[f"model.layers.{i}.mlp.down_proj.weight"] = r(H, F)
+
+        class InternLMCfg:
+            model_type = "internlm"
+            vocab_size, hidden_size, intermediate_size = V, H, F
+            num_hidden_layers, num_attention_heads = L, 4
+            num_key_value_heads = 4
+            max_position_embeddings = 64
+            rms_norm_eps = 1e-6
+            rope_theta = 10000.0
+            tie_word_embeddings = False
+            bias = True
+
+        model, params = from_hf(dict(state), hf_config=InternLMCfg)
+        assert model.config.attention_out_bias and model.config.attention_bias
+        with_bias = _ours_logits(model, params, IDS)
+
+        # zero the o bias -> must equal the qkv-bias-only (qwen2-style) import
+        import copy
+        p0 = copy.deepcopy(params)
+        p0["model"]["layers"]["self_attn"]["o_proj"]["bias"][:] = 0.0
+        zeroed = _ours_logits(model, p0, IDS)
+        state_no_ob = {k: v for k, v in state.items()
+                       if not k.endswith("o_proj.bias")}
+        class QkvOnlyCfg(InternLMCfg):
+            model_type = "qwen2"  # HF Qwen2: qkv bias, o_proj bias=False
+        model2, params2 = from_hf(state_no_ob, hf_config=QkvOnlyCfg)
+        assert not model2.config.attention_out_bias
+        np.testing.assert_allclose(zeroed, _ours_logits(model2, params2, IDS),
+                                   rtol=1e-5, atol=1e-5)
+        assert np.abs(with_bias - zeroed).max() > 1e-3  # the bias is live
 
     def test_gpt_neo_unscaled_attention(self):
         """GPT-Neo: bias-free q/k/v, biased out_proj, NO 1/sqrt(d) softmax
