@@ -162,6 +162,15 @@ class Autotuner:
                     f"offload={offload}: {rec['error']}")
         return rec
 
+    @staticmethod
+    def _features(cand):
+        """Cost-model features for one (stage, mbs, gas, offload)
+        candidate (reference tuner/cost_model.py learns over the same
+        config dims)."""
+        stage, mbs, gas, offload = cand
+        return np.array([1.0, np.log(float(mbs)), float(stage),
+                         float(gas or 1), 1.0 if offload else 0.0])
+
     def run_experiment(self, stage, mbs, gas=None, offload=None):
         """One candidate: build a fresh engine, time train_batch."""
         import deepspeed_tpu
@@ -208,6 +217,12 @@ class Autotuner:
         - ``"grid"`` (GridSearchTuner): every candidate runs.
         - ``"random"`` (RandomTuner): ``num_trials`` candidates sampled
           without replacement from the full product.
+        - ``"model_based"`` (ModelBasedTuner + cost_model, reference
+          ``tuner/model_based_tuner.py``): seed with a few random
+          evaluations, then repeatedly fit a least-squares cost model
+          (log-throughput over the candidate's numeric features) on every
+          result so far and run the unevaluated candidate the model ranks
+          best, up to ``num_trials`` total experiments.
 
         Candidates the memory model rejects are recorded as pruned
         without ever running — no compile, no OOM (crash-prune remains
@@ -217,9 +232,10 @@ class Autotuner:
                  for stage in self.zero_stages
                  for offload in self.offload_candidates
                  for gas in self.gas_candidates]
+        product = [(s, m, g, o) for (s, o, g) in space
+                   for m in sorted(self.micro_batches)]
         if strategy in ("grid", "random"):
-            candidates = [(s, m, g, o) for (s, o, g) in space
-                          for m in sorted(self.micro_batches)]
+            candidates = product
             if strategy == "random":
                 k = min(num_trials or len(candidates), len(candidates))
                 candidates = _random.Random(seed).sample(candidates, k)
@@ -240,8 +256,31 @@ class Autotuner:
                             rec["value"] < prev * 0.98:
                         break
                     prev = rec["value"]
+        elif strategy == "model_based":
+            candidates = [c for c in product
+                          if self._prune_by_memory(*c) is None]
+            budget = min(num_trials or max(3, len(candidates) // 2), len(candidates))
+            rng = _random.Random(seed)
+            seeds = rng.sample(candidates, min(3, budget))
+            evaluated = {}
+            for c in seeds:
+                evaluated[c] = self.run_experiment(*c)
+            while len(evaluated) < budget:
+                remaining = [c for c in candidates if c not in evaluated]
+                if not remaining:
+                    break
+                scored = [(c, r["value"]) for c, r in evaluated.items()
+                          if r["value"] is not None]
+                if len(scored) >= 2:
+                    X = np.array([self._features(c) for c, _ in scored])
+                    y = np.log([v for _, v in scored])
+                    coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+                    remaining.sort(key=lambda c: -float(self._features(c) @ coef))
+                # else: no usable signal yet — fall through in listed order
+                evaluated[remaining[0]] = self.run_experiment(*remaining[0])
         else:
-            raise ValueError(f"unknown strategy {strategy!r}: hillclimb | grid | random")
+            raise ValueError(
+                f"unknown strategy {strategy!r}: hillclimb | grid | random | model_based")
         ok = [r for r in self.results if r["value"] is not None]
         if not ok:
             raise RuntimeError("autotuning: every experiment failed; see results")

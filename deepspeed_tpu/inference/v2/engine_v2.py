@@ -240,6 +240,22 @@ class InferenceEngineV2:
             self.params, self.kv_cache.k, self.kv_cache.v, arrays)
         return np.asarray(out)[np.asarray(slots)]
 
+    def can_burst(self, batch_uids, k):
+        """True when a ``decode_burst(uids, ·, k)`` can reserve KV blocks
+        for all ``k`` tokens per sequence right now — schedulers call
+        this to fall back to stepwise decoding on a tight pool instead
+        of catching exceptions (a failure inside the compiled burst
+        happens after state mutation and donation, so it is NOT safely
+        recoverable; only this pre-check is)."""
+        need = 0
+        for uid in batch_uids:
+            desc = self.state_manager.query(uid)
+            if desc is None or desc.seen_tokens == 0 \
+                    or desc.seen_tokens + k > self.max_ctx_tokens:
+                return False
+            need += desc.blocks_needed(k)
+        return need <= self.kv_cache.free_blocks
+
     def decode_burst(self, batch_uids, batch_tokens, k):
         """Run ``k`` greedy decode steps for one current token per uid in
         ONE compiled program: on-device argmax feeds the next step inside

@@ -112,14 +112,15 @@ class DynamicSplitFuseScheduler:
         # 13...) would compile once per value; rounding down bounds the
         # set to log2(max_burst) programs
         uids = [r.uid for r in live]
-        try:
-            toks = self.engine.decode_burst(uids, [r.next_token for r in live], k)
-        except RuntimeError:
-            # KV pool too tight to reserve k tokens per sequence up front
-            # (decode_burst validates before touching any state). The
-            # stepwise path needs at most one block per sequence per step
-            # and EOS flushes free blocks between steps, so fall back.
+        if not self.engine.can_burst(uids, k):
+            # KV pool too tight to reserve k tokens per sequence up
+            # front. The stepwise path needs at most one block per
+            # sequence per step and EOS flushes free blocks between
+            # steps, so fall back. (A pre-check, not try/except: a
+            # failure inside the compiled burst would land after state
+            # mutation + KV donation and is not recoverable.)
             return None
+        toks = self.engine.decode_burst(uids, [r.next_token for r in live], k)
         for r in live:
             r.next_token = None
         for step_i in range(k):
