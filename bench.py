@@ -62,6 +62,13 @@ def _param_count(params) -> int:
     return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
 
 
+def _model_flops(n_params, tokens, layers, seq, hidden) -> float:
+    """Training flops for MFU accounting (single source for the headline
+    and long-seq benches): 6N per token for the matmuls + the standard
+    12·L·S·H attention term."""
+    return 6.0 * n_params * tokens + 12.0 * layers * seq * hidden * tokens
+
+
 def _measure_tunnel_bandwidth(nbytes=32 << 20):
     """Sustained host->device and device->host MB/s through the tunnel."""
     x = np.random.randn(nbytes // 4).astype(np.float32)
@@ -184,6 +191,60 @@ def bench_serving_v2_ragged():
                     "~70ms tunnel RTT, which a production PCIe host does not pay"}
 
 
+def bench_train_long_seq():
+    """Long-context training on one chip: the same ~551M model as the
+    headline bench at seq 16384 (8x its 2048), micro-batch 1. The Pallas
+    flash kernel's O(S) memory is what makes 16k activations fit a v5e;
+    attention is ~59% of the model flops at this length (vs ~15% at
+    2048), so the MFU here measures the kernel, not just the matmuls.
+    Multi-chip long-context adds ring/Ulysses sequence parallelism
+    (dryrun C). Two warmup steps: the first post-compile call retraces
+    (fresh params take device placement), so timing after one warmup
+    measures compilation."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+
+    groups.destroy_mesh()
+    layers, hidden, S, gas = 16, 1536, 16384, 8
+    model = build_llama("160m", hidden_size=hidden, intermediate_size=4096,
+                        num_hidden_layers=layers, num_attention_heads=16,
+                        num_key_value_heads=16, max_position_embeddings=S,
+                        remat_policy="full")
+    config = {
+        "train_batch_size": gas,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, model.config.vocab_size, size=(gas, 1, S)).astype(np.int32)
+    batch = (jnp.asarray(ids), jnp.asarray(ids))
+    for _ in range(2):  # compile + the post-compile retrace
+        loss = engine.train_batch(batch=batch)
+    np.asarray(loss)
+    t0 = time.perf_counter()
+    for _ in range(2):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.params)
+    np.asarray(loss)  # real sync over the tunnel
+    dt = (time.perf_counter() - t0) / 2
+    n_params = _param_count(engine.params)
+    tokens = gas * S
+    mfu = _model_flops(n_params, tokens, layers, S, hidden) / dt / _peak_flops(jax.devices()[0])
+    engine.destroy()
+    return {"params": n_params, "seq": S, "micro_batch": 1, "gas": gas,
+            "tokens_per_sec_chip": round(tokens / dt, 1),
+            "mfu": round(mfu, 4), "step_s": round(dt, 2),
+            "loss": round(float(loss), 3),
+            "attention_flops_frac": round(12.0 * layers * S * hidden /
+                                          (6.0 * n_params + 12.0 * layers * S * hidden), 3)}
+
+
 def bench_offload_probe():
     """Host-offload mechanics on the real chip + the honest bandwidth
     story (see module docstring)."""
@@ -292,13 +353,18 @@ def main():
     tokens = B * gas * S
     tokens_per_sec_chip = tokens / dt / n_chips
     n_params = _param_count(engine.params)
-    model_flops = 6.0 * n_params * tokens + 12.0 * layers * S * hidden * tokens
-    mfu = model_flops / dt / (n_chips * _peak_flops(jax.devices()[0]))
+    mfu = _model_flops(n_params, tokens, layers, S, hidden) / dt / (
+        n_chips * _peak_flops(jax.devices()[0]))
 
-    serving_2b = serving_2b_int8 = serving_v2 = offload = None
+    serving_2b = serving_2b_int8 = serving_v2 = long_seq = offload = None
     if on_tpu:
         import gc
         del engine  # free the training HBM before the 2.5B serving build
+        gc.collect()
+        try:
+            long_seq = bench_train_long_seq()
+        except Exception as e:
+            long_seq = {"error": f"{type(e).__name__}: {e}"[:300]}
         gc.collect()
         try:
             serving_2b = bench_serving_2b()
@@ -340,6 +406,7 @@ def main():
             "serving_2b": serving_2b,
             "serving_2b_int8": serving_2b_int8,
             "serving_v2_ragged": serving_v2,
+            "train_long_seq": long_seq,
             "offload": offload,
         },
     }))

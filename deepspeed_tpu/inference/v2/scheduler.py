@@ -36,7 +36,7 @@ class DynamicSplitFuseScheduler:
     ``max_new_tokens``."""
 
     def __init__(self, engine, token_budget=None, sample_fn=None, eos_token_id=None,
-                 max_burst=8):
+                 max_burst=16):
         self.engine = engine
         self.budget = int(token_budget or engine.max_tokens)
         if self.budget > engine.max_tokens:

@@ -595,6 +595,8 @@ class DeepSpeedEngine:
             self.opt_state = jax.jit(self._opt_init, out_shardings=state_shardings)(self.master_params)
             self._opt_state_shards = state_shardings
 
+        self._commit_scaler_state()
+
         self._initialized = True
 
         # A load_checkpoint() that ran before materialization stashed the
@@ -1560,9 +1562,9 @@ class DeepSpeedEngine:
     def _load_optim_state(self, optim_name):
         reader = self._reader_engine(optim_name)
         if isinstance(reader, ShardedCheckpointEngine) and self._initialized and self._host_offload is None:
-            # scaler_state is deliberately absent: its leaves are plain
-            # uncommitted scalars, not mesh-sharded arrays — they load
-            # eagerly via the skeleton fallback
+            # scaler_state is deliberately absent from the sharded-load
+            # target: its tiny scalar leaves load eagerly via the
+            # skeleton fallback, then _commit_scaler_state re-places them
             target = {
                 "optimizer_state_dict": self.opt_state,
                 "fp32_master_params": (self.master_params
@@ -1570,6 +1572,17 @@ class DeepSpeedEngine:
             }
             return reader.load_onto(optim_name, target)
         return reader.load(optim_name)
+
+    def _commit_scaler_state(self):
+        """Commit the scaler scalars to their replicated device sharding:
+        freshly-(re)built scaler leaves are uncommitted jnp.asarray
+        scalars, but the fused train program returns them committed — an
+        aval change that would retrace and RECOMPILE the whole program on
+        the next call. Invoked at materialize AND after every checkpoint
+        restore that reassigns scaler_state."""
+        if getattr(self, "mesh", None) is not None and self.scaler_state is not None:
+            self.scaler_state = jax.device_put(
+                self.scaler_state, NamedSharding(self.mesh, P()))
 
     def _restore_optim_state(self, optim_state):
         if isinstance(optim_state, tuple) and optim_state and optim_state[0] == "__ckpt_path__":
@@ -1582,6 +1595,7 @@ class DeepSpeedEngine:
             if optim_state.get("scaler_state") is not None:
                 self.scaler_state = jax.tree.map(jnp.asarray, match_named_tree(optim_state["scaler_state"],
                                                                                self.scaler_state))
+                self._commit_scaler_state()
             for g, g_new in zip(self.optimizer.param_groups, optim_state.get("optimizer_param_groups", [])):
                 g.update(g_new)
             return
@@ -1597,6 +1611,7 @@ class DeepSpeedEngine:
         if "scaler_state" in optim_state and optim_state["scaler_state"] is not None:
             self.scaler_state = jax.tree.map(jnp.asarray, match_named_tree(optim_state["scaler_state"],
                                                                            self.scaler_state))
+            self._commit_scaler_state()
         for g, g_new in zip(self.optimizer.param_groups, optim_state.get("optimizer_param_groups", [])):
             g.update(g_new)
 
@@ -1636,6 +1651,7 @@ class DeepSpeedEngine:
                 if k in self.scaler_state:
                     cur = self.scaler_state[k]
                     self.scaler_state[k] = jnp.asarray(v, getattr(cur, "dtype", jnp.float32))
+            self._commit_scaler_state()
 
     def _load_universal_index(self, udir):
         """Shared universal-load prologue: read + apply metadata, then
