@@ -163,6 +163,7 @@ class InferenceEngineV2:
 
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
         self._burst_fns = {}  # k -> jitted multi-step decode program
+        self._suspended = {}  # uid -> {"handle": host KV, "seen_tokens": int}
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P
             self._replicated = NamedSharding(self.mesh, _P())
@@ -360,6 +361,50 @@ class InferenceEngineV2:
     def flush(self, uid):
         self.state_manager.flush_sequence(uid)
 
+    def suspend(self, uid):
+        """Swap a live sequence's KV blocks to host memory and release
+        them for other sequences (the surface the reference's
+        BlockedKVCache declares but leaves NotImplementedError,
+        kv_cache.py:166 — vLLM-style swapping). The sequence stops being
+        tracked until :meth:`resume`."""
+        desc = self.state_manager.query(uid)
+        if desc is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if uid in self._suspended:
+            raise ValueError(f"sequence {uid} is already suspended")
+        handle = self.kv_cache.offload(desc.blocks)
+        self._suspended[uid] = {"handle": handle, "seen_tokens": desc.seen_tokens}
+        desc.blocks = []  # already freed by offload; don't double-free
+        self.state_manager.flush_sequence(uid)
+
+    def resume(self, uid):
+        """Restore a suspended sequence's KV into freshly reserved blocks
+        (ids may differ; the descriptor re-points at them) and resume
+        tracking — decode continues exactly where it stopped."""
+        ent = self._suspended.get(uid)
+        if ent is None:
+            raise KeyError(f"sequence {uid} is not suspended")
+        # validate EVERYTHING before restore() mutates the pool — a
+        # failure after the scatter would leak the reserved blocks and
+        # lose the host handle
+        if self.state_manager.query(uid) is not None:
+            raise ValueError(f"sequence {uid} was re-registered live while "
+                             f"suspended; flush() it before resume()")
+        n = ent["handle"]["k"].shape[1]
+        if n > self.kv_cache.free_blocks:
+            raise RuntimeError(f"KV pool exhausted: resume needs {n} blocks, "
+                               f"{self.kv_cache.free_blocks} free")
+        if self.state_manager.n_tracked_sequences >= \
+                self.state_manager.max_tracked_sequences:
+            raise RuntimeError("max_tracked_sequences exceeded; flush() a live "
+                               "sequence before resume()")
+        blocks = self.kv_cache.restore(ent["handle"])
+        del self._suspended[uid]
+        desc = self.state_manager.get_or_create_sequence(uid)
+        desc.blocks = list(blocks)
+        desc.seen_tokens = ent["seen_tokens"]
+        return desc.seen_tokens
+
     def destroy(self):
         """Release engine HBM (params, KV pool) and jit caches — v1
         engine.destroy parity for back-to-back engine builds."""
@@ -368,6 +413,7 @@ class InferenceEngineV2:
         self.state_manager = None
         self._step = self._step_greedy = None
         self._burst_fns = {}
+        self._suspended = {}
 
     @property
     def free_blocks(self):

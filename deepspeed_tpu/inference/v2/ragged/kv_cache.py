@@ -10,6 +10,7 @@ jitted step, so XLA updates in place). Block 0 is reserved as the
 null block — padding tokens scatter there and no live sequence ever
 owns it."""
 
+import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
@@ -47,3 +48,37 @@ class BlockedKVCache:
 
     def bytes(self) -> int:
         return 2 * self.k.size * self.k.dtype.itemsize
+
+    # ------------------------------------------------------------------
+    # Host offload / restore (the reference declares this surface but
+    # raises NotImplementedError, kv_cache.py:166/176 "Offloading is not
+    # yet supported"; here it is real — vLLM-style sequence swapping)
+    # ------------------------------------------------------------------
+    def offload(self, blocks):
+        """Move ``blocks``' KV to host memory and free them for reuse.
+        → opaque handle for :meth:`restore`."""
+        blocks = list(blocks)
+        ids = jnp.asarray(blocks, jnp.int32)
+        k_host, v_host = jax.device_get((jnp.take(self.k, ids, axis=1),
+                                         jnp.take(self.v, ids, axis=1)))
+        self.free(blocks)
+        return {"k": k_host, "v": v_host}
+
+    def restore(self, handle):
+        """Bring offloaded KV back into freshly reserved blocks (ids may
+        differ from the original ones — callers re-point their block
+        tables). The pool arrays are donated through the jitted scatter,
+        so the update is in place, not a second pool copy."""
+        n = handle["k"].shape[1]
+        blocks = self.reserve(n)
+        ids = jnp.asarray(blocks, jnp.int32)
+        self.k, self.v = _scatter_blocks(self.k, self.v, ids,
+                                         jnp.asarray(handle["k"], self.dtype),
+                                         jnp.asarray(handle["v"], self.dtype))
+        return blocks
+
+
+# donated pools: the functional .at[].set aliases in place, no pool copy
+_scatter_blocks = jax.jit(
+    lambda pk, pv, ids, kv, vv: (pk.at[:, ids].set(kv), pv.at[:, ids].set(vv)),
+    donate_argnums=(0, 1))

@@ -162,6 +162,49 @@ class TestEngineV2Correctness:
         with pytest.raises(ValueError, match="no prefilled context"):
             engine.decode_burst([93], [5], 2)
 
+    def test_suspend_resume_kv_swapping(self, setup):
+        """KV host swap (beyond the reference, whose offload() raises
+        NotImplementedError): suspend a mid-generation sequence, let
+        another sequence claim + overwrite its freed blocks, resume, and
+        the continuation matches an uninterrupted run exactly."""
+        _, _, engine = setup
+        prompt = (np.arange(14, dtype=np.int32) * 9) % 250
+
+        # uninterrupted reference rollout
+        tok = int(engine.put([61], [prompt], sample="greedy")[0])
+        ref = [tok]
+        for _ in range(3):
+            tok = int(engine.put([61], [[tok]], sample="greedy")[0])
+            ref.append(tok)
+        engine.flush(61)
+
+        # suspended run: prefill, suspend, trample the pool, resume
+        tok = int(engine.put([62], [prompt], sample="greedy")[0])
+        free_before = engine.free_blocks
+        engine.suspend(62)
+        assert engine.free_blocks > free_before  # blocks really freed
+        engine.put([63], [np.arange(40, dtype=np.int32)])  # overwrite pool
+        engine.flush(63)
+        seen = engine.resume(62)
+        assert seen == len(prompt)
+        got = [tok]
+        for _ in range(3):
+            tok = int(engine.put([62], [[tok]], sample="greedy")[0])
+            got.append(tok)
+        engine.flush(62)
+        assert got == ref
+        with pytest.raises(KeyError):
+            engine.resume(99)
+        # resume refuses when the uid was re-registered live meanwhile
+        engine.put([64], [prompt], sample="greedy")
+        engine.suspend(64)
+        engine.put([64], [prompt[:4]])
+        with pytest.raises(ValueError, match="re-registered"):
+            engine.resume(64)
+        engine.flush(64)
+        engine.resume(64)
+        engine.flush(64)
+
     def test_budget_enforced(self, setup):
         _, _, engine = setup
         with pytest.raises(ValueError, match="max_ragged_batch_size"):
