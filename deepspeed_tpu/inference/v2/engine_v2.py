@@ -359,7 +359,15 @@ class InferenceEngineV2:
         return desc.seen_tokens, room
 
     def flush(self, uid):
-        self.state_manager.flush_sequence(uid)
+        """Discard everything the engine holds for ``uid`` — live KV
+        blocks AND any suspended host copy (without this, a suspended
+        sequence whose client went away could never be retired: resume
+        needs pool room, which is exactly what the suspend relieved)."""
+        suspended = self._suspended.pop(uid, None) is not None
+        if self.state_manager.query(uid) is not None:
+            self.state_manager.flush_sequence(uid)
+        elif not suspended:
+            raise KeyError(f"unknown sequence {uid}")
 
     def suspend(self, uid):
         """Swap a live sequence's KV blocks to host memory and release
@@ -401,7 +409,7 @@ class InferenceEngineV2:
         blocks = self.kv_cache.restore(ent["handle"])
         del self._suspended[uid]
         desc = self.state_manager.get_or_create_sequence(uid)
-        desc.blocks = list(blocks)
+        desc.extend_blocks(blocks)
         desc.seen_tokens = ent["seen_tokens"]
         return desc.seen_tokens
 

@@ -201,9 +201,12 @@ class TestEngineV2Correctness:
         engine.put([64], [prompt[:4]])
         with pytest.raises(ValueError, match="re-registered"):
             engine.resume(64)
+        # flush is a total discard: live KV AND the suspended host copy
+        free0 = engine.free_blocks
         engine.flush(64)
-        engine.resume(64)
-        engine.flush(64)
+        assert engine.free_blocks > free0
+        with pytest.raises(KeyError):
+            engine.resume(64)
 
     def test_budget_enforced(self, setup):
         _, _, engine = setup
