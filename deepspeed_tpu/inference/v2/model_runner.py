@@ -137,7 +137,11 @@ def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
         mlp = lp["mlp"]
         gate = _c(_proj(hn2, mlp["gate_proj"]), (None, "tensor"), mesh)
         up = _c(_proj(hn2, mlp["up_proj"]), (None, "tensor"), mesh)
-        h = _c(h + _proj(jax.nn.silu(gate) * up, mlp["down_proj"]), (None, None), mesh)
+        if getattr(cfg, "mlp_activation", "silu") == "gelu_tanh":  # Gemma GeGLU
+            inter = jax.nn.gelu(gate, approximate=True) * up
+        else:
+            inter = jax.nn.silu(gate) * up
+        h = _c(h + _proj(inter, mlp["down_proj"]), (None, None), mesh)
     return h, (kc, vc)
 
 
@@ -234,6 +238,9 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=
     is_gpt = hasattr(cfg, "position_embedding")
     embed = params["model"]["embed_tokens"]
     h = _c(embed[batch["token_ids"]].astype(dtype), (None, None), mesh)  # [T, D]
+    mult = getattr(cfg, "embedding_multiplier", 1.0)
+    if mult != 1.0:  # Gemma: sqrt(hidden_size)
+        h = h * jnp.asarray(mult, h.dtype)
 
     if is_gpt:
         cos = sin = None

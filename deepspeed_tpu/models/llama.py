@@ -58,6 +58,12 @@ class LlamaConfig:
     # InternLM-style o_proj bias (with attention_bias=True: biases on all
     # four attention projections, reference containers/internlm.py)
     attention_out_bias: bool = False
+    # Gemma-family knobs: explicit head_dim decoupled from hidden/heads
+    # (Gemma-7B: 16 heads x 256 on a 3072 hidden), GeGLU gate activation,
+    # and sqrt(hidden) embedding scaling. 0 / "silu" / 1.0 = Llama.
+    head_dim_override: int = 0
+    mlp_activation: str = "silu"  # "silu" | "gelu_tanh"
+    embedding_multiplier: float = 1.0
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash"
     # sequence parallelism: "ulysses" trades seq shards for head shards
     # around local attention (bounded by head count); "ring" keeps the
@@ -89,7 +95,7 @@ class LlamaConfig:
 
     @property
     def head_dim(self):
-        return self.hidden_size // self.num_attention_heads
+        return self.head_dim_override or self.hidden_size // self.num_attention_heads
 
 
 # Named presets (tiny ones drive tests/bench; large ones mirror the
@@ -332,7 +338,12 @@ class LlamaMLP(nn.Module):
         cfg = self.config
         gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj")(h)
         up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj")(h)
-        inter = nn.silu(gate) * up
+        if cfg.mlp_activation == "silu":
+            inter = nn.silu(gate) * up
+        elif cfg.mlp_activation == "gelu_tanh":  # Gemma GeGLU
+            inter = nn.gelu(gate, approximate=True) * up
+        else:
+            raise ValueError(f"mlp_activation {cfg.mlp_activation!r}: silu | gelu_tanh")
         inter = constrain(inter, (("data", "expert"), "sequence", "tensor"))
         return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj")(inter)
 
@@ -387,6 +398,8 @@ class LlamaModel(nn.Module):
         # activation on every step.
         embed = constrain(embed, ("tensor", None))
         h = jnp.take(embed, input_ids, axis=0)
+        if cfg.embedding_multiplier != 1.0:  # Gemma: sqrt(hidden_size)
+            h = h * jnp.asarray(cfg.embedding_multiplier, h.dtype)
         decode = cache is not None
         if not decode:
             h = constrain_hidden(h)

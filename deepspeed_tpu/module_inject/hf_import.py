@@ -103,6 +103,47 @@ def import_llama(state, hf_config):
     return params
 
 
+def import_gemma(state, hf_config):
+    """HF ``GemmaForCausalLM`` state_dict → native Llama-family params.
+    Same tensor layout as llama except GemmaRMSNorm multiplies by
+    ``(1 + w)`` — folded into the native multiplicative scale here — and
+    the head is always tied to the embedding."""
+    params = import_llama(state, hf_config)
+    layers = params["model"]["layers"]
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln]["scale"] = layers[ln]["scale"] + 1.0
+    params["model"]["norm"]["scale"] = params["model"]["norm"]["scale"] + 1.0
+    return params
+
+
+def gemma_config_from_hf(hf_config, **overrides):
+    from deepspeed_tpu.models.llama import LlamaConfig
+    act = getattr(hf_config, "hidden_activation", None) or \
+        getattr(hf_config, "hidden_act", "gelu_pytorch_tanh")
+    if act != "gelu_pytorch_tanh":
+        # transformers' GemmaMLP runs ACT2FN[act] verbatim, so plain
+        # "gelu" means exact erf-GeLU there — refuse rather than
+        # silently substitute the tanh form (every released Gemma
+        # checkpoint uses gelu_pytorch_tanh)
+        raise NotImplementedError(
+            f"Gemma hidden_activation {act!r}: only 'gelu_pytorch_tanh' maps exactly")
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=hf_config.num_key_value_heads,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_word_embeddings=True,
+        head_dim_override=int(hf_config.head_dim),
+        mlp_activation="gelu_tanh",
+        embedding_multiplier=float(hf_config.hidden_size) ** 0.5,
+        **overrides)
+
+
 def import_qwen(state, hf_config):
     """HF ``QWenLMHeadModel`` (Qwen v1, trust_remote_code) state_dict →
     params for :class:`deepspeed_tpu.models.llama.LlamaForCausalLM`.
@@ -227,6 +268,11 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
             f"sliding_window={sw}: the native model attends fully causally, so logits "
             f"diverge past the window. Pass ignore_sliding_window=True to accept "
             f"full-attention semantics (exact for sequences <= {sw} tokens)")
+    # Mistral-Nemo-style decoupled head_dim (hidden 5120, 32 heads,
+    # head_dim 128): honor the explicit value when it differs
+    explicit_hd = int(getattr(hf_config, "head_dim", None) or 0)
+    if explicit_hd * hf_config.num_attention_heads == hf_config.hidden_size:
+        explicit_hd = 0  # matches the derived value; keep the default
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -235,6 +281,7 @@ def llama_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
         num_attention_heads=hf_config.num_attention_heads,
         num_key_value_heads=getattr(hf_config, "num_key_value_heads",
                                     hf_config.num_attention_heads),
+        head_dim_override=explicit_hd,
         max_position_embeddings=hf_config.max_position_embeddings,
         rms_norm_eps=hf_config.rms_norm_eps,
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
@@ -896,6 +943,9 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "qwen":
         from deepspeed_tpu.models.llama import LlamaForCausalLM
         return LlamaForCausalLM(qwen_config_from_hf(hf_config)), import_qwen(state, hf_config)
+    if mt == "gemma":
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        return LlamaForCausalLM(gemma_config_from_hf(hf_config)), import_gemma(state, hf_config)
     if mt == "gpt2":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt2(state, hf_config)
@@ -938,4 +988,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('qwen', 'gpt2', 'gpt_neo', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")
+        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'gpt2', 'gpt_neo', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

@@ -162,6 +162,23 @@ class TestEngineV2Correctness:
         with pytest.raises(ValueError, match="no prefilled context"):
             engine.decode_burst([93], [5], 2)
 
+    def test_gemma_knobs_in_ragged_path(self):
+        """The ragged runner honors the Gemma config knobs (GeGLU gate,
+        embedding multiplier, explicit head_dim): v2 serving logits match
+        the dense flax forward of the same gemma-configured model."""
+        import dataclasses
+        from deepspeed_tpu.models import build_llama
+        model = build_llama("debug", head_dim_override=8, mlp_activation="gelu_tanh",
+                            embedding_multiplier=8.0, tie_word_embeddings=True)
+        rng = jax.random.PRNGKey(3)
+        params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+        engine = InferenceEngineV2(model=model, config=CFG, params=params,
+                                   dtype=jnp.float32)
+        ids = (np.arange(10, dtype=np.int32) * 7) % 250
+        got = engine.put([1], [ids])[0]
+        want = dense_logits(model, params, ids)[-1]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
     def test_suspend_resume_kv_swapping(self, setup):
         """KV host swap (beyond the reference, whose offload() raises
         NotImplementedError): suspend a mid-generation sequence, let

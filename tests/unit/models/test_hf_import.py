@@ -66,6 +66,24 @@ class TestHFImportParity:
             attention_bias=True)
         _check(transformers.LlamaForCausalLM(cfg), IDS)
 
+    def test_gemma_geglu_scaled_embed(self):
+        """Gemma: (1+w) RMSNorm folded into the native scale, GeGLU,
+        sqrt(hidden) embedding scaling, explicit head_dim decoupled from
+        hidden/heads, tied head — exact logit parity."""
+        cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=64, hidden_activation="gelu_pytorch_tanh")
+        _check(transformers.GemmaForCausalLM(cfg), IDS)
+
+    def test_mistral_nemo_decoupled_head_dim(self):
+        """Mistral-Nemo layout: head_dim explicit and != hidden/heads."""
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=40, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=64, sliding_window=None)
+        _check(transformers.MistralForCausalLM(cfg), IDS)
+
     def test_mixtral_moe(self):
         cfg = transformers.MixtralConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
