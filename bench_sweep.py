@@ -88,7 +88,12 @@ if __name__ == "__main__":
     elif which == "gas":
         # r4 finding: the fused-scan dispatch amortization keeps paying
         # past gas=32 (0.548 @32 -> 0.563 @64 -> 0.568 @128); S=4096
-        # regressed (0.536 — flash runs the longer rows less efficiently)
+        # regressed (0.536 — flash runs the longer rows less efficiently).
+        # r4 late sweep (post recompile-fix, warmup=2): gas=192 -> 0.572
+        # (+0.4pp for a 54.6s step); gas=256 crashed the TPU worker
+        # ("worker process crashed or restarted" — likely a step-duration
+        # watchdog at ~73s). Headline stays gas=128: the marginal MFU is
+        # not worth a step time that flirts with the watchdog.
         run("H0: B4 S2048 gas32 dots z3", stage=3, remat_policy="dots",
             B=4, S=2048, gas=32, steps=3, warmup=1)
         run("H3: B4 S2048 gas64 dots z3", stage=3, remat_policy="dots",

@@ -358,6 +358,74 @@ def import_gpt2(state, hf_config):
     }}
 
 
+def import_gpt_bigcode(state, hf_config):
+    """HF ``GPTBigCodeForCausalLM`` (StarCoder family) state_dict → GPT
+    family params: gpt2-shaped but with ``nn.Linear`` weights ([out, in] —
+    transposed on import, unlike gpt2's Conv1D) and a fused c_attn whose
+    rows are [q(D), k(kv_dim), v(kv_dim)] — kv_dim = head_dim under
+    multi-query attention (one shared KV head), D for the MHA variant."""
+    L = hf_config.n_layer
+    D = hf_config.n_embd
+    H = hf_config.n_head
+    Dh = D // H
+    mq = getattr(hf_config, "multi_query", True)
+    kvd = Dh if mq else D
+
+    def split_qkv(i):
+        w = _np(state[f"transformer.h.{i}.attn.c_attn.weight"])  # [D+2*kvd, D]
+        b = _np(state[f"transformer.h.{i}.attn.c_attn.bias"])
+        if w.shape[0] != D + 2 * kvd:
+            raise NotImplementedError(
+                f"gpt_bigcode c_attn rows {w.shape[0]} != D+2*kv_dim ({D + 2 * kvd})")
+        if mq:
+            q = (w[:D].T.copy(), b[:D])
+            k = (w[D:D + kvd].T.copy(), b[D:D + kvd])
+            v = (w[D + kvd:].T.copy(), b[D + kvd:])
+        else:
+            # MHA: rows fully interleave per head — HF views the fused
+            # output as [.., H, 3*head_dim] and splits the last dim into
+            # (q_h, k_h, v_h)
+            wr = w.reshape(H, 3 * Dh, D)
+            br = b.reshape(H, 3 * Dh)
+            q = (wr[:, :Dh].reshape(D, D).T.copy(), br[:, :Dh].reshape(D))
+            k = (wr[:, Dh:2 * Dh].reshape(D, D).T.copy(), br[:, Dh:2 * Dh].reshape(D))
+            v = (wr[:, 2 * Dh:].reshape(D, D).T.copy(), br[:, 2 * Dh:].reshape(D))
+        return [q, k, v]
+
+    per_layer = [split_qkv(i) for i in range(L)]
+    attn = {name: {"kernel": np.stack([per_layer[i][j][0] for i in range(L)]),
+                   "bias": np.stack([per_layer[i][j][1] for i in range(L)])}
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj"))}
+    attn["o_proj"] = {"kernel": _stack(state, "transformer.h.{}.attn.c_proj.weight", L),
+                      "bias": _stack(state, "transformer.h.{}.attn.c_proj.bias", L, _np)}
+
+    layers = {
+        "attn": attn,
+        "input_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_1.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_1.bias", L, _np)}},
+        "post_attention_layernorm": {"norm": {
+            "scale": _stack(state, "transformer.h.{}.ln_2.weight", L, _np),
+            "bias": _stack(state, "transformer.h.{}.ln_2.bias", L, _np)}},
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "transformer.h.{}.mlp.c_fc.weight", L),
+                      "bias": _stack(state, "transformer.h.{}.mlp.c_fc.bias", L, _np)},
+            "fc_out": {"kernel": _stack(state, "transformer.h.{}.mlp.c_proj.weight", L),
+                       "bias": _stack(state, "transformer.h.{}.mlp.c_proj.bias", L, _np)},
+        },
+    }
+    params = {"model": {
+        "embed_tokens": _np(state["transformer.wte.weight"]),
+        "embed_positions": _np(state["transformer.wpe.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.ln_f.weight"]),
+                            "bias": _np(state["transformer.ln_f.bias"])},
+    }}
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        params["lm_head"] = {"kernel": _t(state["lm_head.weight"])}
+    return params
+
+
 def import_gpt_neo(state, hf_config):
     """HF ``GPTNeoForCausalLM`` state_dict → params for the native GPT
     family: gpt2-shaped (learned positions, pre-LN) but with unfused
@@ -501,6 +569,21 @@ def import_bloom(state, hf_config):
 def gpt_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
     from deepspeed_tpu.models.gpt import GPTConfig
     mt = hf_config.model_type
+    if mt == "gpt_bigcode":
+        if not getattr(hf_config, "scale_attn_weights", True):
+            raise NotImplementedError("gpt_bigcode with scale_attn_weights=False "
+                                      "has no exact native mapping")
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
+                         intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+                         num_hidden_layers=hf_config.n_layer,
+                         num_attention_heads=hf_config.n_head,
+                         num_key_value_heads=(1 if getattr(hf_config, "multi_query", True)
+                                              else hf_config.n_head),
+                         max_position_embeddings=hf_config.n_positions,
+                         activation=_hf_activation(hf_config.activation_function),
+                         layer_norm_eps=hf_config.layer_norm_epsilon,
+                         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+                         **overrides)
     if mt == "gpt_neo":
         att_layers = list(getattr(hf_config, "attention_layers", []))
         window = getattr(hf_config, "window_size", 256)
@@ -953,6 +1036,9 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         cfg = gpt_config_from_hf(hf_config, ignore_sliding_window=ignore_sliding_window)
         return GPTForCausalLM(cfg), import_gpt_neo(state, hf_config)
+    if mt == "gpt_bigcode":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt_bigcode(state, hf_config)
     if mt == "opt":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_opt(state, hf_config)
@@ -988,4 +1074,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'gpt2', 'gpt_neo', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")
+        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'gpt2', 'gpt_neo', 'gpt_bigcode', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

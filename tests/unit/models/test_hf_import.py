@@ -260,6 +260,26 @@ class TestHFImportParity:
                                    rtol=1e-5, atol=1e-5)
         assert np.abs(with_bias - zeroed).max() > 1e-3  # the bias is live
 
+    def test_gpt_bigcode_mqa(self):
+        """StarCoder family: fused [q(D), k(kv), v(kv)] c_attn with
+        multi-query attention — exact logit parity."""
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+            multi_query=True)
+        _check(transformers.GPTBigCodeForCausalLM(cfg), IDS)
+
+    def test_gpt_bigcode_mha_variant(self):
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+            multi_query=False)
+        _check(transformers.GPTBigCodeForCausalLM(cfg), IDS)
+
+    def test_gpt_bigcode_untied_head(self):
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+            multi_query=True, tie_word_embeddings=False)
+        _check(transformers.GPTBigCodeForCausalLM(cfg), IDS)
+
     def test_gpt_neo_unscaled_attention(self):
         """GPT-Neo: bias-free q/k/v, biased out_proj, NO 1/sqrt(d) softmax
         scale — exact logit parity against transformers."""
