@@ -426,6 +426,51 @@ def import_gpt_bigcode(state, hf_config):
     return params
 
 
+def import_mpt(state, hf_config):
+    """HF ``MptForCausalLM`` state_dict → GPT family params: ALiBi
+    positions (no wpe), bias-free projections, LayerNorm without bias
+    (imported as zero biases — mathematically identical), contiguous
+    fused Wqkv, exact erf-GeLU MLP."""
+    L = hf_config.n_layers
+    D = hf_config.d_model
+
+    def split_qkv(i):
+        w = _np(state[f"transformer.blocks.{i}.attn.Wqkv.weight"])  # [3D, D]
+        if w.shape[0] != 3 * D:
+            raise NotImplementedError(f"MPT Wqkv rows {w.shape[0]} != 3*d_model ({3 * D})")
+        return w[:D].T.copy(), w[D:2 * D].T.copy(), w[2 * D:].T.copy()
+
+    qkv = [split_qkv(i) for i in range(L)]
+    zeros = np.zeros((L, D), np.float32)
+
+    def ln(fmt):
+        return {"norm": {"scale": _stack(state, fmt, L, _np), "bias": zeros}}
+
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": np.stack([q for q, _, _ in qkv])},
+            "k_proj": {"kernel": np.stack([k for _, k, _ in qkv])},
+            "v_proj": {"kernel": np.stack([v for _, _, v in qkv])},
+            "o_proj": {"kernel": _stack(state, "transformer.blocks.{}.attn.out_proj.weight", L)},
+        },
+        "input_layernorm": ln("transformer.blocks.{}.norm_1.weight"),
+        "post_attention_layernorm": ln("transformer.blocks.{}.norm_2.weight"),
+        "mlp": {
+            "fc_in": {"kernel": _stack(state, "transformer.blocks.{}.ffn.up_proj.weight", L)},
+            "fc_out": {"kernel": _stack(state, "transformer.blocks.{}.ffn.down_proj.weight", L)},
+        },
+    }
+    params = {"model": {
+        "embed_tokens": _np(state["transformer.wte.weight"]),
+        "layers": layers,
+        "final_layernorm": {"scale": _np(state["transformer.norm_f.weight"]),
+                            "bias": np.zeros(D, np.float32)},
+    }}
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        params["lm_head"] = {"kernel": _t(state["lm_head.weight"])}
+    return params
+
+
 def import_gpt_neo(state, hf_config):
     """HF ``GPTNeoForCausalLM`` state_dict → params for the native GPT
     family: gpt2-shaped (learned positions, pre-LN) but with unfused
@@ -582,6 +627,42 @@ def gpt_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
                          max_position_embeddings=hf_config.n_positions,
                          activation=_hf_activation(hf_config.activation_function),
                          layer_norm_eps=hf_config.layer_norm_epsilon,
+                         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+                         **overrides)
+    if mt == "mpt":
+        ac = getattr(hf_config, "attn_config", None)
+        if ac is not None:
+            if getattr(ac, "qk_ln", False):
+                raise NotImplementedError("MPT with attn_config.qk_ln=True has no "
+                                          "exact native mapping")
+            if getattr(ac, "clip_qkv", None):
+                raise NotImplementedError("MPT with attn_config.clip_qkv set has no "
+                                          "exact native mapping")
+            if getattr(ac, "alibi", True) is False:
+                raise NotImplementedError("MPT with attn_config.alibi=False (learned "
+                                          "positions variant) is not supported")
+            if getattr(ac, "alibi_bias_max", 8) != 8:
+                raise NotImplementedError("MPT with alibi_bias_max != 8 diverges from "
+                                          "the standard ALiBi slopes")
+        # HF MptMLP hardcodes 4*d_model regardless of expansion_ratio; a
+        # config claiming otherwise describes weights transformers itself
+        # could not run — refuse rather than build a mismatched model
+        if getattr(hf_config, "expansion_ratio", 4) != 4:
+            raise NotImplementedError("MPT with expansion_ratio != 4: transformers' "
+                                      "MptMLP hardcodes 4*d_model")
+        scale = getattr(ac, "softmax_scale", None) if ac is not None else None
+        return GPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.d_model,
+                         intermediate_size=4 * hf_config.d_model,
+                         num_hidden_layers=hf_config.n_layers,
+                         num_attention_heads=hf_config.n_heads,
+                         num_key_value_heads=hf_config.n_heads,
+                         max_position_embeddings=hf_config.max_seq_len,
+                         position_embedding="alibi",
+                         activation="gelu",
+                         layer_norm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5),
+                         attention_bias=False, mlp_bias=False,
+                         # HF uses attn_config.softmax_scale verbatim when set
+                         attention_softmax_scale=float(scale) if scale else None,
                          tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
                          **overrides)
     if mt == "gpt_neo":
@@ -1039,6 +1120,9 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "gpt_bigcode":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt_bigcode(state, hf_config)
+    if mt == "mpt":
+        from deepspeed_tpu.models.gpt import GPTForCausalLM
+        return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_mpt(state, hf_config)
     if mt == "opt":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_opt(state, hf_config)
@@ -1074,4 +1158,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'gpt2', 'gpt_neo', 'gpt_bigcode', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")
+        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'gpt2', 'gpt_neo', 'gpt_bigcode', 'mpt', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

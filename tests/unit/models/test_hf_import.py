@@ -280,6 +280,26 @@ class TestHFImportParity:
             multi_query=True, tie_word_embeddings=False)
         _check(transformers.GPTBigCodeForCausalLM(cfg), IDS)
 
+    def test_mpt_alibi_no_bias(self):
+        """MPT: ALiBi positions, bias-free projections, no-bias LN
+        (imported as zero biases), fused Wqkv, exact erf-GeLU."""
+        cfg = transformers.MptConfig(vocab_size=128, d_model=32, n_layers=2,
+                                     n_heads=4, max_seq_len=64)
+        _check(transformers.MptForCausalLM(cfg), IDS)
+
+    def test_mpt_untied_head(self):
+        cfg = transformers.MptConfig(vocab_size=128, d_model=32, n_layers=2,
+                                     n_heads=4, max_seq_len=64,
+                                     tie_word_embeddings=False)
+        _check(transformers.MptForCausalLM(cfg), IDS)
+
+    def test_mpt_non_pow2_heads_alibi_parity(self):
+        """Non-power-of-two head counts exercise the two-geometric-series
+        ALiBi slope formula — must still match HF exactly."""
+        cfg = transformers.MptConfig(vocab_size=128, d_model=48, n_layers=2,
+                                     n_heads=6, max_seq_len=64)
+        _check(transformers.MptForCausalLM(cfg), IDS)
+
     def test_gpt_neo_unscaled_attention(self):
         """GPT-Neo: bias-free q/k/v, biased out_proj, NO 1/sqrt(d) softmax
         scale — exact logit parity against transformers."""
