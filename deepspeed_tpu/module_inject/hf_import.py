@@ -144,6 +144,33 @@ def gemma_config_from_hf(hf_config, **overrides):
         **overrides)
 
 
+def import_phi3(state, hf_config):
+    """HF ``Phi3ForCausalLM`` state_dict → native Llama-family params:
+    llama-shaped with a fused ``qkv_proj`` (rows q, k, v contiguous) and
+    a fused ``gate_up_proj`` (first half gate, second half up) —
+    unfused here, then delegated to :func:`import_llama`."""
+    L = hf_config.num_hidden_layers
+    H = hf_config.num_attention_heads
+    Hkv = hf_config.num_key_value_heads
+    Dh = hf_config.hidden_size // H
+    I = hf_config.intermediate_size
+    qd, kvd = H * Dh, Hkv * Dh
+
+    unfused = dict(state)
+    for i in range(L):
+        w = _np(unfused.pop(f"model.layers.{i}.self_attn.qkv_proj.weight"))
+        if w.shape[0] != qd + 2 * kvd:
+            raise NotImplementedError(
+                f"phi3 qkv_proj rows {w.shape[0]} != q+2kv ({qd + 2 * kvd})")
+        unfused[f"model.layers.{i}.self_attn.q_proj.weight"] = w[:qd]
+        unfused[f"model.layers.{i}.self_attn.k_proj.weight"] = w[qd:qd + kvd]
+        unfused[f"model.layers.{i}.self_attn.v_proj.weight"] = w[qd + kvd:]
+        gu = _np(unfused.pop(f"model.layers.{i}.mlp.gate_up_proj.weight"))  # [2I, D]
+        unfused[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:I]
+        unfused[f"model.layers.{i}.mlp.up_proj.weight"] = gu[I:]
+    return import_llama(unfused, hf_config)
+
+
 def import_qwen(state, hf_config):
     """HF ``QWenLMHeadModel`` (Qwen v1, trust_remote_code) state_dict →
     params for :class:`deepspeed_tpu.models.llama.LlamaForCausalLM`.
@@ -662,7 +689,8 @@ def gpt_config_from_hf(hf_config, ignore_sliding_window=False, **overrides):
                          layer_norm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5),
                          attention_bias=False, mlp_bias=False,
                          # HF uses attn_config.softmax_scale verbatim when set
-                         attention_softmax_scale=float(scale) if scale else None,
+                         attention_softmax_scale=(float(scale) if scale is not None
+                                                  else None),
                          tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
                          **overrides)
     if mt == "gpt_neo":
@@ -1110,6 +1138,17 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
     if mt == "gemma":
         from deepspeed_tpu.models.llama import LlamaForCausalLM
         return LlamaForCausalLM(gemma_config_from_hf(hf_config)), import_gemma(state, hf_config)
+    if mt == "phi3":
+        if getattr(hf_config, "partial_rotary_factor", 1.0) != 1.0:
+            # Phi-4-mini ships model_type=phi3 with partial_rotary_factor
+            # 0.75; the native llama family rotates all head dims —
+            # refuse rather than silently diverge
+            raise NotImplementedError(
+                f"phi3 with partial_rotary_factor="
+                f"{hf_config.partial_rotary_factor} is not supported")
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        cfg = llama_config_from_hf(hf_config, ignore_sliding_window=ignore_sliding_window)
+        return LlamaForCausalLM(cfg), import_phi3(state, hf_config)
     if mt == "gpt2":
         from deepspeed_tpu.models.gpt import GPTForCausalLM
         return GPTForCausalLM(gpt_config_from_hf(hf_config)), import_gpt2(state, hf_config)
@@ -1158,4 +1197,4 @@ def from_hf(hf_model_or_state, hf_config=None, ignore_sliding_window=False):
         return BertForMaskedLM(bert_config_from_hf(hf_config)), import_bert(state, hf_config)
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: "
-        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'gpt2', 'gpt_neo', 'gpt_bigcode', 'mpt', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")
+        f"{_LLAMA_TYPES + ('qwen', 'gemma', 'phi3', 'gpt2', 'gpt_neo', 'gpt_bigcode', 'mpt', 'gptj', 'opt', 'bloom', 'gpt_neox', 'falcon', 'phi', 'bert', 'distilbert')}")

@@ -280,6 +280,26 @@ class TestHFImportParity:
             multi_query=True, tie_word_embeddings=False)
         _check(transformers.GPTBigCodeForCausalLM(cfg), IDS)
 
+    def test_phi3_fused_projections(self):
+        """Phi-3 (4k variants: no rope scaling): fused qkv_proj and
+        gate_up_proj split onto the llama layout — exact logit parity."""
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            pad_token_id=0)
+        _check(transformers.Phi3ForCausalLM(cfg), IDS)
+
+    def test_phi3_longrope_refused(self):
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            original_max_position_embeddings=32, pad_token_id=0,
+            rope_scaling={"type": "longrope",
+                          "short_factor": [1.0] * 4, "long_factor": [2.0] * 4})
+        hf = transformers.Phi3ForCausalLM(cfg)
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            from_hf(hf)
+
     def test_mpt_alibi_no_bias(self):
         """MPT: ALiBi positions, bias-free projections, no-bias LN
         (imported as zero biases), fused Wqkv, exact erf-GeLU."""
