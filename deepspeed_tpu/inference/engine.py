@@ -168,23 +168,11 @@ class InferenceEngine:
         module = self.module
 
         def sample_token(logits, rng):
-            logits = logits.astype(jnp.float32)
             if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            if temperature != 1.0:
-                logits = logits / max(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p and top_p < 1.0:
-                sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_l, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                # smallest set with cumulative prob >= top_p
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1)
-                cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
-                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+                return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+            from deepspeed_tpu.inference.sampling import sample_tokens
+            return sample_tokens(logits, rng, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
 
         def fn(params, input_ids, cache, rng, eos_id):
             B, S = input_ids.shape

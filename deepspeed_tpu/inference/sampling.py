@@ -1,0 +1,67 @@
+"""On-device token sampling shared by the v1 and v2 inference engines.
+
+One implementation of temperature → top-k → nucleus → categorical (the
+reference spreads equivalents across its engine generate paths); both
+engines and the hybrid engine delegate here so the filtering semantics
+cannot drift apart.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_KEYS = ("temperature", "top_k", "top_p")
+
+
+def validate_sample_spec(sample):
+    """Reject typo'd keys / invalid values in a sampling spec dict —
+    unknown keys would otherwise be silently dropped (running unfiltered
+    T=1.0 sampling), the opposite of what the caller asked for."""
+    unknown = set(sample) - set(_KEYS)
+    if unknown:
+        raise ValueError(f"unknown sampling keys {sorted(unknown)}; "
+                         f"supported: {list(_KEYS)}")
+    t = sample.get("temperature", 1.0)
+    k = sample.get("top_k", 0)
+    p = sample.get("top_p", 1.0)
+    if not (isinstance(t, (int, float)) and t > 0):
+        raise ValueError(f"temperature must be > 0, got {t!r}")
+    if not (isinstance(k, int) and k >= 0):
+        raise ValueError(f"top_k must be an int >= 0, got {k!r}")
+    if not (isinstance(p, (int, float)) and 0 < p <= 1):
+        raise ValueError(f"top_p must be in (0, 1], got {p!r}")
+
+
+def sample_spec_key(sample):
+    """Normalized hashable static key for jit caching."""
+    validate_sample_spec(sample)
+    return (float(sample.get("temperature", 1.0)),
+            int(sample.get("top_k", 0)),
+            float(sample.get("top_p", 1.0)))
+
+
+def sample_tokens(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
+    """[N, V] logits → [N] int32 sampled token ids (traced code).
+
+    temperature/top_k/top_p are STATIC (they shape the program)."""
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        logits = logits / max(temperature, 1e-6)
+    need_sort = (top_k and top_k > 0) or (top_p and top_p < 1.0)
+    if need_sort:
+        # one descending full-vocab sort serves both filters
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    if top_k and top_k > 0:
+        kth = sorted_l[:, top_k - 1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        if top_k and top_k > 0:
+            # nucleus applies to the top-k-filtered distribution
+            sorted_l = jnp.where(jnp.arange(sorted_l.shape[-1])[None, :] < top_k,
+                                 sorted_l, -jnp.inf)
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

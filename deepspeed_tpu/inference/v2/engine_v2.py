@@ -21,6 +21,10 @@ from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.utils.logging import logger
 
 
+from deepspeed_tpu.inference.sampling import sample_spec_key as _sample_key
+from deepspeed_tpu.inference.sampling import sample_tokens as _sample_tokens
+
+
 def _burst_layout(ms, mb):
     """Single source for the decode-burst metadata wire format: field →
     (start, end) offsets into the flat int32 vector. Both the host pack
@@ -162,8 +166,19 @@ class InferenceEngineV2:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
 
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
-        self._burst_fns = {}  # k -> jitted multi-step decode program
+
+        def step_sample(t, k_, p_):
+            def fn(p, kc, vc, b, rng):
+                logits, kc, vc = step(p, kc, vc, b)
+                return _sample_tokens(logits, rng, t, k_, p_), kc, vc
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        self._make_step_sample = step_sample
+        self._step_sample_fns = {}   # (temperature, top_k, top_p) -> jitted step
+        self._burst_fns = {}  # (k, sample_key|None) -> jitted multi-step program
         self._suspended = {}  # uid -> {"handle": host KV, "seen_tokens": int}
+        # sampling stream, decorrelated from the param-init key
+        self._rng = jax.random.fold_in(rng if rng is not None else jax.random.PRNGKey(0), 7)
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P
             self._replicated = NamedSharding(self.mesh, _P())
@@ -184,9 +199,13 @@ class InferenceEngineV2:
         ``do_checks`` exists for reference API parity but is ignored:
         validation is what keeps sequence state consistent with the KV
         pool, so it always runs."""
-        if sample not in (None, "greedy"):
-            raise ValueError(f"sample={sample!r}: supported modes are None (logits) "
-                             f"and 'greedy' (on-device argmax)")
+        if isinstance(sample, dict):
+            from deepspeed_tpu.inference.sampling import validate_sample_spec
+            validate_sample_spec(sample)  # BEFORE any sequence-state mutation
+        elif not (sample is None or sample == "greedy"):
+            raise ValueError(f"sample={sample!r}: supported modes are None (logits), "
+                             f"'greedy' (on-device argmax), or a sampling dict "
+                             f"{{'temperature', 'top_k', 'top_p'}}")
         batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]
         # Validate the WHOLE batch before touching any sequence state: a
         # mid-loop failure after allocate/advance would leave earlier
@@ -236,9 +255,18 @@ class InferenceEngineV2:
             # batch metadata is replicated over the serving mesh (the flat
             # token batch carries no sharding — only weights/KV do)
             arrays = jax.device_put(arrays, self._replicated)
-        fn = self._step_greedy if sample == "greedy" else self._step
-        out, self.kv_cache.k, self.kv_cache.v = fn(
-            self.params, self.kv_cache.k, self.kv_cache.v, arrays)
+        if isinstance(sample, dict):
+            key = _sample_key(sample)
+            fn = self._step_sample_fns.get(key)
+            if fn is None:
+                fn = self._step_sample_fns[key] = self._make_step_sample(*key)
+            self._rng, sub = jax.random.split(self._rng)
+            out, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, arrays, sub)
+        else:
+            fn = self._step_greedy if sample == "greedy" else self._step
+            out, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, arrays)
         return np.asarray(out)[np.asarray(slots)]
 
     def can_burst(self, batch_uids, k):
@@ -257,19 +285,25 @@ class InferenceEngineV2:
             need += desc.blocks_needed(k)
         return need <= self.kv_cache.free_blocks
 
-    def decode_burst(self, batch_uids, batch_tokens, k):
-        """Run ``k`` greedy decode steps for one current token per uid in
-        ONE compiled program: on-device argmax feeds the next step inside
-        a ``lax.scan``, so the host syncs once per ``k`` generated tokens
-        instead of every token (multi-step scheduling — ~70 ms/step of
-        transport round-trip in tunneled environments, and scheduler CPU
-        on production hosts). Returns int32 tokens ``[k, len(uids)]``.
+    def decode_burst(self, batch_uids, batch_tokens, k, sample=None):
+        """Run ``k`` decode steps for one current token per uid in ONE
+        compiled program: on-device-sampled tokens feed the next step
+        inside a ``lax.scan``, so the host syncs once per ``k`` generated
+        tokens instead of every token (multi-step scheduling — ~70
+        ms/step of transport round-trip in tunneled environments, and
+        scheduler CPU on production hosts). ``sample=None`` decodes
+        greedily; a ``{"temperature", "top_k", "top_p"}`` dict draws
+        stochastically (the engine's PRNG stream advances per burst).
+        Returns int32 tokens ``[k, len(uids)]``.
 
         KV blocks for all ``k`` tokens are reserved up front, so the
         block tables are static across the burst."""
         k = int(k)
         if k < 1:
             raise ValueError("k must be >= 1")
+        skey = _sample_key(sample) if isinstance(sample, dict) else None  # validates
+        if not (sample is None or skey is not None):
+            raise ValueError(f"sample={sample!r}: None (greedy) or a sampling dict")
         if len(batch_uids) != len(batch_tokens):
             raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} tokens")
         if len(batch_uids) > self.max_seqs:
@@ -309,21 +343,26 @@ class InferenceEngineV2:
         assert meta.shape[0] == sum(e - s for s, e in _burst_layout(ms, self.max_blocks_per_seq).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
-        fn = self._burst_fns.get(k)
+        fn = self._burst_fns.get((k, skey))
         if fn is None:
-            fn = self._burst_fns[k] = self._make_burst_fn(k)
-        out, self.kv_cache.k, self.kv_cache.v = fn(
-            self.params, self.kv_cache.k, self.kv_cache.v, meta)
+            fn = self._burst_fns[(k, skey)] = self._make_burst_fn(k, skey)
+        if skey is None:
+            out, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, meta)
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            out, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, meta, sub)
         return np.asarray(out)[:, :len(batch_uids)]
 
-    def _make_burst_fn(self, k):
+    def _make_burst_fn(self, k, skey=None):
         from deepspeed_tpu.inference.v2.model_runner import ragged_forward
         cfg, dtype, mesh = self.model_config, self.dtype, self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
         quantized = self._quantized
         ms, mb = self.max_seqs, self.max_blocks_per_seq
 
-        def burst(p, kc, vc, meta):
+        def burst(p, kc, vc, meta, rng=None):
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)  # once per burst, not per step
@@ -341,13 +380,19 @@ class InferenceEngineV2:
                      "last_index": last}
                 sel, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
                                              attn_impl=attn_impl)
-                nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+                if skey is None:
+                    nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = _sample_tokens(sel, jax.random.fold_in(rng, i), *skey)
                 return (kc, vc, nxt), nxt
 
             (kc, vc, _), out = jax.lax.scan(one, (kc, vc, tokens0),
                                             jnp.arange(k, dtype=jnp.int32))
             return out, kc, vc
 
+        if skey is None:
+            return jax.jit(lambda p, kc, vc, meta: burst(p, kc, vc, meta),
+                           donate_argnums=(1, 2))
         return jax.jit(burst, donate_argnums=(1, 2))
 
     def query(self, uid):

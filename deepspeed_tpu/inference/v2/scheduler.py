@@ -36,7 +36,7 @@ class DynamicSplitFuseScheduler:
     ``max_new_tokens``."""
 
     def __init__(self, engine, token_budget=None, sample_fn=None, eos_token_id=None,
-                 max_burst=16):
+                 max_burst=16, sampling=None):
         self.engine = engine
         self.budget = int(token_budget or engine.max_tokens)
         if self.budget > engine.max_tokens:
@@ -44,12 +44,24 @@ class DynamicSplitFuseScheduler:
         # default greedy sampling runs ON DEVICE (engine.put sample="greedy"):
         # one int32 per sequence crosses to the host instead of a vocab-wide
         # logits row. A custom sample_fn needs the logits, so it opts out.
+        if sampling is not None and sample_fn is not None:
+            raise ValueError("pass either sampling (on-device) or sample_fn (host), not both")
+        # sampling: {"temperature": t, "top_k": k, "top_p": p} → stochastic
+        # sampling ON DEVICE (put(sample=dict) / sampling bursts); None with
+        # no sample_fn → on-device greedy. Both keep vocab-wide logits off
+        # the host; a custom sample_fn opts out of both.
+        # normalize {} to None: an empty dict would mean greedy on one
+        # path and unfiltered T=1.0 sampling on the other
+        self._sampling = dict(sampling) if sampling else None
+        if self._sampling is not None:
+            from deepspeed_tpu.inference.sampling import validate_sample_spec
+            validate_sample_spec(self._sampling)
         self._device_greedy = sample_fn is None
         # multi-step decode: when every live request is decoding, run up
-        # to max_burst greedy steps in one compiled program (on-device
-        # argmax feeds the next step) — one host sync per burst instead of
-        # per token. 1 disables bursting. Only for device greedy: a custom
-        # sample_fn needs each step's logits on the host.
+        # to max_burst steps in one compiled program (on-device sampled
+        # tokens feed the next step) — one host sync per burst instead of
+        # per token. 1 disables bursting. Only for device-side sampling:
+        # a custom sample_fn needs each step's logits on the host.
         self.max_burst = max(1, int(max_burst)) if self._device_greedy else 1
         self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
         self.eos_token_id = eos_token_id
@@ -120,7 +132,8 @@ class DynamicSplitFuseScheduler:
             # failure inside the compiled burst would land after state
             # mutation + KV donation and is not recoverable.)
             return None
-        toks = self.engine.decode_burst(uids, [r.next_token for r in live], k)
+        toks = self.engine.decode_burst(uids, [r.next_token for r in live], k,
+                                        sample=self._sampling)
         for r in live:
             r.next_token = None
         for step_i in range(k):
@@ -151,7 +164,7 @@ class DynamicSplitFuseScheduler:
         if not uids:
             return []
         if self._device_greedy:
-            out = self.engine.put(uids, chunks, sample="greedy")
+            out = self.engine.put(uids, chunks, sample=self._sampling or "greedy")
         else:
             out = self.engine.put(uids, chunks)
         for uid, row in zip(uids, out):
