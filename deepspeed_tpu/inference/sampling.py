@@ -6,6 +6,8 @@ engines and the hybrid engine delegate here so the filtering semantics
 cannot drift apart.
 """
 
+import numbers
+
 import jax
 import jax.numpy as jnp
 
@@ -23,11 +25,12 @@ def validate_sample_spec(sample):
     t = sample.get("temperature", 1.0)
     k = sample.get("top_k", 0)
     p = sample.get("top_p", 1.0)
-    if not (isinstance(t, (int, float)) and t > 0):
+    # numbers.Real/Integral so numpy scalars from config pipelines pass
+    if not (isinstance(t, numbers.Real) and t > 0):
         raise ValueError(f"temperature must be > 0, got {t!r}")
-    if not (isinstance(k, int) and k >= 0):
+    if not (isinstance(k, numbers.Integral) and k >= 0):
         raise ValueError(f"top_k must be an int >= 0, got {k!r}")
-    if not (isinstance(p, (int, float)) and 0 < p <= 1):
+    if not (isinstance(p, numbers.Real) and 0 < p <= 1):
         raise ValueError(f"top_p must be in (0, 1], got {p!r}")
 
 
@@ -46,15 +49,18 @@ def sample_tokens(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
     logits = logits.astype(jnp.float32)
     if temperature != 1.0:
         logits = logits / max(temperature, 1e-6)
-    need_sort = (top_k and top_k > 0) or (top_p and top_p < 1.0)
+    # a top_k >= vocab filters nothing; clamp so any spec is safe for any
+    # model (validation cannot know the vocab size)
+    top_k = min(int(top_k), logits.shape[-1]) if top_k else 0
+    need_sort = top_k > 0 or (top_p and top_p < 1.0)
     if need_sort:
         # one descending full-vocab sort serves both filters
         sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-    if top_k and top_k > 0:
+    if top_k > 0:
         kth = sorted_l[:, top_k - 1][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p and top_p < 1.0:
-        if top_k and top_k > 0:
+        if top_k > 0:
             # nucleus applies to the top-k-filtered distribution
             sorted_l = jnp.where(jnp.arange(sorted_l.shape[-1])[None, :] < top_k,
                                  sorted_l, -jnp.inf)

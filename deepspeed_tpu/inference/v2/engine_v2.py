@@ -302,8 +302,8 @@ class InferenceEngineV2:
         if k < 1:
             raise ValueError("k must be >= 1")
         skey = _sample_key(sample) if isinstance(sample, dict) else None  # validates
-        if not (sample is None or skey is not None):
-            raise ValueError(f"sample={sample!r}: None (greedy) or a sampling dict")
+        if not (sample is None or sample == "greedy" or skey is not None):
+            raise ValueError(f"sample={sample!r}: None/'greedy' or a sampling dict")
         if len(batch_uids) != len(batch_tokens):
             raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} tokens")
         if len(batch_uids) > self.max_seqs:

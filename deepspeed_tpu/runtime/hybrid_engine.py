@@ -96,7 +96,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
             def pick(lg, r):
                 if do_sample:
-                    return jax.random.categorical(r, lg / temperature, axis=-1)
+                    from deepspeed_tpu.inference.sampling import sample_tokens
+                    return sample_tokens(lg, r, temperature=temperature)
                 return jnp.argmax(lg, axis=-1)
 
             rng, sub = jax.random.split(rng)
