@@ -177,8 +177,14 @@ class InferenceEngineV2:
         self._step_sample_fns = {}   # (temperature, top_k, top_p) -> jitted step
         self._burst_fns = {}  # (k, sample_key|None) -> jitted multi-step program
         self._suspended = {}  # uid -> {"handle": host KV, "seen_tokens": int}
-        # sampling stream, decorrelated from the param-init key
-        self._rng = jax.random.fold_in(rng if rng is not None else jax.random.PRNGKey(0), 7)
+        # sampling stream, decorrelated from the param-init key. When the
+        # caller passed params but no rng, seed from OS entropy — parallel
+        # serving replicas must not all draw the identical "stochastic"
+        # token sequence. Pass rng explicitly for reproducible sampling.
+        if rng is None:
+            import os
+            rng = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
+        self._rng = jax.random.fold_in(rng, 7)
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P
             self._replicated = NamedSharding(self.mesh, _P())
@@ -466,6 +472,8 @@ class InferenceEngineV2:
         self.state_manager = None
         self._step = self._step_greedy = None
         self._burst_fns = {}
+        self._step_sample_fns = {}
+        self._make_step_sample = None
         self._suspended = {}
 
     @property

@@ -20,6 +20,7 @@ consumes the padded flat batch —
 """
 
 import functools
+import math
 
 import numpy as np
 
@@ -191,6 +192,13 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):
     q = _c(_proj(x_attn, attn["q_proj"]).reshape(T, H, Dh), (None, "tensor", None), mesh)
     k = _c(_proj(x_attn, attn["k_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
     v = _c(_proj(x_attn, attn["v_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
+    if cfg.attention_softmax_scale is not None:
+        # same pre-scale as models/gpt.py:209 — every attention impl
+        # divides by sqrt(Dh); pre-scaling q realises any other softmax
+        # scale (GPT-Neo's unscaled attention, MPT's softmax_scale)
+        # without touching the paged kernels. Rope is a rotation, so the
+        # scalar commutes with it.
+        q = q * jnp.asarray(cfg.attention_softmax_scale * math.sqrt(Dh), q.dtype)
     if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
         rd = cfg.rotary_dim
         rope = _rope_flat_interleaved if cfg.rope_interleaved else _rope_flat

@@ -111,6 +111,9 @@ class DynamicSplitFuseScheduler:
         when the burst path doesn't apply this round."""
         live = [r for r in self.requests.values() if not r.done]
         if (self.max_burst < 2 or not live or len(live) > self.engine.max_seqs
+                or len(live) > self.budget  # burst must respect the per-step
+                # token budget too: one decode token per live request per
+                # burst step, same bound _plan enforces
                 or any(r.next_token is None for r in live)):
             return None
         k = min(self.max_burst,

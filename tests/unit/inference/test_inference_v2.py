@@ -349,6 +349,26 @@ class TestGPTFamilyServing:
                                    rtol=2e-4, atol=2e-4)
         engine.flush(1)
 
+    def test_attention_softmax_scale_matches_dense(self):
+        """GPT-family with attention_softmax_scale set (GPT-Neo imports
+        use 1.0 = unscaled attention; MPT sets attn_config.softmax_scale):
+        the ragged runner must apply the same q pre-scale as the dense
+        forward (models/gpt.py:209) or serving silently yields wrong
+        logits (round-4 advisor high finding)."""
+        from deepspeed_tpu.models import build_gpt
+        model = build_gpt("gptj-debug", attention_softmax_scale=1.0, remat=False)
+        params = model.init(jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32))["params"]
+        engine = InferenceEngineV2(model=model, config=CFG, params=params, dtype=jnp.float32)
+        ids = (np.arange(10, dtype=np.int32) * 11) % 250
+        out = engine.put([1], [ids])
+        want = dense_logits(model, params, ids)[-1]
+        np.testing.assert_allclose(out[0], want, rtol=2e-4, atol=2e-4)
+        assert int(np.argmax(out[0])) == int(np.argmax(want))
+        out = engine.put([1], [[5]])  # decode step keeps the scale too
+        want = dense_logits(model, params, np.append(ids, 5).astype(np.int32))[-1]
+        np.testing.assert_allclose(out[0], want, rtol=2e-4, atol=2e-4)
+        engine.flush(1)
+
     def test_qwen2_style_qkv_bias_matches_dense(self):
         """Llama-family with attention_bias=True (Qwen2) — biases must
         flow through the ragged runner's projections."""
@@ -385,6 +405,26 @@ class TestScheduler:
         assert out[11] == rollout(prompt_a, 3)
         assert out[12] == rollout(prompt_b, 3)
         # all sequences flushed → all blocks back
+        assert engine.state_manager.n_tracked_sequences == 0
+
+    def test_burst_respects_token_budget(self, setup):
+        """A token_budget smaller than the live-request count must keep
+        bounding per-step work on the all-decoding path too — _try_burst
+        may not bypass it (round-4 advisor finding)."""
+        model, params, engine = setup
+        sched = DynamicSplitFuseScheduler(engine, token_budget=16, max_burst=8)
+        for uid in (21, 22, 23):
+            sched.add_request(uid, (np.arange(4, dtype=np.int32) * (uid % 7 + 1)) % 250,
+                              max_new_tokens=6)
+        sched.step()  # budget 16 prefills all three → all live decoding
+        assert all(not r.prefilling and r.next_token is not None
+                   for r in sched.requests.values())
+        sched.budget = 2  # now 3 live > budget → burst must refuse...
+        assert sched._try_burst() is None
+        sched.budget = 16  # ...and the budget really was the deciding factor
+        assert sched._try_burst() is not None
+        out = sched.run_to_completion()
+        assert all(len(out[u]) == 6 for u in (21, 22, 23))
         assert engine.state_manager.n_tracked_sequences == 0
 
 
