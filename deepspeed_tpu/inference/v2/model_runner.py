@@ -168,7 +168,10 @@ def _moe_mlp(x, p, k, mesh=None):
         topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
     return dropless_moe_ffn(x, topk_idx, topk_vals,
                             p["experts_w1"], p["experts_w3"], p["experts_w2"],
-                            num_experts=gates.shape[-1], mesh=mesh)
+                            num_experts=gates.shape[-1], mesh=mesh,
+                            widen_boundary=False)  # forward-only: keep the
+    # bf16 expert-axis gather (the fp32 boundary exists for the backward
+    # transpose psum, which serving never runs)
 
 
 def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):

@@ -49,7 +49,8 @@ def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation
     return jnp.take(out, unsort, axis=0)
 
 
-def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None):
+def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None,
+                     widen_boundary=True):
     """Post-gate dropless MoE FFN over flat tokens — the one
     implementation behind BOTH v2 ragged serving and dropless training.
 
@@ -87,27 +88,40 @@ def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None)
                 row = P("expert", None, None)
                 psum_axes = ("expert",)
             if E % ep == 0:
+                dtype = x.dtype
+
                 def shard_body(x_full, idx, w1s, w3s, w2s):
                     e_local = E // ep
                     off = jax.lax.axis_index("expert") * e_local
                     local = (idx >= off) & (idx < off + e_local)
                     lidx = jnp.where(local, idx - off, 0)
-                    x_rep = jnp.repeat(x_full, k, axis=0)
-                    out = moe_grouped_mlp(x_rep, lidx, w1s.astype(x_full.dtype),
-                                          w3s.astype(x_full.dtype),
-                                          w2s.astype(x_full.dtype),
+                    x_rep = jnp.repeat(x_full.astype(dtype), k, axis=0)
+                    out = moe_grouped_mlp(x_rep, lidx, w1s.astype(dtype),
+                                          w3s.astype(dtype),
+                                          w2s.astype(dtype),
                                           num_experts=e_local)
                     out = jnp.where(local[:, None], out, 0)
                     # combine partial expert/feature sums in fp32 (also
                     # dodges an XLA:CPU CHECK-crash on bf16 all-reduce
                     # inside shard_map)
                     return jax.lax.psum(out.astype(jnp.float32),
-                                        psum_axes).astype(x_full.dtype)
+                                        psum_axes).astype(dtype)
 
+                # Training (widen_boundary=True): x crosses the region
+                # boundary in fp32 — the TRANSPOSE of the replicated
+                # in_spec is a psum of dx over 'expert', and a bf16 psum
+                # there hits the same XLA:CPU CHECK-crash ('Invalid
+                # binary instruction opcode copy') the forward psum above
+                # dodges; it goes live whenever the layer sits inside
+                # lax.scan (the carry keeps dx alive). Compute stays in
+                # the caller's dtype; only the boundary is widened.
+                # Forward-only serving passes widen_boundary=False and
+                # keeps the bf16 (half-traffic) expert-axis gather.
+                x_in = x.astype(jnp.float32) if widen_boundary else x
                 out_rep = jax.shard_map(
                     shard_body, mesh=mesh, in_specs=(P(), P(), col, col, row),
                     out_specs=P(), axis_names={"expert", "tensor"},
-                    check_vma=False)(x, idx_rep, w1, w3, w2)
+                    check_vma=False)(x_in, idx_rep, w1, w3, w2)
                 out_k = out_rep.reshape(T, k, -1)
                 return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
 

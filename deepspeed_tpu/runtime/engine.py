@@ -510,17 +510,6 @@ class DeepSpeedEngine:
         if self._initialized:
             return
         self._configure_param_offload()
-        mcfg = getattr(self.module, "config", None)
-        if (mcfg is not None and getattr(mcfg, "moe_drop_tokens", True) is False
-                and dict(self.mesh.shape).get("expert", 1) > 1):
-            raise NotImplementedError(
-                "dropless MoE training (moe_drop_tokens=False) cannot run with an "
-                "expert-parallel mesh axis inside the training engine: the batch is "
-                "sharded over 'expert' and differentiating the partial-manual "
-                "shard_map dispatch under that layout CHECK-crashes XLA "
-                "('Invalid binary instruction opcode copy'). Use capacity routing "
-                "(moe_drop_tokens=True) under expert parallelism, or ep=1 for "
-                "dropless; sharded dropless SERVING is unaffected")
         if self.params is None:
             self.params = self._init_params(*fwd_args, **fwd_kwargs)
         else:
