@@ -73,12 +73,14 @@ class LlamaConfig:
     remat: bool = True
     # "full" recomputes everything in backward (min memory, ~8N flops);
     # "dots" saves matmul outputs and recomputes elementwise (the usual
-    # MFU/memory sweet spot); only read when remat=True. (A "save the
-    # attention output" variant was measured and removed: the flash
-    # kernel is a custom_vjp whose bwd residuals (lse) require re-running
-    # the forward anyway, so naming its output saves memory for zero
-    # compute — bench-confirmed no-op at MFU 0.538 vs 0.540.)
-    remat_policy: str = "full"  # "full" | "dots"
+    # MFU/memory sweet spot); "moe" saves only the grouped-GEMM
+    # residuals so dropless-MoE backward skips re-running the expert
+    # GEMMs. Only read when remat=True. (A "save the attention output"
+    # variant was measured and removed: the flash kernel is a custom_vjp
+    # whose bwd residuals (lse) require re-running the forward anyway,
+    # so naming its output saves memory for zero compute —
+    # bench-confirmed no-op at MFU 0.538 vs 0.540.)
+    remat_policy: str = "full"  # "full" | "dots" | "moe"
     # ZeRO-Infinity param offload: engine sets this when the ds_config
     # has zero_optimization.offload_param — the scanned blocks then
     # stream their layer slice host→HBM (runtime/zero/param_stream.py)
@@ -140,7 +142,16 @@ def _remat_policy(name: str):
         return cp.dots_saveable
     if name == "full":
         return cp.nothing_saveable
-    raise ValueError(f"unknown remat_policy {name!r}: expected 'full' or 'dots'")
+    if name == "moe":
+        # Dropless-MoE sweet spot: save ONLY the grouped-GEMM residuals
+        # (sorted rows + gate/up activations, tagged in
+        # ops/grouped_gemm.py) so the backward never re-runs the expert
+        # GEMMs — the single biggest recompute under 'full' — while
+        # attention and everything elementwise still remat. ~3*T*k rows
+        # of extra HBM per layer vs a ~25% cut of expert-GEMM time.
+        return cp.save_only_these_names("moe_xs", "moe_gate", "moe_up",
+                                        "moe_routing", "moe_tiles")
+    raise ValueError(f"unknown remat_policy {name!r}: expected 'full', 'dots' or 'moe'")
 
 
 class RMSNorm(nn.Module):

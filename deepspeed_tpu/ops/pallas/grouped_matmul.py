@@ -1,0 +1,193 @@
+"""Pallas grouped (per-expert) matmul — the MoE expert GEMM.
+
+Capability match for the reference's CUTLASS grouped GEMM
+(``deepspeed/inference/v2/kernels/cutlass_ops/moe_gemm/`` — MoE expert
+dispatch as one kernel over per-expert row groups). TPU redesign,
+megablocks-style: the caller pads each expert's row group to a multiple
+of the row-tile ``tm`` (zeros), so every (tm × K) row tile belongs to
+exactly ONE expert and the kernel needs no in-tile masking at all — a
+scalar-prefetched ``tile_experts`` array steers each row tile's weight
+DMA (``PrefetchScalarGridSpec``: the index map picks ``w[e]`` before the
+tile runs). ``lax.ragged_dot`` measures ~98 TFLOP/s on v5e at Mixtral
+shapes vs ~200 for a dense matmul; tile-aligned groups recover dense
+tiling (the padding waste is ≤ E·(tm-1) rows, ~6% at tm=256, T·k=8k).
+
+Grid order puts the row-tile sweep innermost so each expert's weight
+slab stays resident in VMEM across its whole row range (weights re-DMA
+only on a group boundary); activations stream at one (tm × K) tile per
+step, which keeps the kernel compute-bound.
+
+The backward splits per operand: dx is the same kernel against
+``w.swapaxes(1, 2)``; dw accumulates ``x_tileᵀ @ dy_tile`` into a
+revisited output block, initialized on each group's first row tile.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gmm_kernel(te_ref, x_ref, w_ref, o_ref):
+    o_ref[:] = jnp.dot(x_ref[:], w_ref[0], preferred_element_type=jnp.float32
+                       ).astype(o_ref.dtype)
+
+
+def _gmm_dw_kernel(te_ref, x_ref, dy_ref, o_ref):
+    m = pl.program_id(2)
+    upd = jax.lax.dot_general(
+        x_ref[:], dy_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when((m == 0) | (te_ref[m] != te_ref[jnp.maximum(m - 1, 0)]))
+    def _init():
+        o_ref[0] = upd
+
+    @pl.when((m != 0) & (te_ref[m] == te_ref[jnp.maximum(m - 1, 0)]))
+    def _acc():
+        o_ref[0] += upd
+
+
+def _fit_tile(t, dim):
+    """Largest divisor of ``dim`` that is ≤ t and a multiple of 128 (the
+    lane width) when possible — tiles MUST divide the dim exactly or the
+    grid silently drops the remainder."""
+    t = min(t, dim)
+    while dim % t:
+        t -= 128 if t > 128 else 8
+        if t <= 8:
+            return 8 if dim % 8 == 0 else 1
+    return t
+
+
+def _gmm_raw(x, w, tile_experts, tm, tn, interpret=False):
+    """x [Mp, K] (rows tile-aligned by group), w [E, K, N],
+    tile_experts [Mp/tm] → y [Mp, N] (x.dtype)."""
+    Mp, K = x.shape
+    E, _, N = w.shape
+    tn = _fit_tile(tn, N)
+    grid = (N // tn, Mp // tm)  # row sweep innermost: w slab stays in VMEM
+    return pl.pallas_call(
+        _gmm_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, K), lambda j, i, te: (i, 0)),
+                pl.BlockSpec((1, K, tn), lambda j, i, te: (te[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((tm, tn), lambda j, i, te: (i, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), x.dtype),
+        interpret=interpret,
+    )(tile_experts, x, w)
+
+
+def _gmm_dw_raw(x, dy, tile_experts, num_experts, tk, tn, interpret=False):
+    """dw [E, K, N] fp32 = Σ_{rows of e} x_rowᵀ dy_row (groups tile-aligned;
+    pad rows are zero in BOTH x and dy so they contribute nothing)."""
+    Mp, K = x.shape
+    _, N = dy.shape
+    tm = Mp // tile_experts.shape[0]
+    tk = _fit_tile(tk, K)
+    tn = _fit_tile(tn, N)
+    grid = (K // tk, N // tn, Mp // tm)  # row sweep innermost: revisited
+    # output block accumulates in VMEM, written back on group change
+    out = pl.pallas_call(
+        _gmm_dw_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda kt, j, i, te: (i, kt)),
+                pl.BlockSpec((tm, tn), lambda kt, j, i, te: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, tk, tn), lambda kt, j, i, te: (te[i], kt, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((num_experts, K, N), jnp.float32),
+        interpret=interpret,
+    )(tile_experts, x, dy)
+    # experts that own zero row tiles never get their block written —
+    # mask them to zero (uninitialized output memory otherwise)
+    present = jax.ops.segment_sum(jnp.ones_like(tile_experts), tile_experts,
+                                  num_segments=num_experts) > 0
+    return jnp.where(present[:, None, None], out, 0.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def gmm(x, w, tile_experts, tm=256, tn=512, tk=256, interpret=False):
+    """Grouped matmul on a tile-aligned row layout.
+
+    ``x`` [Mp, K] with rows grouped by expert and each group padded
+    (with zero rows) to a multiple of ``tm``; ``w`` [E, K, N];
+    ``tile_experts`` [Mp/tm] int32 — owning expert of each row tile.
+    → [Mp, N] in ``x.dtype``. Differentiable in x and w.
+    Use :func:`pad_groups_to_tiles` to build the layout.
+    """
+    return _gmm_raw(x, w, tile_experts, tm, tn, interpret)
+
+
+def _gmm_fwd(x, w, tile_experts, tm, tn, tk, interpret):
+    return _gmm_raw(x, w, tile_experts, tm, tn, interpret), (x, w, tile_experts)
+
+
+def _gmm_bwd(tm, tn, tk, interpret, res, dy):
+    x, w, tile_experts = res
+    dy = dy.astype(x.dtype)
+    # dx: the same grouped matmul against the transposed expert weights
+    dx = _gmm_raw(dy, w.swapaxes(1, 2), tile_experts, tm, tn, interpret)
+    # dw: one full [K, N] fp32 accumulator block per expert when it fits
+    # the 4MB VMEM budget (next to the double-buffered input streams) —
+    # x and dy then stream exactly once; otherwise halve the block until
+    # it fits, re-reading x per n-tile and dy per k-tile.
+    K, N = w.shape[1], w.shape[2]
+    tk_dw, tn_dw = K, N
+    while tk_dw * tn_dw * 4 > 4 * 1024 * 1024:  # fit VMEM next to the streams
+        if tn_dw >= tk_dw and tn_dw % 256 == 0:
+            tn_dw //= 2
+        elif tk_dw % 256 == 0:
+            tk_dw //= 2
+        else:
+            tk_dw, tn_dw = tk, tn
+            break
+    dw = _gmm_dw_raw(x, dy, tile_experts, w.shape[0], tk_dw, tn_dw,
+                     interpret).astype(w.dtype)
+    return dx, dw, None
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def tile_layout(sizes, num_rows, tm):
+    """Shared tile-aligned layout math for :func:`gmm` callers.
+
+    ``sizes`` [E] (true per-group row counts, Σ = ``num_rows``) →
+    ``(padded_starts [E], tile_experts [Mp/tm], Mp)``: each group's
+    first padded row, the owning expert per row tile (tail tiles beyond
+    the last padded group clamp to the final expert — their rows are
+    zero by construction, so they contribute nothing), and the static
+    padded row count (every group padded up to a tile multiple, worst
+    case ``num_rows + E*tm``)."""
+    E = sizes.shape[0]
+    Mp = ((num_rows + tm - 1) // tm) * tm + E * tm
+    padded = ((sizes + tm - 1) // tm) * tm
+    padded_starts = jnp.cumsum(padded) - padded
+    tile_experts = jnp.repeat(jnp.arange(E, dtype=jnp.int32), padded // tm,
+                              total_repeat_length=Mp // tm)
+    return padded_starts, tile_experts, Mp
+
+
+def pad_groups_to_tiles(sizes, num_rows, tm):
+    """Layout metadata for group-SORTED rows: ``(dst, tile_experts, Mp)``
+    where ``dst`` [num_rows] maps the j-th sorted row to its padded
+    position. (The training dispatch in ``ops/grouped_gemm.py`` computes
+    per-row slots rank-based without sorting; both share
+    :func:`tile_layout`.)"""
+    padded_starts, tile_experts, Mp = tile_layout(sizes, num_rows, tm)
+    starts = jnp.cumsum(sizes) - sizes
+    row = jnp.arange(num_rows, dtype=jnp.int32)
+    expert_of_row = jnp.searchsorted(jnp.cumsum(sizes), row, side="right").astype(jnp.int32)
+    dst = (padded_starts[expert_of_row] + (row - starts[expert_of_row])).astype(jnp.int32)
+    return dst, tile_experts, Mp
