@@ -94,6 +94,10 @@ class LlamaConfig:
     moe_drop_tokens: bool = True
     # "" | "Jitter" (multiplicative input noise) | "RSample" (logit noise)
     moe_noisy_gate_policy: str = ""
+    # Training CE runs per sequence chunk (remat'd unembed) whenever
+    # S > 2*loss_chunk, so the [S, vocab] logits never materialize —
+    # the long-context HBM spike. 0 disables chunking.
+    loss_chunk: int = 2048
 
     @property
     def head_dim(self):
@@ -457,7 +461,10 @@ class LlamaForCausalLM(nn.Module):
 
     ``__call__(input_ids, labels)`` → ``(loss, logits)``;
     ``__call__(input_ids)`` → ``logits``. Positions with label -100 are
-    ignored (HF convention).
+    ignored (HF convention). For sequences longer than
+    ``2 * config.loss_chunk`` the loss is computed chunk-wise and the
+    second element is **None** — the full [B, S, vocab] logits are never
+    materialized (the long-context HBM spike).
     """
     config: LlamaConfig
 
@@ -471,19 +478,55 @@ class LlamaForCausalLM(nn.Module):
         decode = cache is not None
         h, embed, aux_loss, new_cache = LlamaModel(cfg, name="model")(input_ids, cache=cache,
                                                                       start_pos=start_pos)
-        if cfg.tie_word_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
+        S = input_ids.shape[1]
+        chunked = (labels is not None and not decode and cfg.loss_chunk > 0
+                   and S > 2 * cfg.loss_chunk)
+        if not chunked:
+            if cfg.tie_word_embeddings:
+                logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
+            else:
+                logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
+            if decode:
+                return logits, new_cache
+            logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))
+            if labels is None:
+                return logits
+            loss = causal_lm_loss(logits, labels)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
-        if decode:
-            return logits, new_cache
-        logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))
-        if labels is None:
-            return logits
-        loss = causal_lm_loss(logits, labels)
+            # Long-sequence loss: the full [B, S, V] logits (fp32 logp is
+            # S·V·4 bytes — 4.2 GB at 32k·32000, THE long-context HBM
+            # spike) are never materialized; the unembed + CE run per
+            # sequence chunk under remat, so backward recomputes one
+            # chunk's logits at a time.
+            loss = self._chunked_causal_loss(cfg, h, embed, labels)
+            logits = None
         if cfg.moe_num_experts > 0:
             loss = loss + cfg.moe_aux_loss_coef * aux_loss / cfg.num_hidden_layers
         return loss, logits
+
+    def _chunked_causal_loss(self, cfg, h, embed, labels):
+        C = cfg.loss_chunk
+        hs, ls = h[:, :-1], labels[:, 1:]
+        pad = (-hs.shape[1]) % C
+        if pad:
+            hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+            ls = jnp.pad(ls, ((0, 0), (0, pad)), constant_values=-100)
+        n = hs.shape[1] // C
+        total = jnp.zeros((), jnp.float32)
+        count = jnp.zeros((), jnp.int32)
+        if cfg.tie_word_embeddings:
+            step = jax.checkpoint(lambda hc, lc: _ce_chunk_stats(
+                jnp.einsum("bsd,vd->bsv", hc, embed.astype(hc.dtype)), lc))
+            for i in range(n):
+                s, c = step(hs[:, i * C:(i + 1) * C], ls[:, i * C:(i + 1) * C])
+                total, count = total + s, count + c
+        else:
+            lm_head = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")
+            step = nn.remat(_dense_ce_chunk, prevent_cse=False)
+            for i in range(n):
+                s, c = step(lm_head, hs[:, i * C:(i + 1) * C], ls[:, i * C:(i + 1) * C])
+                total, count = total + s, count + c
+        return total / jnp.maximum(count, 1).astype(jnp.float32)
 
     def tp_rule(self, path: str, shape) -> P:
         """Megatron-style tensor sharding (consumed by ZeroShardingPolicy).
@@ -515,17 +558,27 @@ def llama_tp_rule(path: str, shape) -> P:
     return P()  # norms, biases, gates replicated
 
 
-def masked_cross_entropy(logits, targets):
-    """Mean token cross entropy in fp32; positions with target -100 are
-    ignored (HF convention). Shared by the causal and MLM heads."""
+def _ce_chunk_stats(logits, targets):
+    """(masked nll sum fp32, valid-token count) for one loss chunk."""
     logits = logits.astype(jnp.float32)
     targets = targets.astype(jnp.int32)
-    mask = (targets != -100)
+    mask = targets != -100
     safe = jnp.where(mask, targets, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    denom = jnp.maximum(mask.sum(), 1)
-    return jnp.where(mask, nll, 0.0).sum() / denom
+    return jnp.where(mask, nll, 0.0).sum(), mask.sum()
+
+
+def _dense_ce_chunk(lm_head, hc, lc):
+    """nn.remat-able chunk step for the untied lm_head path."""
+    return _ce_chunk_stats(lm_head(hc), lc)
+
+
+def masked_cross_entropy(logits, targets):
+    """Mean token cross entropy in fp32; positions with target -100 are
+    ignored (HF convention). Shared by the causal and MLM heads."""
+    s, c = _ce_chunk_stats(logits, targets)
+    return s / jnp.maximum(c, 1).astype(jnp.float32)
 
 
 def causal_lm_loss(logits, labels):
