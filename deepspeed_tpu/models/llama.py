@@ -516,7 +516,8 @@ class LlamaForCausalLM(nn.Module):
         count = jnp.zeros((), jnp.int32)
         if cfg.tie_word_embeddings:
             step = jax.checkpoint(lambda hc, lc: _ce_chunk_stats(
-                jnp.einsum("bsd,vd->bsv", hc, embed.astype(hc.dtype)), lc))
+                constrain(jnp.einsum("bsd,vd->bsv", hc, embed.astype(hc.dtype)),
+                          (("data", "expert"), None, "tensor")), lc))
             for i in range(n):
                 s, c = step(hs[:, i * C:(i + 1) * C], ls[:, i * C:(i + 1) * C])
                 total, count = total + s, count + c
@@ -570,8 +571,11 @@ def _ce_chunk_stats(logits, targets):
 
 
 def _dense_ce_chunk(lm_head, hc, lc):
-    """nn.remat-able chunk step for the untied lm_head path."""
-    return _ce_chunk_stats(lm_head(hc), lc)
+    """nn.remat-able chunk step for the untied lm_head path. The chunk
+    logits keep the vocab-sharded layout of the full path (the fp32
+    log-probs are the buffer the chunking exists to bound)."""
+    logits = constrain(lm_head(hc), (("data", "expert"), None, "tensor"))
+    return _ce_chunk_stats(logits, lc)
 
 
 def masked_cross_entropy(logits, targets):

@@ -384,6 +384,17 @@ class DeepSpeedEngine:
     def _apply_module(self, params, *args, rngs=None, **kwargs):
         """Run the wrapped model. Supports flax modules ({'params': p}) and
         plain callables f(params, *args)."""
+        if getattr(self, "_generic_param_offload", False) and getattr(
+                self, "_param_offload_enabled", False):
+            # generic offload_param: upload the host-resident tree to its
+            # device compute layout inside the step program (XLA sinks
+            # each copy to first use and frees after last use). Inside a
+            # manual shard_map region (quantized/1-bit comm cores) the
+            # hop already happened before the region — a mesh-sharding
+            # device_put is illegal in here, so skip.
+            from deepspeed_tpu.ops.pallas import current_manual_axes
+            if not current_manual_axes():
+                params = jax.tree.map(jax.device_put, params, self._param_device_shardings)
         if hasattr(self.module, "apply"):
             try:
                 return self.module.apply({"params": params}, *args, rngs=rngs, **kwargs)
@@ -410,10 +421,12 @@ class DeepSpeedEngine:
         """Validate + arm ZeRO-Infinity param offload (offload_param).
 
         Reference semantics (``deepspeed/runtime/zero/stage3.py`` offload
-        branches): params may be offloaded only under ZeRO-3. The TPU
-        mechanism needs a model whose scanned blocks stream their own
-        layer slices (``param_stream_prefix`` + ``config.offload_params``),
-        so anything else raises instead of silently ignoring the config.
+        branches; ``partition_parameters.py:808`` works on any module):
+        params may be offloaded only under ZeRO-3. deepspeed_tpu models
+        stream per-layer slices inside their scan
+        (``param_stream_prefix`` + ``config.offload_params``); any other
+        flax module takes the generic path — whole tree in pinned_host,
+        uploaded by the step program itself.
         """
         zc = self._config.zero_config
         device = zc.offload_param_device().value
@@ -432,21 +445,28 @@ class DeepSpeedEngine:
             # swap_tensor/partitioned_param_swapper.py:36.
             self._param_nvme_path = self._config.zero_config.offload_param.nvme_path
             assert self._param_nvme_path, "offload_param.device=nvme requires nvme_path"
-        if self._quantized_comm_enabled() or self._onebit_enabled():
-            raise NotImplementedError(
-                "offload_param cannot combine with quantized/1-bit communication: the "
-                "manual shard_map gradient core does not stream host-resident params")
         cfg = getattr(self.module, "config", None)
         prefix = getattr(self.module, "param_stream_prefix", None)
-        if cfg is None or prefix is None or not hasattr(cfg, "offload_params"):
-            raise NotImplementedError(
-                f"offload_param needs a model with param-streaming support "
-                f"(config.offload_params + param_stream_prefix); "
-                f"{type(self.module).__name__} has neither — use a deepspeed_tpu model "
-                f"or disable offload_param")
-        if not cfg.offload_params:
-            import dataclasses as _dc
-            self.module = self.module.clone(config=_dc.replace(cfg, offload_params=True))
+        if cfg is not None and prefix is not None and hasattr(cfg, "offload_params"):
+            # deepspeed_tpu model: the scanned blocks stream their own
+            # layer slices host→HBM inside the scan (param_stream.py) —
+            # O(1 layer) of params resident at a time.
+            self._param_stream_prefix = prefix
+            self._generic_param_offload = False
+            if not cfg.offload_params:
+                import dataclasses as _dc
+                self.module = self.module.clone(config=_dc.replace(cfg, offload_params=True))
+        else:
+            # Arbitrary module (reference parity:
+            # zero/partition_parameters.py:808 wraps any nn.Module): the
+            # WHOLE param tree lives in pinned_host between steps and the
+            # jitted step device_puts it to HBM. The copies are graph ops,
+            # so XLA's latency-hiding scheduler sinks each upload to just
+            # before its first use and frees it after its last — for a
+            # sequential model that recovers a streaming working set
+            # without knowing the module's structure.
+            self._param_stream_prefix = ""
+            self._generic_param_offload = True
 
     def destroy(self):
         """Release engine resources (reference engine.destroy): jit
@@ -465,7 +485,7 @@ class DeepSpeedEngine:
         if self._param_swapper is None:
             return
         from deepspeed_tpu.runtime.swap_tensor.param_swapper import NVMeParamHandle
-        prefix = self.module.param_stream_prefix
+        prefix = self._param_stream_prefix
         swapper = self._param_swapper
 
         def off(path, leaf):
@@ -528,10 +548,11 @@ class DeepSpeedEngine:
         self._trainable_mask = self._build_trainable_mask()
 
         if self._param_offload_enabled:
-            # ZeRO-Infinity param offload: the scanned-layer subtree lives
-            # in the device's pinned_host memory space; the model streams
-            # each layer slice to HBM inside the scan (param_stream.py).
-            prefix = self.module.param_stream_prefix
+            # ZeRO-Infinity param offload: the offloaded subtree (scanned
+            # layers for streaming models, everything for the generic
+            # path) lives in the device's pinned_host memory space.
+            prefix = self._param_stream_prefix
+            self._param_device_shardings = self._param_shardings
             self._param_shardings = path_tree_map(
                 lambda path, s: s.with_memory_kind("pinned_host")
                 if path.startswith(prefix) else s, self._param_shardings)
@@ -743,6 +764,7 @@ class DeepSpeedEngine:
             return loss, grads, efb_new
 
         def core(params, scale, rng, args, kwargs, efb):
+            params = self._hop_offloaded_to_device(params)
             mapped = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(param_in_specs, P(), P(),
@@ -754,6 +776,17 @@ class DeepSpeedEngine:
             return mapped(params, scale, rng, args, kwargs, efb)
 
         return core
+
+    def _hop_offloaded_to_device(self, params):
+        """offload_param × manual shard_map comm cores: pinned_host
+        operands are illegal inside a manual region, so the step hops the
+        host-resident tree to its device layout BEFORE entering shard_map
+        (reference stage3 composes offload with the quantized collectives
+        the same way — gather from host, then exchange). Outside the
+        offload configs this is a no-op."""
+        if not getattr(self, "_param_offload_enabled", False):
+            return params
+        return jax.tree.map(jax.device_put, params, self._param_device_shardings)
 
     def _init_onebit_efb(self):
         n = dict(self.mesh.shape)["data"]
@@ -848,6 +881,7 @@ class DeepSpeedEngine:
             return loss, grads
 
         def core(params, scale, rng, args, kwargs):
+            params = self._hop_offloaded_to_device(params)
             mapped = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(param_in_specs, P(), P(),
