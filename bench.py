@@ -167,10 +167,9 @@ def bench_serving_v2_ragged():
     from deepspeed_tpu.parallel import groups
 
     groups.destroy_mesh()
-    # GQA shape (24 q heads / 8 KV heads): the modern serving layout, and
-    # 8-sublane-aligned so the Pallas paged-decode kernel engages (20-head
-    # MHA pools fall back to the XLA gather path — see
-    # ops/pallas/paged_attention.kernel_supported)
+    # GQA shape (24 q heads / 8 KV heads): the modern serving layout.
+    # The Pallas paged-decode kernel now engages for ANY KV-head count
+    # (flattened-pool DMA, ops/pallas/paged_attention.kernel_supported)
     model = build_llama("7b", hidden_size=3072, intermediate_size=8192,
                         num_hidden_layers=22, num_attention_heads=24,
                         num_key_value_heads=8, max_position_embeddings=2048,

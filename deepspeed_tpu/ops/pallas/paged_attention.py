@@ -52,32 +52,32 @@ def xla_paged_attention(q, kc, vc, block_tables, token_pos, alibi_slopes=None):
 
 
 def kernel_supported(head_dim, block_size, n_kv_heads=None):
-    """Mosaic constraint: the per-block DMA slices the pool's last dim,
-    which must be 128-lane aligned — i.e. head_dim % 128 == 0. True for
-    the Llama/Mistral/Falcon/GPT-J 128-dim-head families; 64-dim-head
-    models (e.g. Bloom-560M, GPT-2) and ALiBi models take the XLA gather
-    path (see ``inference/v2/modules/heuristics.py`` — lane-packing two
-    64-dim heads per register is possible but unimplemented).
-
-    ``n_kv_heads`` (the pool's second-minor dim) must tile the 8-sublane
-    granule for the per-block slice. Measured on v5e Mosaic
-    (2026-07-31): multiples of 8 compile, and so do 2 and 4 (they divide
-    the sublane tile); 1, 6, 12, and 20 are INTERNAL Mosaic failures.
-    Common GQA pools (2/4/8/16/32 KV heads) all pass; odd MHA counts
-    (e.g. 20) fall back to the XLA gather path."""
-    return (head_dim % 128 == 0 and block_size % 8 == 0
-            and (n_kv_heads is None or n_kv_heads % 8 == 0
-                 or n_kv_heads in (2, 4)))
+    """Mosaic constraint: the per-block DMA copies a 2-D
+    ``[block_size, Hkv*Dh]`` slice (the pool's KV-head and head dims are
+    flattened before the kernel), so the lane dim is ``Hkv * head_dim``
+    — a multiple of 128 for any head count when head_dim % 128 == 0, and
+    the sublane dim is ``block_size`` (multiple of 8). ANY KV-head count
+    is supported this way (round 4's Hkv % 8 restriction came from
+    slicing the un-flattened [bs, Hkv, Dh] pool, whose second-minor dim
+    had to tile the 8-sublane granule — 1/6/12/20-head pools crashed
+    Mosaic; the flattened layout re-measured compiling and matching the
+    XLA reference on a real v5e for all four counts, 2026-08-01). 64-dim-head models (e.g. Bloom-560M, GPT-2) and ALiBi
+    models take the XLA gather path
+    (see ``inference/v2/modules/heuristics.py``)."""
+    return head_dim % 128 == 0 and block_size % 8 == 0
 
 
 def _kernel(tab_ref, pos_ref, q_ref, kc_ref, vc_ref, o_ref,
-            k_buf, v_buf, k_sem, v_sem, *, bs, max_blocks, groups):
-    """One token: q_ref [1, H, Dh] (VMEM); kc/vc whole pool
-    [NB, bs, Hkv, Dh] stay in HBM (ANY) — each table block is DMA'd
-    into the VMEM scratch buffers; tab/pos in SMEM via scalar prefetch."""
+            k_buf, v_buf, k_sem, v_sem, *, bs, max_blocks, groups, n_kv_heads):
+    """One token: q_ref [1, H, Dh] (VMEM); kc/vc whole pool flattened to
+    [NB, bs, Hkv*Dh] stay in HBM (ANY) — each table block is DMA'd into
+    the VMEM scratch buffers as a 2-D [bs, Hkv*Dh] slice (lane dim a
+    128-multiple for ANY KV-head count); tab/pos in SMEM via scalar
+    prefetch. Per-head columns are 128-aligned lane slices of the
+    buffer."""
     t = pl.program_id(0)
     H, Dh = q_ref.shape[1], q_ref.shape[2]
-    Hkv = kc_ref.shape[2]
+    Hkv = n_kv_heads
     G = groups
     pos = pos_ref[t]
     scale = 1.0 / np.sqrt(Dh)
@@ -93,10 +93,14 @@ def _kernel(tab_ref, pos_ref, q_ref, kc_ref, vc_ref, o_ref,
         cv.start()
         ck.wait()
         cv.wait()
-        # per-kv-head 2-D matmuls, statically unrolled
+        kbuf = k_buf[:]  # one read; heads are lane slices of it
+        vbuf = v_buf[:]
+        # per-kv-head 2-D matmuls, statically unrolled; head h occupies
+        # lanes [h*Dh, (h+1)*Dh) of the flattened buffer
         s_parts = []
         for h in range(Hkv):
-            kh = k_buf[:, h, :].astype(jnp.float32)  # [bs, Dh]
+            kh = jax.lax.slice(kbuf, (0, h * Dh), (bs, (h + 1) * Dh)
+                               ).astype(jnp.float32)  # [bs, Dh]
             qh = jax.lax.slice(q, (h * G, 0), ((h + 1) * G, Dh))  # [G, Dh]
             s_parts.append(jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                                precision=jax.lax.Precision.HIGHEST))
@@ -109,7 +113,8 @@ def _kernel(tab_ref, pos_ref, q_ref, kc_ref, vc_ref, o_ref,
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv_parts = []
         for h in range(Hkv):
-            vh = v_buf[:, h, :].astype(jnp.float32)  # [bs, Dh]
+            vh = jax.lax.slice(vbuf, (0, h * Dh), (bs, (h + 1) * Dh)
+                               ).astype(jnp.float32)  # [bs, Dh]
             ph = jax.lax.slice(p, (h * G, 0), ((h + 1) * G, bs))  # [G, bs]
             pv_parts.append(jax.lax.dot_general(ph, vh, (((1,), (0,)), ((), ())),
                                                 precision=jax.lax.Precision.HIGHEST))
@@ -147,16 +152,22 @@ def paged_decode_attention(q, kc, vc, block_tables, token_pos, interpret=None):
         ],
         out_specs=pl.BlockSpec((1, H, Dh), lambda t, tab, pos: (t, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((bs, Hkv, Dh), kc.dtype),
-            pltpu.VMEM((bs, Hkv, Dh), vc.dtype),
+            pltpu.VMEM((bs, Hkv * Dh), kc.dtype),
+            pltpu.VMEM((bs, Hkv * Dh), vc.dtype),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
     )
-    kernel = functools.partial(_kernel, bs=bs, max_blocks=MB, groups=groups)
+    kernel = functools.partial(_kernel, bs=bs, max_blocks=MB, groups=groups,
+                               n_kv_heads=Hkv)
+    # flatten [NB, bs, Hkv, Dh] → [NB, bs, Hkv*Dh]: contiguous view, and
+    # the per-block DMA slice becomes 2-D with a 128-multiple lane dim
+    # for any KV-head count
+    kc2 = kc.reshape(NB, bs, Hkv * Dh)
+    vc2 = vc.reshape(NB, bs, Hkv * Dh)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, H, Dh), q.dtype),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), token_pos.astype(jnp.int32), q, kc, vc)
+    )(block_tables.astype(jnp.int32), token_pos.astype(jnp.int32), q, kc2, vc2)
