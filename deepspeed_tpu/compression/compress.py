@@ -158,8 +158,13 @@ def structural_head_prune(params, attention_pattern, num_heads, dense_ratio):
     rectangular. → ``(pruned_params, kept_heads)`` — rebuild the model
     with ``num_attention_heads=kept_heads`` to consume the tree. Exact
     (matches the head-masked forward) because heads are independent up to
-    the o-projection. MQA/GQA trees (separate kv head count) are refused:
-    slicing query heads out of a shared kv group changes the grouping."""
+    the o-projection.
+
+    MQA/GQA trees (separate kv head count): query heads are pruned
+    UNIFORMLY PER KV GROUP (the same keep count in every group, the
+    top-scored heads within each), so the query→kv grouping stays valid
+    with ``num_key_value_heads`` unchanged and kv projections untouched;
+    rebuild with ``num_attention_heads=kept_heads`` (a multiple of Hkv)."""
     import numpy as np
 
     flat = _flat_by_path(params)
@@ -168,20 +173,37 @@ def structural_head_prune(params, attention_pattern, num_heads, dense_ratio):
     H = int(num_heads)
     o = np.asarray(flat[ok])
     D_out = o.shape[-1]
-    if np.asarray(flat[kk]).shape[-1] != np.asarray(flat[qk]).shape[-1]:
-        raise NotImplementedError(
-            "structural head pruning requires H == Hkv (MHA); GQA/MQA key-value "
-            "grouping would change under query-head slicing")
+    assert o.shape[-2] % H == 0, (
+        f"o_proj input dim {o.shape[-2]} is not divisible by num_heads {H} — "
+        f"wrong num_heads for this tree?")
     Dh = o.shape[-2] // H
-    keep = max(1, int(round(H * dense_ratio)))
+    kv_dim = np.asarray(flat[kk]).shape[-1]
+    assert kv_dim % Dh == 0 and H % (kv_dim // Dh) == 0, (
+        f"kv width {kv_dim} / head_dim {Dh} does not evenly group the {H} query "
+        f"heads — wrong num_heads for this tree?")
+    Hkv = kv_dim // Dh
+    g = H // Hkv  # query heads per kv group (1 group of H when MHA)
     lead = o.shape[:-2]
     n = int(np.prod(lead)) if lead else 1
     # per-head score from the o-projection input rows (reference attn_ow)
     per_head = np.abs(o.reshape(n, H, Dh, D_out)).sum(axis=(2, 3))  # [n, H]
-    idx = np.sort(np.argsort(-per_head, axis=-1)[:, :keep], axis=-1)  # [n, keep]
+
+    if Hkv == H:
+        keep = max(1, int(round(H * dense_ratio)))
+        idx = np.sort(np.argsort(-per_head, axis=-1)[:, :keep], axis=-1)  # [n, keep]
+        proj_to_slice = (qk, kk, vk)
+    else:
+        # per-group selection: head q belongs to group q // g both before
+        # and after pruning (groups keep their order and a uniform size)
+        kpg = max(1, int(round(g * dense_ratio)))
+        keep = Hkv * kpg
+        grouped = per_head.reshape(n, Hkv, g)
+        in_group = np.sort(np.argsort(-grouped, axis=-1)[..., :kpg], axis=-1)  # [n, Hkv, kpg]
+        idx = (in_group + g * np.arange(Hkv)[None, :, None]).reshape(n, keep)
+        proj_to_slice = (qk,)  # kv projections keep all Hkv heads
 
     replacements = {}
-    for path in (qk, kk, vk):
+    for path in proj_to_slice:
         w = np.asarray(flat[path])
         D_in = w.shape[-2]
         w4 = w.reshape(n, D_in, H, Dh)

@@ -113,26 +113,37 @@ def fuse_lora_tree(params, lora_alpha, lora_r=None):
     :func:`unfuse_lora_tree`. The delta is accumulated in fp32 and cast
     back to the base dtype.
 
-    Quantized bases (``base_kernel_q``) refuse: re-quantizing the fused
-    weight would permanently lose bits on unfuse."""
+    Quantized bases (``base_kernel_q``) dequantize → fuse → requantize
+    (reference ``hybrid_engine.py:138-146`` over its quantized
+    ``OptimizedLinear``, ``linear/quantization.py:18``); the ORIGINAL
+    int8 carrier rides in the stash, so unfuse restores it bit-exactly —
+    the requantization error exists only while fused, on the fused
+    weight."""
     stash = {}
 
     def walk(d, path):
         if not isinstance(d, dict):
             return d
         if _is_lora_site(d):
-            if "base_kernel_q" in d:
-                raise NotImplementedError(
-                    f"cannot fuse LoRA into the quantized base at {path}: "
-                    "re-quantization is lossy; dequantize the base first or "
-                    "generate unfused")
-            a, b, base = d["lora_a"], d["lora_b"], d["base_kernel"]
+            a, b = d["lora_a"], d["lora_b"]
             scaling = _site_scaling(a, lora_alpha, lora_r)
             delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scaling
             out = dict(d)
-            out["base_kernel"] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+            if "base_kernel_q" in d:
+                from deepspeed_tpu.ops.pallas.quantization import (dequantize_int8,
+                                                                   quantize_int8)
+                gs = d["base_kernel_q"].shape[-1]
+                base = dequantize_int8(d["base_kernel_q"], d["base_kernel_scales"],
+                                       delta.shape, dtype=jnp.float32)
+                vq, sq, _ = quantize_int8(base + delta, group_size=gs)
+                out["base_kernel_q"] = vq
+                out["base_kernel_scales"] = sq
+                stash[path] = (d["base_kernel_q"], d["base_kernel_scales"], b)
+            else:
+                base = d["base_kernel"]
+                out["base_kernel"] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+                stash[path] = b
             out["lora_b"] = jnp.zeros_like(b)
-            stash[path] = b
             return out
         return {k: walk(v, f"{path}/{k}" if path else k) for k, v in d.items()}
 
@@ -148,11 +159,19 @@ def unfuse_lora_tree(params, stash, lora_alpha, lora_r=None):
         if not isinstance(d, dict):
             return d
         if _is_lora_site(d) and path in stash:
+            out = dict(d)
+            if "base_kernel_q" in d:
+                # quantized base: restore the stashed original carrier
+                # bit-exactly (no arithmetic, no rounding)
+                vq, sq, b = stash[path]
+                out["base_kernel_q"] = vq
+                out["base_kernel_scales"] = sq
+                out["lora_b"] = b
+                return out
             b = stash[path]
             a, base = d["lora_a"], d["base_kernel"]
             scaling = _site_scaling(a, lora_alpha, lora_r)
             delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scaling
-            out = dict(d)
             out["base_kernel"] = (base.astype(jnp.float32) - delta).astype(base.dtype)
             out["lora_b"] = b
             return out

@@ -380,10 +380,43 @@ def test_structural_head_prune_matches_masked_forward():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 
 
-def test_structural_head_prune_refuses_gqa():
+def test_structural_head_prune_gqa_per_group():
+    """GQA head pruning (reference compress.py:100 head pruning applies
+    per-policy to any attention): query heads pruned uniformly per kv
+    group — kv projections untouched, grouping preserved — and the
+    reduced model matches the head-masked dense forward."""
+    import dataclasses
     from deepspeed_tpu.compression import structural_head_prune
     from deepspeed_tpu.models import build_llama
-    model = build_llama("debug", remat=False)  # GQA: H=4, Hkv=2
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
-    with pytest.raises(NotImplementedError, match="GQA"):
-        structural_head_prune(params, r"self_attn", 4, 0.5)
+    model = build_llama("debug", num_attention_heads=8, num_key_value_heads=2,
+                        remat=False)  # 2 kv groups x 4 query heads
+    cfg = model.config
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(0, 250, size=(2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    pruned, kept = structural_head_prune(params, r"self_attn", 8, dense_ratio=0.5)
+    assert kept == 4  # 2 per group x 2 groups
+    attn = pruned["model"]["layers"]["self_attn"]
+    assert attn["q_proj"]["kernel"].shape[-1] == kept * cfg.head_dim
+    assert attn["k_proj"]["kernel"].shape[-1] == 2 * cfg.head_dim  # kv untouched
+    assert attn["o_proj"]["kernel"].shape[-2] == kept * cfg.head_dim
+
+    small = build_llama("debug", num_attention_heads=kept, num_key_value_heads=2,
+                        head_dim_override=cfg.head_dim, remat=False)
+    got = small.apply({"params": pruned}, ids)
+
+    # reference: dense forward with the dropped query heads' o-rows zeroed
+    masked = jax.tree.map(lambda x: np.array(x, copy=True), params)
+    o = masked["model"]["layers"]["self_attn"]["o_proj"]["kernel"]  # [L, H*Dh, D]
+    L, HD, D = o.shape
+    H, Dh, g = 8, cfg.head_dim, 4
+    per_head = np.abs(o.reshape(L, H, Dh, D)).sum(axis=(2, 3))
+    for l in range(L):
+        for grp in range(2):
+            scores = per_head[l, grp * g:(grp + 1) * g]
+            drop = np.argsort(-scores)[2:] + grp * g
+            o_l = o[l].reshape(H, Dh, D)
+            o_l[drop] = 0.0
+    want = model.apply({"params": masked}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)

@@ -69,14 +69,42 @@ class TestLoraFuseTree:
             np.testing.assert_allclose(np.asarray(va), np.asarray(vb),
                                        rtol=1e-6, atol=1e-6, err_msg=str(ka))
 
-    def test_quantized_base_refuses(self):
+    def test_quantized_base_fuses_and_unfuses_bit_exact(self):
+        """LoRA fuse over an int8 quantized base (reference
+        hybrid_engine.py:138-146 with linear/quantization.py):
+        dequantize → fuse → requantize; the stash carries the ORIGINAL
+        carrier so unfuse restores it bit-exactly."""
         from deepspeed_tpu.linear.config import QuantizationConfig
+        from deepspeed_tpu.ops.pallas.quantization import quantize_int8
         model = nn.Sequential([OptimizedLinear(8, lora_config=LORA,
                                                quantization_config=QuantizationConfig(),
                                                dtype=jnp.float32)])
         params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))["params"]
-        with pytest.raises(NotImplementedError, match="quantized base"):
-            fuse_lora_tree(params, ALPHA)
+        # give the quantized base real content + nonzero adapters
+        site = params["layers_0"]
+        rng = np.random.RandomState(5)
+        w = jnp.asarray(rng.randn(8, 8).astype(np.float32) * 0.1)
+        gs = site["base_kernel_q"].shape[-1]
+        vq, sq, _ = quantize_int8(w, group_size=gs)
+        site = dict(site, base_kernel_q=vq, base_kernel_scales=sq,
+                    lora_b=site["lora_b"] + 0.05)
+        params = dict(params, layers_0=site)
+
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        want = model.apply({"params": params}, x)
+        fused, stash = fuse_lora_tree(params, ALPHA)
+        assert float(jnp.abs(fused["layers_0"]["lora_b"]).max()) == 0.0
+        got = model.apply({"params": fused}, x)
+        # requantization error on the fused weight only (int8 group quant)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=0.08, atol=0.02)
+
+        restored = unfuse_lora_tree(fused, stash, ALPHA)
+        np.testing.assert_array_equal(np.asarray(restored["layers_0"]["base_kernel_q"]),
+                                      np.asarray(vq))
+        np.testing.assert_array_equal(np.asarray(restored["layers_0"]["base_kernel_scales"]),
+                                      np.asarray(sq))
+        np.testing.assert_array_equal(np.asarray(restored["layers_0"]["lora_b"]),
+                                      np.asarray(site["lora_b"]))
 
 
 class TestHybridEngineLoraFuse:
