@@ -59,7 +59,14 @@ def _peak_flops(device) -> float:
 
 
 def _param_count(params) -> int:
-    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
+    """Logical parameter count: quantized carriers count their original
+    tensor shape (fp6 packs 4 codes into 3 bytes, so the raw leaf size
+    under-reports by 25%)."""
+    from deepspeed_tpu.inference.quantization.quantization import QuantizedWeight
+    is_q = lambda x: isinstance(x, QuantizedWeight)
+    return int(sum(np.prod(x.shape)  # QuantizedWeight.shape IS the logical shape
+                   for x in jax.tree.leaves(params, is_leaf=is_q)
+                   if is_q(x) or hasattr(x, "shape")))
 
 
 def _model_flops(n_params, tokens, layers, seq, hidden) -> float:
@@ -493,51 +500,26 @@ def main():
     mfu = _model_flops(n_params, tokens, layers, S, hidden) / dt / (
         n_chips * _peak_flops(jax.devices()[0]))
 
-    serving_2b = serving_2b_int8 = serving_v2 = long_seq = moe = offload = None
-    serving_2b_fp8 = serving_2b_fp6 = None
+    lanes = [
+        ("train_long_seq", bench_train_long_seq, {}),
+        ("train_moe", bench_train_moe, {}),
+        ("serving_2b", bench_serving_2b, {}),
+        ("serving_2b_int8", bench_serving_2b, {"dtype": "int8"}),
+        ("serving_2b_fp8", bench_serving_2b, {"quant_scheme": "fp8"}),
+        ("serving_2b_fp6", bench_serving_2b, {"quant_scheme": "fp6"}),
+        ("serving_v2_ragged", bench_serving_v2_ragged, {}),
+        ("offload", bench_offload_probe, {}),
+    ]
+    extras = {key: None for key, _, _ in lanes}
     if on_tpu:
         import gc
         del engine  # free the training HBM before the 2.5B serving build
-        gc.collect()
-        try:
-            long_seq = bench_train_long_seq()
-        except Exception as e:
-            long_seq = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            moe = bench_train_moe()
-        except Exception as e:
-            moe = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            serving_2b = bench_serving_2b()
-        except Exception as e:
-            serving_2b = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            serving_2b_int8 = bench_serving_2b(dtype="int8")
-        except Exception as e:
-            serving_2b_int8 = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            serving_2b_fp8 = bench_serving_2b(quant_scheme="fp8")
-        except Exception as e:
-            serving_2b_fp8 = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            serving_2b_fp6 = bench_serving_2b(quant_scheme="fp6")
-        except Exception as e:
-            serving_2b_fp6 = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            serving_v2 = bench_serving_v2_ragged()
-        except Exception as e:
-            serving_v2 = {"error": f"{type(e).__name__}: {e}"[:300]}
-        gc.collect()
-        try:
-            offload = bench_offload_probe()
-        except Exception as e:
-            offload = {"error": f"{type(e).__name__}: {e}"[:300]}
+        for key, fn, kwargs in lanes:
+            gc.collect()
+            try:
+                extras[key] = fn(**kwargs)
+            except Exception as e:
+                extras[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -556,14 +538,7 @@ def main():
             "backend": jax.default_backend(),
             "device": jax.devices()[0].device_kind,
             "n_chips": n_chips,
-            "serving_2b": serving_2b,
-            "serving_2b_int8": serving_2b_int8,
-            "serving_2b_fp8": serving_2b_fp8,
-            "serving_2b_fp6": serving_2b_fp6,
-            "serving_v2_ragged": serving_v2,
-            "train_long_seq": long_seq,
-            "train_moe": moe,
-            "offload": offload,
+            **extras,
         },
     }))
 

@@ -1,0 +1,216 @@
+"""Property/fuzz tests for the v2 serving state machines.
+
+Reference test style: ``tests/unit/inference/v2/ragged/`` exercises the
+block allocator and sequence descriptors with randomized workloads;
+here the allocator, the shared sampler (``inference/sampling.py``
+top-k∘top-p composition), and the suspend/resume lifecycle each get a
+randomized oracle-checked drive.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.sampling import sample_tokens, validate_sample_spec
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+
+
+class TestBlockAllocatorFuzz:
+
+    def test_random_alloc_free_keeps_invariants(self):
+        """1000 random alloc/free ops against a set-based oracle: ids
+        stay unique, in range, conserved, and never double-owned."""
+        rng = np.random.RandomState(0)
+        N = 64
+        alloc = BlockedAllocator(N)
+        owned = []  # flat list of live ids (the oracle)
+        for step in range(1000):
+            if owned and rng.rand() < 0.45:
+                # free a random subset
+                take = rng.randint(1, min(len(owned), 8) + 1)
+                idx = rng.choice(len(owned), size=take, replace=False)
+                blocks = [owned[i] for i in idx]
+                for b in sorted(idx, reverse=True):
+                    owned.pop(b)
+                alloc.free(np.asarray(blocks, np.int32))
+            else:
+                want = rng.randint(1, 9)
+                if want > alloc.free_blocks:
+                    with pytest.raises(ValueError, match="free"):
+                        alloc.allocate(want)
+                    continue
+                got = alloc.allocate(want)
+                assert len(got) == want
+                assert all(0 <= b < N for b in got)
+                assert len(set(map(int, got))) == want, "duplicate ids in one grant"
+                assert not (set(map(int, got)) & set(owned)), "block double-owned"
+                owned.extend(int(b) for b in got)
+            assert alloc.free_blocks == N - len(owned), "conservation violated"
+
+    def test_double_free_and_bad_ids_raise(self):
+        alloc = BlockedAllocator(8)
+        got = alloc.allocate(3)
+        alloc.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            alloc.free(got[:1])
+        with pytest.raises(ValueError, match="invalid block"):
+            alloc.free([99])
+        with pytest.raises(ValueError, match="invalid block"):
+            alloc.free([-1])
+
+
+class TestSamplerProperties:
+    """sample_tokens: the sampled id must always lie in the allowed set
+    implied by (temperature, top_k, top_p) — fuzzed over random logits
+    including ties and extreme values."""
+
+    def _allowed(self, logits, top_k, top_p):
+        """Oracle: allowed token set after top-k then nucleus filtering
+        (mirrors the documented semantics, independently coded)."""
+        l = np.asarray(logits, np.float64)
+        V = l.shape[-1]
+        order = np.argsort(-l, kind="stable")
+        allowed = np.ones(V, bool)
+        if top_k:
+            k = min(int(top_k), V)
+            kth = l[order[k - 1]]
+            allowed &= l >= kth  # ties at the kth value stay allowed
+        if top_p and top_p < 1.0:
+            base = np.where(allowed, l, -np.inf)
+            sl = np.sort(base)[::-1]
+            probs = np.exp(sl - np.max(sl))
+            probs = probs / probs.sum()
+            cum = np.cumsum(probs)
+            cutoff_idx = int(np.sum(cum < top_p))
+            cutoff = sl[min(cutoff_idx, V - 1)]
+            allowed &= l >= cutoff
+        return allowed
+
+    @pytest.mark.parametrize("top_k,top_p", [(0, 1.0), (1, 1.0), (4, 1.0),
+                                             (0, 0.5), (0, 0.05), (4, 0.5),
+                                             (2, 0.9), (1000, 0.3)])
+    def test_sampled_ids_stay_in_allowed_set(self, top_k, top_p):
+        rng = np.random.RandomState(top_k * 31 + int(top_p * 100))
+        for trial in range(8):
+            V = rng.choice([5, 17, 64])
+            logits = rng.randn(3, V).astype(np.float32) * rng.choice([0.5, 3.0])
+            if trial % 3 == 0:
+                logits[:, : V // 2] = logits[:, :1]  # ties
+            out = sample_tokens(jnp.asarray(logits), jax.random.PRNGKey(trial),
+                                temperature=1.0, top_k=top_k, top_p=top_p)
+            for row, tok in enumerate(np.asarray(out)):
+                allowed = self._allowed(logits[row], top_k, top_p)
+                assert allowed[int(tok)], (
+                    f"token {tok} outside allowed set (k={top_k}, p={top_p}, "
+                    f"row logits {logits[row]})")
+
+    def test_top_k_1_is_argmax(self):
+        rng = np.random.RandomState(7)
+        logits = jnp.asarray(rng.randn(5, 33).astype(np.float32))
+        for seed in range(5):
+            out = sample_tokens(logits, jax.random.PRNGKey(seed), top_k=1)
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(jnp.argmax(logits, -1)))
+
+    def test_tiny_top_p_is_argmax(self):
+        """top_p smaller than the max token's probability → nucleus is
+        exactly the argmax."""
+        rng = np.random.RandomState(8)
+        logits = jnp.asarray(rng.randn(4, 21).astype(np.float32))
+        out = sample_tokens(logits, jax.random.PRNGKey(0), top_p=1e-6)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(jnp.argmax(logits, -1)))
+
+    def test_validate_sample_spec_edges(self):
+        validate_sample_spec({"temperature": 0.7, "top_k": 5, "top_p": 0.9})
+        validate_sample_spec({"top_k": 0, "top_p": 1.0})
+        for bad in ({"top_k": -1}, {"top_p": 0.0}, {"top_p": 1.5},
+                    {"temperature": -0.1}, {"top_k": 2.5}):
+            with pytest.raises(ValueError):
+                validate_sample_spec(bad)
+
+
+class TestSuspendResumeFuzz:
+    """Randomized drive of the suspend/resume/flush lifecycle against a
+    host-side oracle: block accounting conserved, resumed sequences keep
+    their token counts, and illegal transitions raise."""
+
+    def _engine(self):
+        from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, InferenceEngineV2,
+                                                RaggedInferenceEngineConfig)
+        from deepspeed_tpu.models import build_llama
+        model = build_llama("debug", remat=False)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        cfg = RaggedInferenceEngineConfig(
+            kv_block_size=8,
+            state_manager=DSStateManagerConfig(max_ragged_batch_size=64,
+                                               max_ragged_sequence_count=8,
+                                               max_tracked_sequences=8,
+                                               max_context=64))
+        return InferenceEngineV2(model=model, config=cfg, params=params,
+                                 dtype=jnp.float32)
+
+    def test_random_lifecycle_keeps_block_accounting(self):
+        engine = self._engine()
+        rng = np.random.RandomState(1)
+        total = engine.free_blocks
+        live, suspended = {}, {}  # uid -> token count so far
+        next_uid = 0
+        for step in range(60):
+            ops = ["put_new"]
+            if live:
+                ops += ["decode", "suspend", "flush_live"]
+            if suspended:
+                ops += ["resume", "flush_suspended"]
+            op = rng.choice(ops)
+            if op == "put_new" and len(live) + len(suspended) < 6:
+                uid = next_uid
+                next_uid += 1
+                n = int(rng.randint(1, 12))
+                toks = rng.randint(0, 250, size=n).astype(np.int32)
+                engine.put([uid], [toks])
+                live[uid] = n
+            elif op == "decode":
+                uid = int(rng.choice(list(live)))
+                if live[uid] + 1 <= 64:
+                    engine.put([uid], [[int(rng.randint(0, 250))]])
+                    live[uid] += 1
+            elif op == "suspend":
+                uid = int(rng.choice(list(live)))
+                engine.suspend(uid)
+                suspended[uid] = live.pop(uid)
+                with pytest.raises(Exception):
+                    engine.suspend(uid)  # double-suspend refuses
+            elif op == "resume":
+                uid = int(rng.choice(list(suspended)))
+                seen = engine.resume(uid)
+                assert seen == suspended[uid], (
+                    f"resume lost tokens: {seen} != {suspended[uid]}")
+                live[uid] = suspended.pop(uid)
+            elif op == "flush_live":
+                uid = int(rng.choice(list(live)))
+                engine.flush(uid)
+                del live[uid]
+            elif op == "flush_suspended":
+                uid = int(rng.choice(list(suspended)))
+                engine.flush(uid)
+                del suspended[uid]
+            # invariant: suspended sequences hold NO device blocks; live
+            # sequences hold ceil(tokens/8) each
+            expect_held = sum(-(-n // 8) for n in live.values())
+            assert engine.free_blocks == total - expect_held, (
+                f"step {step} op {op}: free {engine.free_blocks} != "
+                f"{total} - {expect_held}")
+        # drain: everything flushed returns every block
+        for uid in list(live):
+            engine.flush(uid)
+        for uid in list(suspended):
+            engine.flush(uid)
+        assert engine.free_blocks == total
+
+    def test_resume_unknown_uid_raises(self):
+        engine = self._engine()
+        with pytest.raises(Exception):
+            engine.resume(1234)

@@ -116,6 +116,19 @@ def test_mixtral_tp_ep_composed_serving():
         model, params, _cfg(tensor_parallel_degree=2, expert_parallel_degree=2), prompts)
 
 
+def test_mixtral_tp4_ep2_full_mesh_serving():
+    """World-size-8 composition (tensor=4, expert=2 — every virtual CPU
+    device): the widest sharding the debug models support; parity vs the
+    single-device engine proves the layout scales past the 4-device
+    lanes."""
+    model = build_llama("mixtral-debug", remat=False, moe_capacity_factor=64.0)
+    params = _params(model, seed=5)
+    prompts = [(np.arange(9, dtype=np.int32) * 17) % 250,
+               (np.arange(6, dtype=np.int32) * 5) % 250]
+    _assert_same_serving(
+        model, params, _cfg(tensor_parallel_degree=4, expert_parallel_degree=2), prompts)
+
+
 def test_expert_weights_stay_sharded():
     model = build_llama("mixtral-debug", remat=False)
     engine = InferenceEngineV2(model=model, config=_cfg(expert_parallel_degree=2),
