@@ -492,6 +492,10 @@ def main():
         import gc
         gc.collect()
         engine, loss, dt, gas = run_train_bench(64)
+    # fetch the loss value NOW: the extras below destroy/rebuild meshes
+    # and churn HBM, after which a deferred D2H of this buffer can fail
+    # (observed RESOURCE_EXHAUSTED at the final print on the axon rig)
+    loss = float(loss)
 
     n_chips = jax.device_count()
     tokens = B * gas * S

@@ -4,10 +4,12 @@ conversion utilities.
 Parity surface for ``deepspeed/checkpoint/`` (``ds_to_universal.py``,
 ``universal_checkpoint.py``, ``deepspeed_checkpoint.py``)."""
 
+from deepspeed_tpu.checkpoint.megatron import megatron_to_universal
 from deepspeed_tpu.checkpoint.universal import (TagReader, ds_to_universal, is_universal_dir,
                                                 load_universal_metadata, read_universal_param, resolve_tag)
 
 __all__ = [
     "TagReader", "ds_to_universal", "is_universal_dir",
-    "load_universal_metadata", "read_universal_param", "resolve_tag",
+    "load_universal_metadata", "megatron_to_universal", "read_universal_param",
+    "resolve_tag",
 ]

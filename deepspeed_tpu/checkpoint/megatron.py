@@ -1,0 +1,188 @@
+"""Megatron-DeepSpeed checkpoint ingestion → universal layout.
+
+Capability match for the reference's Megatron checkpoint tooling
+(``deepspeed/checkpoint/deepspeed_checkpoint.py`` — ``DeepSpeedCheckpoint``
+over ``layer_NN-model_TT-model_states.pt`` shards — and the 2D/3D
+reshape utilities ``reshape_meg_2d.py`` / ``reshape_3d_utils.py``).
+
+TPU redesign: instead of remapping the (pp, tp) rank grid shard-to-shard,
+ingestion CONSOLIDATES — every parameter's tp shards merge along their
+Megatron-parallel axis into one full fp32 tensor written to the
+universal layout (``checkpoint/universal.py``). Any target topology then
+re-slices at load, which is exactly what the reference's universal
+pipeline does for Megatron checkpoints (``ds_to_universal.py``); the
+explicit old-grid→new-grid reshape maps become unnecessary.
+
+Torch is used only to deserialize the ``.pt`` shards (CPU); everything
+downstream is numpy.
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.universal import UNIVERSAL_METADATA, ZERO_FP32, _param_dir
+
+LAYER_FILE_RE = re.compile(r"layer_(\d+)-model_(\d+)-model_states\.pt$")
+MP_RANK_FILE_RE = re.compile(r"mp_rank_(\d+)_model_states\.pt$")
+
+# Megatron-LM parameter-name conventions → merge axis of the tp shards.
+# Torch Linear weights are [out_features, in_features]: column-parallel
+# layers shard dim 0, row-parallel layers shard dim 1; embeddings shard
+# the vocab dim 0. Everything unmatched is replicated (must agree across
+# ranks).
+COLUMN_PARALLEL = (
+    "query_key_value.weight", "query_key_value.bias",
+    "query.weight", "query.bias",
+    "key_value.weight", "key_value.bias",
+    "dense_h_to_4h.weight", "dense_h_to_4h.bias",
+    "lm_head.weight",
+)
+ROW_PARALLEL = (
+    "attention.dense.weight",
+    "self_attention.dense.weight",
+    "dense_4h_to_h.weight",
+)
+# Only word embeddings use VocabParallelEmbedding in Megatron-LM;
+# position embeddings are REPLICATED across tp ranks.
+VOCAB_PARALLEL = ("word_embeddings.weight",)
+
+
+def merge_axis_for(name):
+    """→ 0 (column/vocab parallel), 1 (row parallel) or None (replicated)
+    for a Megatron parameter name."""
+    if any(name.endswith(s) for s in COLUMN_PARALLEL + VOCAB_PARALLEL):
+        return 0
+    if any(name.endswith(s) for s in ROW_PARALLEL):
+        return 1
+    return None
+
+
+def _discover(src_dir):
+    """→ (layers: {layer_idx: {tp: path}}, mp_ranks: {tp: path})."""
+    layers, mp_ranks = {}, {}
+    for fname in sorted(os.listdir(src_dir)):
+        m = LAYER_FILE_RE.match(fname)
+        if m:
+            layers.setdefault(int(m.group(1)), {})[int(m.group(2))] = os.path.join(
+                src_dir, fname)
+            continue
+        m = MP_RANK_FILE_RE.match(fname)
+        if m:
+            mp_ranks[int(m.group(1))] = os.path.join(src_dir, fname)
+    return layers, mp_ranks
+
+
+def _load_pt(path):
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    return sd
+
+
+def _to_numpy(t):
+    import torch
+    if isinstance(t, torch.Tensor):
+        return t.detach().to(torch.float32).cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _merge(name, shards):
+    """Merge one parameter's tp shards (list ordered by tp rank)."""
+    arrays = [_to_numpy(s) for s in shards]
+    axis = merge_axis_for(name)
+    if axis is None or arrays[0].ndim == 0 or len(arrays) == 1:
+        for a in arrays[1:]:
+            if not np.allclose(arrays[0], a, rtol=1e-5, atol=1e-6):
+                raise ValueError(
+                    f"replicated parameter {name!r} differs across tp ranks — "
+                    f"unknown sharding convention; extend COLUMN_PARALLEL/"
+                    f"ROW_PARALLEL for this name")
+        return arrays[0]
+    axis = min(axis, arrays[0].ndim - 1)
+    return np.concatenate(arrays, axis=axis)
+
+
+def megatron_to_universal(src_dir, output_dir, param_map=None):
+    """Ingest a Megatron-DeepSpeed layer-sharded checkpoint directory
+    into the universal fp32 layout (reference parity:
+    ``DeepSpeedCheckpoint`` + ``ds_to_universal`` over Megatron trees;
+    the tp merge replaces ``reshape_meg_2d_parallel`` — consolidate once,
+    re-slice at load for ANY new (pp, tp, dp)).
+
+    ``param_map``: optional ``f(layer_idx, megatron_name) -> str`` giving
+    the universal parameter path; defaults to
+    ``layer_{idx:02d}/{name}`` with dots replaced by "/".
+    → ``output_dir``.
+    """
+    layers, mp_ranks = _discover(src_dir)
+    if not layers:
+        raise FileNotFoundError(
+            f"no 'layer_NN-model_TT-model_states.pt' files in {src_dir} — "
+            f"not a Megatron-DeepSpeed checkpoint?")
+    tp_degree = max(len(v) for v in layers.values())
+
+    if param_map is None:
+        def param_map(layer_idx, name):
+            return f"layer_{layer_idx:02d}/" + name.replace(".", "/")
+
+    os.makedirs(output_dir, exist_ok=True)
+    index = {}
+    for layer_idx in sorted(layers):
+        ranks = layers[layer_idx]
+        if len(ranks) not in (1, tp_degree):
+            raise ValueError(
+                f"layer {layer_idx} has {len(ranks)} tp shards; expected 1 or {tp_degree}")
+        shards = [_load_pt(ranks[tp]) for tp in sorted(ranks)]
+        key_sets = [set(sd) for sd in shards]
+        union = set().union(*key_sets)
+        for tp, ks in zip(sorted(ranks), key_sets):
+            if ks != union:
+                raise ValueError(
+                    f"layer {layer_idx}: tp rank {tp} shard is missing parameters "
+                    f"{sorted(union - ks)} present on other ranks — inconsistent "
+                    f"checkpoint")
+        for name in sorted(union):
+            merged = _merge(name, [sd[name] for sd in shards])
+            path = param_map(layer_idx, name)
+            pdir = _param_dir(output_dir, path)
+            os.makedirs(pdir, exist_ok=True)
+            np.save(os.path.join(pdir, f"{ZERO_FP32}.npy"), merged)
+            index[path] = {"shape": list(merged.shape), "moments": [],
+                           "megatron_layer": layer_idx, "megatron_name": name}
+
+    # iteration / args ride in the mp_rank files when present
+    meta_extra = {}
+    if mp_ranks:
+        sd = _load_pt(mp_ranks[min(mp_ranks)])
+        for key in ("iteration", "global_steps"):
+            if isinstance(sd.get(key), int):
+                meta_extra["global_steps"] = sd[key]
+        args = sd.get("args")
+        if args is not None:
+            meta_extra["megatron_args"] = {
+                k: v for k, v in sorted(vars(args).items())
+                if isinstance(v, (int, float, str, bool, type(None)))
+            } if hasattr(args, "__dict__") else None
+
+    universal = {
+        "universal_format_version": 1,
+        "source": "megatron-deepspeed",
+        "source_dir": os.path.abspath(src_dir),
+        "tp_degree_ingested": tp_degree,
+        "global_steps": meta_extra.get("global_steps", 0),
+        "global_samples": 0,
+        "skipped_steps": 0,
+        "micro_steps": 0,
+        "lr_scheduler": None,
+        "client_state": {},
+        "optimizer_scalars": {},
+        "optimizer_param_groups": None,
+        "scaler_state": None,
+        "megatron_args": meta_extra.get("megatron_args"),
+        "params": index,
+    }
+    with open(os.path.join(output_dir, UNIVERSAL_METADATA), "w") as f:
+        json.dump(universal, f, indent=1)
+    return output_dir
