@@ -88,23 +88,32 @@ def _to_numpy(t):
     return np.asarray(t, np.float32)
 
 
-def _merge(name, shards):
+def _merge(name, shards, gated_mlp=False):
     """Merge one parameter's tp shards (list ordered by tp rank)."""
     arrays = [_to_numpy(s) for s in shards]
     axis = merge_axis_for(name)
     if axis is None or arrays[0].ndim == 0 or len(arrays) == 1:
         for a in arrays[1:]:
-            if not np.allclose(arrays[0], a, rtol=1e-5, atol=1e-6):
+            same = (np.array_equal(arrays[0], a, equal_nan=True)
+                    or np.allclose(arrays[0], a, rtol=1e-5, atol=1e-6, equal_nan=True))
+            if not same:
                 raise ValueError(
                     f"replicated parameter {name!r} differs across tp ranks — "
                     f"unknown sharding convention; extend COLUMN_PARALLEL/"
                     f"ROW_PARALLEL for this name")
         return arrays[0]
+    if gated_mlp and any(name.endswith(s) for s in
+                         ("dense_h_to_4h.weight", "dense_h_to_4h.bias")):
+        # swiglu/geglu: each tp shard is [gate_i; up_i] along dim 0 —
+        # plain concat would interleave [g0,u0,g1,u1]; rebuild [G; U]
+        # (reference ds_to_universal's h_to_4h sub-param handling)
+        halves = [np.split(a, 2, axis=0) for a in arrays]
+        return np.concatenate([h[0] for h in halves] + [h[1] for h in halves], axis=0)
     axis = min(axis, arrays[0].ndim - 1)
     return np.concatenate(arrays, axis=axis)
 
 
-def megatron_to_universal(src_dir, output_dir, param_map=None):
+def megatron_to_universal(src_dir, output_dir, param_map=None, gated_mlp=False):
     """Ingest a Megatron-DeepSpeed layer-sharded checkpoint directory
     into the universal fp32 layout (reference parity:
     ``DeepSpeedCheckpoint`` + ``ds_to_universal`` over Megatron trees;
@@ -114,6 +123,9 @@ def megatron_to_universal(src_dir, output_dir, param_map=None):
     ``param_map``: optional ``f(layer_idx, megatron_name) -> str`` giving
     the universal parameter path; defaults to
     ``layer_{idx:02d}/{name}`` with dots replaced by "/".
+    ``gated_mlp``: set True for checkpoints trained with --swiglu/geglu —
+    each tp shard of ``dense_h_to_4h`` is then [gate_i; up_i] and the
+    merge de-interleaves into [G; U] instead of plain concatenation.
     → ``output_dir``.
     """
     layers, mp_ranks = _discover(src_dir)
@@ -122,6 +134,12 @@ def megatron_to_universal(src_dir, output_dir, param_map=None):
             f"no 'layer_NN-model_TT-model_states.pt' files in {src_dir} — "
             f"not a Megatron-DeepSpeed checkpoint?")
     tp_degree = max(len(v) for v in layers.values())
+    expected = list(range(tp_degree))
+    for layer_idx, ranks in sorted(layers.items()):
+        if sorted(ranks) != expected:
+            raise ValueError(
+                f"layer {layer_idx} has tp shards {sorted(ranks)}; expected "
+                f"{expected} — incomplete copy of the checkpoint?")
 
     if param_map is None:
         def param_map(layer_idx, name):
@@ -131,9 +149,6 @@ def megatron_to_universal(src_dir, output_dir, param_map=None):
     index = {}
     for layer_idx in sorted(layers):
         ranks = layers[layer_idx]
-        if len(ranks) not in (1, tp_degree):
-            raise ValueError(
-                f"layer {layer_idx} has {len(ranks)} tp shards; expected 1 or {tp_degree}")
         shards = [_load_pt(ranks[tp]) for tp in sorted(ranks)]
         key_sets = [set(sd) for sd in shards]
         union = set().union(*key_sets)
@@ -144,7 +159,7 @@ def megatron_to_universal(src_dir, output_dir, param_map=None):
                     f"{sorted(union - ks)} present on other ranks — inconsistent "
                     f"checkpoint")
         for name in sorted(union):
-            merged = _merge(name, [sd[name] for sd in shards])
+            merged = _merge(name, [sd[name] for sd in shards], gated_mlp=gated_mlp)
             path = param_map(layer_idx, name)
             pdir = _param_dir(output_dir, path)
             os.makedirs(pdir, exist_ok=True)

@@ -470,10 +470,21 @@ class DeepSpeedEngine:
 
     def destroy(self):
         """Release engine resources (reference engine.destroy): jit
-        caches, accumulated grads, and the NVMe param swap files."""
+        caches, accumulated grads, the NVMe param swap files, AND the
+        device state (params / fp32 master / optimizer moments) — a
+        destroyed engine's HBM must be reclaimable for a back-to-back
+        engine build (the bench runs several ~0.5-2.5B engines in one
+        process)."""
         self._jit_cache.clear()
         self._grads_acc = None
         self._pending = None
+        self.params = None
+        self.master_params = None
+        self.opt_state = None
+        if getattr(self, "_host_offload", None) is not None:
+            self._host_offload.close()
+        self._host_offload = None
+        self._initialized = False
         if self._param_swapper is not None:
             self._param_swapper.close()
             self._param_swapper = None

@@ -92,6 +92,16 @@ class OptimizerStateSwapper:
             self.aio.wait()
             self._writes_pending = False
 
+    def close(self):
+        """Drain pending IO and delete the swap files (engine.destroy)."""
+        import shutil
+        try:
+            self.flush()
+        except Exception:
+            pass
+        self._buffers = {}
+        shutil.rmtree(self.path, ignore_errors=True)
+
     # Full-tensor access for checkpointing --------------------------------
     def read_full(self, name):
         total = int(self.offsets[-1])

@@ -124,6 +124,12 @@ class HostOffloadOptimizer:
             f"offload_optimizer supports Adam/Adagrad/Lion families; got {type(optimizer).__name__} "
             f"(the reference similarly requires a DeepSpeedCPUOptimizer for offload)")
 
+    def close(self):
+        """Release NVMe swap files + aio resources (engine.destroy)."""
+        if self.swapper is not None:
+            self.swapper.close()
+            self.swapper = None
+
     def _load_native(self):
         try:
             if self.kind == "adam":

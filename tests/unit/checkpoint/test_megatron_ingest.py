@@ -146,3 +146,48 @@ def test_asymmetric_shard_keys_raise(tmp_path):
                src / "layer_03-model_01-model_states.pt")
     with pytest.raises(ValueError, match="missing parameters"):
         megatron_to_universal(str(src), str(tmp_path / "u6"))
+
+
+def test_gated_mlp_deinterleave(tmp_path):
+    """swiglu/geglu: each tp shard of dense_h_to_4h is [gate_i; up_i] —
+    the merge must rebuild [G; U], not interleave [g0,u0,g1,u1]."""
+    src = tmp_path / "gated"
+    src.mkdir()
+    g = torch.Generator().manual_seed(2)
+    G = torch.randn(8, 4, generator=g)   # full gate rows
+    U = torch.randn(8, 4, generator=g)   # full up rows
+    for tp_rank in range(2):
+        shard = torch.cat([G[tp_rank * 4:(tp_rank + 1) * 4],
+                           U[tp_rank * 4:(tp_rank + 1) * 4]], dim=0)
+        torch.save({"mlp.dense_h_to_4h.weight": shard},
+                   src / f"layer_03-model_{tp_rank:02d}-model_states.pt")
+    out = megatron_to_universal(str(src), str(tmp_path / "u7"), gated_mlp=True)
+    got = read_universal_param(out, "layer_03/mlp/dense_h_to_4h/weight")
+    np.testing.assert_allclose(np.asarray(got), torch.cat([G, U], 0).numpy(), rtol=1e-6)
+
+
+def test_missing_shard_file_raises(tmp_path):
+    """A tp=3 tree missing every model_01 file must fail loudly, not
+    merge ranks {0, 2} as adjacent chunks."""
+    src = tmp_path / "holes"
+    src.mkdir()
+    for tp_rank in (0, 2):
+        torch.save({"mlp.dense_h_to_4h.weight": torch.ones(2, 2)},
+                   src / f"layer_03-model_{tp_rank:02d}-model_states.pt")
+    with pytest.raises(ValueError, match="incomplete"):
+        megatron_to_universal(str(src), str(tmp_path / "u8"))
+
+
+def test_nan_replicated_param_accepted(tmp_path):
+    """Bitwise-identical replicated shards containing NaN are consistent,
+    not a convention mismatch."""
+    src = tmp_path / "nan"
+    src.mkdir()
+    t = torch.ones(4)
+    t[1] = float("nan")
+    for tp_rank in range(2):
+        torch.save({"input_layernorm.bias": t.clone()},
+                   src / f"layer_03-model_{tp_rank:02d}-model_states.pt")
+    out = megatron_to_universal(str(src), str(tmp_path / "u9"))
+    got = read_universal_param(out, "layer_03/input_layernorm/bias")
+    assert np.isnan(np.asarray(got)[1])
