@@ -116,6 +116,29 @@ class InferenceEngineV2:
         self.max_blocks_per_seq = -(-int(sm.max_context) // self.block_size)
         num_blocks = int(self._config.num_kv_blocks) or (
             1 + self.max_seqs * self.max_blocks_per_seq)
+        if not int(self._config.num_kv_blocks):
+            # Derived sizing (max_seqs x max_context worst case) can dwarf
+            # HBM for wide-KV models — e.g. the default 512-seq manager at
+            # 20 KV heads x Dh 128 derives a 43 GB pool. Cap the DEFAULT
+            # at 8 GB PER POOL SHARD (the pool shards its KV-head dim over
+            # the 'tensor' axis when divisible) with a warning; an explicit
+            # num_kv_blocks is honored as given.
+            bytes_per_block = (2 * cfg.num_hidden_layers * self.block_size *
+                               cfg.num_key_value_heads * cfg.head_dim *
+                               jnp.dtype(dtype).itemsize)
+            pool_shards = 1
+            if self.mesh is not None:
+                tp_size = dict(self.mesh.shape).get("tensor", 1)
+                if cfg.num_key_value_heads % max(tp_size, 1) == 0:
+                    pool_shards = tp_size
+            cap = max(2, int(8e9 * pool_shards // bytes_per_block))
+            if num_blocks > cap:
+                logger.warning(
+                    f"derived KV pool ({num_blocks} blocks, "
+                    f"{num_blocks * bytes_per_block / 1e9:.1f} GB) exceeds the 8 GB "
+                    f"default budget — capping at {cap} blocks; set "
+                    f"num_kv_blocks or a smaller state_manager to silence")
+                num_blocks = cap
         self.kv_cache = BlockedKVCache(cfg.num_hidden_layers, num_blocks, self.block_size,
                                        cfg.num_key_value_heads, cfg.head_dim, dtype=dtype)
         if self.mesh is not None:

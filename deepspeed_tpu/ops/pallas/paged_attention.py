@@ -141,6 +141,23 @@ def paged_decode_attention(q, kc, vc, block_tables, token_pos, interpret=None):
     groups = H // Hkv
     if not interpret and not kernel_supported(Dh, bs, Hkv):
         return xla_paged_attention(q, kc, vc, block_tables, token_pos)
+    # The block tables + positions ride in SMEM via scalar prefetch and
+    # v5e SMEM is ~1 MB: oversized state configs (e.g. the default
+    # max_tokens=768 x max_context/bs tables) overflow it at COMPILE
+    # time ("Ran out of memory in memory space smem"). Fall back to the
+    # XLA gather path when ITS dense [T, MB*bs, Hkv, Dh] KV copy is
+    # affordable; otherwise raise actionable guidance — the gather at
+    # these shapes can be 100s of GB and would surface as an opaque
+    # allocator OOM.
+    if not interpret and (T * MB + T) * 4 > 768 * 1024:
+        gather_bytes = 2 * T * MB * bs * Hkv * Dh * kc.dtype.itemsize
+        if gather_bytes <= 2 << 30:
+            return xla_paged_attention(q, kc, vc, block_tables, token_pos)
+        raise ValueError(
+            f"paged decode block table [{T}, {MB}] overflows the kernel's SMEM "
+            f"budget and the XLA gather fallback would materialize "
+            f"{gather_bytes/1e9:.0f} GB of KV — shrink max_ragged_batch_size / "
+            f"max_context, or raise kv_block_size")
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # tables, positions
