@@ -24,6 +24,7 @@ import re
 import numpy as np
 
 from deepspeed_tpu.checkpoint.universal import UNIVERSAL_METADATA, ZERO_FP32, _param_dir
+from deepspeed_tpu.utils.logging import logger
 
 LAYER_FILE_RE = re.compile(r"layer_(\d+)-model_(\d+)-model_states\.pt$")
 MP_RANK_FILE_RE = re.compile(r"mp_rank_(\d+)_model_states\.pt$")
@@ -146,6 +147,15 @@ def megatron_to_universal(src_dir, output_dir, param_map=None, gated_mlp=False):
             return f"layer_{layer_idx:02d}/" + name.replace(".", "/")
 
     os.makedirs(output_dir, exist_ok=True)
+    # Only parameter values are ingested: Megatron optimizer shards
+    # (Adam exp_avg / exp_avg_sq) are not read, so every entry below
+    # carries "moments": [] and a resumed run restarts Adam moments from
+    # zero. Expect a short loss bump after resume; lower the LR or
+    # re-warm briefly if that matters for the run.
+    logger.warning(
+        "megatron ingestion: optimizer moments are NOT ingested — training "
+        "resumed from this universal checkpoint restarts Adam moments from "
+        "zero (parameter values and step count are preserved)")
     index = {}
     for layer_idx in sorted(layers):
         ranks = layers[layer_idx]
@@ -171,9 +181,13 @@ def megatron_to_universal(src_dir, output_dir, param_map=None, gated_mlp=False):
     meta_extra = {}
     if mp_ranks:
         sd = _load_pt(mp_ranks[min(mp_ranks)])
+        # 'iteration' is Megatron's canonical step counter; fall back to
+        # 'global_steps' only when it is absent (first hit wins so a
+        # stale secondary key cannot overwrite the canonical one)
         for key in ("iteration", "global_steps"):
             if isinstance(sd.get(key), int):
                 meta_extra["global_steps"] = sd[key]
+                break
         args = sd.get("args")
         if args is not None:
             meta_extra["megatron_args"] = {

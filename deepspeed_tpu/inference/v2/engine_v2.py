@@ -459,6 +459,18 @@ class InferenceEngineV2:
         desc.blocks = []  # already freed by offload; don't double-free
         self.state_manager.flush_sequence(uid)
 
+    def is_suspended(self, uid):
+        """True when ``uid``'s KV lives in a suspended host copy."""
+        return uid in self._suspended
+
+    def suspended_blocks(self, uid):
+        """Pool blocks a :meth:`resume` of ``uid`` would need — serving
+        admission checks this against ``free_blocks`` before resuming."""
+        ent = self._suspended.get(uid)
+        if ent is None:
+            raise KeyError(f"sequence {uid} is not suspended")
+        return int(ent["handle"]["k"].shape[1])
+
     def resume(self, uid):
         """Restore a suspended sequence's KV into freshly reserved blocks
         (ids may differ; the descriptor re-points at them) and resume

@@ -15,14 +15,18 @@ import numpy as np
 
 class Request:
 
-    def __init__(self, uid, prompt_tokens, max_new_tokens):
+    def __init__(self, uid, prompt_tokens, max_new_tokens, priority=0):
         self.uid = uid
         self.prompt = list(np.atleast_1d(np.asarray(prompt_tokens)).tolist())
         self.max_new_tokens = max_new_tokens
+        self.priority = int(priority)  # larger = scheduled first
         self.prefill_cursor = 0  # prompt tokens already scheduled
         self.generated = []
         self.next_token = None  # decode token awaiting scheduling
         self.done = False
+        # paused requests hold scheduler state but take no step work —
+        # their KV may be suspended to host (gateway preemption)
+        self.paused = False
 
     @property
     def prefilling(self):
@@ -36,7 +40,7 @@ class DynamicSplitFuseScheduler:
     ``max_new_tokens``."""
 
     def __init__(self, engine, token_budget=None, sample_fn=None, eos_token_id=None,
-                 max_burst=16, sampling=None):
+                 max_burst=16, sampling=None, on_token=None):
         self.engine = engine
         self.budget = int(token_budget or engine.max_tokens)
         if self.budget > engine.max_tokens:
@@ -65,19 +69,84 @@ class DynamicSplitFuseScheduler:
         self.max_burst = max(1, int(max_burst)) if self._device_greedy else 1
         self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
         self.eos_token_id = eos_token_id
+        # on_token(uid, token, done): called for every accepted token —
+        # the serving gateway's streaming hook. None = no streaming.
+        self.on_token = on_token
         self.requests = OrderedDict()  # uid -> Request
 
-    def add_request(self, uid, prompt_tokens, max_new_tokens=16):
+    def add_request(self, uid, prompt_tokens, max_new_tokens=16, priority=0):
         if uid in self.requests:
             raise ValueError(f"uid {uid} already queued")
-        req = Request(uid, prompt_tokens, max_new_tokens)
+        req = Request(uid, prompt_tokens, max_new_tokens, priority=priority)
         if not req.prompt:
             raise ValueError(f"uid {uid}: empty prompt can never be scheduled")
         self.requests[uid] = req
+        return req
 
     @property
     def has_work(self):
         return any(not r.done for r in self.requests.values())
+
+    def _live(self):
+        """Schedulable requests, highest priority first (stable: equal
+        priorities keep arrival order). Paused requests hold their state
+        but take no step work."""
+        live = [r for r in self.requests.values() if not r.done and not r.paused]
+        return sorted(live, key=lambda r: -r.priority)
+
+    def cancel(self, uid):
+        """Stop a request now: mark done, release its engine state (live
+        KV or suspended host copy). Returns the tokens generated so far."""
+        r = self.requests.get(uid)
+        if r is None:
+            raise KeyError(f"unknown request {uid}")
+        if not r.done:
+            r.done = True
+            r.next_token = None
+            try:
+                self.engine.flush(uid)
+            except KeyError:
+                pass  # nothing prefilled yet — no engine state to drop
+        return list(r.generated)
+
+    def retire(self, uid):
+        """Remove a finished request from the table (long-running serving
+        must not grow the request dict without bound)."""
+        r = self.requests.get(uid)
+        if r is None:
+            raise KeyError(f"unknown request {uid}")
+        if not r.done:
+            raise ValueError(f"request {uid} is still live — cancel() first")
+        del self.requests[uid]
+        return r
+
+    def pause(self, uid):
+        """Preempt a live request: suspend its KV to host memory (freeing
+        pool blocks for other sequences) and stop scheduling it until
+        :meth:`unpause`. Returns True when KV was actually offloaded
+        (False for a request that never reached the engine)."""
+        r = self.requests.get(uid)
+        if r is None:
+            raise KeyError(f"unknown request {uid}")
+        if r.done or r.paused:
+            raise ValueError(f"request {uid} is not pausable (done={r.done})")
+        r.paused = True
+        if self.engine.query(uid) is not None:
+            self.engine.suspend(uid)
+            return True
+        return False
+
+    def unpause(self, uid):
+        """Resume a paused request; restores suspended KV (needs pool
+        room — caller checks ``engine.suspended_blocks(uid)`` first)."""
+        r = self.requests.get(uid)
+        if r is None:
+            raise KeyError(f"unknown request {uid}")
+        if not r.paused:
+            raise ValueError(f"request {uid} is not paused")
+        if self.engine.is_suspended(uid):
+            self.engine.resume(uid)
+        r.paused = False
 
     def _plan(self):
         """One step's (uids, token-chunks) within the budget: decodes
@@ -85,7 +154,7 @@ class DynamicSplitFuseScheduler:
         uids, chunks = [], []
         budget = self.budget
         max_seqs = self.engine.max_seqs
-        live = [r for r in self.requests.values() if not r.done]
+        live = self._live()
         # 1) decodes: one token each
         for r in live:
             if r.next_token is not None and budget > 0 and len(uids) < max_seqs:
@@ -109,7 +178,7 @@ class DynamicSplitFuseScheduler:
     def _try_burst(self):
         """All live requests decoding → run a k-step decode burst; None
         when the burst path doesn't apply this round."""
-        live = [r for r in self.requests.values() if not r.done]
+        live = self._live()
         if (self.max_burst < 2 or not live or len(live) > self.engine.max_seqs
                 or len(live) > self.budget  # burst must respect the per-step
                 # token budget too: one decode token per live request per
@@ -157,6 +226,8 @@ class DynamicSplitFuseScheduler:
             self.engine.flush(r.uid)
         else:
             r.next_token = tok
+        if self.on_token is not None:
+            self.on_token(r.uid, tok, r.done)
 
     def step(self):
         """Schedule + run one engine step; returns the uids stepped."""

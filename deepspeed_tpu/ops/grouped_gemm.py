@@ -46,12 +46,18 @@ _GMM_TILE_M = 256  # measured best on v5e at Mixtral training shapes:
 FORCE_INTERPRET = False
 
 
-def _use_pallas_gmm(num_rows, d_model):
+def _use_pallas_gmm(num_rows, d_model, d_ff):
     """The Pallas grouped matmul wins on TPU at training batch sizes
     (~1.6x ragged_dot, 85% of bf16 peak on v5e); its per-group row-tile
     padding (up to E*tm rows) drowns tiny decode batches, where
     ragged_dot stays. CPU (tests) always falls back to ragged_dot
-    unless FORCE_INTERPRET exercises the branch in interpret mode."""
+    unless FORCE_INTERPRET exercises the branch in interpret mode.
+
+    Both contraction widths must be lane-aligned: the kernel tiles N in
+    128-wide lanes, and the gate/up GEMMs have N = d_ff while the down
+    GEMM has N = d_model — a 128-aligned d_model with an unaligned d_ff
+    (e.g. a debug preset with d_ff=344) would mosaic-fail inside the
+    kernel, so gate on both and let ragged_dot take those shapes."""
     if FORCE_INTERPRET:
         return True
     try:
@@ -59,7 +65,8 @@ def _use_pallas_gmm(num_rows, d_model):
             return False
     except Exception:
         return False
-    return num_rows >= 8 * _GMM_TILE_M and d_model % 128 == 0
+    return (num_rows >= 8 * _GMM_TILE_M and d_model % 128 == 0
+            and d_ff % 128 == 0)
 
 
 def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation=jax.nn.silu):
@@ -76,7 +83,7 @@ def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation
     all three grouped GEMMs (``inter`` rebuilds elementwise from
     gate/up; the down GEMM's forward is dead code in the rebuild)."""
     from jax.ad_checkpoint import checkpoint_name
-    if _use_pallas_gmm(x.shape[0], x.shape[1]):
+    if _use_pallas_gmm(x.shape[0], x.shape[1], w_gate.shape[-1]):
         from deepspeed_tpu.ops.pallas.grouped_matmul import gmm
         tm = min(_GMM_TILE_M, max(8, x.shape[0] // 8)) if FORCE_INTERPRET else _GMM_TILE_M
         M = x.shape[0]

@@ -1,0 +1,25 @@
+"""Serving gateway: the request-level front-end over the v2 ragged
+engine (DeepSpeed-MII / FastGen serving-entry-point analogue).
+
+``ServingGateway`` accepts requests at any time from any thread
+(``submit() -> RequestHandle`` with per-token streaming + cancellation),
+applies KV-aware admission control and priority preemption, exports SLO
+metrics through the ``deepspeed_tpu.monitor`` backends, and drains
+cleanly. See ``docs/MIGRATING.md`` ("Serving gateway")."""
+
+from deepspeed_tpu.serving.admission import (AdmissionQueue, CapacityGate,
+                                             DeadlineExceededError, GatewayClosedError,
+                                             GatewayFailedError, QueueFullError,
+                                             RequestCancelledError, RequestShedError,
+                                             RequestTooLargeError, ServingError)
+from deepspeed_tpu.serving.config import ServingConfig, get_serving_config
+from deepspeed_tpu.serving.gateway import RequestHandle, ServingGateway
+from deepspeed_tpu.serving.metrics import ServingMetrics
+
+__all__ = [
+    "ServingGateway", "RequestHandle", "ServingConfig", "get_serving_config",
+    "ServingMetrics", "AdmissionQueue", "CapacityGate", "ServingError",
+    "GatewayClosedError", "GatewayFailedError", "QueueFullError",
+    "RequestTooLargeError", "RequestShedError", "RequestCancelledError",
+    "DeadlineExceededError",
+]

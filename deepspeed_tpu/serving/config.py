@@ -1,0 +1,71 @@
+"""Serving gateway config (the ``serving`` ds_config block).
+
+Validated the same way ``runtime/config.py`` validates its sections:
+a :class:`DeepSpeedConfigModel` with field-level constraints plus
+cross-field checks that raise at construction — anything configured but
+unsupported refuses loudly instead of no-opping.
+"""
+
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+ADMISSION_POLICIES = ("reject", "shed", "block")
+
+
+def get_serving_config(param_dict):
+    """Extract + validate the ``serving`` block of a ds_config dict."""
+    return ServingConfig(**param_dict.get("serving", {}))
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    """Request-level front-end knobs for :class:`ServingGateway`.
+
+    ``admission_policy`` decides what ``submit()`` does when the wait
+    queue is full:
+
+    - ``"reject"``  — raise :class:`QueueFullError` immediately;
+    - ``"shed"``    — evict the lowest-priority queued request (only if
+      it is strictly lower priority than the new one, else reject);
+    - ``"block"``   — block the submitting thread up to
+      ``block_timeout_s``, then raise :class:`QueueFullError`.
+    """
+
+    # -- admission / backpressure ------------------------------------
+    max_queue_depth: int = Field(256, ge=1)
+    admission_policy: str = "reject"
+    block_timeout_s: float = Field(30.0, gt=0)
+    # preempt (KV-suspend) the lowest-priority running request when a
+    # strictly higher-priority one cannot otherwise be admitted
+    allow_preemption: bool = True
+
+    # -- scheduling --------------------------------------------------
+    token_budget: int = Field(0, ge=0)  # 0 = engine max_tokens
+    max_burst: int = Field(16, ge=1)
+    eos_token_id: Optional[int] = None
+    sampling: Optional[dict] = None  # on-device stochastic sampling spec
+    default_max_new_tokens: int = Field(16, ge=1)
+    default_priority: int = 0
+
+    # -- lifecycle / pump --------------------------------------------
+    drain_timeout_s: float = Field(120.0, gt=0)
+    idle_poll_s: float = Field(0.001, gt=0)  # pump wait when no work
+
+    # -- metrics -----------------------------------------------------
+    metrics_window: int = Field(1024, ge=16)  # percentile reservoir size
+    # publish metrics through monitor.write_events() every N engine
+    # steps; 0 disables periodic publishing (snapshot() still works)
+    metrics_interval_steps: int = Field(0, ge=0)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.admission_policy not in ADMISSION_POLICIES:
+            raise ValueError(
+                f"serving.admission_policy={self.admission_policy!r}: must be one "
+                f"of {ADMISSION_POLICIES}")
+        if self.sampling is not None:
+            from deepspeed_tpu.inference.sampling import validate_sample_spec
+            validate_sample_spec(self.sampling)
+        return self

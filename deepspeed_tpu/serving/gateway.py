@@ -1,0 +1,503 @@
+"""Request-level serving front-end over the v2 ragged engine.
+
+``ServingGateway`` owns an :class:`InferenceEngineV2` plus a
+:class:`DynamicSplitFuseScheduler` and runs a **pump loop** in a
+background thread: clients ``submit()`` at any time from any thread and
+get back a :class:`RequestHandle` that streams tokens as the engine
+produces them. The pump overlaps host-side work (admission, deadline
+checks, queue management) with device decode bursts — the structural fix
+for the host-sync cadence that dominates ragged-serving wall time.
+
+Layering (everything engine-side stays single-threaded in the pump):
+
+    client threads --submit()--> AdmissionQueue --pump--> scheduler --> engine
+                   <--handle.tokens() stream-- on_token callback <--+
+
+Admission is KV-block aware (:class:`CapacityGate`): a request enters
+the scheduler only when its full worst-case footprint fits the pool next
+to every other active request, so the engine's "KV pool exhausted" error
+can never wedge the pump. Higher-priority requests may *preempt* running
+lower-priority ones (KV suspended to host via ``engine.suspend``,
+resumed when the pool has room again).
+
+Lifecycle: ``drain()`` stops admission, finishes everything in flight,
+stops the pump, and destroys the engine. A pump crash fails every
+outstanding handle with :class:`GatewayFailedError` instead of hanging
+clients.
+"""
+
+import itertools
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+from deepspeed_tpu.serving.admission import (AdmissionQueue, CapacityGate,
+                                             DeadlineExceededError, GatewayClosedError,
+                                             GatewayFailedError, RequestCancelledError,
+                                             RequestShedError)
+from deepspeed_tpu.serving.config import ServingConfig
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.utils.logging import logger
+
+_DONE = object()  # stream sentinel
+
+
+class RequestHandle:
+    """Client-side view of one in-flight request.
+
+    ``tokens()`` iterates generated token ids as they stream out of the
+    engine; it raises the terminal :class:`ServingError` when the request
+    ended abnormally (shed / cancelled / deadline / gateway failure).
+    ``result()`` blocks to completion and returns the full token list.
+    """
+
+    def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s):
+        self.uid = uid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.priority = priority
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + deadline_s
+                         if deadline_s is not None else None)
+        self.status = "queued"  # queued|running|completed|cancelled|shed|deadline|failed
+        self.error = None
+        self.ttft_s = None
+        self.queue_wait_s = None
+        self._stream = _queue.Queue()
+        self._collected = []
+        self._first_token_at = None
+        self._last_token_at = None
+        self._done = threading.Event()
+        self._cancel_cb = None  # wired by the gateway
+
+    # ------------------------------------------------------------- client API
+    def tokens(self, timeout=None):
+        """Yield token ids as they are generated. Raises the terminal
+        error for abnormal endings after yielding what was produced."""
+        while True:
+            item = self._stream.get(timeout=timeout)
+            if item is _DONE:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+    def result(self, timeout=None):
+        """Block until the request finishes; return all generated token
+        ids (raises the terminal error for abnormal endings)."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(f"request {self.uid} still running after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return list(self._collected)
+
+    def cancel(self):
+        """Ask the gateway to stop this request (no-op once finished)."""
+        if not self._done.is_set() and self._cancel_cb is not None:
+            self._cancel_cb(self)
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    # ------------------------------------------------------- gateway internals
+    def _emit(self, token):
+        self._collected.append(token)
+        self._stream.put(token)
+
+    def _finish(self, status, error=None):
+        if self._done.is_set():
+            return False
+        self.status = status
+        self.error = error
+        self._done.set()
+        self._stream.put(_DONE)
+        return True
+
+
+class ServingGateway:
+
+    def __init__(self, engine, config=None, monitor=None, auto_start=True):
+        """``engine``: an idle :class:`InferenceEngineV2` (the gateway
+        takes ownership — ``drain()`` destroys it). ``monitor``: any
+        object with the ``Monitor.write_events(event_list)`` interface;
+        serving metrics are published through it every
+        ``metrics_interval_steps`` engine steps."""
+        self.engine = engine
+        self.config = config or ServingConfig()
+        self.monitor = monitor
+        cfg = self.config
+        self.scheduler = DynamicSplitFuseScheduler(
+            engine,
+            token_budget=cfg.token_budget or None,
+            eos_token_id=cfg.eos_token_id,
+            max_burst=cfg.max_burst,
+            sampling=cfg.sampling,
+            on_token=self._on_token)
+        self.metrics = ServingMetrics(window=cfg.metrics_window)
+        self.gate = CapacityGate(engine, self.scheduler.budget)
+        self.queue = AdmissionQueue(cfg.max_queue_depth, cfg.admission_policy,
+                                    cfg.block_timeout_s)
+        self._uids = itertools.count()
+        self._active = {}    # uid -> handle, admitted to the scheduler
+        self._paused = []    # uids preempted (KV suspended), admission order
+        self._finished = []  # uids completed during the current step
+        self._cancels = []   # handles with a pending cancel request
+        self._cancel_lock = threading.Lock()
+        self._state = "running"  # running|draining|stopped|failed
+        self._state_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._pump_stop = False
+        self._pump_thread = None
+        if auto_start:
+            self.start()
+
+    # ---------------------------------------------------------------- client
+    def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
+               deadline_ms=None):
+        """Accept a request from any thread → :class:`RequestHandle`.
+
+        Raises :class:`RequestTooLargeError` when the request can never
+        fit this engine, :class:`QueueFullError` per the admission
+        policy, :class:`GatewayClosedError` after ``drain()`` began.
+        """
+        prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.config.default_max_new_tokens)
+        prio = int(priority if priority is not None
+                   else self.config.default_priority)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if self._state in ("draining", "stopped"):
+            raise GatewayClosedError("gateway is draining — not accepting requests")
+        if self._state == "failed":
+            raise GatewayFailedError("gateway pump died; rebuild the gateway")
+        try:
+            self.gate.check_feasible(len(prompt), max_new)
+        except Exception:
+            self.metrics.count("rejected_too_large")
+            raise
+        handle = RequestHandle(next(self._uids), prompt, max_new, prio,
+                               deadline_ms / 1e3 if deadline_ms is not None else None)
+        handle._cancel_cb = self._request_cancel
+        try:
+            shed = self.queue.push(handle)
+        except Exception as e:
+            from deepspeed_tpu.serving.admission import QueueFullError
+            if isinstance(e, QueueFullError):
+                self.metrics.count("rejected_queue_full")
+            raise
+        self.metrics.count("submitted")
+        self.metrics.gauge_peak("queue_depth_peak",
+                                getattr(handle, "_depth_at_enqueue", 1))
+        if shed is not None:
+            self.metrics.count("shed")
+            shed._finish("shed", RequestShedError(
+                f"request {shed.uid} (priority {shed.priority}) evicted from a "
+                f"full queue by request {handle.uid} (priority {prio})"))
+        self._wake.set()
+        return handle
+
+    def _request_cancel(self, handle):
+        with self._cancel_lock:
+            self._cancels.append(handle)
+        self._wake.set()
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        if self._pump_thread is not None:
+            return
+        self._pump_stop = False
+        self._pump_thread = threading.Thread(target=self._run, name="ds-serve-pump",
+                                             daemon=True)
+        self._pump_thread.start()
+
+    def drain(self, timeout=None):
+        """Stop admitting, finish everything in flight (queued requests
+        included — they were accepted), then stop the pump and destroy
+        the engine. Raises :class:`TimeoutError` if in-flight work does
+        not finish in time (engine left alive for inspection)."""
+        timeout = self.config.drain_timeout_s if timeout is None else timeout
+        with self._state_lock:
+            if self._state in ("stopped", "failed"):
+                return
+            self._state = "draining"
+        self.queue.close()
+        self._wake.set()
+        thread = self._pump_thread
+        if thread is None:
+            # manual-pump mode (auto_start=False): drive the pump inline
+            deadline = time.monotonic() + timeout
+            while self._active or len(self.queue) > 0:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"drain: in-flight requests still running after "
+                        f"{timeout}s ({len(self._active)} active, "
+                        f"{len(self.queue)} queued)")
+                self._pump_once()
+        else:
+            # the pump thread exits on its own once draining finds
+            # nothing in flight (see _run)
+            thread.join(timeout=timeout)
+            if thread.is_alive():
+                raise TimeoutError(
+                    f"drain: in-flight requests still running after {timeout}s "
+                    f"({len(self._active)} active, {len(self.queue)} queued)")
+            self._pump_thread = None
+        if self._state != "failed":
+            with self._state_lock:
+                self._state = "stopped"
+            self.engine.destroy()
+
+    def shutdown(self):
+        """Hard stop: fail every outstanding request and destroy the
+        engine. For aborts; prefer :meth:`drain` for clean exits."""
+        with self._state_lock:
+            if self._state == "stopped":
+                return
+            self._state = "draining"  # reject new submits while we tear down
+        self.queue.close()
+        self._stop_pump()
+        self._fail_outstanding(GatewayClosedError("gateway shut down"))
+        with self._state_lock:
+            self._state = "stopped"
+        self.engine.destroy()
+
+    def _stop_pump(self):
+        thread = self._pump_thread
+        self._pump_stop = True
+        self._wake.set()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=30)
+        self._pump_thread = None
+
+    def _fail_outstanding(self, error):
+        for entry in self.queue.candidates():
+            self.queue.remove(entry)
+            if entry._finish("failed", error):
+                self.metrics.count("failed")
+        for uid, handle in list(self._active.items()):
+            try:
+                self.scheduler.cancel(uid)
+            except Exception:
+                pass
+            if handle._finish("failed", error):
+                self.metrics.count("failed")
+        self._active.clear()
+        self._paused = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.drain()
+        else:
+            self.shutdown()
+        return False
+
+    # ------------------------------------------------------------------ pump
+    def _run(self):
+        while not self._pump_stop:
+            try:
+                did_work = self._pump_once()
+            except Exception as e:  # crash-safe: never hang clients
+                logger.exception("serving pump died")
+                with self._state_lock:
+                    self._state = "failed"
+                self._fail_outstanding(GatewayFailedError(
+                    f"serving pump died: {type(e).__name__}: {e}"))
+                return
+            in_flight = bool(self._active) or len(self.queue) > 0
+            if not in_flight and self._state == "draining":
+                return
+            if not did_work:
+                self._wake.wait(timeout=self.config.idle_poll_s if in_flight
+                                else 0.05)
+                self._wake.clear()
+
+    def _pump_once(self):
+        """One pump iteration; True when any request made progress."""
+        did = False
+        did |= self._process_cancels()
+        did |= self._process_deadlines()
+        did |= self._admit()
+        did |= self._resume_paused()
+        did |= self._step()
+        self.metrics.gauge(
+            queue_depth=len(self.queue),
+            running=len(self._active) - len(self._paused),
+            paused=len(self._paused),
+            kv_free_blocks=int(self.engine.free_blocks),
+            kv_occupancy=round(1.0 - self.engine.free_blocks /
+                               max(self.gate.usable_blocks, 1), 4))
+        interval = self.config.metrics_interval_steps
+        if self.monitor is not None and interval and did:
+            steps = self.metrics.snapshot()["counters"]["engine_steps"]
+            if steps and steps % interval == 0:
+                self.metrics.write_events(self.monitor, step=steps)
+        return did
+
+    def _process_cancels(self):
+        with self._cancel_lock:
+            cancels, self._cancels = self._cancels, []
+        did = False
+        for handle in cancels:
+            if handle.done:
+                continue
+            did |= self._terminate(handle, "cancelled", RequestCancelledError(
+                f"request {handle.uid} cancelled after "
+                f"{len(handle._collected)} tokens"), "cancelled")
+        return did
+
+    def _process_deadlines(self):
+        now = time.monotonic()
+        did = False
+        for entry in self.queue.expired(now):
+            did |= self._terminate(entry, "deadline", DeadlineExceededError(
+                f"request {entry.uid} expired in queue after "
+                f"{(now - entry.submitted_at) * 1e3:.0f}ms"), "deadline_expired")
+        for uid, handle in list(self._active.items()):
+            if handle.deadline is not None and now >= handle.deadline:
+                did |= self._terminate(handle, "deadline", DeadlineExceededError(
+                    f"request {uid} exceeded its deadline mid-generation "
+                    f"({len(handle._collected)} tokens generated)"),
+                    "deadline_expired")
+        return did
+
+    def _terminate(self, handle, status, error, counter):
+        """Stop a queued or active request with the given terminal state."""
+        uid = handle.uid
+        if uid in self._active:
+            self.scheduler.cancel(uid)
+            self.scheduler.retire(uid)
+            self._release(handle)
+        elif not self.queue.remove(handle):
+            return False  # already finished concurrently
+        if handle._finish(status, error):
+            self.metrics.count(counter)
+            return True
+        return False
+
+    def _release(self, handle):
+        self.gate.release(len(handle.prompt), handle.max_new_tokens)
+        self._active.pop(handle.uid, None)
+        if handle.uid in self._paused:
+            self._paused.remove(handle.uid)
+
+    def _admit(self):
+        """Move queued requests into the scheduler, highest priority
+        first, while their full KV footprint fits; optionally preempt
+        lower-priority running requests for the head of the queue."""
+        did = False
+        for entry in self.queue.candidates():
+            plen, max_new = len(entry.prompt), entry.max_new_tokens
+            while not self.gate.try_commit(plen, max_new):
+                if not self.config.allow_preemption or not self._preempt_for(entry):
+                    return did  # strict priority order: no skip-ahead
+            if not self.queue.remove(entry):  # cancelled concurrently
+                self.gate.release(plen, max_new)
+                continue
+            if entry.done:  # shed/failed between snapshot and now
+                self.gate.release(plen, max_new)
+                continue
+            self.scheduler.add_request(entry.uid, entry.prompt,
+                                       max_new_tokens=max_new,
+                                       priority=entry.priority)
+            entry.status = "running"
+            entry.queue_wait_s = time.monotonic() - entry.submitted_at
+            self.metrics.observe_queue_wait(entry.queue_wait_s)
+            self.metrics.count("admitted")
+            self._active[entry.uid] = entry
+            did = True
+        return did
+
+    def _preempt_for(self, entry):
+        """Suspend the lowest-priority running request whose priority is
+        strictly below ``entry``'s; False when no valid victim exists."""
+        running = [(uid, h) for uid, h in self._active.items()
+                   if uid not in self._paused]
+        victims = [(uid, h) for uid, h in running if h.priority < entry.priority]
+        if not victims:
+            return False
+        # lowest priority loses; youngest among ties (oldest keeps running)
+        uid, handle = min(reversed(victims), key=lambda it: it[1].priority)
+        self.scheduler.pause(uid)
+        self.gate.release(len(handle.prompt), handle.max_new_tokens)
+        self._paused.append(uid)
+        self.metrics.count("preemptions")
+        logger.info(f"serving: preempted request {uid} (priority "
+                    f"{handle.priority}) for request {entry.uid} (priority "
+                    f"{entry.priority})")
+        return True
+
+    def _resume_paused(self):
+        """Bring preempted requests back once the pool has room again
+        (highest priority first; admitted queue entries take precedence
+        because _admit runs before this)."""
+        did = False
+        for uid in sorted(self._paused, key=lambda u: -self._active[u].priority):
+            handle = self._active[uid]
+            if not self.gate.try_commit(len(handle.prompt), handle.max_new_tokens):
+                break
+            self.scheduler.unpause(uid)
+            self._paused.remove(uid)
+            self.metrics.count("resumes")
+            did = True
+        return did
+
+    def _step(self):
+        if not any(uid not in self._paused for uid in self._active):
+            return False
+        stepped = self.scheduler.step()
+        self.metrics.count("engine_steps")
+        if not stepped and not self._finished:
+            # every live request is schedulable yet nothing ran — a real
+            # stall would spin the pump forever; fail fast instead
+            raise RuntimeError(
+                f"scheduler stalled with {len(self._active)} active requests")
+        for uid in self._finished:
+            handle = self._active.get(uid)
+            if handle is None:
+                continue
+            self.scheduler.retire(uid)
+            self._release(handle)
+            if handle._finish("completed"):
+                self.metrics.count("completed")
+        self._finished = []
+        return True
+
+    def _on_token(self, uid, token, done):
+        """Streaming hook, called by the scheduler for every accepted
+        token (pump thread)."""
+        handle = self._active.get(uid)
+        if handle is None:
+            return
+        now = time.monotonic()
+        if handle._first_token_at is None:
+            handle._first_token_at = now
+            handle.ttft_s = now - handle.submitted_at
+            self.metrics.observe_ttft(handle.ttft_s)
+        else:
+            self.metrics.observe_token_latency(now - handle._last_token_at)
+        handle._last_token_at = now
+        handle._emit(int(token))
+        self.metrics.count("tokens_generated")
+        if done:
+            self._finished.append(uid)
+
+    # ------------------------------------------------------------------ misc
+    @property
+    def state(self):
+        return self._state
+
+    def snapshot(self):
+        """Metrics snapshot plus gateway state (tests / CLI)."""
+        snap = self.metrics.snapshot()
+        snap["state"] = self._state
+        return snap
