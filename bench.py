@@ -429,6 +429,87 @@ def bench_offload_probe():
                      f"~{wire_gb / n_params * 2e9:.0f} GB of grads+params)")}
 
 
+def bench_checkpoint():
+    """Train-step stall for sync vs nebula async checkpointing: how long
+    `save_checkpoint` blocks the training loop. Both paths run the same
+    serialization + atomic-commit protocol; async moves everything after
+    the host snapshot onto the background writer. Runs on CPU too (the
+    lane exercises host memcpy + disk, not the MXU) with a debug-sized
+    model; TPU uses a ~120M-param state so the disk write is long enough
+    to dominate."""
+    import shutil
+    import tempfile
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.nebula.service import snapshot_tree
+    from deepspeed_tpu.parallel import groups
+
+    on_tpu = jax.default_backend() == "tpu"
+    groups.destroy_mesh()
+    if on_tpu:
+        model = build_llama("160m", hidden_size=768, intermediate_size=2048,
+                            num_hidden_layers=8, num_attention_heads=12,
+                            num_key_value_heads=12, max_position_embeddings=512,
+                            remat=False)
+    else:
+        model = build_llama("debug", hidden_size=256, intermediate_size=688,
+                            num_hidden_layers=4)
+    ckpt_dir = tempfile.mkdtemp(prefix="nebula_bench_")
+    config = {
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 4,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 1000000,
+        "nebula": {"enabled": True, "persistent_time_interval": 0,
+                   "persistent_storage_path": ckpt_dir,
+                   "num_of_version_in_retention": 2},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    ids = np.zeros((4, 256), np.int32)
+    engine.train_batch(batch=(jnp.asarray(ids), jnp.asarray(ids)))
+    jax.block_until_ready(engine.params)
+    svc = engine._checkpoint_service
+
+    def timed_save(tag, async_save):
+        t0 = time.perf_counter()
+        engine.save_checkpoint(tag=tag, async_save=async_save)
+        return time.perf_counter() - t0
+
+    # warm both paths (dir creation, writer-thread start, page cache)
+    timed_save("warm_sync", False)
+    timed_save("warm_async", True)
+    svc.wait()
+
+    sync_s = min(timed_save(f"sync{i}", False) for i in range(2))
+    stalls, bg_writes = [], []
+    for i in range(2):
+        stalls.append(timed_save(f"async{i}", True))
+        t0 = time.perf_counter()
+        svc.wait()
+        bg_writes.append(time.perf_counter() - t0)
+    async_stall_s = min(stalls)
+
+    t0 = time.perf_counter()
+    snapshot_tree({"p": engine.params, "o": engine.opt_state})
+    snapshot_s = time.perf_counter() - t0
+
+    n_params = _param_count(engine.params)
+    engine.destroy()
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return {"params": n_params,
+            "stall_s_sync": round(sync_s, 4),
+            "stall_s_async": round(async_stall_s, 4),
+            "snapshot_s": round(snapshot_s, 4),
+            "bg_write_s": round(min(bg_writes), 4),
+            "stall_ratio_async_vs_sync": round(async_stall_s / sync_s, 4),
+            "note": "stall = how long save_checkpoint blocks the train loop; "
+                    "async pays only the device->host snapshot, the serialize + "
+                    "write + atomic commit run on the nebula writer thread"}
+
+
 def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import build_llama
@@ -514,6 +595,7 @@ def main():
         ("serving_2b_fp6", bench_serving_2b, {"quant_scheme": "fp6"}),
         ("serving_v2_ragged", bench_serving_v2_ragged, {}),
         ("offload", bench_offload_probe, {}),
+        ("checkpoint", bench_checkpoint, {}),
     ]
     extras = {key: None for key, _, _ in lanes}
     if on_tpu:
@@ -525,6 +607,13 @@ def main():
                 extras[key] = fn(**kwargs)
             except Exception as e:
                 extras[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        # the checkpoint lane has no TPU dependency (host memcpy + disk):
+        # run it everywhere so the async-stall contract is measured in CI
+        try:
+            extras["checkpoint"] = bench_checkpoint()
+        except Exception as e:
+            extras["checkpoint"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     full = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -576,6 +665,7 @@ def main():
             "serve_int8_tok_s": _pick("serving_2b_int8", "gen_tokens_per_sec_e2e"),
             "serve_fp8_tok_s": _pick("serving_2b_fp8", "gen_tokens_per_sec_e2e"),
             "serve_ragged_tok_s": _pick("serving_v2_ragged", "gen_tokens_per_sec"),
+            "ckpt_stall_ratio": _pick("checkpoint", "stall_ratio_async_vs_sync"),
             "full_results": out_path,
         },
     }))

@@ -4,3 +4,4 @@ from deepspeed_tpu.elasticity.elasticity import (
     ensure_immutable_elastic_config,
     get_compatible_gpus,
 )
+from deepspeed_tpu.elasticity.elastic_agent import is_elastic_restart

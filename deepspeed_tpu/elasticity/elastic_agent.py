@@ -29,6 +29,17 @@ from typing import Callable, Optional, Sequence
 from deepspeed_tpu.utils.logging import logger
 
 
+def is_elastic_restart():
+    """True inside a worker the elastic agent relaunched after a failure
+    (``DS_ELASTIC_RESTART_COUNT`` > 0). The engine's resume path uses
+    this to route tag resolution through the nebula manifest validator:
+    a crash mid-checkpoint must fall back to the newest intact tag."""
+    try:
+        return int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0")) > 0
+    except ValueError:
+        return False
+
+
 class DSElasticAgent:
     """Per-host supervisor: run → monitor → relaunch on failure.
 

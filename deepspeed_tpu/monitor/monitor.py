@@ -187,8 +187,13 @@ class MonitorMaster(Monitor):
     """Fans events out to every enabled backend (reference monitor.py:30)."""
 
     def __init__(self, monitor_config: DeepSpeedMonitorConfig):
+        import threading
         self.monitor_config = monitor_config
         self.backends = []
+        # the nebula checkpoint writer reports timings from its background
+        # thread; backend writers (csv file handles, tb event files) are
+        # not reentrant, so serialize the fan-out
+        self._write_lock = threading.Lock()
         self.tb_monitor = None
         self.wandb_monitor = None
         self.csv_monitor = None
@@ -212,5 +217,6 @@ class MonitorMaster(Monitor):
     def write_events(self, event_list):
         if _control_rank() != 0:
             return
-        for backend in self.backends:
-            backend.write_events(event_list)
+        with self._write_lock:
+            for backend in self.backends:
+                backend.write_events(event_list)

@@ -1,3 +1,14 @@
 from deepspeed_tpu.nebula.config import DeepSpeedNebulaConfig, get_nebula_config
+from deepspeed_tpu.nebula.service import (CheckpointWriteError, NebulaCheckpointService, resolve_load_tag,
+                                          snapshot_tree, validate_tag, write_latest)
 
-__all__ = ["DeepSpeedNebulaConfig", "get_nebula_config"]
+__all__ = [
+    "DeepSpeedNebulaConfig",
+    "get_nebula_config",
+    "NebulaCheckpointService",
+    "CheckpointWriteError",
+    "snapshot_tree",
+    "resolve_load_tag",
+    "validate_tag",
+    "write_latest",
+]

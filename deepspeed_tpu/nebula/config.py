@@ -1,7 +1,11 @@
-"""Nebula (Azure async checkpoint service) config parity
-(reference deepspeed/nebula/config.py). The service itself is
-Azure-proprietary; the sharded checkpoint engine is the TPU-native
-async-ish path — this config parses and reports unsupported."""
+"""Nebula checkpoint service config (reference deepspeed/nebula/config.py).
+
+The reference delegates to the Azure-proprietary Nebula service; here the
+same config keys drive the TPU-native async checkpoint service in
+``deepspeed_tpu.nebula.service`` (snapshot-to-host double buffering +
+background write + atomic commit). ``persistent_time_interval`` is
+interpreted as *seconds between persisted versions* for auto-tagged
+saves (explicitly tagged saves always persist)."""
 
 from typing import Optional
 
@@ -19,8 +23,7 @@ class DeepSpeedNebulaConfig(DeepSpeedConfigModel):
 
 def get_nebula_config(param_dict):
     cfg = DeepSpeedNebulaConfig(**param_dict.get("nebula", {}))
-    if cfg.enabled:
-        raise NotImplementedError(
-            "nebula: the Azure Nebula checkpoint service is not available on TPU — "
-            "use the sharded checkpoint engine (default) or 'checkpoint': {'sharded': true}")
+    if cfg.enabled and cfg.num_of_version_in_retention < 1:
+        raise ValueError("nebula: num_of_version_in_retention must be >= 1 "
+                         f"(got {cfg.num_of_version_in_retention})")
     return cfg

@@ -1,0 +1,470 @@
+"""Nebula: TPU-native async fault-tolerant checkpoint service.
+
+The reference's ``deepspeed/nebula`` delegates to a proprietary Azure
+service; this module implements the capability natively over the
+existing ``CheckpointEngine`` implementations, following the CheckFreq
+split (snapshot-then-persist):
+
+- **snapshot** (in ``snapshot_tree``, called from the training loop's
+  thread): device→host copy of every array leaf at the step boundary.
+  ``save_checkpoint(async_save=True)`` returns after this copy — the
+  train step stalls for a memcpy, not a disk write. Snapshots are
+  double-buffered: the new snapshot is taken first (second buffer), then
+  the caller blocks until the previous background write drains, so at
+  most one write is in flight and at most two host copies ever exist.
+- **persist** (background ``nebula-writer`` thread): serializes every
+  state dict through the configured ``CheckpointEngine`` into a fresh
+  hidden temp dir and atomically commits.
+
+Commit protocol — crash-safe at every point:
+
+1. all files are written under ``<save_dir>/.nebula_tmp/<tag>/``;
+2. a manifest (``nebula_manifest.json``) naming every file and its byte
+   size is written into the temp dir (tmp + ``os.replace``);
+3. the temp dir is promoted to ``<save_dir>/<tag>`` (``os.rename``);
+4. the ``latest`` pointer is rotated (tmp + ``os.replace``);
+5. retention GC removes committed versions beyond
+   ``num_of_version_in_retention``.
+
+A tag is **loadable iff its manifest validates**. A crash before (3)
+leaves nothing at the final path; a crash between (3) and (4) leaves a
+committed tag on disk while ``latest`` still names the previous one —
+both are intact, and resume follows ``latest`` (a torn or missing
+``latest`` falls back to the newest committed tag; preferring a valid
+``latest`` also keeps ``save_latest=False`` side-checkpoints from
+hijacking resume). A failed background write is never silent:
+the exception is re-raised from the NEXT ``save_checkpoint`` call
+(``CheckpointWriteError``), and the on-disk state remains the previous
+intact version.
+
+Multi-process note: with the sharded engine every process runs the same
+``save`` collectively (the engine's internal host barriers line up
+across the writer threads); manifest/promote/latest/GC run on the
+control-plane rank 0 only.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from deepspeed_tpu.nebula.config import DeepSpeedNebulaConfig
+from deepspeed_tpu.runtime.checkpoint_engine import CheckpointCorruptionError, HostShardSnapshot
+from deepspeed_tpu.utils.logging import logger
+
+MANIFEST_NAME = "nebula_manifest.json"
+TMP_ROOT = ".nebula_tmp"
+LATEST = "latest"
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed. Raised from the next
+    ``save_checkpoint`` call so the failure is never silent; the previous
+    committed checkpoint on disk is unaffected."""
+
+
+# ----------------------------------------------------------------------
+# Snapshot (device → host, called from the training thread)
+# ----------------------------------------------------------------------
+def snapshot_tree(tree):
+    """Host snapshot of a state pytree: every ``jax.Array`` leaf becomes
+    a ``HostShardSnapshot`` holding this process's replica-0 shards as
+    numpy (one D2H batch per leaf); numpy leaves are kept by reference
+    (they are already host-resident and the engine rebuilds its state
+    dicts per save); scalars/strings pass through."""
+    import jax
+
+    from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import _normalize_index
+
+    def snap(leaf):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            picked, seen = [], set()
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                coords = tuple(tuple(se) for se in _normalize_index(shard.index, leaf.shape))
+                if coords in seen:
+                    continue
+                seen.add(coords)
+                picked.append((coords, shard.data))
+            datas = jax.device_get([d for _, d in picked])
+            chunks = [(coords, np.ascontiguousarray(d)) for (coords, _), d in zip(picked, datas)]
+            return HostShardSnapshot(leaf.shape, leaf.dtype, chunks)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree.map(snap, tree)
+
+
+def snapshot_bytes(tree):
+    """Total host bytes held by a snapshot tree (metrics)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, HostShardSnapshot) or hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+# ----------------------------------------------------------------------
+# Manifest + commit + resume-side validation (module-level: the resume
+# path must work without a service instance, e.g. under the elastic
+# agent's restart of a job whose config has changed)
+# ----------------------------------------------------------------------
+def write_latest(save_dir, tag):
+    """Atomically rotate the ``latest`` pointer (tmp + ``os.replace``) —
+    a crash mid-write can never leave a torn pointer."""
+    path = os.path.join(save_dir, LATEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fd:
+        fd.write(str(tag))
+    os.replace(tmp, path)
+
+
+def read_latest(save_dir):
+    path = os.path.join(save_dir, LATEST)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as fd:
+        return fd.read().strip() or None
+
+
+def write_manifest(tag_dir, tag, extra=None):
+    """Record every file under ``tag_dir`` with its byte size. Written
+    LAST (after all payload files): a manifest's presence means the write
+    finished; its sizes detect truncation after the fact."""
+    files = {}
+    for root, _dirs, names in os.walk(tag_dir):
+        for name in names:
+            if name == MANIFEST_NAME or name.endswith(".tmp"):
+                continue
+            full = os.path.join(root, name)
+            files[os.path.relpath(full, tag_dir)] = {"bytes": os.path.getsize(full)}
+    manifest = {"version": 1, "tag": str(tag), "files": files}
+    manifest.update(extra or {})
+    tmp = os.path.join(tag_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as fd:
+        json.dump(manifest, fd, indent=1)
+    os.replace(tmp, os.path.join(tag_dir, MANIFEST_NAME))
+    return manifest
+
+
+def validate_tag(save_dir, tag):
+    """Check that ``<save_dir>/<tag>`` is a committed, untorn checkpoint.
+    Returns the manifest dict; raises ``CheckpointCorruptionError`` with
+    the specific defect otherwise."""
+    tag_dir = os.path.join(save_dir, str(tag))
+    if not os.path.isdir(tag_dir):
+        raise CheckpointCorruptionError(tag_dir, "tag directory does not exist")
+    mpath = os.path.join(tag_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        raise CheckpointCorruptionError(
+            tag_dir, "missing manifest — the save never committed (resume from an older tag)")
+    try:
+        with open(mpath) as fd:
+            manifest = json.load(fd)
+        files = manifest["files"]
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        raise CheckpointCorruptionError(mpath, f"torn manifest ({e})") from e
+    for rel, info in files.items():
+        full = os.path.join(tag_dir, rel)
+        if not os.path.isfile(full):
+            raise CheckpointCorruptionError(tag_dir, f"manifest lists '{rel}' but it is missing")
+        actual = os.path.getsize(full)
+        if actual != int(info["bytes"]):
+            raise CheckpointCorruptionError(
+                full, f"size mismatch for '{rel}': manifest says {info['bytes']} bytes, "
+                f"disk holds {actual} — truncated or overwritten")
+    return manifest
+
+
+def _manifest_tags(save_dir):
+    """Committed (manifest-bearing) tag dirs, newest manifest first."""
+    out = []
+    for name in os.listdir(save_dir):
+        tag_dir = os.path.join(save_dir, name)
+        mpath = os.path.join(tag_dir, MANIFEST_NAME)
+        if name != TMP_ROOT and os.path.isdir(tag_dir) and os.path.isfile(mpath):
+            out.append((os.path.getmtime(mpath), name))
+    return [name for _, name in sorted(out, reverse=True)]
+
+
+def resolve_load_tag(load_dir):
+    """Resume-side tag resolution: the newest *intact* tag.
+
+    Prefers the ``latest`` pointer when it validates; a torn/uncommitted
+    latest falls back to the newest tag whose manifest validates. Legacy
+    directories (no manifests anywhere) trust ``latest`` as-is, since
+    there is nothing to validate against."""
+    if load_dir is None or not os.path.isdir(load_dir):
+        return None
+    latest = read_latest(load_dir)
+    candidates = _manifest_tags(load_dir)
+    if not candidates:
+        return latest  # legacy layout: nothing validatable
+    if latest is not None:
+        candidates = [latest] + [t for t in candidates if t != latest]
+    for tag in candidates:
+        try:
+            validate_tag(load_dir, tag)
+            if latest is not None and tag != latest:
+                logger.warning(f"[nebula] latest tag '{latest}' is torn or uncommitted; "
+                               f"resuming from newest intact tag '{tag}'")
+            return tag
+        except CheckpointCorruptionError as e:
+            logger.warning(f"[nebula] skipping tag '{tag}': {e.reason}")
+    return None
+
+
+# ----------------------------------------------------------------------
+# The service
+# ----------------------------------------------------------------------
+class _Job:
+    __slots__ = ("save_dir", "tag", "parts", "save_latest", "snapshot_s", "step", "meta")
+
+    def __init__(self, save_dir, tag, parts, save_latest, snapshot_s, step, meta):
+        self.save_dir = save_dir
+        self.tag = str(tag)
+        self.parts = parts  # [(state_snapshot, relpath-under-tag-dir)]
+        self.save_latest = save_latest
+        self.snapshot_s = snapshot_s
+        self.step = step
+        self.meta = meta or {}
+
+
+class NebulaCheckpointService:
+    """Async checkpoint writer with atomic commit, retention GC, and
+    writer-failure propagation. One instance per engine; one daemon
+    writer thread, started lazily on the first async save."""
+
+    def __init__(self, config: DeepSpeedNebulaConfig, checkpoint_engine, monitor=None):
+        self.config = config
+        self.checkpoint_engine = checkpoint_engine
+        self.monitor = monitor
+        self._lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._pending_job = None
+        self._wake = threading.Condition(self._lock)
+        self._thread = None
+        self._failure = None  # (tag, exception) of the last failed write
+        self._last_persist = None  # monotonic time of the last commit
+        self._stats = {"saves": 0, "commits": 0, "gc_removed": 0, "failures": 0}
+        # test-only fault-injection hook: callable(point, detail) invoked
+        # at labelled stages of the writer (see _execute)
+        self.test_hook = None
+        import atexit
+        atexit.register(self.wait)  # never lose an in-flight write at exit
+
+    # -- failure propagation ------------------------------------------
+    def raise_pending_failure(self):
+        """Surface the last background write failure (called at the top
+        of every ``save_checkpoint``). Clears the failure: the caller is
+        expected to react (alert, re-save) — the disk still holds the
+        previous intact version either way."""
+        with self._lock:
+            failure, self._failure = self._failure, None
+        if failure is not None:
+            tag, exc = failure
+            raise CheckpointWriteError(
+                f"background checkpoint write for tag '{tag}' failed "
+                f"({type(exc).__name__}: {exc}); the previous committed checkpoint is "
+                f"intact — re-save or investigate before trusting tag '{tag}'") from exc
+
+    @property
+    def pending_failure(self):
+        with self._lock:
+            return self._failure
+
+    # -- barrier -------------------------------------------------------
+    def wait(self, timeout=None):
+        """Block until the background writer is idle (all submitted
+        writes committed or failed). Called automatically before
+        ``load_checkpoint``, on engine drain/destroy, and at exit."""
+        return self._idle.wait(timeout)
+
+    flush = wait
+
+    @property
+    def queue_depth(self):
+        return 0 if self._idle.is_set() else 1
+
+    def persist_due(self):
+        """Honors ``persistent_time_interval`` (seconds between persisted
+        versions) for auto-tagged saves; explicitly-tagged saves bypass."""
+        interval = float(self.config.persistent_time_interval or 0)
+        if interval <= 0:
+            return True
+        with self._lock:
+            last = self._last_persist
+        return last is None or (time.monotonic() - last) >= interval
+
+    # -- submission ----------------------------------------------------
+    def save_async(self, save_dir, tag, parts, save_latest=True, snapshot_s=0.0,
+                   step=None, meta=None):
+        """Enqueue a background write of already-snapshotted state. The
+        caller's snapshot (``parts``) is the second buffer; block here
+        until the previous write drains so at most one is in flight."""
+        self.wait()
+        if not parts and not _is_rank0():
+            return  # nothing to write from this process
+        job = _Job(save_dir, tag, parts, save_latest, snapshot_s, step, meta)
+        with self._lock:
+            self._idle.clear()
+            self._pending_job = job
+            self._ensure_thread_locked()
+            self._wake.notify()
+
+    def save_sync(self, save_dir, tag, parts, save_latest=True, snapshot_s=0.0,
+                  step=None, meta=None):
+        """Same commit protocol, executed inline (``async_save=False``):
+        errors raise directly in the caller."""
+        self.wait()
+        if not parts and not _is_rank0():
+            return
+        self._execute(_Job(save_dir, tag, parts, save_latest, snapshot_s, step, meta))
+
+    def shutdown(self, wait=True):
+        if wait:
+            self.wait()
+
+    # -- writer thread -------------------------------------------------
+    def _ensure_thread_locked(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._run, name="nebula-writer", daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while self._pending_job is None:
+                    self._wake.wait()
+                job, self._pending_job = self._pending_job, None
+            try:
+                self._execute(job)
+            except BaseException as e:  # propagate to the next save, never die silently
+                with self._lock:
+                    self._failure = (job.tag, e)
+                    self._stats["failures"] += 1
+                logger.error(f"[nebula] background write of tag '{job.tag}' failed: "
+                             f"{type(e).__name__}: {e}")
+            finally:
+                with self._lock:
+                    if self._pending_job is None:
+                        self._idle.set()
+
+    # -- the write + commit path --------------------------------------
+    def _hook(self, point, detail=None):
+        if self.test_hook is not None:
+            self.test_hook(point, detail)
+
+    def _execute(self, job):
+        self._stats["saves"] += 1
+        rank0 = _is_rank0()
+        tag_tmp = os.path.join(job.save_dir, TMP_ROOT, job.tag)
+        if rank0:
+            if os.path.isdir(tag_tmp):
+                shutil.rmtree(tag_tmp)
+            os.makedirs(tag_tmp)
+        self._hook("before_write", job.tag)
+        t0 = time.perf_counter()
+        for state, rel in job.parts:
+            self.checkpoint_engine.save(state, os.path.join(tag_tmp, rel))
+            self._hook("after_part", rel)
+        write_s = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        removed = 0
+        nbytes = 0
+        if rank0:
+            self._hook("before_manifest", job.tag)
+            manifest = write_manifest(tag_tmp, job.tag, extra=job.meta)
+            nbytes = sum(int(f["bytes"]) for f in manifest["files"].values())
+            self._hook("before_promote", job.tag)
+            self._promote(tag_tmp, os.path.join(job.save_dir, job.tag))
+            if job.save_latest:
+                self._hook("before_latest", job.tag)
+                write_latest(job.save_dir, job.tag)
+            removed = self.gc(job.save_dir)
+            self._hook("after_commit", job.tag)
+        commit_s = time.perf_counter() - t1
+        with self._lock:
+            self._last_persist = time.monotonic()
+            self._stats["commits"] += 1
+            self._stats["gc_removed"] += removed
+        logger.info(f"[nebula] committed tag '{job.tag}' "
+                    f"(write {write_s:.2f}s, commit {commit_s:.3f}s, {nbytes / 1e6:.1f} MB, "
+                    f"gc removed {removed})")
+        self._emit_metrics(job, write_s, commit_s, nbytes, removed)
+
+    @staticmethod
+    def _promote(tag_tmp, tag_dir):
+        """Atomically swing the temp dir into the final tag path. If the
+        tag already exists (re-save), the old version is moved aside
+        first so it is never destroyed before the new one is complete."""
+        if os.path.isdir(tag_dir):
+            old = tag_dir + ".gc"
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.rename(tag_dir, old)
+            os.rename(tag_tmp, tag_dir)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.makedirs(os.path.dirname(tag_dir), exist_ok=True)
+            os.rename(tag_tmp, tag_dir)
+
+    def gc(self, save_dir):
+        """Retention: keep the newest ``num_of_version_in_retention``
+        committed versions (plus whatever ``latest`` names); only
+        manifest-bearing (nebula-committed) tags are ever removed. Also
+        clears stale temp/aside dirs from crashed saves."""
+        keep = max(1, int(self.config.num_of_version_in_retention))
+        latest = read_latest(save_dir)
+        removed = 0
+        for tag in _manifest_tags(save_dir)[keep:]:
+            if tag == latest:
+                continue
+            shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+            removed += 1
+        tmp_root = os.path.join(save_dir, TMP_ROOT)
+        if os.path.isdir(tmp_root) and not os.listdir(tmp_root):
+            shutil.rmtree(tmp_root, ignore_errors=True)
+        for name in os.listdir(save_dir):
+            if name.endswith(".gc"):
+                shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
+        return removed
+
+    # -- telemetry -----------------------------------------------------
+    def _emit_metrics(self, job, write_s, commit_s, nbytes, removed):
+        mon = self.monitor
+        if mon is None or not getattr(mon, "enabled", False):
+            return
+        step = job.step if job.step is not None else self._stats["commits"]
+        try:
+            mon.write_events([
+                ("Train/Checkpoint/snapshot_s", float(job.snapshot_s), step),
+                ("Train/Checkpoint/write_s", float(write_s), step),
+                ("Train/Checkpoint/commit_s", float(commit_s), step),
+                ("Train/Checkpoint/bytes", int(nbytes), step),
+                ("Train/Checkpoint/queue_depth", self.queue_depth, step),
+                ("Train/Checkpoint/gc_removed", int(removed), step),
+            ])
+        except Exception as e:  # monitoring must never take down the writer
+            logger.warning(f"[nebula] metric write failed: {e}")
+
+    @property
+    def stats(self):
+        with self._lock:
+            return dict(self._stats)
+
+
+def _is_rank0():
+    try:
+        from deepspeed_tpu import comm as dist
+        return not dist.is_initialized() or dist.get_rank() == 0
+    except Exception:
+        return True

@@ -1,0 +1,21 @@
+"""Stable import surface for checkpoint engines.
+
+``from deepspeed_tpu.runtime.checkpoint_engine import CheckpointEngine``
+is the supported spelling (the nebula async service, the training engine
+and external tooling all import from here rather than the submodules).
+"""
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (CheckpointCorruptionError, CheckpointEngine,
+                                                                       HostShardSnapshot)
+from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import (ArrayCheckpointEngine,
+                                                                             TorchCheckpointEngine)
+from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import ShardedCheckpointEngine
+
+__all__ = [
+    "CheckpointEngine",
+    "CheckpointCorruptionError",
+    "HostShardSnapshot",
+    "ArrayCheckpointEngine",
+    "TorchCheckpointEngine",
+    "ShardedCheckpointEngine",
+]

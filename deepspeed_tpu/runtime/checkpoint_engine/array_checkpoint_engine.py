@@ -11,7 +11,8 @@ import os
 
 import numpy as np
 
-from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (CheckpointCorruptionError, CheckpointEngine,
+                                                                       HostShardSnapshot)
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -24,6 +25,8 @@ def _hostify(tree):
         return {k: _hostify(v) for k, v in tree.items()}
     if isinstance(tree, (list, tuple)):
         return [_hostify(v) for v in tree]
+    if isinstance(tree, HostShardSnapshot):
+        return tree.to_numpy()  # async snapshot: device→host already done
     if hasattr(tree, "addressable_shards") or hasattr(tree, "device"):
         return np.asarray(jax.device_get(tree))
     return tree
@@ -57,7 +60,12 @@ class ArrayCheckpointEngine(CheckpointEngine):
         from flax import serialization
         with open(path, "rb") as f:
             blob = f.read()
-        state = serialization.msgpack_restore(blob)
+        try:
+            state = serialization.msgpack_restore(blob)
+        except Exception as e:
+            raise CheckpointCorruptionError(
+                path, f"torn msgpack payload ({type(e).__name__}: {e}) — the save was "
+                "interrupted mid-write (resume from an older tag)") from e
         logger.debug(f"[DeepSpeedTPU] Loaded {path}.")
         return state
 

@@ -8,6 +8,59 @@ arbitrary nested state dicts (pytrees of arrays + python scalars).
 
 from abc import ABC, abstractmethod
 
+import numpy as np
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint on disk is unreadable: missing/torn index or manifest,
+    truncated shard payload, or incomplete chunk coverage. Carries the
+    offending ``path`` and a one-line ``reason`` so callers (and the
+    resume-path validator) can report exactly what is broken and fall
+    back to an older intact tag instead of dying mid-restore."""
+
+    def __init__(self, path, reason):
+        super().__init__(f"corrupt checkpoint at {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+class HostShardSnapshot:
+    """Host-memory snapshot of one (possibly sharded) device array.
+
+    The async checkpoint service (``nebula/``) copies device state to host
+    at the step boundary and lets a background thread do the serialization
+    + disk write. For sharded arrays the snapshot keeps the replica-0
+    shard structure — ``chunks`` is ``[(coords, np.ndarray), ...]`` with
+    ``coords`` the global ``((start, stop), ...)`` slice per dim — so the
+    background write produces the exact chunk layout a live sharded save
+    would, without holding the full array per host."""
+
+    __slots__ = ("shape", "dtype", "chunks")
+
+    def __init__(self, shape, dtype, chunks):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.chunks = chunks
+
+    @property
+    def nbytes(self):
+        return int(sum(d.nbytes for _, d in self.chunks))
+
+    def to_numpy(self):
+        """Assemble the full array from this host's chunks (consolidated
+        saves; only complete on a process that addresses every slice)."""
+        if len(self.chunks) == 1 and all(
+                (s, e) == (0, d) for (s, e), d in zip(self.chunks[0][0], self.shape)):
+            return self.chunks[0][1]
+        out = np.zeros(self.shape, dtype=self.dtype)
+        for coords, data in self.chunks:
+            out[tuple(slice(s, e) for s, e in coords)] = data
+        return out
+
+    def __array__(self, dtype=None):
+        full = self.to_numpy()
+        return full.astype(dtype) if dtype is not None else np.asarray(full)
+
 
 class CheckpointEngine(ABC):
 

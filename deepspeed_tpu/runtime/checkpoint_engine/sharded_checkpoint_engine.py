@@ -25,12 +25,14 @@ Layout (``<path>`` is the metadata file, e.g. ``mp_rank_00_model_states.pt``):
 import glob
 import json
 import os
+import shutil
 
 import numpy as np
 
 import jax
 
-from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (CheckpointCorruptionError, CheckpointEngine,
+                                                                       HostShardSnapshot)
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 _MARKER = "__ds_sharded__"
@@ -133,7 +135,13 @@ class _ChunkWriter:
         self.meta = {}  # key -> {shape, dtype}
 
     def add(self, key, arr):
-        if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+        if isinstance(arr, HostShardSnapshot):
+            # async-save path: the device→host copy already happened at
+            # the step boundary; write the captured replica-0 chunks
+            self.meta[key] = {"shape": list(arr.shape), "dtype": arr.dtype.name}
+            for coords, data in arr.chunks:
+                self._write(key, data, [list(se) for se in coords])
+        elif isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
             self.meta[key] = {"shape": list(arr.shape), "dtype": arr.dtype.name}
             seen = set()
             for shard in arr.addressable_shards:
@@ -175,16 +183,28 @@ class ShardedReader:
 
     def __init__(self, shard_dir):
         self.dir = shard_dir
-        with open(os.path.join(shard_dir, "index.json")) as f:
-            self.meta = json.load(f)["arrays"]
+        index_path = os.path.join(shard_dir, "index.json")
+        if not os.path.isfile(index_path):
+            raise CheckpointCorruptionError(shard_dir, "missing index.json — the save never "
+                                            "finished (resume from an older tag)")
+        try:
+            with open(index_path) as f:
+                self.meta = json.load(f)["arrays"]
+        except (json.JSONDecodeError, KeyError) as e:
+            raise CheckpointCorruptionError(index_path, f"torn index.json ({e}) — the save was "
+                                            "interrupted mid-write (resume from an older tag)") from e
         self._chunks = {}  # key -> [record+file]
         for cpath in sorted(glob.glob(os.path.join(shard_dir, "chunks_p*.json"))):
             proc = os.path.basename(cpath)[len("chunks_p"):-len(".json")]
             dfile = os.path.join(shard_dir, f"data_p{proc}.bin")
-            with open(cpath) as f:
-                for rec in json.load(f):
-                    rec["file"] = dfile
-                    self._chunks.setdefault(rec["key"], []).append(rec)
+            try:
+                with open(cpath) as f:
+                    recs = json.load(f)
+            except json.JSONDecodeError as e:
+                raise CheckpointCorruptionError(cpath, f"torn chunk metadata ({e})") from e
+            for rec in recs:
+                rec["file"] = dfile
+                self._chunks.setdefault(rec["key"], []).append(rec)
         self._mmaps = {}
 
     def keys(self):
@@ -214,6 +234,11 @@ class ShardedReader:
                 continue
             chunk_shape = tuple(e - s for s, e in src)
             raw = self._mmap(rec["file"])[rec["offset"]:rec["offset"] + rec["nbytes"]]
+            if raw.size != rec["nbytes"]:
+                raise CheckpointCorruptionError(
+                    rec["file"], f"truncated shard payload for '{key}': chunk at offset "
+                    f"{rec['offset']} wants {rec['nbytes']} bytes, file holds {raw.size} — "
+                    "the save was interrupted mid-write (resume from an older tag)")
             chunk = raw.view(np.dtype(rec["dtype"])).reshape(chunk_shape)
             src_sel = tuple(slice(s - ss, e - ss) for (s, e), (ss, _) in zip(inter, src))
             dst_sel = tuple(slice(s - ts, e - ts) for (s, e), (ts, _) in zip(inter, tgt))
@@ -221,8 +246,9 @@ class ShardedReader:
             filled += int(np.prod([e - s for s, e in inter]))
         want = int(np.prod(out_shape))
         if filled < want:
-            raise ValueError(f"checkpoint chunks cover only {filled}/{want} elements of "
-                             f"'{key}' slice {tgt} — missing shard files?")
+            raise CheckpointCorruptionError(
+                self.dir, f"chunks cover only {filled}/{want} elements of '{key}' slice {tgt} "
+                "— missing shard files (resume from an older tag)")
         return out
 
     def read_full(self, key):
@@ -285,20 +311,38 @@ class ShardedCheckpointEngine(CheckpointEngine):
         proc = dist.get_process_rank() if dist.is_initialized() else 0
         skeleton, arrays = _skeletonize(state_dict)
         sdir = self.shard_dir(path)
-        # Stale chunks from a previous save with more processes (or a
-        # different layout) would merge into future reads: clear first.
-        if proc == 0 and os.path.isdir(sdir):
-            for f in os.listdir(sdir):
-                os.unlink(os.path.join(sdir, f))
-        _host_sync()  # writes must not start before the clean finishes
-        writer = _ChunkWriter(sdir, proc)
+        # Every save writes into a fresh per-save temp dir and renames it
+        # into place only once complete: a crash at any point leaves the
+        # previously-committed shard dir untouched and loadable (deleting
+        # the old dir before writing the new one destroyed the only good
+        # copy). The fixed ".saving" name is deliberate — all processes
+        # of one collective save must target the same dir, and a leftover
+        # from a crashed save is cleared on the next attempt. This also
+        # keeps stale chunks from a previous save with more processes (or
+        # a different layout) out of future reads.
+        tmp_sdir = sdir + ".saving"
+        if proc == 0:
+            if os.path.isdir(tmp_sdir):
+                shutil.rmtree(tmp_sdir)
+            os.makedirs(tmp_sdir)
+        _host_sync()  # writes must not start before the temp dir is fresh
+        writer = _ChunkWriter(tmp_sdir, proc)
         for key, arr in arrays:
             writer.add(key, arr)
         writer.finish()
+        _host_sync()  # every process's chunks durable before the promote
         if proc == 0:
-            with open(os.path.join(sdir, "index.json") + ".tmp", "w") as f:
+            with open(os.path.join(tmp_sdir, "index.json"), "w") as f:
                 json.dump({"version": 1, "arrays": writer.meta}, f)
-            os.replace(os.path.join(sdir, "index.json") + ".tmp", os.path.join(sdir, "index.json"))
+            if os.path.isdir(sdir):
+                old = sdir + ".gc"
+                if os.path.isdir(old):
+                    shutil.rmtree(old)
+                os.rename(sdir, old)
+                os.rename(tmp_sdir, sdir)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(tmp_sdir, sdir)
             from flax import serialization
             os.makedirs(os.path.dirname(path), exist_ok=True)
             blob = serialization.msgpack_serialize({"__ds_sharded_skeleton__": skeleton}, in_place=False)

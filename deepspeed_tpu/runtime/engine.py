@@ -24,6 +24,7 @@ Analogue of the reference's ``deepspeed/runtime/engine.py``
 
 import os
 import re
+import time
 from typing import Optional
 
 import numpy as np
@@ -45,9 +46,8 @@ from deepspeed_tpu.ops.op_base import DeepSpeedOptimizer
 from deepspeed_tpu.ops.sgd import SGD
 from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.runtime import lr_schedules
-from deepspeed_tpu.runtime.checkpoint_engine.array_checkpoint_engine import ArrayCheckpointEngine
-from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import (ShardedCheckpointEngine,
-                                                                              flatten_named, match_named_tree)
+from deepspeed_tpu.runtime.checkpoint_engine import ArrayCheckpointEngine, ShardedCheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import flatten_named, match_named_tree
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER,
                                              LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
@@ -200,6 +200,15 @@ class DeepSpeedEngine:
             self.checkpoint_engine = ShardedCheckpointEngine()
         else:
             self.checkpoint_engine = ArrayCheckpointEngine()
+
+        # Nebula async checkpoint service: snapshot-to-host + background
+        # write with atomic commit ("nebula": {"enabled": true}).
+        self._checkpoint_service = None
+        if getattr(self._config, "nebula_config", None) is not None and self._config.nebula_config.enabled:
+            from deepspeed_tpu.nebula.service import NebulaCheckpointService
+            self._checkpoint_service = NebulaCheckpointService(self._config.nebula_config,
+                                                               self.checkpoint_engine,
+                                                               monitor=self.monitor)
 
         # Data loader
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
@@ -475,6 +484,10 @@ class DeepSpeedEngine:
         destroyed engine's HBM must be reclaimable for a back-to-back
         engine build (the bench runs several ~0.5-2.5B engines in one
         process)."""
+        if self._checkpoint_service is not None:
+            # drain: an in-flight background checkpoint must commit (or
+            # surface its failure) before the state it snapshots dies
+            self._checkpoint_service.shutdown(wait=True)
         self._jit_cache.clear()
         self._grads_acc = None
         self._pending = None
@@ -1436,18 +1449,52 @@ class DeepSpeedEngine:
         return os.path.join(checkpoints_path, str(tag),
                             "zero_pp_rank_0_mp_rank_00_optim_states.pt")
 
-    def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True, exclude_frozen_parameters=False):
+    def save_checkpoint(self,
+                        save_dir=None,
+                        tag=None,
+                        client_state={},
+                        save_latest=True,
+                        exclude_frozen_parameters=False,
+                        async_save=None):
         assert self._initialized, "cannot save before the first forward/train_batch"
+        nebula = self._checkpoint_service
+        if nebula is not None:
+            # a failed background write surfaces here, never silently
+            nebula.raise_pending_failure()
+        if save_dir is None:
+            if nebula is not None and self._config.nebula_config.persistent_storage_path:
+                save_dir = self._config.nebula_config.persistent_storage_path
+            else:
+                raise ValueError("save_checkpoint requires save_dir "
+                                 "(or nebula.persistent_storage_path in the config)")
+        if async_save is None:
+            async_save = nebula is not None
+        elif async_save and nebula is None:
+            raise ValueError("async_save=True requires the nebula checkpoint service: "
+                             'set "nebula": {"enabled": true} in the config')
         self._ensure_params_resident()  # NVMe-swapped leaves back for serialization
-        if tag is None:
+        auto_tag = tag is None
+        if auto_tag:
             tag = f"global_step{self.global_steps}"
         tag = str(tag)
+        if nebula is not None and auto_tag and not nebula.persist_due():
+            log_dist(f"[nebula] skipping auto-tagged save '{tag}': persistent_time_interval "
+                     f"({self._config.nebula_config.persistent_time_interval}s) not yet elapsed",
+                     ranks=[0])
+            return False
         self._validate_checkpoint_tag(tag)
         self.checkpoint_engine.create(tag)
         sharded = isinstance(self.checkpoint_engine, ShardedCheckpointEngine)
         # sharded save: leave leaves on device, every process writes its
-        # own shards; consolidated save: host-ify on rank 0 only.
-        ser = (lambda t: t) if sharded else _to_serializable
+        # own shards; consolidated save: host-ify on rank 0 only. Under
+        # nebula, device state is snapshotted to host up front (the step
+        # stalls for the copy only) and the write happens off-thread.
+        snapshot_t0 = time.perf_counter()
+        if nebula is not None:
+            from deepspeed_tpu.nebula.service import snapshot_tree
+            ser = snapshot_tree
+        else:
+            ser = (lambda t: t) if sharded else _to_serializable
 
         model_state = {
             "module": ser(self.params),
@@ -1468,8 +1515,6 @@ class DeepSpeedEngine:
         # per-mp-rank files meaningless), so pin the mp placeholder.
         ckpt_name = (self._get_ckpt_name(save_dir, tag, mp_placeholder="00") if sharded
                      else self._get_ckpt_name(save_dir, tag))
-        if sharded or dist.get_process_rank() == 0:
-            self.checkpoint_engine.save(model_state, ckpt_name)
 
         if self._host_offload is not None:
             opt_sd = self._host_offload.export_state()
@@ -1487,13 +1532,29 @@ class DeepSpeedEngine:
         }
         optim_name = (self._get_optimizer_ckpt_name_sharded(save_dir, tag) if sharded
                       else self._get_optimizer_ckpt_name(save_dir, tag, dp_rank=0))
+
+        if nebula is not None:
+            snapshot_s = time.perf_counter() - snapshot_t0
+            tag_dir = os.path.join(save_dir, tag)
+            parts = []
+            if sharded or dist.get_process_rank() == 0:
+                parts = [(model_state, os.path.relpath(ckpt_name, tag_dir)),
+                         (optim_state, os.path.relpath(optim_name, tag_dir))]
+            submit = nebula.save_async if async_save else nebula.save_sync
+            submit(save_dir, tag, parts, save_latest=save_latest,
+                   snapshot_s=snapshot_s, step=self.global_steps)
+            return True
+
         if sharded or dist.get_process_rank() == 0:
+            self.checkpoint_engine.save(model_state, ckpt_name)
             self.checkpoint_engine.save(optim_state, optim_name)
 
-        if save_latest and dist.get_process_rank() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as fd:
-                fd.write(tag)
         self.checkpoint_engine.commit(tag)
+        # `latest` rotates only after commit, via tmp + os.replace: a
+        # crash anywhere leaves the pointer naming a finished checkpoint
+        if save_latest and dist.get_process_rank() == 0:
+            from deepspeed_tpu.nebula.service import write_latest
+            write_latest(save_dir, tag)
         return True
 
     def _validate_checkpoint_tag(self, tag):
@@ -1510,24 +1571,50 @@ class DeepSpeedEngine:
             logger.warning(msg)
 
     def load_checkpoint(self,
-                        load_dir,
+                        load_dir=None,
                         tag=None,
                         load_module_strict=True,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True,
                         load_module_only=False,
                         custom_load_fn=None):
+        if self._checkpoint_service is not None:
+            # barrier: never read a tag whose background write is in flight
+            self._checkpoint_service.wait()
+        if load_dir is None:
+            ncfg = getattr(self._config, "nebula_config", None)
+            if ncfg is not None and (ncfg.load_path or ncfg.persistent_storage_path):
+                load_dir = ncfg.load_path or ncfg.persistent_storage_path
+            else:
+                raise ValueError("load_checkpoint requires load_dir "
+                                 "(or nebula.load_path/persistent_storage_path in the config)")
         if self._config.load_universal_checkpoint:
             return self.load_universal_checkpoint(load_dir, tag)
         if tag is None:
-            latest_path = os.path.join(load_dir, "latest")
-            if os.path.isfile(latest_path):
-                with open(latest_path, "r") as fd:
-                    tag = fd.read().strip()
+            from deepspeed_tpu.elasticity import is_elastic_restart
+            validated_resume = ((self._checkpoint_service is not None
+                                 and self._config.nebula_config.enable_nebula_load)
+                                or is_elastic_restart())
+            if validated_resume:
+                # manifest-validated resolution: newest *intact* tag, even
+                # if `latest` names a torn or uncommitted one
+                from deepspeed_tpu.nebula.service import resolve_load_tag
+                tag = resolve_load_tag(load_dir)
+                if tag is None:
+                    logger.warning(f"No intact checkpoint found under {load_dir}; "
+                                   f"starting fresh")
+                    return None, {}
+                latest_path = None
             else:
-                logger.warning(f"Unable to find latest file at {latest_path}, "
-                               f"if trying to load latest checkpoint please pass `tag`")
-                return None, {}
+                latest_path = os.path.join(load_dir, "latest")
+            if tag is None and latest_path is not None:
+                if os.path.isfile(latest_path):
+                    with open(latest_path, "r") as fd:
+                        tag = fd.read().strip()
+                else:
+                    logger.warning(f"Unable to find latest file at {latest_path}, "
+                                   f"if trying to load latest checkpoint please pass `tag`")
+                    return None, {}
 
         ckpt_name = self._get_ckpt_name(load_dir, tag)
         if not os.path.isfile(ckpt_name):
@@ -1561,6 +1648,11 @@ class DeepSpeedEngine:
         self.global_samples = int(model_state.get("global_samples", 0))
         self.skipped_steps = int(model_state.get("skipped_steps", 0))
         self.micro_steps = int(model_state.get("micro_steps", 0))
+        # a checkpoint never captures mid-accumulation gradients; any
+        # half-accumulated micro-grads from before the load would
+        # contaminate the first post-resume optimizer update
+        self._grads_acc = None
+        self._pending = None
         self.loaded_checkpoint_dp_world_size = model_state.get("dp_world_size")
         self.loaded_checkpoint_mp_world_size = model_state.get("mp_world_size")
         client_state = model_state.get("client_state", {})

@@ -1,0 +1,108 @@
+"""Fault-injection harness for the nebula async checkpoint service.
+
+Two kinds of faults:
+
+- **writer faults** (``kill_writer_at``): hook the service's labelled
+  stages (``before_write``, ``after_part``, ``before_manifest``,
+  ``before_promote``, ``before_latest``, ``after_commit``) and raise
+  ``WriterKilled`` there — simulates the writer dying mid-flight at any
+  point of the commit protocol.
+- **disk faults** (``truncate_file`` / ``corrupt_json`` /
+  ``delete_manifest``): mutate a committed checkpoint's files the way a
+  crashed/partial write or bit-rot would, to exercise the resume-side
+  validators.
+"""
+
+import glob
+import json
+import os
+
+
+class WriterKilled(RuntimeError):
+    """Injected writer-thread death."""
+
+
+class FaultInjector:
+    """Raises ``WriterKilled`` the first time the writer reaches
+    ``point``; records every stage reached (``.trace``) for assertions.
+    Use as ``service.test_hook = FaultInjector("before_promote")`` or via
+    ``kill_writer_at``."""
+
+    def __init__(self, kill_at=None, kill_detail=None):
+        self.kill_at = kill_at
+        self.kill_detail = kill_detail
+        self.trace = []
+        self.killed = False
+
+    def __call__(self, point, detail=None):
+        self.trace.append((point, detail))
+        if self.killed or self.kill_at is None or point != self.kill_at:
+            return
+        if self.kill_detail is not None and detail != self.kill_detail:
+            return
+        self.killed = True
+        raise WriterKilled(f"injected fault at stage '{point}' (detail={detail})")
+
+
+def kill_writer_at(service, point, detail=None):
+    """Arm ``service`` to kill its writer at ``point``; returns the
+    injector (check ``.killed`` / ``.trace`` afterwards)."""
+    inj = FaultInjector(point, detail)
+    service.test_hook = inj
+    return inj
+
+
+def disarm(service):
+    service.test_hook = None
+
+
+# ----------------------------------------------------------------------
+# disk faults
+# ----------------------------------------------------------------------
+def truncate_file(path, frac=0.5):
+    """Cut ``path`` down to ``frac`` of its size (a torn write)."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * frac))
+    with open(path, "rb+") as fd:
+        fd.truncate(keep)
+    return keep
+
+
+def corrupt_json(path):
+    """Replace a JSON file with a torn prefix of itself (unparseable)."""
+    with open(path) as fd:
+        text = fd.read()
+    with open(path, "w") as fd:
+        fd.write(text[:max(1, len(text) // 2)].rstrip("}] \n"))
+
+
+def delete_manifest(tag_dir):
+    os.remove(os.path.join(tag_dir, "nebula_manifest.json"))
+
+
+# ----------------------------------------------------------------------
+# locating checkpoint internals
+# ----------------------------------------------------------------------
+def shard_data_files(tag_dir):
+    """Every chunk payload (``data_p*.bin``) under a committed tag."""
+    return sorted(glob.glob(os.path.join(tag_dir, "**", "data_p*.bin"), recursive=True))
+
+
+def shard_index_files(tag_dir):
+    return sorted(glob.glob(os.path.join(tag_dir, "**", "index.json"), recursive=True))
+
+
+def manifest_path(tag_dir):
+    return os.path.join(tag_dir, "nebula_manifest.json")
+
+
+def fix_manifest_size(tag_dir, rel_or_abs):
+    """Re-record one file's byte size in the manifest (so a truncation
+    fault targets the *payload* validators, not the manifest check)."""
+    mpath = manifest_path(tag_dir)
+    with open(mpath) as fd:
+        manifest = json.load(fd)
+    rel = os.path.relpath(rel_or_abs, tag_dir) if os.path.isabs(rel_or_abs) else rel_or_abs
+    manifest["files"][rel]["bytes"] = os.path.getsize(os.path.join(tag_dir, rel))
+    with open(mpath, "w") as fd:
+        json.dump(manifest, fd)

@@ -186,11 +186,18 @@ class TestStructuralAutoTP:
 
 class TestParityOdds:
 
-    def test_nebula_config_rejects_enabled(self):
+    def test_nebula_config_parses(self):
+        # nebula is live (round 7): enabling it configures the native
+        # async checkpoint service instead of raising
         from deepspeed_tpu.nebula import get_nebula_config
         assert get_nebula_config({}).enabled is False
-        with pytest.raises(NotImplementedError):
-            get_nebula_config({"nebula": {"enabled": True}})
+        cfg = get_nebula_config({"nebula": {"enabled": True,
+                                            "persistent_storage_path": "/tmp/ckpt",
+                                            "num_of_version_in_retention": 3}})
+        assert cfg.enabled and cfg.num_of_version_in_retention == 3
+        with pytest.raises(ValueError):
+            get_nebula_config({"nebula": {"enabled": True,
+                                          "num_of_version_in_retention": 0}})
 
     def test_numa_binding(self):
         from deepspeed_tpu.utils.numa import bind_rank_to_cores, get_numa_cores
