@@ -42,7 +42,8 @@ class InferenceEngine:
         # Weight-only quantized serving (reference init_inference with
         # dtype=torch.int8, or a quantized_initialization scheme): the
         # params tree is stored in grouped-layout quantized carriers and
-        # each scanned block dequantizes its own layer slice at use.
+        # each scanned block consumes its own layer slice through the
+        # fused dequant-matmul (QuantDense → QuantizedWeight.matmul).
         self._weight_quant = None
         if self.dtype == jnp.int8:
             self._weight_quant = "int8"
@@ -50,10 +51,16 @@ class InferenceEngine:
         qinit = self._config.quant.weight.quantized_initialization
         if qinit.get("scheme") in ("int8", "fp8", "fp6"):
             self._weight_quant = qinit["scheme"]
-        # No module surgery needed: QuantizedWeight is a flax AxisMetadata
-        # box, so flax unboxes (= dequantizes) at each param ACCESS — for
-        # scanned layer stacks that is inside the scan body on the sliced
-        # carriers, keeping only O(1 layer) of dequantized weights live.
+        # No module surgery needed: the models' QuantDense projections
+        # fetch the raw QuantizedWeight box at param access — inside the
+        # scan body, on the sliced carriers — and route it through the
+        # fused dequant-matmul Pallas kernel (ops/pallas/
+        # fused_quant_matmul.py), so the full-precision weight matrix is
+        # never materialized: one VMEM tile set on TPU, and off-TPU the
+        # identical-math jnp fallback still keeps at most O(1 layer)
+        # transient. Non-kernel params (embeds, norm scales) keep the
+        # flax AxisMetadata unbox path. DS_FUSED_QMM=0 restores
+        # unbox-then-matmul for A/B comparison.
 
         tp = int(self._config.tensor_parallel.tp_size)
         self.mp_world_size = tp
@@ -96,8 +103,9 @@ class InferenceEngine:
     def _set_params(self, params):
         """Cast to engine dtype and TP-shard over the mesh. Under weight
         quantization, >=2-D float leaves become grouped-layout quantized
-        carriers first (the model's scanned blocks dequantize their own
-        slices at apply time). The caller's tree is left intact (no
+        carriers first (the model's scanned blocks consume their own
+        slices via the fused dequant-matmul). The caller's tree is left
+        intact (no
         donation — it may be shared); the no-fp32-spike path for LARGE
         models is :meth:`_materialize`, which fuses init + quantization
         in one program."""

@@ -2,9 +2,11 @@ from deepspeed_tpu.inference.quantization.quantization import (QuantizedWeight,
                                                                 _init_group_wise_weight_quantization,
                                                                 dequantize_tree,
                                                                 dequantize_tree_except,
+                                                                fused_qmm_enabled,
+                                                                matmul_any,
                                                                 maybe_dequantize,
                                                                 quantized_bytes)
 
 __all__ = ["_init_group_wise_weight_quantization", "QuantizedWeight",
-           "dequantize_tree", "dequantize_tree_except", "maybe_dequantize",
-           "quantized_bytes"]
+           "dequantize_tree", "dequantize_tree_except", "fused_qmm_enabled",
+           "matmul_any", "maybe_dequantize", "quantized_bytes"]

@@ -9,6 +9,7 @@ jitted forward consumes the transform's output, so XLA fuses the
 dequant into the first matmul and only the quantized bytes live in HBM."""
 
 import math
+import os
 import re
 
 import jax
@@ -67,6 +68,30 @@ class QuantizedWeight(flax_meta.AxisMetadata):
             return dequantize_fp6(self.values, self.scales, self.shape, dtype=dtype)
         from deepspeed_tpu.ops.pallas.quantization import dequantize_int8
         return dequantize_int8(self.values, self.scales, self.shape, dtype=dtype)
+
+    def matmul(self, x, dtype=None, interpret=None, force_pallas=None):
+        """Fused ``x @ dequant(self)`` — the FP6-LLM execution path: on
+        TPU the Pallas kernel dequantizes weight tiles in VMEM inside
+        the matmul K-loop so the full-precision matrix never hits HBM;
+        elsewhere (CPU, or sharded under a live mesh where pallas_call
+        has no GSPMD rule) it lowers to the identical-math jnp fallback
+        ``x @ self.dequantized(dtype)``. This is what quantized serving
+        call sites should use instead of ``unbox()``-then-matmul.
+
+        ``dtype`` overrides the stored ``dequant_dtype``. Only 2-D
+        grouped-layout carriers take the fused route (a scan slice of a
+        stacked layer leaf is exactly that); everything else — flat
+        layout, stacked 3-D carriers, ``DS_FUSED_QMM=0`` — falls back
+        to dequantize-then-matmul.
+        """
+        dd = dtype if dtype is not None else self.dequant_dtype
+        if (self.layout == "grouped" and getattr(self.values, "ndim", 0) == 2
+                and fused_qmm_enabled()):
+            from deepspeed_tpu.ops.pallas.fused_quant_matmul import quant_matmul
+            return quant_matmul(x, self.values, self.scales, self.scheme,
+                                dequant_dtype=dd, interpret=interpret,
+                                force_pallas=force_pallas)
+        return x @ self.dequantized(dd)
 
     def nbytes(self):
         return int(self.values.size * self.values.dtype.itemsize +
@@ -165,19 +190,28 @@ def _quantize_grouped(x, scheme, group_size, dequant_dtype=jnp.bfloat16):
 
 
 def _dequantize_grouped(values, scales, scheme, dtype):
-    # Shapes derive from the carriers (not stored metadata) so a slice of
-    # a stacked leaf — e.g. one layer's slice inside an ``nn.scan`` body —
-    # dequantizes correctly: the grouped layout has no padding, so
-    # orig_last = ng * group (codes) = packed_last * 4/3 for fp6.
-    ng = scales.shape[-1]
-    grouped = values.reshape(values.shape[:-1] + (ng, values.shape[-1] // ng))
-    if scheme == "fp6":
-        from deepspeed_tpu.ops.fp_quantizer.quantize import _decode_e3m2, unpack_fp6
-        vals = _decode_e3m2(unpack_fp6(grouped))
-    else:
-        vals = grouped.astype(jnp.float32)
-    out = vals * scales[..., None]
-    return out.reshape(out.shape[:-2] + (-1,)).astype(dtype)
+    # Canonical decode lives next to the fused kernel (single source of
+    # truth for the grouped layout); shapes derive from the carriers so
+    # a slice of a stacked leaf — e.g. one layer's slice inside an
+    # ``nn.scan`` body — dequantizes correctly.
+    from deepspeed_tpu.ops.pallas.fused_quant_matmul import dequantize_grouped
+    return dequantize_grouped(values, scales, scheme, dtype)
+
+
+def fused_qmm_enabled():
+    """Fused dequant-matmul toggle (env ``DS_FUSED_QMM``, default on).
+    Read at trace time — flip it and retrace to A/B the unbox path
+    (bench.py's fused-vs-unbox lanes do exactly that)."""
+    return os.environ.get("DS_FUSED_QMM", "1").lower() not in ("0", "false", "off")
+
+
+def matmul_any(x, w, dtype=None):
+    """``x @ w`` for a dense array OR a QuantizedWeight (fused when
+    quantized) — the one-liner consumers use so a params leaf can be
+    either without branching at every call site."""
+    if isinstance(w, QuantizedWeight):
+        return w.matmul(x, dtype=dtype)
+    return x @ (w.astype(dtype) if dtype is not None else w)
 
 
 def dequantize_tree(tree, dtype=jnp.bfloat16):

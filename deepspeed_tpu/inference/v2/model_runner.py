@@ -61,8 +61,11 @@ def _layernorm(x, p, eps):
 
 def _proj(x, p):
     """Dense apply from raw params (kernel + optional bias, e.g. Qwen2's
-    QKV biases or the GPT family's biased projections)."""
-    y = x @ p["kernel"].astype(x.dtype)
+    QKV biases or the GPT family's biased projections). A QuantizedWeight
+    kernel routes through the fused dequant-matmul — the bf16 matrix is
+    never materialized, not even for this one layer slice."""
+    from deepspeed_tpu.inference.quantization import matmul_any
+    y = matmul_any(x, p["kernel"], dtype=x.dtype)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
@@ -112,10 +115,10 @@ def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None, mesh=None, impl=None):
 def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
     lp, kc, vc = xs
     # Weight-only quantized serving: the scan sliced this layer's
-    # quantized carriers; dequantize just the slice (transient, freed
-    # after the layer's matmuls). No-op for full-precision params.
-    from deepspeed_tpu.inference.quantization import dequantize_tree
-    lp = dequantize_tree(lp, h.dtype)
+    # quantized carriers; they stay quantized here and every projection
+    # consumes them through the fused dequant-matmul in _proj (norm
+    # scales / biases are plain arrays). Only the MoE expert stack still
+    # dequantizes per slice, inside _moe_mlp.
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     attn = lp["self_attn"]
@@ -161,6 +164,12 @@ def _moe_mlp(x, p, k, mesh=None):
     combines — expert weights never leave their shard, the serving
     analogue of training's expert-axis dispatch."""
     from deepspeed_tpu.ops.grouped_gemm import dropless_moe_ffn
+    # The stacked expert weights feed the grouped GEMM as dense arrays;
+    # dequantize this layer's MoE subtree at entry (transient, freed
+    # after the FFN — fusing dequant into the grouped GEMM is future
+    # work). No-op for full-precision params.
+    from deepspeed_tpu.inference.quantization import dequantize_tree
+    p = dequantize_tree(p, x.dtype)
     gates = jax.nn.softmax(
         (x.astype(jnp.float32) @ p["gate"]["wg"]["kernel"].astype(jnp.float32)), axis=-1)
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
@@ -179,8 +188,7 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):
     parallel wiring, optional partial rotary / ALiBi, biased
     projections, LayerNorm or RMSNorm)."""
     lp, kc, vc = xs
-    from deepspeed_tpu.inference.quantization import dequantize_tree
-    lp = dequantize_tree(lp, h.dtype)  # per-slice dequant (no-op if dense)
+    # Quantized carriers stay boxed; _proj consumes them fused.
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     attn = lp["attn"]

@@ -3,6 +3,7 @@
 from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
 from deepspeed_tpu.linear.optimized_linear import (OptimizedLinear, QuantizedParameter,
                                                     lora_frozen_patterns)
+from deepspeed_tpu.linear.quant_dense import QuantDense
 
-__all__ = ["OptimizedLinear", "LoRAConfig", "QuantizationConfig", "QuantizedParameter",
-           "lora_frozen_patterns"]
+__all__ = ["OptimizedLinear", "LoRAConfig", "QuantizationConfig", "QuantDense",
+           "QuantizedParameter", "lora_frozen_patterns"]

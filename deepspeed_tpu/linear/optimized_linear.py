@@ -6,8 +6,11 @@ optimized_linear.py:18: frozen, optionally sharded/quantized base
 weight + trainable low-rank adapters). TPU redesign as a flax module:
 
 - the base kernel is stored int8 + per-group fp32 scales when
-  ``quantization_config`` is given (weight-only storage; dequantized to
-  the compute dtype at use — the MXU computes in bf16 either way);
+  ``quantization_config`` is given, in the grouped layout
+  (``base_kernel_q [in, out]``, ``base_kernel_scales [in, ng]``) that
+  the fused dequant-matmul kernel consumes — the frozen base is applied
+  as ``x @ dequant(...)`` without ever materializing the dense matrix
+  (``ops/pallas/fused_quant_matmul.py``; jnp fallback off-TPU);
 - the LoRA pair (``lora_a`` [in, r], ``lora_b`` [r, out]) is trainable;
   the base is excluded from updates by the engine's
   ``frozen_parameters`` mask (pattern ``"base_kernel"``);
@@ -55,26 +58,33 @@ class OptimizedLinear(nn.Module):
         in_dim = x.shape[-1]
         lora = self.lora_config or LoRAConfig()
         if self.quantization_config is not None:
-            gs = self.quantization_config.group_size
-            n = in_dim * self.output_dim
-            groups = -(-n // gs)
+            # Grouped layout ([in, out] int8 + [in, ng] fp32 scales along
+            # the output dim) — the storage the fused dequant-matmul
+            # consumes, so the frozen base is never materialized densely.
+            from deepspeed_tpu.inference.quantization.quantization import (
+                QuantizedWeight, _pick_group)
+            g = _pick_group(self.output_dim, self.quantization_config.group_size)
             values = self.param("base_kernel_q",
-                                lambda k, s: jnp.zeros(s, jnp.int8), (groups, gs))
+                                lambda k, s: jnp.zeros(s, jnp.int8),
+                                (in_dim, self.output_dim))
             scales = self.param("base_kernel_scales",
-                                lambda k, s: jnp.ones(s, jnp.float32), (groups,))
-            from deepspeed_tpu.ops.pallas.quantization import dequantize_int8
-            base = dequantize_int8(values, scales, (in_dim, self.output_dim),
-                                   dtype=self.dtype)
+                                lambda k, s: jnp.ones(s, jnp.float32),
+                                (in_dim, self.output_dim // g))
+            qw = QuantizedWeight(jax.lax.stop_gradient(values),
+                                 jax.lax.stop_gradient(scales),
+                                 (in_dim, self.output_dim), "int8",
+                                 layout="grouped", dequant_dtype=self.dtype)
+            base_y = qw.matmul(x)  # frozen; adapters learn
         else:
             base = self.param("base_kernel", nn.initializers.lecun_normal(),
                               (in_dim, self.output_dim), jnp.float32).astype(self.dtype)
-        base = jax.lax.stop_gradient(base)  # frozen; adapters learn
+            base_y = x @ jax.lax.stop_gradient(base)  # frozen; adapters learn
 
         a = self.param("lora_a", nn.initializers.lecun_normal(),
                        (in_dim, lora.lora_r), jnp.float32).astype(self.dtype)
         b = self.param("lora_b", nn.initializers.zeros,
                        (lora.lora_r, self.output_dim), jnp.float32).astype(self.dtype)
-        y = x @ base + (x @ a) @ b * (lora.lora_alpha / lora.lora_r)
+        y = base_y + (x @ a) @ b * (lora.lora_alpha / lora.lora_r)
         if self.use_bias:
             y = y + self.param("bias", nn.initializers.zeros,
                                (self.output_dim,), jnp.float32).astype(self.dtype)
@@ -130,15 +140,18 @@ def fuse_lora_tree(params, lora_alpha, lora_r=None):
             delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scaling
             out = dict(d)
             if "base_kernel_q" in d:
-                from deepspeed_tpu.ops.pallas.quantization import (dequantize_int8,
-                                                                   quantize_int8)
-                gs = d["base_kernel_q"].shape[-1]
-                base = dequantize_int8(d["base_kernel_q"], d["base_kernel_scales"],
-                                       delta.shape, dtype=jnp.float32)
-                vq, sq, _ = quantize_int8(base + delta, group_size=gs)
-                out["base_kernel_q"] = vq
-                out["base_kernel_scales"] = sq
-                stash[path] = (d["base_kernel_q"], d["base_kernel_scales"], b)
+                # grouped carriers: group width derives from the shapes
+                from deepspeed_tpu.inference.quantization.quantization import \
+                    _quantize_grouped
+                from deepspeed_tpu.ops.pallas.fused_quant_matmul import \
+                    dequantize_grouped
+                vq0, sq0 = d["base_kernel_q"], d["base_kernel_scales"]
+                g = vq0.shape[-1] // sq0.shape[-1]
+                base = dequantize_grouped(vq0, sq0, "int8", jnp.float32)
+                qw = _quantize_grouped(base + delta, "int8", g)
+                out["base_kernel_q"] = qw.values
+                out["base_kernel_scales"] = qw.scales
+                stash[path] = (vq0, sq0, b)
             else:
                 base = d["base_kernel"]
                 out["base_kernel"] = (base.astype(jnp.float32) + delta).astype(base.dtype)

@@ -1,0 +1,70 @@
+"""Quantization-aware drop-in for ``nn.Dense``.
+
+``nn.Dense`` consumes a ``QuantizedWeight`` kernel through flax's
+AxisMetadata unboxing: ``self.param`` dequantizes the carrier to a full
+bf16 matrix and THEN matmuls — the dequantize-then-matmul tax the
+fused Pallas kernel exists to remove. ``QuantDense`` fetches the raw
+box and routes a quantized kernel through ``QuantizedWeight.matmul``
+(fused dequant-GEMM; jnp fallback off-TPU), while a plain dense kernel
+takes the exact ``nn.Dense`` math.
+
+Param names, shapes, and initializers match ``nn.Dense`` exactly, so
+checkpoints, init RNG streams, and TP rules (which key on
+``*/kernel``) are all interchangeable — swapping the class is the whole
+migration.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+from flax.core import meta as flax_meta
+from flax.linen.dtypes import promote_dtype
+
+
+class QuantDense(nn.Module):
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+    precision: Any = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, inputs):
+        # lazy import: linear/ must stay importable without inference/
+        from deepspeed_tpu.inference.quantization.quantization import QuantizedWeight
+        kernel = None
+        if self.has_variable("params", "kernel"):
+            raw = self.get_variable("params", "kernel")
+            if isinstance(raw, QuantizedWeight):
+                # ``self.param(..., unbox=False)`` would run flax's shape
+                # check against the carrier leaves, which a packed fp6
+                # kernel legitimately fails (last dim is 3/4 size); the
+                # carrier's own reshape math validates consistency.
+                kernel = raw
+        if kernel is None:
+            kernel = self.param("kernel", self.kernel_init,
+                                (jnp.shape(inputs)[-1], self.features),
+                                self.param_dtype, unbox=False)
+        bias = (self.param("bias", self.bias_init, (self.features,),
+                           self.param_dtype)
+                if self.use_bias else None)
+        if isinstance(kernel, QuantizedWeight):
+            dd = kernel.dequant_dtype
+            if self.dtype is not None:
+                inputs, dd = inputs.astype(self.dtype), self.dtype
+            y = kernel.matmul(inputs, dtype=dd)
+            return y if bias is None else y + bias.astype(y.dtype)
+        if isinstance(kernel, flax_meta.AxisMetadata):  # e.g. nn.Partitioned
+            kernel = kernel.unbox()
+        inputs, kernel, bias = promote_dtype(inputs, kernel, bias, dtype=self.dtype)
+        y = jax.lax.dot_general(inputs, kernel,
+                                (((inputs.ndim - 1,), (0,)), ((), ())),
+                                precision=self.precision)
+        if bias is not None:
+            y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y

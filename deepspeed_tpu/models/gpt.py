@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.linear.quant_dense import QuantDense
+
 from deepspeed_tpu.models.llama import (RMSNorm, apply_rope, causal_lm_loss, einsum_attention,
                                         repeat_kv, rope_frequencies, _local_attention,
                                         _remat_policy)
@@ -200,9 +202,9 @@ class GPTAttention(nn.Module):
         H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
         qkv_bias = cfg.attention_bias if cfg.attention_qkv_bias is None else cfg.attention_qkv_bias
-        q = nn.Dense(H * Dh, use_bias=qkv_bias, name="q_proj")(h).reshape(B, S, H, Dh)
-        k = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
-        v = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
+        q = QuantDense(H * Dh, use_bias=qkv_bias, name="q_proj")(h).reshape(B, S, H, Dh)
+        k = QuantDense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
+        v = QuantDense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
         if cfg.attention_softmax_scale is not None:
             # every attention impl divides by sqrt(head_dim); pre-scaling q
             # realises any other softmax scale without touching the kernels
@@ -236,7 +238,7 @@ class GPTAttention(nn.Module):
                 bias = alibi_bias(H, start + jnp.arange(S), jnp.arange(s_max))
             out = einsum_attention(q, kx, vx, bias=bias, mask=mask)
             out = out.reshape(B, S, H * Dh)
-            return nn.Dense(D, use_bias=cfg.attention_bias, name="o_proj")(out), new_cache
+            return QuantDense(D, use_bias=cfg.attention_bias, name="o_proj")(out), new_cache
 
         k, v = repeat_kv(k, v, H // Hkv)
 
@@ -258,7 +260,7 @@ class GPTAttention(nn.Module):
             out = head_to_seq_shard(out)
 
         out = out.reshape(B, S, H * Dh)
-        return nn.Dense(D, use_bias=cfg.attention_bias, name="o_proj")(out), None
+        return QuantDense(D, use_bias=cfg.attention_bias, name="o_proj")(out), None
 
 
 class GPTMLP(nn.Module):
@@ -267,10 +269,10 @@ class GPTMLP(nn.Module):
     @nn.compact
     def __call__(self, h):
         cfg = self.config
-        inter = nn.Dense(cfg.intermediate_size, use_bias=cfg.mlp_bias, name="fc_in")(h)
+        inter = QuantDense(cfg.intermediate_size, use_bias=cfg.mlp_bias, name="fc_in")(h)
         inter = _activation(cfg.activation)(inter)
         inter = constrain(inter, (("data", "expert"), "sequence", "tensor"))
-        return nn.Dense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="fc_out")(inter)
+        return QuantDense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="fc_out")(inter)
 
 
 class GPTBlock(nn.Module):
@@ -377,7 +379,7 @@ class GPTForCausalLM(nn.Module):
         if cfg.tie_word_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, name="lm_head")(h)
+            logits = QuantDense(cfg.vocab_size, use_bias=cfg.lm_head_bias, name="lm_head")(h)
         if decode:
             return logits, new_cache
         logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))

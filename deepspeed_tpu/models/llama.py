@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.linear.quant_dense import QuantDense
+
 from deepspeed_tpu.ops.pallas import spec_divides as _spec_divides
 from deepspeed_tpu.sequence.layer import (constrain, constrain_hidden, head_to_seq_shard, heads_spec,
                                           hidden_spec, seq_to_head_shard)
@@ -297,9 +299,9 @@ class LlamaAttention(nn.Module):
         H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
         qkv_bias = cfg.attention_bias
-        q = nn.Dense(H * Dh, use_bias=qkv_bias, name="q_proj")(h).reshape(B, S, H, Dh)
-        k = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
-        v = nn.Dense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
+        q = QuantDense(H * Dh, use_bias=qkv_bias, name="q_proj")(h).reshape(B, S, H, Dh)
+        k = QuantDense(Hkv * Dh, use_bias=qkv_bias, name="k_proj")(h).reshape(B, S, Hkv, Dh)
+        v = QuantDense(Hkv * Dh, use_bias=qkv_bias, name="v_proj")(h).reshape(B, S, Hkv, Dh)
 
         cos, sin = rope_frequencies(Dh, cfg.max_position_embeddings, cfg.rope_theta,
                                     scaling=rope_scaling_of(cfg))
@@ -321,7 +323,7 @@ class LlamaAttention(nn.Module):
             mask = (k_idx <= q_pos)[None, None, :, :]  # [1, 1, T, S_max]
             out = einsum_attention(q, kx, vx, mask=mask)
             out = out.reshape(B, S, H * Dh)
-            return nn.Dense(D, use_bias=cfg.attention_out_bias, name="o_proj")(out), new_cache
+            return QuantDense(D, use_bias=cfg.attention_out_bias, name="o_proj")(out), new_cache
 
         if cfg.sp_impl == "ring":
             # Ring context parallelism: stay sequence-sharded; K/V blocks
@@ -342,7 +344,7 @@ class LlamaAttention(nn.Module):
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}: expected 'ulysses' or 'ring'")
 
         out = out.reshape(B, S, H * Dh)
-        return nn.Dense(D, use_bias=cfg.attention_out_bias, name="o_proj")(out), None
+        return QuantDense(D, use_bias=cfg.attention_out_bias, name="o_proj")(out), None
 
 
 class LlamaMLP(nn.Module):
@@ -351,8 +353,8 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, h):
         cfg = self.config
-        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj")(h)
-        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj")(h)
+        gate = QuantDense(cfg.intermediate_size, use_bias=False, name="gate_proj")(h)
+        up = QuantDense(cfg.intermediate_size, use_bias=False, name="up_proj")(h)
         if cfg.mlp_activation == "silu":
             inter = nn.silu(gate) * up
         elif cfg.mlp_activation == "gelu_tanh":  # Gemma GeGLU
@@ -360,7 +362,7 @@ class LlamaMLP(nn.Module):
         else:
             raise ValueError(f"mlp_activation {cfg.mlp_activation!r}: silu | gelu_tanh")
         inter = constrain(inter, (("data", "expert"), "sequence", "tensor"))
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj")(inter)
+        return QuantDense(cfg.hidden_size, use_bias=False, name="down_proj")(inter)
 
 
 class LlamaBlock(nn.Module):
@@ -485,7 +487,7 @@ class LlamaForCausalLM(nn.Module):
             if cfg.tie_word_embeddings:
                 logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(h.dtype))
             else:
-                logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
+                logits = QuantDense(cfg.vocab_size, use_bias=False, name="lm_head")(h)
             if decode:
                 return logits, new_cache
             logits = constrain(logits, (("data", "expert"), "sequence", "tensor"))
@@ -522,7 +524,7 @@ class LlamaForCausalLM(nn.Module):
                 s, c = step(hs[:, i * C:(i + 1) * C], ls[:, i * C:(i + 1) * C])
                 total, count = total + s, count + c
         else:
-            lm_head = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")
+            lm_head = QuantDense(cfg.vocab_size, use_bias=False, name="lm_head")
             step = nn.remat(_dense_ce_chunk, prevent_cse=False)
             for i in range(n):
                 s, c = step(lm_head, hs[:, i * C:(i + 1) * C], ls[:, i * C:(i + 1) * C])

@@ -11,13 +11,12 @@ group quantization for FP6-LLM weight-only serving). TPU form:
   3 carrier bytes, so storage is 0.75x FP8 exactly as the reference's
   ``fp_quantize.cu`` bitfield achieves. Encode is vectorized fp32 bit
   arithmetic (round-to-nearest-even); decode is branch-free integer
-  arithmetic that XLA FUSES into the consuming matmul — the reference
-  needs a CUDA kernel because torch cannot fuse bit-twiddling into a
-  GEMM, whereas a standalone TPU unpack kernel would round-trip the
-  dequantized fp tensor through HBM and defeat the 6-bit footprint
-  (the byte-interleaved unpack also needs cross-lane shuffles Mosaic
-  does not express; verified on-chip that the XLA decode compiles and
-  the quality/footprint contract holds).
+  shifts + one bitcast (no transcendentals), cheap enough that it runs
+  either fused by XLA into a consuming matmul or inside the Pallas
+  fused dequant-matmul kernel
+  (``ops/pallas/fused_quant_matmul.py``), which unpacks packed tiles
+  in VMEM so the decoded tensor never round-trips through HBM and the
+  6-bit footprint holds end to end.
 """
 
 import jax
@@ -25,6 +24,13 @@ import jax.numpy as jnp
 
 _FP8_MAX = {8: 448.0, 12: 448.0}
 FP6_MAX = 28.0  # e3m2 bias-3: (1 + 3/4) * 2^(7-3)
+
+# Static pack/unpack tables, hoisted to module level so the per-call
+# trace never rebuilds them: 4 six-bit codes live in one little-endian
+# 24-bit word at these bit offsets.
+_FP6_CODE_SHIFTS = (0, 6, 12, 18)
+_E3M2_EXP_BIAS = 3  # fp32 exponent rebias for bit-assembled decode
+_E3M2_SUBNORMAL_STEP = 0.0625  # codes 0..7: linear grid n * 2^-4
 
 
 def _fp_dtype(q_bits):
@@ -59,37 +65,57 @@ def _encode_e3m2(x):
 
 
 def _decode_e3m2(code):
-    """uint8 codes → fp32 values."""
-    code = code.astype(jnp.int32)
-    sign = jnp.where((code >> 5) & 1 == 1, -1.0, 1.0)
-    mag = code & 0x1F
+    """uint8 codes → fp32 values, branch-free bit assembly.
+
+    Normals (mag >= 8) are assembled directly as fp32 bits — sign into
+    bit 31, ``e - bias + 127`` into the exponent field, the 2-bit
+    mantissa into the fp32 mantissa top — so decode is pure integer
+    shifts + one bitcast: no ``exp2`` transcendental, no division, and
+    the whole thing runs inside a Pallas kernel (the fused
+    dequant-matmul tiles call this on unpacked code tiles in VMEM).
+    Codes 0..7 are the linear grid ±mag * 2^-4 (subnormals + E=1).
+    """
+    c = code.astype(jnp.int32)
+    mag = c & 0x1F
     e = mag >> 2
-    m = (mag & 3).astype(jnp.float32)
-    small = mag * 0.0625  # codes 0..7: linear grid (subnormal + E=1)
-    normal = (1.0 + m / 4.0) * jnp.exp2((e - 3).astype(jnp.float32))
-    return sign * jnp.where(mag < 8, small, normal)
+    m = mag & 3
+    sign_bit = (c & 0x20) << 26  # code sign (bit 5) → fp32 sign (bit 31)
+    normal = jax.lax.bitcast_convert_type(
+        sign_bit | ((e + (127 - _E3M2_EXP_BIAS)) << 23) | (m << 21), jnp.float32)
+    signed_step = jnp.where((c & 0x20) != 0, -_E3M2_SUBNORMAL_STEP,
+                            _E3M2_SUBNORMAL_STEP)
+    small = signed_step * mag.astype(jnp.float32)
+    return jnp.where(mag < 8, small, normal)
 
 
 def pack_fp6(codes):
-    """uint8 codes [..., 4n] → packed carrier bytes [..., 3n]."""
+    """uint8 codes [..., 4n] → packed carrier bytes [..., 3n]: each
+    4-code quad becomes one little-endian 24-bit word (code i at bit
+    offset ``_FP6_CODE_SHIFTS[i]``), emitted as 3 bytes."""
+    if codes.shape[-1] % 4:
+        raise ValueError(
+            f"fp6 pack needs a multiple of 4 codes, got last dim {codes.shape[-1]}")
     c = codes.reshape(codes.shape[:-1] + (-1, 4)).astype(jnp.uint32)
-    c0, c1, c2, c3 = c[..., 0], c[..., 1], c[..., 2], c[..., 3]
-    b0 = (c0 | (c1 << 6)) & 0xFF
-    b1 = ((c1 >> 2) | (c2 << 4)) & 0xFF
-    b2 = ((c2 >> 4) | (c3 << 2)) & 0xFF
-    return jnp.stack([b0, b1, b2], axis=-1).reshape(
-        codes.shape[:-1] + (codes.shape[-1] // 4 * 3,)).astype(jnp.uint8)
+    u = c[..., 0]
+    for i, s in enumerate(_FP6_CODE_SHIFTS[1:], start=1):
+        u = u | (c[..., i] << s)
+    b = jnp.stack([u & 0xFF, (u >> 8) & 0xFF, (u >> 16) & 0xFF], axis=-1)
+    return b.reshape(codes.shape[:-1] + (codes.shape[-1] // 4 * 3,)).astype(jnp.uint8)
 
 
 def unpack_fp6(packed):
-    """packed bytes [..., 3n] → uint8 codes [..., 4n]."""
+    """packed bytes [..., 3n] → uint8 codes [..., 4n] (inverse of
+    :func:`pack_fp6`). Raises when the carrier length cannot hold whole
+    24-bit words — a truncated/misaligned buffer would otherwise decode
+    to silent garbage."""
+    if packed.shape[-1] % 3:
+        raise ValueError(
+            f"packed fp6 carrier last dim {packed.shape[-1]} is not divisible "
+            "by 3 (4 codes pack into 3 bytes)")
     b = packed.reshape(packed.shape[:-1] + (-1, 3)).astype(jnp.uint32)
-    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
-    c0 = b0 & 0x3F
-    c1 = ((b0 >> 6) | (b1 << 2)) & 0x3F
-    c2 = ((b1 >> 4) | (b2 << 4)) & 0x3F
-    c3 = (b2 >> 2) & 0x3F
-    return jnp.stack([c0, c1, c2, c3], axis=-1).reshape(
+    u = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+    codes = jnp.stack([(u >> s) & 0x3F for s in _FP6_CODE_SHIFTS], axis=-1)
+    return codes.reshape(
         packed.shape[:-1] + (packed.shape[-1] // 3 * 4,)).astype(jnp.uint8)
 
 

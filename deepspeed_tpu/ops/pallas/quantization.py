@@ -109,8 +109,14 @@ def quantize_int8(x, group_size=2048, stochastic=False, seed=0, interpret=None):
     return values, scales, x.shape
 
 
-def dequantize_int8(values, scales, orig_shape, dtype=jnp.float32, interpret=None):
-    """Inverse of :func:`quantize_int8`."""
+def dequantize_int8(values, scales, orig_shape, dtype=None, interpret=None):
+    """Inverse of :func:`quantize_int8`. ``dtype`` defaults to bf16 — the
+    serving dequant dtype — so a caller that forgets to thread its
+    ``dequant_dtype`` through cannot silently upcast to fp32 and double
+    the transient footprint; pass ``dtype=jnp.float32`` explicitly where
+    full precision matters (round-trip bounds, LoRA fuse math)."""
+    if dtype is None:
+        dtype = jnp.bfloat16
     from deepspeed_tpu.ops.pallas import use_pallas
     use_kernel = use_pallas() or interpret is True
     if interpret is None:

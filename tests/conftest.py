@@ -31,6 +31,11 @@ sys.path.insert(0, _tests_dir)  # so fixtures import as `unit.simple_model`
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running sweeps excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def reset_global_state():
     """Fresh mesh/comm state per test."""

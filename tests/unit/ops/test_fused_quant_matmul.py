@@ -1,0 +1,188 @@
+"""Fused dequant-matmul kernel parity (ops/pallas/fused_quant_matmul.py).
+
+The fused kernel is the default quantized-serving execution path (v1
+QuantDense, v2 _proj, OptimizedLinear), so its numerics are pinned here
+against the reference dequantize-then-matmul for every scheme, across
+non-square shapes, group sizes, and a TP-sharded carrier. The kernel
+runs in interpret mode (tier-1 is CPU); large-shape sweeps carry the
+``slow`` marker.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.quantization.quantization import (QuantizedWeight,
+                                                               _quantize_grouped,
+                                                               matmul_any)
+from deepspeed_tpu.ops.pallas.fused_quant_matmul import (dequantize_grouped,
+                                                         quant_matmul)
+
+SCHEMES = ("int8", "fp8", "fp6")
+
+
+def _qw(rng, k, n, scheme, group, scale=0.1):
+    w = jnp.asarray(rng.randn(k, n).astype(np.float32) * scale)
+    qw = _quantize_grouped(w, scheme, group)
+    assert isinstance(qw, QuantizedWeight), (scheme, k, n, group)
+    return qw
+
+
+class TestFusedParity:
+    """Kernel (interpret mode) vs reference x @ dequant — tight fp32
+    tolerance: both paths decode the same carriers, so the only
+    difference is MXU accumulation order."""
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    @pytest.mark.parametrize("shape,group", [
+        ((8, 48, 64), 16),     # small, nothing 128-aligned
+        ((16, 128, 96), 32),   # K aligned, N odd-sized
+        ((5, 96, 160), 32),    # M not a multiple of 8 (pads)
+        ((1, 64, 256), 64),    # decode-step GEMV
+        ((7, 72, 120), 12),    # group not a power of two (fp6: 12 % 4 == 0)
+    ])
+    def test_matches_reference(self, scheme, shape, group):
+        m, k, n = shape
+        rng = np.random.RandomState(hash((scheme, shape)) % 2**31)
+        qw = _qw(rng, k, n, scheme, group)
+        x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        ref = x @ qw.dequantized(jnp.float32)
+        got = quant_matmul(x, qw.values, qw.scales, scheme,
+                           dequant_dtype=jnp.float32, interpret=True)
+        assert got.dtype == ref.dtype
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_batched_input_and_bf16_dequant(self, scheme):
+        rng = np.random.RandomState(11)
+        qw = _qw(rng, 64, 128, scheme, 32)
+        x = jnp.asarray(rng.randn(2, 6, 64).astype(np.float32)).astype(jnp.bfloat16)
+        ref = x @ qw.dequantized(jnp.bfloat16)
+        got = qw.matmul(x, interpret=True)  # stored dequant_dtype = bf16
+        assert got.shape == (2, 6, 128) and got.dtype == ref.dtype
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=0.05, atol=0.05)
+
+    def test_dequantize_grouped_matches_dequantized(self):
+        rng = np.random.RandomState(12)
+        for scheme in SCHEMES:
+            qw = _qw(rng, 32, 96, scheme, 24 if scheme != "fp6" else 16)
+            np.testing.assert_array_equal(
+                np.asarray(dequantize_grouped(qw.values, qw.scales, scheme,
+                                              jnp.float32)),
+                np.asarray(qw.dequantized(jnp.float32)))
+
+    def test_grad_flows_through_x_only(self):
+        rng = np.random.RandomState(13)
+        qw = _qw(rng, 32, 64, "int8", 16)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+
+        def loss(x):
+            return qw.matmul(x, dtype=jnp.float32, interpret=True).sum()
+
+        g = jax.grad(loss)(x)
+        gref = jnp.ones((4, 64)) @ qw.dequantized(jnp.float32).T
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    @pytest.mark.parametrize("shape,group", [
+        ((256, 1024, 2048), 128),
+        ((64, 2048, 512), 512),
+    ])
+    def test_large_shape_sweep(self, scheme, shape, group):
+        m, k, n = shape
+        rng = np.random.RandomState(17)
+        qw = _qw(rng, k, n, scheme, group)
+        x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        ref = x @ qw.dequantized(jnp.float32)
+        got = quant_matmul(x, qw.values, qw.scales, scheme,
+                           dequant_dtype=jnp.float32, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestShardedCarrier:
+    """Under a live multi-device mesh the fused call lowers to the jnp
+    reference, which GSPMD shards with the carriers' own specs — TP
+    sharding of quantized weights must survive the fused default."""
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_tp_sharded_matmul_matches_dense(self, scheme):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.parallel.topology import make_mesh_topology
+        mesh = make_mesh_topology(tensor=2, data=1,
+                                  devices=jax.devices()[:2])
+        from deepspeed_tpu.parallel import groups
+        groups.set_mesh(mesh)
+        rng = np.random.RandomState(23)
+        qw = _qw(rng, 32, 128, scheme, 32)
+        # column-parallel placement: values/scales sharded on the out dim
+        v = jax.device_put(qw.values, NamedSharding(mesh, P(None, "tensor")))
+        s = jax.device_put(qw.scales, NamedSharding(mesh, P(None, "tensor")))
+        sq = QuantizedWeight(v, s, qw.shape, scheme, "grouped", jnp.float32)
+        x = jnp.asarray(rng.randn(8, 32).astype(np.float32))
+        with mesh:
+            got = jax.jit(lambda x: sq.matmul(x, dtype=jnp.float32))(x)
+        ref = x @ qw.dequantized(jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestUnboxNeverCalled:
+    """Regression: the fused default must not fall back to
+    ``QuantizedWeight.unbox()`` (dequantize-the-whole-kernel) anywhere on
+    the serving matmul path."""
+
+    def _poison(self, monkeypatch):
+        def boom(self):
+            raise AssertionError("QuantizedWeight.unbox() called on the fused path")
+        monkeypatch.setattr(QuantizedWeight, "unbox", boom)
+
+    def test_v2_proj_does_not_unbox(self, monkeypatch):
+        self._poison(monkeypatch)
+        from deepspeed_tpu.inference.v2.model_runner import _proj
+        rng = np.random.RandomState(29)
+        qw = _qw(rng, 32, 64, "int8", 16)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+        y = _proj(x, {"kernel": qw})
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(x @ qw.dequantized(jnp.float32)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_quant_dense_does_not_unbox(self, monkeypatch):
+        from deepspeed_tpu.linear.quant_dense import QuantDense
+        rng = np.random.RandomState(31)
+        model = QuantDense(48, use_bias=False)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        qw = _quantize_grouped(params["kernel"], "fp6", 16)
+        self._poison(monkeypatch)
+        y = model.apply({"params": {"kernel": qw}}, x)
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32),
+            np.asarray(x @ qw.dequantized(qw.dequant_dtype), np.float32),
+            rtol=1e-3, atol=1e-3)
+
+    def test_matmul_any_dense_passthrough(self):
+        x = jnp.ones((2, 4))
+        w = jnp.full((4, 3), 0.5)
+        np.testing.assert_allclose(np.asarray(matmul_any(x, w)),
+                                   np.full((2, 3), 2.0))
+
+
+class TestEnvKnob:
+
+    def test_ds_fused_qmm_off_uses_unbox_math(self, monkeypatch):
+        monkeypatch.setenv("DS_FUSED_QMM", "0")
+        rng = np.random.RandomState(37)
+        qw = _qw(rng, 32, 64, "int8", 16)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+        got = qw.matmul(x, dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(x @ qw.dequantized(jnp.float32)))

@@ -128,10 +128,17 @@ class TestQuantization:
         x = jnp.asarray(rng.randn(1000).astype(np.float32))
         v, s, shape = quantize_int8(x, group_size=256, interpret=True)
         assert v.dtype == jnp.int8
-        back = dequantize_int8(v, s, shape, interpret=True)
+        # fp32 explicitly: the default dequant dtype is bf16 (serving)
+        # whose rounding would swamp the int8 bound below
+        back = dequantize_int8(v, s, shape, dtype=jnp.float32, interpret=True)
         # max error per group is scale/2 = absmax/254
         bound = float(jnp.max(jnp.abs(x))) / 127.0
         assert float(jnp.max(jnp.abs(back - x))) <= bound
+
+    def test_default_dequant_dtype_is_bf16(self):
+        x = jnp.asarray(np.random.RandomState(9).randn(64).astype(np.float32))
+        v, s, shape = quantize_int8(x, group_size=64, interpret=True)
+        assert dequantize_int8(v, s, shape, interpret=True).dtype == jnp.bfloat16
 
     def test_matches_xla_reference(self):
         rng = np.random.RandomState(4)

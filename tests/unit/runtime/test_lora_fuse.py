@@ -74,18 +74,20 @@ class TestLoraFuseTree:
         hybrid_engine.py:138-146 with linear/quantization.py):
         dequantize → fuse → requantize; the stash carries the ORIGINAL
         carrier so unfuse restores it bit-exactly."""
+        from deepspeed_tpu.inference.quantization.quantization import _quantize_grouped
         from deepspeed_tpu.linear.config import QuantizationConfig
-        from deepspeed_tpu.ops.pallas.quantization import quantize_int8
         model = nn.Sequential([OptimizedLinear(8, lora_config=LORA,
                                                quantization_config=QuantizationConfig(),
                                                dtype=jnp.float32)])
         params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))["params"]
         # give the quantized base real content + nonzero adapters
+        # (grouped layout: [in, out] carriers, group width from shapes)
         site = params["layers_0"]
         rng = np.random.RandomState(5)
         w = jnp.asarray(rng.randn(8, 8).astype(np.float32) * 0.1)
-        gs = site["base_kernel_q"].shape[-1]
-        vq, sq, _ = quantize_int8(w, group_size=gs)
+        g = site["base_kernel_q"].shape[-1] // site["base_kernel_scales"].shape[-1]
+        qw = _quantize_grouped(w, "int8", g)
+        vq, sq = qw.values, qw.scales
         site = dict(site, base_kernel_q=vq, base_kernel_scales=sq,
                     lora_b=site["lora_b"] + 0.05)
         params = dict(params, layers_0=site)
