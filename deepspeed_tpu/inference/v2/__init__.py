@@ -5,10 +5,11 @@ ragged/ (state manager, sequence descriptors, blocked KV cache,
 ragged batch), plus the Dynamic SplitFuse continuous-batching scheduler
 the reference ships via DeepSpeed-MII."""
 
-from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, QuantizationConfig,
+from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, PrefixCacheConfig,
+                                                  QuantizationConfig,
                                                   RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 
 __all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig", "DSStateManagerConfig",
-           "QuantizationConfig", "DynamicSplitFuseScheduler"]
+           "QuantizationConfig", "PrefixCacheConfig", "DynamicSplitFuseScheduler"]

@@ -21,6 +21,17 @@ class QuantizationConfig(DeepSpeedConfigModel):
     quantization_mode: str = "none"
 
 
+class PrefixCacheConfig(DeepSpeedConfigModel):
+    """Radix prefix cache (cross-request KV reuse). ``enabled`` is the
+    config gate; the ``DS_PREFIX_CACHE`` env var overrides it in both
+    directions (kill switch). ``max_cached_blocks`` caps how many pool
+    blocks the trie may own at once (0 = bounded only by pool pressure —
+    unreferenced cached blocks are evicted LRU when allocation needs
+    them)."""
+    enabled: bool = False
+    max_cached_blocks: int = 0
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel_degree: int = 1
     expert_parallel_degree: int = 1  # MoE expert sharding for serving
@@ -31,3 +42,4 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     num_kv_blocks: int = 0  # 0 = derive from max_context * max sequences
     state_manager: DSStateManagerConfig = DSStateManagerConfig()
     quantization: QuantizationConfig = QuantizationConfig()
+    prefix_cache: PrefixCacheConfig = PrefixCacheConfig()

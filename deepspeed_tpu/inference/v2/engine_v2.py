@@ -148,6 +148,18 @@ class InferenceEngineV2:
             self.kv_cache.k = jax.device_put(self.kv_cache.k, pool)
             self.kv_cache.v = jax.device_put(self.kv_cache.v, pool)
         self.state_manager = DSStateManager(self.kv_cache, int(sm.max_tracked_sequences))
+        # Radix prefix cache (cross-request KV reuse): config-gated with
+        # the DS_PREFIX_CACHE env kill switch. When live, retired
+        # sequences' full blocks become content-addressable and new
+        # prompts start past their longest cached prefix.
+        from deepspeed_tpu.inference.v2.prefix_cache import (PrefixCacheManager,
+                                                             prefix_cache_enabled)
+        self.prefix_cache = None
+        if prefix_cache_enabled(self._config.prefix_cache):
+            self.prefix_cache = PrefixCacheManager(
+                self.kv_cache,
+                max_cached_blocks=int(self._config.prefix_cache.max_cached_blocks))
+            self.state_manager.attach_prefix_cache(self.prefix_cache)
         # positions are bounded by BOTH the block table and the RoPE table
         self.max_ctx_tokens = min(self.max_blocks_per_seq * self.block_size,
                                   int(cfg.max_position_embeddings))
@@ -259,9 +271,10 @@ class InferenceEngineV2:
                                  f"max_context={max_ctx}")
             blocks_needed += (desc.blocks_needed(len(tokens)) if desc is not None
                               else -(-len(tokens) // self.block_size))
-        if blocks_needed > self.kv_cache.free_blocks:
+        if blocks_needed > self._reclaimable_blocks():
             raise RuntimeError(f"KV pool exhausted: need {blocks_needed} blocks, "
-                               f"{self.kv_cache.free_blocks} free — flush() sequences first")
+                               f"{self._reclaimable_blocks()} reclaimable — "
+                               f"flush() sequences first")
         if new_seqs + self.state_manager.n_tracked_sequences > \
                 self.state_manager.max_tracked_sequences:
             raise RuntimeError("max_tracked_sequences exceeded for this batch")
@@ -274,6 +287,9 @@ class InferenceEngineV2:
             self.state_manager.allocate_for(desc, len(tokens))
             self._batch.insert_sequence(desc, tokens)
             desc.advance(len(tokens))
+            if self.prefix_cache is not None:
+                # content log for retire-time insertion into the trie
+                desc.tokens.extend(int(t) for t in tokens)
             slots.append(desc.slot)
         # decode bucket: a batch of ≤ max_seqs tokens (pure decode round)
         # runs the small compiled step; prefill chunks run the full-budget
@@ -312,7 +328,7 @@ class InferenceEngineV2:
                     or desc.seen_tokens + k > self.max_ctx_tokens:
                 return False
             need += desc.blocks_needed(k)
-        return need <= self.kv_cache.free_blocks
+        return need <= self._reclaimable_blocks()
 
     def decode_burst(self, batch_uids, batch_tokens, k, sample=None):
         """Run ``k`` decode steps for one current token per uid in ONE
@@ -352,9 +368,10 @@ class InferenceEngineV2:
                                  f"max_context={self.max_ctx_tokens}")
             blocks_needed += desc.blocks_needed(k)
             descs.append(desc)
-        if blocks_needed > self.kv_cache.free_blocks:
+        if blocks_needed > self._reclaimable_blocks():
             raise RuntimeError(f"KV pool exhausted: need {blocks_needed} blocks, "
-                               f"{self.kv_cache.free_blocks} free — flush() sequences first")
+                               f"{self._reclaimable_blocks()} reclaimable — "
+                               f"flush() sequences first")
 
         tokens0 = np.zeros(ms, np.int32)
         token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
@@ -382,7 +399,19 @@ class InferenceEngineV2:
             self._rng, sub = jax.random.split(self._rng)
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, meta, sub)
-        return np.asarray(out)[:, :len(batch_uids)]
+        toks = np.asarray(out)[:, :len(batch_uids)]
+        if self.prefix_cache is not None:
+            # log what the burst actually WROTE to the KV cache: step i
+            # writes its input token's KV, so positions [seen, seen+k)
+            # hold the entry token followed by the first k-1 outputs (the
+            # final sampled token is never written — it would be the next
+            # step's input). EOS truncation is a scheduler concern; the
+            # cache is content-addressed, so post-EOS tokens just hash to
+            # prefixes nobody asks for.
+            for i, desc in enumerate(descs):
+                desc.tokens.append(int(tokens0[i]))
+                desc.tokens.extend(int(t) for t in toks[:-1, i])
+        return toks
 
     def _make_burst_fn(self, k, skey=None):
         from deepspeed_tpu.inference.v2.model_runner import ragged_forward
@@ -424,6 +453,40 @@ class InferenceEngineV2:
                            donate_argnums=(1, 2))
         return jax.jit(burst, donate_argnums=(1, 2))
 
+    def _reclaimable_blocks(self):
+        """Blocks an allocation can actually obtain right now: the free
+        list plus unreferenced cached blocks the prefix cache will evict
+        under pressure. This is the number every pool-exhaustion check
+        compares against — cached-but-evictable blocks must never cause
+        a spurious reject."""
+        free = self.kv_cache.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.evictable_blocks
+        return free
+
+    @property
+    def evictable_blocks(self):
+        """Unreferenced prefix-cache blocks (0 without a cache) — serving
+        admission counts these as reclaimable capacity."""
+        return self.prefix_cache.evictable_blocks if self.prefix_cache is not None else 0
+
+    def prefix_match(self, uid, prompt_tokens):
+        """Start tracking ``uid`` with its longest cached prompt prefix
+        pre-populated (no-op returning 0 when the prefix cache is off or
+        the sequence already exists). → the number of leading prompt
+        tokens whose KV is already in the pool; the caller starts
+        prefill at that offset. Always capped one token short of the
+        prompt, so the last prompt token is recomputed and first-token
+        logits exist."""
+        if self.prefix_cache is None:
+            return 0
+        desc = self.state_manager.query(uid)
+        if desc is not None:
+            return desc.cached_tokens
+        prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
+        desc = self.state_manager.get_or_create_sequence(uid, prompt_tokens=prompt)
+        return desc.cached_tokens
+
     def query(self, uid):
         """→ (seen_tokens, max_new_before_realloc) parity surface."""
         desc = self.state_manager.query(uid)
@@ -454,10 +517,20 @@ class InferenceEngineV2:
             raise KeyError(f"unknown sequence {uid}")
         if uid in self._suspended:
             raise ValueError(f"sequence {uid} is already suspended")
-        handle = self.kv_cache.offload(desc.blocks)
-        self._suspended[uid] = {"handle": handle, "seen_tokens": desc.seen_tokens}
-        desc.blocks = []  # already freed by offload; don't double-free
-        self.state_manager.flush_sequence(uid)
+        # Shared prefix blocks belong to the radix trie and other live
+        # sequences may be attending over them RIGHT NOW: copy their KV
+        # into the handle but leave the blocks cached (decref only). The
+        # resumed sequence gets private copies — correct, at the price of
+        # re-duplicating a prefix that may still be cache-resident.
+        shared = desc.blocks[:desc.shared_blocks]
+        handle = self.kv_cache.offload(desc.blocks, keep=shared)
+        if self.prefix_cache is not None:
+            self.prefix_cache.release_lease(uid)
+        self._suspended[uid] = {"handle": handle, "seen_tokens": desc.seen_tokens,
+                                "tokens": list(desc.tokens)}
+        desc.blocks = []  # freed by offload / kept by the trie; never double-free
+        desc.shared_blocks = 0
+        self.state_manager.drop_sequence(uid)
 
     def is_suspended(self, uid):
         """True when ``uid``'s KV lives in a suspended host copy."""
@@ -485,18 +558,23 @@ class InferenceEngineV2:
             raise ValueError(f"sequence {uid} was re-registered live while "
                              f"suspended; flush() it before resume()")
         n = ent["handle"]["k"].shape[1]
-        if n > self.kv_cache.free_blocks:
+        if n > self._reclaimable_blocks():
             raise RuntimeError(f"KV pool exhausted: resume needs {n} blocks, "
-                               f"{self.kv_cache.free_blocks} free")
+                               f"{self._reclaimable_blocks()} reclaimable")
         if self.state_manager.n_tracked_sequences >= \
                 self.state_manager.max_tracked_sequences:
             raise RuntimeError("max_tracked_sequences exceeded; flush() a live "
                                "sequence before resume()")
+        if self.prefix_cache is not None:
+            self.prefix_cache.ensure_free(n)
         blocks = self.kv_cache.restore(ent["handle"])
         del self._suspended[uid]
         desc = self.state_manager.get_or_create_sequence(uid)
         desc.extend_blocks(blocks)
         desc.seen_tokens = ent["seen_tokens"]
+        # every restored block is private (shared_blocks stays 0); the
+        # token log survives suspension so retire can still cache them
+        desc.tokens = list(ent.get("tokens", ()))
         return desc.seen_tokens
 
     def destroy(self):
@@ -505,6 +583,7 @@ class InferenceEngineV2:
         self.params = None
         self.kv_cache = None
         self.state_manager = None
+        self.prefix_cache = None
         self._step = self._step_greedy = None
         self._burst_fns = {}
         self._step_sample_fns = {}

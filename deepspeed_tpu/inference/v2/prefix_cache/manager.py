@@ -1,0 +1,162 @@
+"""Cross-request KV reuse over the blocked KV pool.
+
+``PrefixCacheManager`` layers refcounted, content-addressable block
+ownership on top of :class:`BlockedKVCache`/:class:`BlockedAllocator`:
+
+- every physical block is either FREE (allocator free list), PRIVATE
+  (owned by exactly one live sequence), or CACHED (owned by the radix
+  trie; ``ref`` counts the live sequences currently sharing it);
+- only FULL, immutable blocks are ever shared — each sequence's
+  trailing partial block stays private, so the hot path needs no
+  copy-on-write;
+- a new sequence ``acquire()``s its longest cached prefix (capped one
+  token short of the prompt so the model always recomputes the last
+  prompt token and produces first-token logits) and starts prefill at
+  the first uncached token;
+- on retire/flush the sequence's completed full blocks are inserted
+  into the trie instead of freed (duplicates of already-cached content
+  are freed immediately), and its prefix lease is dropped;
+- allocation pressure reclaims unreferenced cached blocks in LRU order
+  (``reserve``/``ensure_free``), so caching only ever trades IDLE pool
+  space for hits — it can never starve live sequences.
+"""
+
+import os
+
+from deepspeed_tpu.inference.v2.prefix_cache.radix_index import RadixPrefixIndex
+
+
+def prefix_cache_enabled(config) -> bool:
+    """Config gate plus the ``DS_PREFIX_CACHE`` kill switch: when the env
+    var is set it wins in BOTH directions (``0``/``false``/``off`` force
+    the cache off, anything else forces it on); unset defers to
+    ``config.enabled``."""
+    env = os.environ.get("DS_PREFIX_CACHE")
+    if env is not None:
+        return env.strip().lower() not in ("0", "", "false", "off", "no")
+    return bool(getattr(config, "enabled", False))
+
+
+class PrefixCacheManager:
+
+    def __init__(self, kv_cache, max_cached_blocks=0):
+        self.kv_cache = kv_cache
+        self.block_size = int(kv_cache.block_size)
+        # 0 = bounded only by pool pressure (LRU eviction on demand)
+        self.max_cached_blocks = int(max_cached_blocks)
+        self.index = RadixPrefixIndex(self.block_size)
+        self._leases = {}  # uid -> matched node path (refs held)
+        # request-level + token-level hit accounting
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+        self.insertions = 0
+
+    # ------------------------------------------------------------- capacity
+    @property
+    def evictable_blocks(self):
+        """Cached blocks no live sequence references — reclaimable
+        capacity the allocator can get back on demand."""
+        return self.index.evictable_blocks
+
+    @property
+    def cached_blocks(self):
+        return self.index.num_nodes
+
+    def ensure_free(self, num_blocks):
+        """Evict unreferenced cached blocks (LRU) until the allocator has
+        ``num_blocks`` free, or the trie has nothing left to give."""
+        deficit = num_blocks - self.kv_cache.free_blocks
+        if deficit > 0:
+            freed = self.index.evict(deficit)
+            if freed:
+                self.kv_cache.free(freed)
+
+    def reserve(self, num_blocks):
+        """Drop-in for ``BlockedKVCache.reserve`` that reclaims cached
+        blocks under pressure before allocating."""
+        self.ensure_free(num_blocks)
+        return self.kv_cache.reserve(num_blocks)
+
+    # ------------------------------------------------------------ sequences
+    def acquire(self, uid, prompt_tokens):
+        """Match ``prompt_tokens``' longest cached block-aligned prefix
+        and lease it to ``uid`` (refs held until :meth:`release` /
+        :meth:`release_lease`). → ``(block_ids, cached_tokens)``."""
+        if uid in self._leases:
+            raise ValueError(f"sequence {uid} already holds a prefix lease")
+        # never match the WHOLE prompt: the last prompt token must be
+        # recomputed so its logits exist to sample the first new token
+        max_blocks = (len(prompt_tokens) - 1) // self.block_size
+        path = self.index.match(prompt_tokens, max_blocks)
+        self.lookups += 1
+        if not path:
+            return [], 0
+        for node in path:
+            self.index.incref(node)
+        self._leases[uid] = path
+        cached = len(path) * self.block_size
+        self.hits += 1
+        self.tokens_saved += cached
+        return [node.block_id for node in path], cached
+
+    def release_lease(self, uid):
+        """Drop ``uid``'s prefix refs without inserting anything (the
+        suspend path — its blocks are leaving the pool, not retiring)."""
+        for node in self._leases.pop(uid, ()):
+            self.index.decref(node)
+
+    def release(self, uid, desc):
+        """Retire ``desc``: insert its completed full blocks into the
+        trie (duplicates freed), free the trailing partial block, drop
+        the prefix lease. This REPLACES ``kv_cache.free(desc.blocks)``
+        — a shared prefix block is decref'd, never hard-freed."""
+        bs = self.block_size
+        # only blocks whose token content was recorded are insertable
+        full = min(desc.seen_tokens, len(desc.tokens)) // bs
+        full = min(full, len(desc.blocks))
+        freed = []
+        node = self.index.root
+        chain = set()
+        for i in range(full):
+            chunk = tuple(int(t) for t in desc.tokens[i * bs:(i + 1) * bs])
+            block = int(desc.blocks[i])
+            existing = self.index.lookup_child(node, chunk)
+            if existing is not None:
+                # content already cached: our copy is redundant unless it
+                # IS the cached block (a leased shared prefix block)
+                if existing.block_id != block:
+                    freed.append(block)
+                node = existing
+                self.index.touch(node)
+                chain.add(node)
+                continue
+            if self.max_cached_blocks and \
+                    self.index.num_nodes >= self.max_cached_blocks:
+                evicted = self.index.evict(1, protect=chain)
+                if not evicted:
+                    # cache full of referenced blocks: stop chaining here
+                    # (a gap would orphan deeper chunks) and free the rest
+                    freed.extend(int(b) for b in desc.blocks[i:full])
+                    break
+                freed.extend(evicted)
+            node = self.index.insert_child(node, chunk, block)
+            chain.add(node)
+            self.insertions += 1
+        freed.extend(int(b) for b in desc.blocks[full:])
+        self.release_lease(uid)
+        if freed:
+            self.kv_cache.free(freed)
+
+    # -------------------------------------------------------------- metrics
+    def stats(self):
+        """Monitor-facing snapshot (``Serve/PrefixCache/*`` tags)."""
+        return {
+            "hit_rate": round(self.hits / self.lookups, 4) if self.lookups else 0.0,
+            "tokens_saved": self.tokens_saved,
+            "cached_blocks": self.cached_blocks,
+            "evictions": self.index.evictions,
+            "evictable_blocks": self.evictable_blocks,
+            "lookups": self.lookups,
+            "insertions": self.insertions,
+        }

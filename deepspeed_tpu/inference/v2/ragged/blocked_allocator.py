@@ -5,7 +5,11 @@ Capability match for the reference's block allocator backing
 a free-list over a fixed pool of KV blocks. Pure host-side bookkeeping
 (numpy); the device never sees this structure, only the block tables
 the scheduler builds from it.
-"""
+
+The free list is a FIFO list (allocation order stays deterministic —
+tests and block-table goldens rely on it) mirrored by a set, so the
+double-free check in ``free()`` is O(1) per block instead of a scan of
+the whole free list (O(free²) per call at pool scale)."""
 
 import numpy as np
 
@@ -17,6 +21,7 @@ class BlockedAllocator:
             raise ValueError(f"need at least 1 block, got {num_blocks}")
         self._num_blocks = num_blocks
         self._free = list(range(num_blocks))
+        self._free_set = set(self._free)
 
     @property
     def free_blocks(self) -> int:
@@ -32,13 +37,19 @@ class BlockedAllocator:
                 f"requested {num_blocks} blocks but only {len(self._free)} free")
         out = self._free[:num_blocks]
         self._free = self._free[num_blocks:]
+        self._free_set.difference_update(out)
         return np.asarray(out, dtype=np.int32)
 
     def free(self, blocks) -> None:
         blocks = [int(b) for b in np.atleast_1d(blocks)]
+        # validate the WHOLE batch (including duplicates within it)
+        # before mutating, so a failed free leaves the list untouched
+        seen = set()
         for b in blocks:
             if b < 0 or b >= self._num_blocks:
                 raise ValueError(f"invalid block id {b}")
-            if b in self._free:
+            if b in self._free_set or b in seen:
                 raise ValueError(f"double free of block {b}")
+            seen.add(b)
         self._free.extend(blocks)
+        self._free_set.update(blocks)

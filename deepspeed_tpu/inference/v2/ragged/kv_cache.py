@@ -18,6 +18,13 @@ from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 NULL_BLOCK = 0
 
 
+class KVCacheHandleError(ValueError):
+    """An offload handle does not match this pool's layout — raised on
+    the host BEFORE the jitted scatter, instead of a shape/dtype blow-up
+    inside compiled code (whose error points at XLA internals, not at
+    the corrupt handle)."""
+
+
 class BlockedKVCache:
 
     def __init__(self, num_layers, num_blocks, block_size, n_kv_heads, head_dim,
@@ -43,7 +50,8 @@ class BlockedKVCache:
         return self._allocator.allocate(num_blocks)
 
     def free(self, blocks):
-        if len(blocks):
+        blocks = list(blocks)  # any iterable, generators included
+        if blocks:
             self._allocator.free(blocks)
 
     def bytes(self) -> int:
@@ -54,21 +62,57 @@ class BlockedKVCache:
     # raises NotImplementedError, kv_cache.py:166/176 "Offloading is not
     # yet supported"; here it is real — vLLM-style sequence swapping)
     # ------------------------------------------------------------------
-    def offload(self, blocks):
+    def offload(self, blocks, keep=()):
         """Move ``blocks``' KV to host memory and free them for reuse.
-        → opaque handle for :meth:`restore`."""
-        blocks = list(blocks)
+        → opaque handle for :meth:`restore`. Blocks listed in ``keep``
+        are copied into the handle but NOT freed — the prefix-cache
+        suspend path, where a shared prefix block stays owned by the
+        radix trie while the suspended sequence carries its own copy."""
+        blocks = [int(b) for b in blocks]
+        for b in blocks:
+            if b < 0 or b >= self.num_blocks:
+                raise KVCacheHandleError(f"invalid block id {b} for a "
+                                         f"{self.num_blocks}-block pool")
         ids = jnp.asarray(blocks, jnp.int32)
         k_host, v_host = jax.device_get((jnp.take(self.k, ids, axis=1),
                                          jnp.take(self.v, ids, axis=1)))
-        self.free(blocks)
+        keep = {int(b) for b in keep}
+        self.free(b for b in blocks if b not in keep)
         return {"k": k_host, "v": v_host}
+
+    def _validate_handle(self, handle):
+        """Shape/dtype-check an offload handle against the pool layout
+        (raises :class:`KVCacheHandleError`) so corruption surfaces as a
+        typed host error, never inside the jitted scatter."""
+        if not isinstance(handle, dict) or "k" not in handle or "v" not in handle:
+            raise KVCacheHandleError("offload handle must be a dict with "
+                                     "'k' and 'v' arrays")
+        k, v = handle["k"], handle["v"]
+        want = (self.num_layers, None, self.block_size, self.n_kv_heads,
+                self.head_dim)
+        for name, arr in (("k", k), ("v", v)):
+            shape = getattr(arr, "shape", None)
+            if shape is None or len(shape) != 5 or any(
+                    w is not None and s != w for s, w in zip(shape, want)):
+                raise KVCacheHandleError(
+                    f"handle['{name}'] shape {shape} does not match pool "
+                    f"layout [num_layers={self.num_layers}, n, "
+                    f"block_size={self.block_size}, n_kv_heads="
+                    f"{self.n_kv_heads}, head_dim={self.head_dim}]")
+            if jnp.dtype(arr.dtype) != jnp.dtype(self.dtype):
+                raise KVCacheHandleError(
+                    f"handle['{name}'] dtype {arr.dtype} does not match "
+                    f"pool dtype {jnp.dtype(self.dtype).name}")
+        if k.shape != v.shape:
+            raise KVCacheHandleError(
+                f"handle k/v shapes disagree: {k.shape} vs {v.shape}")
 
     def restore(self, handle):
         """Bring offloaded KV back into freshly reserved blocks (ids may
         differ from the original ones — callers re-point their block
         tables). The pool arrays are donated through the jitted scatter,
         so the update is in place, not a second pool copy."""
+        self._validate_handle(handle)
         n = handle["k"].shape[1]
         blocks = self.reserve(n)
         ids = jnp.asarray(blocks, jnp.int32)

@@ -20,6 +20,13 @@ class DSSequenceDescriptor:
         self.seen_tokens = 0  # tokens already written to the KV cache
         self.blocks = []  # owned KV block ids, in order
         self.in_flight_tokens = 0
+        # ---- prefix-cache bookkeeping (zero/empty when caching is off) ----
+        self.cached_tokens = 0   # leading tokens whose KV came from the cache
+        self.shared_blocks = 0   # leading blocks owned by the radix trie
+        # token ids written to the KV cache, in order (== KV content over
+        # [0, seen_tokens)); the engine records these only when a prefix
+        # cache is attached, so retire can content-address the blocks
+        self.tokens = []
 
     @property
     def cur_allocated_blocks(self) -> int:

@@ -21,6 +21,10 @@ class Request:
         self.max_new_tokens = max_new_tokens
         self.priority = int(priority)  # larger = scheduled first
         self.prefill_cursor = 0  # prompt tokens already scheduled
+        # radix prefix cache: leading prompt tokens whose KV was reused
+        # from the cache (prefill skips them — the cursor starts there)
+        self.prefix_cached_tokens = 0
+        self.prefix_checked = False
         self.generated = []
         self.next_token = None  # decode token awaiting scheduling
         self.done = False
@@ -167,6 +171,16 @@ class DynamicSplitFuseScheduler:
             if budget <= 0 or len(uids) >= max_seqs:
                 break
             if r.prefilling and r.uid not in uids:
+                if not r.prefix_checked:
+                    # first time this request is scheduled: ask the engine
+                    # for its longest cached prompt prefix — prefill then
+                    # starts at the first uncached token (batch positions
+                    # follow the descriptor's seen_tokens automatically)
+                    r.prefix_checked = True
+                    match = getattr(self.engine, "prefix_match", None)
+                    if match is not None and r.prefill_cursor == 0:
+                        r.prefix_cached_tokens = int(match(r.uid, r.prompt))
+                        r.prefill_cursor = r.prefix_cached_tokens
                 take = min(budget, len(r.prompt) - r.prefill_cursor)
                 chunk = r.prompt[r.prefill_cursor:r.prefill_cursor + take]
                 r.prefill_cursor += take

@@ -69,7 +69,12 @@ class CapacityGate:
 
     def __init__(self, engine, token_budget):
         self.block_size = int(engine.block_size)
-        self.usable_blocks = int(engine.free_blocks)
+        # evictable prefix-cache blocks are RECLAIMABLE capacity: the
+        # allocator takes them back (LRU) on demand, so a warm cache must
+        # not shrink what admission believes the pool can hold — caching
+        # trades idle space for hits, never admission headroom
+        self.usable_blocks = int(engine.free_blocks) + \
+            int(getattr(engine, "evictable_blocks", 0))
         self.max_ctx_tokens = int(engine.max_ctx_tokens)
         self.max_tracked = int(engine.state_manager.max_tracked_sequences)
         self.token_budget = int(token_budget)

@@ -336,6 +336,9 @@ class ServingGateway:
             kv_free_blocks=int(self.engine.free_blocks),
             kv_occupancy=round(1.0 - self.engine.free_blocks /
                                max(self.gate.usable_blocks, 1), 4))
+        prefix_cache = getattr(self.engine, "prefix_cache", None)
+        if prefix_cache is not None:
+            self.metrics.set_external("Serve/PrefixCache", prefix_cache.stats())
         interval = self.config.metrics_interval_steps
         if self.monitor is not None and interval and did:
             steps = self.metrics.snapshot()["counters"]["engine_steps"]

@@ -76,6 +76,9 @@ class ServingMetrics:
         # gauges (last observed; *_peak are high-water marks)
         self._gauges = {"queue_depth": 0, "queue_depth_peak": 0, "running": 0,
                         "paused": 0, "kv_free_blocks": 0, "kv_occupancy": 0.0}
+        # external gauge groups published under their own tag prefix
+        # (e.g. "Serve/PrefixCache" -> {"hit_rate": ..., ...})
+        self._external = {}
 
     # ---------------------------------------------------------------- events
     def count(self, name, n=1):
@@ -103,6 +106,13 @@ class ServingMetrics:
         with self._lock:
             self._gauges[name] = max(self._gauges.get(name, 0), value)
 
+    def set_external(self, tag_prefix, values):
+        """Publish a subsystem's gauge dict under its own tag prefix —
+        events come out as ``{tag_prefix}/{key}`` (the prefix-cache
+        surface: ``Serve/PrefixCache/{hit_rate,tokens_saved,...}``)."""
+        with self._lock:
+            self._external[tag_prefix] = dict(values)
+
     # ---------------------------------------------------------------- export
     def snapshot(self):
         """Plain-dict view of everything (tests / CLI / debugging)."""
@@ -110,6 +120,7 @@ class ServingMetrics:
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
+                "external": {p: dict(v) for p, v in self._external.items()},
                 "ttft": self.ttft.to_dict(),
                 "token_latency": self.token_latency.to_dict(),
                 "queue_wait": self.queue_wait.to_dict(),
@@ -124,6 +135,9 @@ class ServingMetrics:
             out.append((f"serving/count/{name}", val, step))
         for name, val in snap["gauges"].items():
             out.append((f"serving/gauge/{name}", val, step))
+        for prefix, vals in snap["external"].items():
+            for name, val in vals.items():
+                out.append((f"{prefix}/{name}", val, step))
         for hist in ("ttft", "token_latency", "queue_wait"):
             for stat in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
                 out.append((f"serving/{hist}/{stat}", snap[hist][stat], step))
