@@ -233,6 +233,10 @@ def bench_serving_v2_ragged():
             max_tracked_sequences=n_req,
             max_context=prompt_len + new_tokens))
     engine = InferenceEngineV2(model=model, config=cfg)
+    # DS_SANITIZE off must add zero overhead: the serving step is a bare
+    # jax.jit, not a checkify wrapper (structural proof -- no wrapper, no cost)
+    assert not engine._sanitize and not getattr(engine._step, "_ds_sanitized", False), \
+        "serving bench must run unsanitized (unset DS_SANITIZE)"
     rng = np.random.RandomState(0)
 
     def run(n, plen, ntok):

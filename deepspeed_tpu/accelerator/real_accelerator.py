@@ -6,7 +6,6 @@ Analogue of the reference's ``accelerator/real_accelerator.py``
 visible, else CPU).
 """
 
-import os
 
 ds_accelerator = None
 
@@ -27,9 +26,10 @@ def get_accelerator():
     if ds_accelerator is not None:
         return ds_accelerator
 
-    accelerator_name = None
-    if "DS_ACCELERATOR" in os.environ:
-        accelerator_name = os.environ["DS_ACCELERATOR"]
+    from deepspeed_tpu.utils.env_registry import env_raw
+
+    accelerator_name = env_raw("DS_ACCELERATOR")
+    if accelerator_name is not None:
         _validate_accelerator(accelerator_name)
 
     if accelerator_name is None:

@@ -28,7 +28,8 @@ import traceback
 
 
 def run_experiment_dir(exp_dir):
-    platform = os.environ.get("DS_FORCE_PLATFORM")
+    from deepspeed_tpu.utils.env_registry import env_raw
+    platform = env_raw("DS_FORCE_PLATFORM")
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)

@@ -26,6 +26,7 @@ import sys
 import time
 from typing import Callable, Optional, Sequence
 
+from deepspeed_tpu.utils.env_registry import env_int
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -34,10 +35,7 @@ def is_elastic_restart():
     (``DS_ELASTIC_RESTART_COUNT`` > 0). The engine's resume path uses
     this to route tag resolution through the nebula manifest validator:
     a crash mid-checkpoint must fall back to the newest intact tag."""
-    try:
-        return int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0")) > 0
-    except ValueError:
-        return False
+    return env_int("DS_ELASTIC_RESTART_COUNT") > 0
 
 
 class DSElasticAgent:

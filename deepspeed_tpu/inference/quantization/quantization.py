@@ -9,13 +9,13 @@ jitted forward consumes the transform's output, so XLA fuses the
 dequant into the first matmul and only the quantized bytes live in HBM."""
 
 import math
-import os
 import re
 
 import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.zero.partitioning import path_tree_map
+from deepspeed_tpu.utils.env_registry import env_bool
 
 
 from flax.core import meta as flax_meta
@@ -202,7 +202,7 @@ def fused_qmm_enabled():
     """Fused dequant-matmul toggle (env ``DS_FUSED_QMM``, default on).
     Read at trace time — flip it and retrace to A/B the unbox path
     (bench.py's fused-vs-unbox lanes do exactly that)."""
-    return os.environ.get("DS_FUSED_QMM", "1").lower() not in ("0", "false", "off")
+    return env_bool("DS_FUSED_QMM")
 
 
 def matmul_any(x, w, dtype=None):

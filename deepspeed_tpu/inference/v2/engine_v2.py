@@ -19,6 +19,7 @@ from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
 from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.sanitize import maybe_checkify_jit, sanitize_enabled
 
 
 from deepspeed_tpu.inference.sampling import sample_spec_key as _sample_key
@@ -168,6 +169,11 @@ class InferenceEngineV2:
         mesh = self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
         quantized = self._quantized
+        # DS_SANITIZE sampled ONCE at construction: when off every step
+        # below is a plain jax.jit (identical HLO); when on the steps are
+        # checkified (NaN/Inf + OOB-gather checks in the traced forward).
+        self._sanitize = sanitize_enabled()
+        sanitize = self._sanitize
 
         ms, mb = self.max_seqs, self.max_blocks_per_seq
 
@@ -189,7 +195,8 @@ class InferenceEngineV2:
             return ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
                                   attn_impl=attn_impl)
 
-        self._step = jax.jit(step, donate_argnums=(1, 2))
+        self._step = maybe_checkify_jit(step, donate_argnums=(1, 2),
+                                        enabled=sanitize)
 
         def step_greedy(p, kc, vc, b):
             logits, kc, vc = step(p, kc, vc, b)
@@ -200,13 +207,15 @@ class InferenceEngineV2:
             # because torch keeps them resident).
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
 
-        self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
+        self._step_greedy = maybe_checkify_jit(step_greedy, donate_argnums=(1, 2),
+                                               enabled=sanitize)
 
         def step_sample(t, k_, p_):
             def fn(p, kc, vc, b, rng):
                 logits, kc, vc = step(p, kc, vc, b)
                 return _sample_tokens(logits, rng, t, k_, p_), kc, vc
-            return jax.jit(fn, donate_argnums=(1, 2))
+            return maybe_checkify_jit(fn, donate_argnums=(1, 2),
+                                      enabled=sanitize)
 
         self._make_step_sample = step_sample
         self._step_sample_fns = {}   # (temperature, top_k, top_p) -> jitted step
@@ -247,7 +256,8 @@ class InferenceEngineV2:
             raise ValueError(f"sample={sample!r}: supported modes are None (logits), "
                              f"'greedy' (on-device argmax), or a sampling dict "
                              f"{{'temperature', 'top_k', 'top_p'}}")
-        batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]
+        # host-side list→array prep on caller-provided tokens, no device sync
+        batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]  # ds-lint: disable=host-sync -- input tokens are host lists, never device arrays
         # Validate the WHOLE batch before touching any sequence state: a
         # mid-loop failure after allocate/advance would leave earlier
         # sequences claiming KV that was never written.
@@ -312,7 +322,7 @@ class InferenceEngineV2:
             fn = self._step_greedy if sample == "greedy" else self._step
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, arrays)
-        return np.asarray(out)[np.asarray(slots)]
+        return np.asarray(out)[np.asarray(slots)]  # ds-lint: disable=host-sync -- THE one intended sync per step: callers consume host tokens/logits
 
     def can_burst(self, batch_uids, k):
         """True when a ``decode_burst(uids, ·, k)`` can reserve KV blocks
@@ -380,7 +390,7 @@ class InferenceEngineV2:
         for i, (desc, tok) in enumerate(zip(descs, batch_tokens)):
             desc.slot = i
             self.state_manager.allocate_for(desc, k)
-            tokens0[i] = int(np.asarray(tok).reshape(-1)[-1])
+            tokens0[i] = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous burst's host copy
             token_seq[i] = i
             pos0[i] = desc.seen_tokens
             tables[i, :len(desc.blocks)] = desc.blocks
@@ -399,7 +409,7 @@ class InferenceEngineV2:
             self._rng, sub = jax.random.split(self._rng)
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, meta, sub)
-        toks = np.asarray(out)[:, :len(batch_uids)]
+        toks = np.asarray(out)[:, :len(batch_uids)]  # ds-lint: disable=host-sync -- THE one intended sync per k-step burst
         if self.prefix_cache is not None:
             # log what the burst actually WROTE to the KV cache: step i
             # writes its input token's KV, so positions [seen, seen+k)
@@ -449,9 +459,11 @@ class InferenceEngineV2:
             return out, kc, vc
 
         if skey is None:
-            return jax.jit(lambda p, kc, vc, meta: burst(p, kc, vc, meta),
-                           donate_argnums=(1, 2))
-        return jax.jit(burst, donate_argnums=(1, 2))
+            return maybe_checkify_jit(lambda p, kc, vc, meta: burst(p, kc, vc, meta),
+                                      donate_argnums=(1, 2),
+                                      enabled=self._sanitize)
+        return maybe_checkify_jit(burst, donate_argnums=(1, 2),
+                                  enabled=self._sanitize)
 
     def _reclaimable_blocks(self):
         """Blocks an allocation can actually obtain right now: the free

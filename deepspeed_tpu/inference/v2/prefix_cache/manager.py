@@ -21,9 +21,11 @@ ownership on top of :class:`BlockedKVCache`/:class:`BlockedAllocator`:
   space for hits — it can never starve live sequences.
 """
 
-import os
+import threading
 
 from deepspeed_tpu.inference.v2.prefix_cache.radix_index import RadixPrefixIndex
+from deepspeed_tpu.utils.env_registry import env_opt_bool
+from deepspeed_tpu.utils.sanitize import check_prefix_index, sanitize_enabled
 
 
 def prefix_cache_enabled(config) -> bool:
@@ -31,9 +33,9 @@ def prefix_cache_enabled(config) -> bool:
     var is set it wins in BOTH directions (``0``/``false``/``off`` force
     the cache off, anything else forces it on); unset defers to
     ``config.enabled``."""
-    env = os.environ.get("DS_PREFIX_CACHE")
-    if env is not None:
-        return env.strip().lower() not in ("0", "", "false", "off", "no")
+    forced = env_opt_bool("DS_PREFIX_CACHE")
+    if forced is not None:
+        return forced
     return bool(getattr(config, "enabled", False))
 
 
@@ -51,6 +53,15 @@ class PrefixCacheManager:
         self.hits = 0
         self.tokens_saved = 0
         self.insertions = 0
+        # the gateway pump thread and client threads (suspend/flush)
+        # both mutate the trie + lease table; RLock because release()
+        # re-enters release_lease()
+        self._lock = threading.RLock()
+        self._sanitize = sanitize_enabled()
+
+    def _check(self):
+        if self._sanitize:
+            check_prefix_index(self.index)
 
     # ------------------------------------------------------------- capacity
     @property
@@ -66,11 +77,13 @@ class PrefixCacheManager:
     def ensure_free(self, num_blocks):
         """Evict unreferenced cached blocks (LRU) until the allocator has
         ``num_blocks`` free, or the trie has nothing left to give."""
-        deficit = num_blocks - self.kv_cache.free_blocks
-        if deficit > 0:
-            freed = self.index.evict(deficit)
-            if freed:
-                self.kv_cache.free(freed)
+        with self._lock:
+            deficit = num_blocks - self.kv_cache.free_blocks
+            if deficit > 0:
+                freed = self.index.evict(deficit)
+                if freed:
+                    self.kv_cache.free(freed)
+            self._check()
 
     def reserve(self, num_blocks):
         """Drop-in for ``BlockedKVCache.reserve`` that reclaims cached
@@ -83,28 +96,32 @@ class PrefixCacheManager:
         """Match ``prompt_tokens``' longest cached block-aligned prefix
         and lease it to ``uid`` (refs held until :meth:`release` /
         :meth:`release_lease`). → ``(block_ids, cached_tokens)``."""
-        if uid in self._leases:
-            raise ValueError(f"sequence {uid} already holds a prefix lease")
-        # never match the WHOLE prompt: the last prompt token must be
-        # recomputed so its logits exist to sample the first new token
-        max_blocks = (len(prompt_tokens) - 1) // self.block_size
-        path = self.index.match(prompt_tokens, max_blocks)
-        self.lookups += 1
-        if not path:
-            return [], 0
-        for node in path:
-            self.index.incref(node)
-        self._leases[uid] = path
-        cached = len(path) * self.block_size
-        self.hits += 1
-        self.tokens_saved += cached
-        return [node.block_id for node in path], cached
+        with self._lock:
+            if uid in self._leases:
+                raise ValueError(f"sequence {uid} already holds a prefix lease")
+            # never match the WHOLE prompt: the last prompt token must be
+            # recomputed so its logits exist to sample the first new token
+            max_blocks = (len(prompt_tokens) - 1) // self.block_size
+            path = self.index.match(prompt_tokens, max_blocks)
+            self.lookups += 1
+            if not path:
+                return [], 0
+            for node in path:
+                self.index.incref(node)
+            self._leases[uid] = path
+            cached = len(path) * self.block_size
+            self.hits += 1
+            self.tokens_saved += cached
+            self._check()
+            return [node.block_id for node in path], cached
 
     def release_lease(self, uid):
         """Drop ``uid``'s prefix refs without inserting anything (the
         suspend path — its blocks are leaving the pool, not retiring)."""
-        for node in self._leases.pop(uid, ()):
-            self.index.decref(node)
+        with self._lock:
+            for node in self._leases.pop(uid, ()):
+                self.index.decref(node)
+            self._check()
 
     def release(self, uid, desc):
         """Retire ``desc``: insert its completed full blocks into the
@@ -112,41 +129,43 @@ class PrefixCacheManager:
         the prefix lease. This REPLACES ``kv_cache.free(desc.blocks)``
         — a shared prefix block is decref'd, never hard-freed."""
         bs = self.block_size
-        # only blocks whose token content was recorded are insertable
-        full = min(desc.seen_tokens, len(desc.tokens)) // bs
-        full = min(full, len(desc.blocks))
-        freed = []
-        node = self.index.root
-        chain = set()
-        for i in range(full):
-            chunk = tuple(int(t) for t in desc.tokens[i * bs:(i + 1) * bs])
-            block = int(desc.blocks[i])
-            existing = self.index.lookup_child(node, chunk)
-            if existing is not None:
-                # content already cached: our copy is redundant unless it
-                # IS the cached block (a leased shared prefix block)
-                if existing.block_id != block:
-                    freed.append(block)
-                node = existing
-                self.index.touch(node)
+        with self._lock:
+            # only blocks whose token content was recorded are insertable
+            full = min(desc.seen_tokens, len(desc.tokens)) // bs
+            full = min(full, len(desc.blocks))
+            freed = []
+            node = self.index.root
+            chain = set()
+            for i in range(full):
+                chunk = tuple(int(t) for t in desc.tokens[i * bs:(i + 1) * bs])
+                block = int(desc.blocks[i])
+                existing = self.index.lookup_child(node, chunk)
+                if existing is not None:
+                    # content already cached: our copy is redundant unless it
+                    # IS the cached block (a leased shared prefix block)
+                    if existing.block_id != block:
+                        freed.append(block)
+                    node = existing
+                    self.index.touch(node)
+                    chain.add(node)
+                    continue
+                if self.max_cached_blocks and \
+                        self.index.num_nodes >= self.max_cached_blocks:
+                    evicted = self.index.evict(1, protect=chain)
+                    if not evicted:
+                        # cache full of referenced blocks: stop chaining here
+                        # (a gap would orphan deeper chunks) and free the rest
+                        freed.extend(int(b) for b in desc.blocks[i:full])
+                        break
+                    freed.extend(evicted)
+                node = self.index.insert_child(node, chunk, block)
                 chain.add(node)
-                continue
-            if self.max_cached_blocks and \
-                    self.index.num_nodes >= self.max_cached_blocks:
-                evicted = self.index.evict(1, protect=chain)
-                if not evicted:
-                    # cache full of referenced blocks: stop chaining here
-                    # (a gap would orphan deeper chunks) and free the rest
-                    freed.extend(int(b) for b in desc.blocks[i:full])
-                    break
-                freed.extend(evicted)
-            node = self.index.insert_child(node, chunk, block)
-            chain.add(node)
-            self.insertions += 1
-        freed.extend(int(b) for b in desc.blocks[full:])
-        self.release_lease(uid)
-        if freed:
-            self.kv_cache.free(freed)
+                self.insertions += 1
+            freed.extend(int(b) for b in desc.blocks[full:])
+            self.release_lease(uid)
+            if freed:
+                self.kv_cache.free(freed)
+            self._check()
 
     # -------------------------------------------------------------- metrics
     def stats(self):

@@ -29,6 +29,7 @@ from deepspeed_tpu.launcher.constants import (EXPORT_ENVS, LOCAL_LAUNCHER, MPICH
 from deepspeed_tpu.launcher.multinode_runner import (LocalRunner, MPICHRunner, OpenMPIRunner,
                                                      PDSHRunner, SSHRunner, SlurmRunner,
                                                      run_commands)
+from deepspeed_tpu.utils.env_registry import env_int, env_str
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -44,9 +45,9 @@ def parse_args(args=None):
     parser.add_argument("--num_nodes", type=int, default=-1,
                         help="limit to the first N hosts")
     parser.add_argument("--master_port", type=int,
-                        default=int(os.environ.get("DS_MASTER_PORT", 29500)))
+                        default=env_int("DS_MASTER_PORT"))
     parser.add_argument("--master_addr", type=str,
-                        default=os.environ.get("DS_MASTER_ADDR", ""))
+                        default=env_str("DS_MASTER_ADDR"))
     parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
                         help=f"{PDSH_LAUNCHER}|{SSH_LAUNCHER}|{OPENMPI_LAUNCHER}|"
                              f"{SLURM_LAUNCHER}|{LOCAL_LAUNCHER}")

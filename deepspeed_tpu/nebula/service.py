@@ -335,6 +335,7 @@ class NebulaCheckpointService:
     # -- writer thread -------------------------------------------------
     def _ensure_thread_locked(self):
         if self._thread is None or not self._thread.is_alive():
+            # ds-lint: disable=thread-shared-state -- _locked contract: every caller already holds self._lock
             self._thread = threading.Thread(target=self._run, name="nebula-writer", daemon=True)
             self._thread.start()
 
@@ -363,7 +364,8 @@ class NebulaCheckpointService:
             self.test_hook(point, detail)
 
     def _execute(self, job):
-        self._stats["saves"] += 1
+        with self._lock:
+            self._stats["saves"] += 1
         rank0 = _is_rank0()
         tag_tmp = os.path.join(job.save_dir, TMP_ROOT, job.tag)
         if rank0:

@@ -17,7 +17,6 @@ interpreter mode on CPU against the XLA references.
 
 import contextlib
 import contextvars
-import os
 
 import jax
 
@@ -48,9 +47,10 @@ def current_manual_axes():
 
 
 def _pallas_enabled() -> bool:
-    env = os.environ.get("DS_PALLAS")
-    if env is not None:
-        return env not in ("0", "false", "False")
+    from deepspeed_tpu.utils.env_registry import env_opt_bool
+    forced = env_opt_bool("DS_PALLAS")
+    if forced is not None:
+        return forced
     return jax.default_backend() == "tpu"
 
 

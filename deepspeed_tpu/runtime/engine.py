@@ -54,6 +54,7 @@ from deepspeed_tpu.runtime.constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, 
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler, has_overflow, scaler_state, update_scale
 from deepspeed_tpu.runtime.zero.partitioning import ZeroShardingPolicy, batch_spec, path_tree_map
+from deepspeed_tpu.utils.env_registry import env_int
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER, FORWARD_GLOBAL_TIMER,
                                        FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER, STEP_MICRO_TIMER, TRAIN_BATCH_TIMER,
@@ -144,8 +145,8 @@ class DeepSpeedEngine:
         self.master_params = None
         self.opt_state = None
         self._initialized = False
-        self._param_rng = jax.random.PRNGKey(int(os.environ.get("DS_SEED", 42)))
-        self._dropout_rng = jax.random.PRNGKey(int(os.environ.get("DS_SEED", 42)) + 1)
+        self._param_rng = jax.random.PRNGKey(env_int("DS_SEED"))
+        self._dropout_rng = jax.random.PRNGKey(env_int("DS_SEED") + 1)
 
         # Precision
         if self.bfloat16_enabled():

@@ -212,7 +212,8 @@ class ServingGateway:
     def start(self):
         if self._pump_thread is not None:
             return
-        self._pump_stop = False
+        with self._state_lock:
+            self._pump_stop = False
         self._pump_thread = threading.Thread(target=self._run, name="ds-serve-pump",
                                              daemon=True)
         self._pump_thread.start()
@@ -270,7 +271,8 @@ class ServingGateway:
 
     def _stop_pump(self):
         thread = self._pump_thread
-        self._pump_stop = True
+        with self._state_lock:
+            self._pump_stop = True
         self._wake.set()
         if thread is not None and thread is not threading.current_thread():
             thread.join(timeout=30)

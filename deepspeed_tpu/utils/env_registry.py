@@ -1,0 +1,195 @@
+"""Central registry for every ``DS_*`` environment knob.
+
+Before this module each subsystem read ``os.environ`` ad hoc with its
+own truthiness rules (``DS_PALLAS`` treated ``""`` as true,
+``DS_FUSED_QMM`` treated it as true, ``DS_PREFIX_CACHE`` as false).
+All reads now route through here so:
+
+- parsing is uniform — the falsy strings are exactly
+  ``{"0", "", "false", "off", "no"}`` (case/whitespace-insensitive);
+- every knob carries a name, default, and description, which powers
+  the ``ds_lint --list-knobs`` docs generator (docs/MIGRATING.md);
+- the ``env-registry`` lint rule can flag any ``DS_*`` read that
+  bypasses the registry.
+
+This module must stay dependency-free (stdlib only): it is imported by
+``deepspeed_tpu.utils.logging`` (which reads ``DS_TPU_LOG_LEVEL``) and
+by ``op_builder`` at build time, so it cannot import anything that
+pulls in jax or the rest of the package.
+"""
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Union
+
+# the ONE truthiness rule; everything else is truthy (including "yes",
+# "on", "2", and arbitrary junk — kill switches err toward "set means on")
+_FALSY = frozenset({"0", "", "false", "off", "no"})
+
+
+def parse_bool(raw: str) -> bool:
+    """Uniform env-string truthiness: falsy iff in ``_FALSY`` after
+    strip+casefold."""
+    return raw.strip().lower() not in _FALSY
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    """One registered ``DS_*`` environment variable."""
+    name: str
+    kind: str  # bool | int | str | optional_bool | optional_str
+    default: Union[bool, int, str, None]
+    description: str
+    consumer: str  # module that reads it — docs/debugging breadcrumb
+
+    def describe_default(self) -> str:
+        if self.kind in ("optional_bool", "optional_str"):
+            return "(unset)"
+        if self.kind == "bool":
+            return "1" if self.default else "0"
+        return str(self.default)
+
+
+_REGISTRY: Dict[str, EnvKnob] = {}
+
+
+def register(name: str, kind: str, default, description: str,
+             consumer: str) -> EnvKnob:
+    if not name.startswith("DS_"):
+        raise ValueError(f"env knob {name!r} must start with DS_")
+    if kind not in ("bool", "int", "str", "optional_bool", "optional_str"):
+        raise ValueError(f"unknown knob kind {kind!r} for {name}")
+    if name in _REGISTRY:
+        raise ValueError(f"env knob {name} registered twice")
+    knob = EnvKnob(name, kind, default, description, consumer)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def get_knob(name: str) -> EnvKnob:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"env knob {name} is not registered; add it to "
+            "deepspeed_tpu/utils/env_registry.py") from None
+
+
+def all_knobs() -> List[EnvKnob]:
+    return sorted(_REGISTRY.values(), key=lambda k: k.name)
+
+
+# ------------------------------------------------------------------ readers
+def env_raw(name: str) -> Optional[str]:
+    """The raw string, or None when unset. The knob must be registered —
+    this is the only accessor that exposes "unset" for the tri-state
+    knobs (``DS_PALLAS``, ``DS_PREFIX_CACHE``)."""
+    get_knob(name)
+    return os.environ.get(name)
+
+
+def env_bool(name: str) -> bool:
+    knob = get_knob(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(knob.default)
+    return parse_bool(raw)
+
+
+def env_opt_bool(name: str) -> Optional[bool]:
+    """Tri-state: None when unset, else uniform truthiness."""
+    raw = env_raw(name)
+    if raw is None:
+        return None
+    return parse_bool(raw)
+
+
+def env_int(name: str) -> int:
+    knob = get_knob(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return int(knob.default)
+    try:
+        return int(raw)
+    except ValueError:
+        return int(knob.default)
+
+
+def env_str(name: str) -> str:
+    knob = get_knob(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return str(knob.default)
+    return raw
+
+
+# ------------------------------------------------------------------- knobs
+# Runtime / training
+register("DS_SEED", "int", 42,
+         "Base PRNG seed for parameter init and dropout streams.",
+         "deepspeed_tpu/runtime/engine.py")
+register("DS_ACCELERATOR", "optional_str", None,
+         "Force the accelerator backend (tpu|cpu); unset auto-detects.",
+         "deepspeed_tpu/accelerator/real_accelerator.py")
+register("DS_TPU_LOG_LEVEL", "str", "info",
+         "Logger level for the framework logger "
+         "(debug|info|warning|error).",
+         "deepspeed_tpu/utils/logging.py")
+
+# Kernels / inference
+register("DS_PALLAS", "optional_bool", None,
+         "Force Pallas TPU kernels on/off; unset auto-enables on the "
+         "TPU backend only.",
+         "deepspeed_tpu/ops/pallas/__init__.py")
+register("DS_FUSED_QMM", "bool", True,
+         "Kill switch for the fused dequant-matmul Pallas kernels in "
+         "quantized serving.",
+         "deepspeed_tpu/inference/quantization/quantization.py")
+register("DS_PREFIX_CACHE", "optional_bool", None,
+         "Kill switch for the radix prefix cache; set it wins in both "
+         "directions, unset defers to the engine config.",
+         "deepspeed_tpu/inference/v2/prefix_cache/manager.py")
+register("DS_SANITIZE", "bool", False,
+         "Enable runtime sanitizers: checkify NaN/OOB checks around "
+         "the v2 model forward plus allocator/prefix-cache invariant "
+         "assertions. Off by default (zero hot-path cost).",
+         "deepspeed_tpu/utils/sanitize.py")
+
+# Launcher / elasticity
+register("DS_MASTER_ADDR", "str", "",
+         "Default master coordinator address for the launcher.",
+         "deepspeed_tpu/launcher/runner.py")
+register("DS_MASTER_PORT", "int", 29500,
+         "Default master coordinator port for the launcher.",
+         "deepspeed_tpu/launcher/runner.py")
+register("DS_ELASTIC_RESTART_COUNT", "int", 0,
+         "Restart ordinal the elastic agent exports into worker "
+         "environments; >0 marks an elastic restart.",
+         "deepspeed_tpu/elasticity/elastic_agent.py")
+register("DS_ELASTIC_ENABLED", "bool", False,
+         "Set by the elastic agent in worker environments when elastic "
+         "training is active.",
+         "deepspeed_tpu/elasticity/elastic_agent.py")
+
+# Autotuning / build
+register("DS_FORCE_PLATFORM", "optional_str", None,
+         "Pin the JAX platform (cpu|tpu) in autotuner experiment "
+         "runners; unset uses the default backend.",
+         "deepspeed_tpu/autotuning/exp_runner.py")
+register("DS_CXX", "optional_str", None,
+         "C++ compiler for op_builder JIT extension builds; unset "
+         "falls back to c++/g++/clang++ on PATH.",
+         "op_builder/builder.py")
+register("DS_BUILD_DIR", "optional_str", None,
+         "Build/cache directory for op_builder JIT extensions; unset "
+         "uses ~/.cache/deepspeed_tpu/ops.",
+         "op_builder/builder.py")
+
+# Test-only
+register("DS_SKIP_MULTIPROC", "bool", False,
+         "Test-only: skip multi-process launcher tests.",
+         "tests/unit/multiprocess")
+register("DS_TEST_CKPT_DIR", "optional_str", None,
+         "Test-only: checkpoint directory handed to multi-process "
+         "checkpoint tests.",
+         "tests/unit/multiprocess")

@@ -10,6 +10,8 @@ import logging
 import os
 import sys
 
+from deepspeed_tpu.utils.env_registry import env_str
+
 log_levels = {
     "debug": logging.DEBUG,
     "info": logging.INFO,
@@ -39,7 +41,8 @@ class LoggerFactory:
 
 
 logger = LoggerFactory.create_logger(
-    name="DeepSpeedTPU", level=log_levels.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+    name="DeepSpeedTPU",
+    level=log_levels.get(env_str("DS_TPU_LOG_LEVEL"), logging.INFO))
 
 
 @functools.lru_cache(None)

@@ -1,0 +1,124 @@
+"""Runtime sanitizers behind the ``DS_SANITIZE`` env knob.
+
+Two layers, both OFF by default with zero hot-path cost:
+
+- device-side: :func:`maybe_checkify_jit` wraps a to-be-jitted function
+  with ``jax.experimental.checkify`` float/index checks (NaN/Inf and
+  out-of-bounds gathers inside the v2 model runner forward). When the
+  flag is off it returns ``jax.jit(fn, ...)`` verbatim, so the lowered
+  HLO is bit-identical to an unsanitized build (asserted by
+  tests/unit/tooling/test_sanitize.py).
+- host-side: the blocked allocator and prefix-cache manager call the
+  ``check_*`` invariant assertions after every mutation — free-list /
+  free-set mirror consistency and refcount-vs-reclaimable accounting
+  in the radix trie. Violations raise typed errors instead of silently
+  corrupting block ownership.
+
+Enablement is sampled once per object construction (engine, allocator,
+manager), not per call, so flipping the env var mid-run does not
+resurrect checks on live objects.
+"""
+
+import jax
+
+from deepspeed_tpu.utils.env_registry import env_bool
+
+
+class SanitizerError(RuntimeError):
+    """Base class for all DS_SANITIZE-raised failures."""
+
+
+class SanitizerNaNError(SanitizerError):
+    """checkify tripped inside a sanitized jitted function (NaN/Inf
+    produced, or an out-of-bounds gather/scatter index)."""
+
+
+class AllocatorCorruptionError(SanitizerError):
+    """BlockedAllocator free-list/free-set mirror disagreement."""
+
+
+class PrefixCacheCorruptionError(SanitizerError):
+    """Radix trie refcount/reclaimable accounting disagreement."""
+
+
+def sanitize_enabled() -> bool:
+    return env_bool("DS_SANITIZE")
+
+
+def maybe_checkify_jit(fn, donate_argnums=(), enabled=None):
+    """``jax.jit`` with optional checkify instrumentation.
+
+    When ``enabled`` is falsy this is EXACTLY ``jax.jit(fn,
+    donate_argnums=...)`` — no wrapper object, no per-call branch, so
+    the sanitizer's off-state cannot perturb the compiled HLO. When
+    enabled, the traced function is checkified with float + index
+    checks and the returned callable resolves the error on host after
+    each call, raising :class:`SanitizerNaNError`.
+    """
+    if enabled is None:
+        enabled = sanitize_enabled()
+    if not enabled:
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    from jax.experimental import checkify
+
+    # checkify preserves the argument signature (only the return value
+    # grows an error prefix), so donation positions carry over
+    checked = jax.jit(
+        checkify.checkify(
+            fn, errors=checkify.float_checks | checkify.index_checks),
+        donate_argnums=donate_argnums)
+
+    def run(*args):
+        err, out = checked(*args)
+        msg = err.get()
+        if msg:
+            raise SanitizerNaNError(msg)
+        return out
+
+    run.__wrapped__ = fn
+    run._ds_sanitized = True
+    return run
+
+
+# ------------------------------------------------------- host invariants
+def check_allocator(alloc) -> None:
+    """Free-list vs free-set mirror: same length, same membership. A
+    disagreement means a double free slipped past (or a free was lost)
+    and block ownership is corrupt."""
+    free, mirror = alloc._free, alloc._free_set
+    if len(free) != len(mirror) or set(free) != mirror:
+        raise AllocatorCorruptionError(
+            f"free-list/free-set mirror out of sync: list has "
+            f"{len(free)} entries, set has {len(mirror)} "
+            f"(symmetric difference: {sorted(set(free) ^ mirror)[:8]})")
+
+
+def check_prefix_index(index) -> None:
+    """Walk the radix trie and re-derive the cached accounting: node
+    count, ref-0 (reclaimable) count, and non-negative refcounts must
+    all match the O(1) counters the hot path maintains."""
+    nodes = 0
+    ref0 = 0
+    stack = [index.root]
+    while stack:
+        node = stack.pop()
+        # children maps chained key -> [RadixNode] collision bucket
+        for bucket in node.children.values():
+            for child in bucket:
+                nodes += 1
+                if child.ref < 0:
+                    raise PrefixCacheCorruptionError(
+                        f"negative refcount {child.ref} on cached block "
+                        f"{child.block_id}")
+                if child.ref == 0:
+                    ref0 += 1
+                stack.append(child)
+    if nodes != index.num_nodes:
+        raise PrefixCacheCorruptionError(
+            f"trie has {nodes} nodes but num_nodes counter says "
+            f"{index.num_nodes}")
+    if ref0 != index.evictable_blocks:
+        raise PrefixCacheCorruptionError(
+            f"trie has {ref0} ref-0 (reclaimable) blocks but the "
+            f"evictable counter says {index.evictable_blocks}")

@@ -25,6 +25,18 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CSRC_DIR = os.path.join(REPO_ROOT, "csrc")
 
 
+def _env_knob(name):
+    """DS_* reads route through the central registry (name/default/docs
+    in deepspeed_tpu/utils/env_registry.py). op_builder must also work
+    standalone before the package is importable, hence the fallback to
+    a plain environ read with the same unset semantics."""
+    try:
+        from deepspeed_tpu.utils.env_registry import env_raw
+        return env_raw(name)
+    except ImportError:
+        return os.environ.get(name)
+
+
 class OpBuilderError(RuntimeError):
     pass
 
@@ -52,7 +64,7 @@ class OpBuilder:
 
     # -- compatibility ------------------------------------------------------
     def compiler(self):
-        return os.environ.get("DS_CXX", shutil.which("g++") or shutil.which("c++"))
+        return _env_knob("DS_CXX") or shutil.which("g++") or shutil.which("c++")
 
     def is_compatible(self, verbose=False):
         if self.compiler() is None:
@@ -64,7 +76,8 @@ class OpBuilder:
 
     # -- build --------------------------------------------------------------
     def build_dir(self):
-        d = os.environ.get("DS_BUILD_DIR", os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops"))
+        d = _env_knob("DS_BUILD_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops")
         os.makedirs(d, exist_ok=True)
         return d
 

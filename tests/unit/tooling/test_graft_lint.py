@@ -1,0 +1,441 @@
+"""graft-lint rule-family tests: each of the five families has a
+positive (seeded violation caught), a negative (idiomatic clean code
+passes), a pragma case, and the baseline mechanism is covered
+end-to-end."""
+
+import json
+import textwrap
+
+import pytest
+
+from tools.graft_lint.linter import (MESH_AXES, FileLinter, Violation,
+                                     lint_file, lint_paths, load_baseline)
+
+
+def lint_src(src, relpath="deepspeed_tpu/somewhere/mod.py"):
+    return FileLinter(relpath, textwrap.dedent(src), relpath=relpath).run()
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------- jit-purity
+class TestJitPurity:
+
+    def test_side_effects_in_decorated_jit(self):
+        vs = lint_src("""
+            import time, random, jax
+
+            @jax.jit
+            def f(x):
+                time.sleep(0.1)
+                random.random()
+                print(x)
+                return x
+        """)
+        assert rules_of(vs) == ["jit-purity"] * 3
+
+    def test_branch_on_traced_param(self):
+        vs = lint_src("""
+            import jax
+
+            @jax.jit
+            def f(x, n):
+                if x > 0:
+                    return x
+                while n:
+                    n = n - 1
+                return n
+        """)
+        assert rules_of(vs) == ["jit-purity"] * 2
+
+    def test_wrapped_not_decorated(self):
+        # jax.jit(fn) / shard_map(fn) call forms mark fn traced too
+        vs = lint_src("""
+            import os, jax
+
+            def step(p, b):
+                lr = os.environ.get("LEARNING_RATE")
+                return p
+
+            _step = jax.jit(step, donate_argnums=(0,))
+        """)
+        assert rules_of(vs) == ["jit-purity"]
+
+    def test_self_mutation_in_traced(self):
+        vs = lint_src("""
+            import jax
+
+            @jax.jit
+            def f(self, x):
+                self.calls += 1
+                return x
+        """)
+        assert rules_of(vs) == ["jit-purity"]
+
+    def test_negative_static_branches_ok(self):
+        # identity/containment tests and closure-var branches are static
+        vs = lint_src("""
+            import jax
+
+            def make(cfg):
+                quantized = cfg.quantized
+
+                def step(p, b, rng=None):
+                    if rng is None:
+                        p = p
+                    if quantized:
+                        p = p
+                    if "moe" in p:
+                        p = p
+                    return p
+
+                return jax.jit(step)
+        """)
+        assert vs == []
+
+    def test_nested_def_params_not_assumed_traced(self):
+        # tree.map callback params are static metadata, not tracers
+        vs = lint_src("""
+            import jax
+
+            @jax.jit
+            def f(p, dims):
+                def gather(leaf, dim):
+                    if dim < 0:
+                        return leaf
+                    return leaf * 2
+                return jax.tree.map(gather, p, dims)
+        """)
+        assert vs == []
+
+    def test_untraced_function_free(self):
+        vs = lint_src("""
+            import time
+
+            def host_fn(x):
+                time.sleep(1)
+                print(x)
+                if x:
+                    return 1
+        """)
+        assert vs == []
+
+    def test_pragma_suppresses(self):
+        vs = lint_src("""
+            import time, jax
+
+            @jax.jit
+            def f(x):
+                time.sleep(1)  # ds-lint: disable=jit-purity -- trace-time warmup, intentional
+                return x
+        """)
+        assert vs == []
+
+
+# ----------------------------------------------------------------- host-sync
+class TestHostSync:
+    REL = "deepspeed_tpu/inference/v2/scheduler.py"
+
+    def test_sync_calls_in_hot_path(self):
+        vs = lint_src("""
+            import numpy as np
+            import jax
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, toks):
+                    a = toks.item()
+                    b = np.asarray(toks)
+                    jax.device_get(toks)
+                    toks.block_until_ready()
+                    c = float(toks)
+                    return a, b, c
+        """, relpath=self.REL)
+        assert rules_of(vs) == ["host-sync"] * 5
+
+    def test_outside_hot_path_free(self):
+        # same calls in a non-registered method: not the decode loop
+        vs = lint_src("""
+            import numpy as np
+
+            class DynamicSplitFuseScheduler:
+                def summarize(self, toks):
+                    return np.asarray(toks).item()
+        """, relpath=self.REL)
+        assert vs == []
+
+    def test_int_and_host_math_allowed(self):
+        # int() on host bookkeeping is the hot path's bread and butter
+        vs = lint_src("""
+            class DynamicSplitFuseScheduler:
+                def _plan(self, r):
+                    budget = int(self.engine.free_blocks)
+                    return min(budget, len(r))
+        """, relpath=self.REL)
+        assert vs == []
+
+    def test_pragma_with_reason(self):
+        vs = lint_src("""
+            import numpy as np
+
+            class DynamicSplitFuseScheduler:
+                def step(self, out):
+                    return np.asarray(out)  # ds-lint: disable=host-sync -- the one sync per step
+        """, relpath=self.REL)
+        assert vs == []
+
+
+# ------------------------------------------------------- thread-shared-state
+class TestThreadSharedState:
+
+    def test_unlocked_write_flagged(self):
+        vs = lint_src("""
+            class ServingGateway:
+                def _stop(self):
+                    self._pump_stop = True
+        """)
+        assert rules_of(vs) == ["thread-shared-state"]
+
+    def test_locked_write_ok(self):
+        vs = lint_src("""
+            class ServingGateway:
+                def _stop(self):
+                    with self._state_lock:
+                        self._pump_stop = True
+        """)
+        assert vs == []
+
+    def test_mutating_call_and_subscript(self):
+        vs = lint_src("""
+            class NebulaCheckpointService:
+                def _execute(self, job):
+                    self._stats["saves"] += 1
+
+                def _enqueue(self, h):
+                    self._pending_job = h
+        """)
+        assert rules_of(vs) == ["thread-shared-state"] * 2
+
+    def test_list_mutator_flagged(self):
+        vs = lint_src("""
+            class ServingGateway:
+                def _request_cancel(self, h):
+                    self._cancels.append(h)
+        """)
+        assert rules_of(vs) == ["thread-shared-state"]
+
+    def test_init_exempt(self):
+        vs = lint_src("""
+            class ServingGateway:
+                def __init__(self):
+                    self._pump_stop = False
+                    self._cancels = []
+        """)
+        assert vs == []
+
+    def test_unregistered_class_and_attr_free(self):
+        vs = lint_src("""
+            class SomethingElse:
+                def poke(self):
+                    self._state = 1
+
+            class ServingGateway:
+                def poke(self):
+                    self._not_shared = 1
+        """)
+        assert vs == []
+
+    def test_registry_matches_mesh_of_real_classes(self):
+        # the registry names real classes — catch silent renames
+        import deepspeed_tpu  # noqa: F401  (package import side effects)
+        from deepspeed_tpu.inference.v2.prefix_cache.manager import \
+            PrefixCacheManager  # noqa: F401
+        from deepspeed_tpu.inference.v2.ragged.blocked_allocator import \
+            BlockedAllocator  # noqa: F401
+        from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401
+        from deepspeed_tpu.nebula.service import \
+            NebulaCheckpointService  # noqa: F401
+        from deepspeed_tpu.serving.gateway import ServingGateway  # noqa: F401
+        from deepspeed_tpu.serving.metrics import ServingMetrics  # noqa: F401
+        from tools.graft_lint.linter import THREAD_SHARED_REGISTRY
+        for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
+                    ServingMetrics, BlockedAllocator, PrefixCacheManager):
+            assert cls.__name__ in THREAD_SHARED_REGISTRY
+
+
+# ------------------------------------------------------------ spec-consistency
+class TestSpecConsistency:
+
+    def test_unknown_axis_flagged(self):
+        vs = lint_src("""
+            from jax.sharding import PartitionSpec as P
+            spec = P("model", None)
+        """)
+        assert rules_of(vs) == ["spec-consistency"]
+        assert "model" in vs[0].message
+
+    def test_declared_axes_ok(self):
+        vs = lint_src("""
+            from jax.sharding import PartitionSpec as P
+            a = P("data", None, ("expert", "tensor"))
+            b = P("pipe", "sequence")
+        """)
+        assert vs == []
+
+    def test_mesh_axes_in_sync_with_topology(self):
+        from deepspeed_tpu.parallel.topology import MESH_AXES as REAL
+        assert tuple(MESH_AXES) == tuple(REAL)
+
+    def test_fp32_literal_leak_in_kernel_dir(self):
+        rel = "deepspeed_tpu/ops/pallas/fixture.py"
+        vs = lint_src("""
+            import jax.numpy as jnp
+            eps = jnp.asarray(1e-6)
+            full = jnp.full((8,), 0.5)
+        """, relpath=rel)
+        assert rules_of(vs) == ["spec-consistency"] * 2
+
+    def test_dtype_given_or_nonliteral_ok(self):
+        rel = "deepspeed_tpu/ops/pallas/fixture.py"
+        vs = lint_src("""
+            import jax.numpy as jnp
+
+            def f(cos, x):
+                a = jnp.asarray(1e-6, jnp.bfloat16)
+                b = jnp.full((8,), 0.5, x.dtype)
+                c = jnp.asarray(cos)          # Name arg: dtype follows input
+                d = jnp.zeros((8, 8), jnp.float32)
+                e = jnp.asarray(True)         # bool literal, not a float leak
+                return a, b, c, d, e
+        """, relpath=rel)
+        assert vs == []
+
+    def test_dtype_rule_scoped_to_kernel_and_model_dirs(self):
+        vs = lint_src("""
+            import jax.numpy as jnp
+            eps = jnp.asarray(1e-6)
+        """, relpath="deepspeed_tpu/runtime/engine_fixture.py")
+        assert vs == []
+
+
+# -------------------------------------------------------------- env-registry
+class TestEnvRegistry:
+
+    def test_direct_reads_flagged(self):
+        vs = lint_src("""
+            import os
+            a = os.environ.get("DS_FOO")
+            b = os.getenv("DS_BAR", "1")
+            c = os.environ["DS_BAZ"]
+            d = "DS_QUX" in os.environ
+        """)
+        assert rules_of(vs) == ["env-registry"] * 4
+
+    def test_non_ds_and_writes_ok(self):
+        vs = lint_src("""
+            import os
+            a = os.environ.get("XLA_FLAGS")
+            os.environ["DS_EXPORTED"] = "1"   # exporting to children is fine
+            env = dict(os.environ)
+            env["DS_CHILD"] = "1"
+        """)
+        assert vs == []
+
+    def test_registry_module_itself_exempt(self):
+        vs = lint_src("""
+            import os
+            raw = os.environ.get("DS_SANITIZE")
+        """, relpath="deepspeed_tpu/utils/env_registry.py")
+        assert vs == []
+
+    def test_registry_parsing_uniform(self):
+        from deepspeed_tpu.utils.env_registry import parse_bool
+        for falsy in ("0", "", "false", "False", "FALSE", "off", "no", " 0 "):
+            assert parse_bool(falsy) is False
+        for truthy in ("1", "true", "on", "yes", "2", "junk"):
+            assert parse_bool(truthy) is True
+
+    def test_all_registered_knobs_have_docs(self):
+        from deepspeed_tpu.utils.env_registry import all_knobs
+        knobs = all_knobs()
+        assert len(knobs) >= 10
+        for k in knobs:
+            assert k.name.startswith("DS_")
+            assert k.description and k.consumer
+
+
+# ------------------------------------------------------------------ baseline
+class TestBaseline:
+
+    def test_baseline_suppresses_by_symbol_not_line(self, tmp_path):
+        src = textwrap.dedent("""
+            class ServingGateway:
+                def _stop(self):
+                    self._pump_stop = True
+        """)
+        f = tmp_path / "gw.py"
+        f.write_text(src)
+        rel = str(f.relative_to(tmp_path))
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"version": 1, "suppressions": [
+            {"rule": "thread-shared-state", "path": rel,
+             "symbol": "ServingGateway._stop"}]}))
+        baseline = load_baseline(str(bl))
+        vs, baselined = lint_paths([str(f)], baseline=baseline,
+                                   root=str(tmp_path))
+        assert vs == [] and baselined == 1
+        # shifting the line must NOT invalidate the entry
+        f.write_text("\n\n\n" + src)
+        vs, baselined = lint_paths([str(f)], baseline=baseline,
+                                   root=str(tmp_path))
+        assert vs == [] and baselined == 1
+
+    def test_baseline_misses_other_symbols(self, tmp_path):
+        f = tmp_path / "gw.py"
+        f.write_text(textwrap.dedent("""
+            class ServingGateway:
+                def _other(self):
+                    self._pump_stop = True
+        """))
+        baseline = {("thread-shared-state", "gw.py", "ServingGateway._stop")}
+        vs, baselined = lint_paths([str(f)], baseline=baseline,
+                                   root=str(tmp_path))
+        assert rules_of(vs) == ["thread-shared-state"] and baselined == 0
+
+    def test_bad_version_rejected(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"version": 99, "suppressions": []}))
+        with pytest.raises(ValueError):
+            load_baseline(str(bl))
+
+
+# ----------------------------------------------------------------------- CLI
+class TestCli:
+
+    def test_exit_codes_and_json(self, tmp_path, capsys):
+        from tools.graft_lint.cli import main
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n@jax.jit\ndef f(x):\n    print(x)\n"
+                       "    return x\n")
+        assert main([str(bad)]) == 1
+        capsys.readouterr()
+        assert main(["--format=json", str(bad)]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["violations"][0]["rule"] == "jit-purity"
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good)]) == 0
+
+    def test_list_knobs_table(self, capsys):
+        from tools.graft_lint.cli import main
+        assert main(["--list-knobs"]) == 0
+        out = capsys.readouterr().out
+        assert "DS_SANITIZE" in out and "DS_FUSED_QMM" in out
+        assert out.startswith("| Variable |")
+
+    def test_violation_fields(self):
+        vs = lint_file("x.py", source="import os\n"
+                       "v = os.environ.get('DS_X')\n", relpath="x.py")
+        assert isinstance(vs[0], Violation)
+        assert vs[0].line == 2 and vs[0].symbol == "<module>"

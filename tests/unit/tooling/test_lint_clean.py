@@ -1,0 +1,28 @@
+"""Tier-1 regression gate: ds_lint must stay clean on deepspeed_tpu/.
+
+A new violation fails this test; fix it, pragma it with a reason, or
+(for pre-existing debt only) add a baseline entry.
+"""
+
+import os
+
+from tools.graft_lint.cli import DEFAULT_BASELINE, REPO_ROOT
+from tools.graft_lint.linter import lint_paths, load_baseline
+
+
+def test_ds_lint_clean_on_package():
+    baseline = (load_baseline(DEFAULT_BASELINE)
+                if os.path.exists(DEFAULT_BASELINE) else set())
+    violations, _ = lint_paths([os.path.join(REPO_ROOT, "deepspeed_tpu")],
+                               baseline=baseline, root=REPO_ROOT)
+    assert violations == [], "\n" + "\n".join(
+        f"{v.path}:{v.line}: [{v.rule}] {v.symbol}: {v.message}"
+        for v in violations)
+
+
+def test_baseline_is_empty_of_new_debt():
+    """The shipped baseline starts empty — intentional keeps use inline
+    pragmas (which carry their reason); baseline entries are reserved
+    for future pre-existing debt during rule tightening."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert baseline == set()

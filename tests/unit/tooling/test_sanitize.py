@@ -1,0 +1,146 @@
+"""DS_SANITIZE runtime sanitizer coverage.
+
+- on: an injected NaN in the v2 forward raises SanitizerNaNError; a
+  forged allocator mirror corruption raises AllocatorCorruptionError; a
+  forged radix-trie refcount skew raises PrefixCacheCorruptionError.
+- off: the same paths are silent and maybe_checkify_jit lowers to HLO
+  byte-identical to a plain jax.jit (zero hot-path cost).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.prefix_cache.manager import PrefixCacheManager
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.utils.sanitize import (AllocatorCorruptionError,
+                                          PrefixCacheCorruptionError,
+                                          SanitizerNaNError,
+                                          check_prefix_index,
+                                          maybe_checkify_jit,
+                                          sanitize_enabled)
+
+
+def small_engine(dtype=jnp.float32):
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import build_llama
+    cfg = RaggedInferenceEngineConfig()
+    cfg.state_manager.max_ragged_batch_size = 64
+    cfg.state_manager.max_ragged_sequence_count = 4
+    cfg.state_manager.max_context = 64
+    cfg.kv_block_size = 8
+    model = build_llama("debug")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return InferenceEngineV2(model=model, config=cfg, params=params,
+                             dtype=dtype)
+
+
+def poison_params(engine):
+    leaves, treedef = jax.tree.flatten(engine.params)
+    leaves[0] = leaves[0].at[...].set(jnp.nan)
+    engine.params = jax.tree.unflatten(treedef, leaves)
+
+
+class TestSanitizeOn:
+
+    def test_flag_parsing(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        assert sanitize_enabled()
+        monkeypatch.setenv("DS_SANITIZE", "0")
+        assert not sanitize_enabled()
+        monkeypatch.delenv("DS_SANITIZE")
+        assert not sanitize_enabled()
+
+    def test_injected_nan_raises_typed_error(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        engine = small_engine()
+        assert engine._sanitize
+        out = engine.put([1], [[5, 6, 7]])   # clean forward passes checks
+        assert np.isfinite(np.asarray(out)).all()
+        poison_params(engine)
+        with pytest.raises(SanitizerNaNError):
+            engine.put([2], [[5, 6, 7]])
+
+    def test_forged_allocator_double_free_mirror(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        alloc = BlockedAllocator(8)
+        blocks = alloc.allocate(4)
+        alloc.free(blocks)
+        # forge the corruption a missed lock/double-free would leave:
+        # the list and its O(1) mirror disagree
+        alloc._free.append(int(blocks[0]))
+        with pytest.raises(AllocatorCorruptionError):
+            alloc.allocate(1)
+
+    def test_forged_refcount_skew_in_trie(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        from deepspeed_tpu.inference.v2.prefix_cache.radix_index import \
+            RadixPrefixIndex
+        index = RadixPrefixIndex(2)
+        node = index.insert_child(index.root, (11, 12), block_id=3)
+        check_prefix_index(index)  # consistent: 1 node, ref 0
+        node.ref += 1  # forged: bypasses incref's _ref0 bookkeeping
+        with pytest.raises(PrefixCacheCorruptionError):
+            check_prefix_index(index)
+
+    def test_manager_checks_on_mutation(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+
+        class PoolStub:
+            block_size = 2
+            free_blocks = 64
+
+            def free(self, blocks):
+                pass
+
+        mgr = PrefixCacheManager(PoolStub())
+        node = mgr.index.insert_child(mgr.index.root, (1, 2), block_id=0)
+        node.ref = 5  # forged skew (incref was bypassed)
+        with pytest.raises(PrefixCacheCorruptionError):
+            mgr.acquire("u1", [1, 2, 3])
+
+
+class TestSanitizeOff:
+
+    def test_engine_plain_jit_and_silent(self, monkeypatch):
+        monkeypatch.delenv("DS_SANITIZE", raising=False)
+        engine = small_engine()
+        assert not engine._sanitize
+        # the step is a PLAIN jitted function — no sanitizer wrapper
+        assert not getattr(engine._step, "_ds_sanitized", False)
+        poison_params(engine)
+        out = engine.put([1], [[5, 6, 7]])  # NaN propagates silently
+        assert np.isnan(np.asarray(out)).any()
+
+    def test_allocator_corruption_silent(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "0")
+        alloc = BlockedAllocator(8)
+        blocks = alloc.allocate(4)
+        alloc.free(blocks)
+        alloc._free.append(int(blocks[0]))
+        alloc.allocate(1)  # no sanitizer, no error
+
+    def test_hlo_unchanged(self, monkeypatch):
+        """maybe_checkify_jit with the flag off must lower to exactly
+        the HLO of a bare jax.jit — the sanitizer's off-state cannot
+        perturb compiled serving code."""
+        monkeypatch.delenv("DS_SANITIZE", raising=False)
+
+        def f(x, y):
+            return jnp.dot(x, y) / (1.0 + jnp.abs(y).sum())
+
+        x = jnp.ones((8, 8), jnp.float32)
+        plain = jax.jit(f).lower(x, x).as_text()
+        gated = maybe_checkify_jit(f, enabled=False).lower(x, x).as_text()
+        assert gated == plain
+        # and the on-state really does instrument (different program)
+        checked = maybe_checkify_jit(f, enabled=True)
+        assert getattr(checked, "_ds_sanitized", False)
+        assert np.allclose(checked(x, x), plain_out(f, x))
+
+
+def plain_out(f, x):
+    return jax.jit(f)(x, x)

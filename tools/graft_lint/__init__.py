@@ -1,0 +1,24 @@
+"""graft-lint: AST-based TPU-hazard static analysis for deepspeed_tpu.
+
+Five rule families (docs/LINTING.md has the catalog):
+
+- jit-purity          Python side effects / traced-value branching
+                      inside jit/pjit/shard_map/Pallas-traced functions
+- host-sync           device→host synchronization in serving hot paths
+- thread-shared-state unlocked writes to attributes shared across
+                      threads (registry of known multi-thread classes)
+- spec-consistency    PartitionSpec axis names vs the declared mesh
+                      axes; fp32-constant dtype leaks in kernel/model code
+- env-registry        DS_* env reads bypassing utils/env_registry.py
+
+Suppression is either an inline pragma
+``# ds-lint: disable=<rule>[,<rule>] -- reason`` on the offending line
+(preferred — carries its reason in the code) or a baseline entry in
+``tools/graft_lint/baseline.json`` (for pre-existing debt only).
+"""
+
+from tools.graft_lint.linter import (MESH_AXES, RULES, Violation, lint_file,
+                                     lint_paths, load_baseline)
+
+__all__ = ["MESH_AXES", "RULES", "Violation", "lint_file", "lint_paths",
+           "load_baseline"]

@@ -1,0 +1,88 @@
+"""ds_lint command line: lint deepspeed_tpu/ for TPU hazards.
+
+Exit codes: 0 clean, 1 violations, 2 usage/internal error. ``--format
+json`` emits a machine-readable report for CI; ``--list-knobs`` prints
+the DS_* env-knob table from utils/env_registry.py (markdown) instead
+of linting.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+from tools.graft_lint.linter import RULES, lint_paths, load_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def _load_env_registry():
+    """Load utils/env_registry.py straight from its file — the module
+    is stdlib-only by contract, and loading it this way keeps ds_lint
+    runnable without importing the jax-heavy package __init__."""
+    path = os.path.join(REPO_ROOT, "deepspeed_tpu", "utils",
+                        "env_registry.py")
+    spec = importlib.util.spec_from_file_location("_ds_env_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def format_knobs_markdown():
+    reg = _load_env_registry()
+    lines = ["| Variable | Type | Default | Description |",
+             "|---|---|---|---|"]
+    for k in reg.all_knobs():
+        lines.append(f"| `{k.name}` | {k.kind} | `{k.describe_default()}` "
+                     f"| {k.description} (read by `{k.consumer}`) |")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_lint",
+        description="TPU-hazard static analysis for deepspeed_tpu "
+                    f"(rules: {', '.join(RULES)})")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: deepspeed_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON (default: tools/graft_lint/"
+                             "baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report baselined violations too")
+    parser.add_argument("--list-knobs", action="store_true",
+                        help="print the DS_* env knob table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_knobs:
+        print(format_knobs_markdown())
+        return 0
+
+    paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu")]
+    baseline = set()
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = load_baseline(args.baseline)
+    violations, baselined = lint_paths(paths, baseline=baseline,
+                                       root=REPO_ROOT)
+
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [v._asdict() for v in violations],
+            "baselined": baselined,
+        }, indent=2))
+    else:
+        for v in violations:
+            print(f"{v.path}:{v.line}:{v.col}: [{v.rule}] {v.symbol}: "
+                  f"{v.message}")
+        note = f" ({baselined} baselined)" if baselined else ""
+        print(f"ds_lint: {len(violations)} violation(s){note}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

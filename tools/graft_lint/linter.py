@@ -1,0 +1,555 @@
+"""The graft-lint analysis core: pure-AST, no jax import.
+
+Each rule family is a method suite on :class:`FileLinter`; a file is
+parsed once and every rule walks the same tree. Violations carry a
+``symbol`` (dotted class.function scope) so baseline entries survive
+line-number drift.
+"""
+
+import ast
+import json
+import os
+from collections import namedtuple
+
+# Rule ids ----------------------------------------------------------------
+JIT_PURITY = "jit-purity"
+HOST_SYNC = "host-sync"
+THREAD_SHARED = "thread-shared-state"
+SPEC_CONSISTENCY = "spec-consistency"
+ENV_REGISTRY = "env-registry"
+RULES = (JIT_PURITY, HOST_SYNC, THREAD_SHARED, SPEC_CONSISTENCY,
+         ENV_REGISTRY)
+
+# Must mirror deepspeed_tpu/parallel/topology.py MESH_AXES — the linter
+# cannot import the package (no jax at lint time); a unit test asserts
+# the two stay in sync.
+MESH_AXES = ("pipe", "data", "expert", "sequence", "tensor")
+
+Violation = namedtuple("Violation", "rule path line col symbol message")
+
+# ------------------------------------------------------------------ config
+# Names whose call wraps a function for tracing (the first positional
+# argument, or the decorated function).
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call",
+                 "shard_map_kernel", "maybe_checkify_jit", "checkify"}
+
+# host-sync scope: file suffix -> traced-hot-path qualnames. These are
+# the serving paths where one stray sync serializes the pipeline.
+_HOT_PATHS = {
+    "inference/v2/scheduler.py": {
+        "DynamicSplitFuseScheduler._plan",
+        "DynamicSplitFuseScheduler._try_burst",
+        "DynamicSplitFuseScheduler.step",
+    },
+    "serving/gateway.py": {
+        "ServingGateway._pump_once",
+        "ServingGateway._admit",
+        "ServingGateway._step",
+        "ServingGateway._process_cancels",
+        "ServingGateway._process_deadlines",
+        "ServingGateway._resume_paused",
+        "ServingGateway._on_token",
+    },
+    "inference/v2/engine_v2.py": {
+        "InferenceEngineV2.put",
+        "InferenceEngineV2.decode_burst",
+    },
+}
+
+# Calls that force a device→host sync (or a host copy of device data).
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready",
+                "np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+# float()/bool() on an array force a sync; int() is deliberately NOT
+# flagged — the hot paths do int() on host-side allocator bookkeeping
+# constantly, and int() on a device array shows up via the np.* /
+# .item() patterns above anyway.
+_SYNC_BUILTINS = {"float", "bool"}
+
+# thread-shared-state registry: class -> attributes mutated by more
+# than one thread. Writes outside ``with self.<*lock*>:`` are flagged
+# (``__init__`` is exempt — the object is not yet published).
+THREAD_SHARED_REGISTRY = {
+    "ServingGateway": {"_cancels", "_state", "_pump_stop"},
+    "NebulaCheckpointService": {"_pending_job", "_failure", "_last_persist",
+                                "_stats", "_thread"},
+    "MonitorMaster": {"backends"},
+    "ServingMetrics": {"_counters", "_gauges", "_external"},
+    "BlockedAllocator": {"_free", "_free_set"},
+    "PrefixCacheManager": {"_leases", "lookups", "hits", "tokens_saved",
+                           "insertions"},
+}
+
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "update", "add", "discard", "setdefault", "popitem",
+             "difference_update", "appendleft"}
+
+# spec-consistency dtype-leak scope (fp32 Python constants materialized
+# as arrays in bf16 arithmetic): kernel and model code only.
+_DTYPE_DIRS = ("ops/pallas/", "models/")
+_JNP_CTORS = {"jnp.array": 2, "jnp.asarray": 2, "jnp.ones": 2,
+              "jnp.zeros": 2, "jnp.full": 3}  # value -> positional arity
+#  with dtype
+
+
+# ----------------------------------------------------------------- helpers
+def _dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last(dotted):
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def _self_attr(node):
+    """'attr' when node is ``self.attr`` (unwrapping subscripts:
+    ``self.attr[k]`` → 'attr'), else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _has_float_literal(node):
+    """True when node is/contains a non-bool float constant (the thing
+    that silently materializes as fp32)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp):
+        return _has_float_literal(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return any(_has_float_literal(e) for e in node.elts)
+    return False
+
+
+def _parse_pragmas(source):
+    """line -> set of disabled rule names ('all' disables everything).
+    A pragma on its own line applies to the next line too."""
+    pragmas = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        idx = text.find("# ds-lint:")
+        if idx < 0:
+            continue
+        body = text[idx + len("# ds-lint:"):]
+        body = body.split("--", 1)[0]  # strip the reason
+        body = body.strip()
+        if not body.startswith("disable="):
+            continue
+        rules = {r.strip() for r in body[len("disable="):].split(",") if r.strip()}
+        pragmas.setdefault(lineno, set()).update(rules)
+        if text[:idx].strip() == "":  # standalone pragma line
+            pragmas.setdefault(lineno + 1, set()).update(rules)
+    return pragmas
+
+
+def load_baseline(path):
+    """tools/graft_lint/baseline.json → set of (rule, path, symbol)
+    triples. Line numbers are deliberately not part of the key."""
+    with open(path) as fd:
+        data = json.load(fd)
+    if data.get("version") != 1:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return {(e["rule"], e["path"], e.get("symbol", "")) for e in
+            data.get("suppressions", ())}
+
+
+# --------------------------------------------------------------- the pass
+class FileLinter:
+
+    def __init__(self, path, source, relpath=None):
+        self.path = path
+        # rule scoping matches on /-separated relative paths
+        self.relpath = (relpath or path).replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.violations = []
+        # parent / scope bookkeeping filled by _annotate
+        self._parents = {}
+        self._qualnames = {}
+        self._traced = set()  # FunctionDef/Lambda nodes traced by jit
+        self._annotate()
+
+    # -- tree annotation ---------------------------------------------------
+    def _annotate(self):
+        defs_by_name = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+        # dotted scope names
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                parts = [node.name]
+                p = self._parents.get(node)
+                while p is not None:
+                    if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                        parts.append(p.name)
+                    p = self._parents.get(p)
+                self._qualnames[node] = ".".join(reversed(parts))
+
+        # traced functions: decorated with a jit wrapper, or passed as
+        # the first argument to one
+        roots = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _last(_dotted(target)) in _JIT_WRAPPERS:
+                        roots.add(node)
+            if isinstance(node, ast.Call) and \
+                    _last(_dotted(node.func)) in _JIT_WRAPPERS and node.args:
+                wrapped = node.args[0]
+                if isinstance(wrapped, ast.Lambda):
+                    roots.add(wrapped)
+                elif isinstance(wrapped, ast.Name):
+                    for d in defs_by_name.get(wrapped.id, ()):
+                        roots.add(d)
+        # everything defined inside a traced function traces with it
+        for root in roots:
+            for sub in ast.walk(root):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    self._traced.add(sub)
+        self._traced |= roots
+        self._traced_roots = roots
+
+    def _qualname(self, node):
+        return self._qualnames.get(node, "<module>")
+
+    def _enclosing_symbol(self, node):
+        p = node
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                return self._qualname(p)
+            p = self._parents.get(p)
+        return "<module>"
+
+    def _emit(self, rule, node, message):
+        self.violations.append(Violation(
+            rule=rule, path=self.relpath, line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            symbol=self._enclosing_symbol(node), message=message))
+
+    # -- rule 1: jit-purity ------------------------------------------------
+    def check_jit_purity(self):
+        for fn in self._traced:
+            # Only the ROOT traced function's params are definitely
+            # tracers. Nested-def params are often static metadata bound
+            # through jax.tree.map (partition dims, config), so the
+            # branch check stays root-only; side-effect checks apply to
+            # the whole traced subtree.
+            params = set()
+            if fn in self._traced_roots:
+                args = fn.args
+                for a in (args.posonlyargs + args.args + args.kwonlyargs
+                          + ([args.vararg] if args.vararg else [])
+                          + ([args.kwarg] if args.kwarg else [])):
+                    params.add(a.arg)
+                params.discard("self")
+            for node in ast.walk(fn):
+                if node is fn:
+                    continue
+                # nested defs/lambdas are traced too and get their own
+                # iteration — only check nodes fn directly owns
+                if self._owner_fn(node) is not fn:
+                    continue
+                self._check_purity_node(fn, node, params)
+
+    def _owner_fn(self, node):
+        p = self._parents.get(node)
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return p
+            p = self._parents.get(p)
+        return None
+
+    def _check_purity_node(self, fn, node, params):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            root = dotted.split(".", 1)[0] if dotted else None
+            if root in ("time", "random") or (
+                    dotted and dotted.startswith(("np.random.",
+                                                  "numpy.random."))):
+                self._emit(JIT_PURITY, node,
+                           f"call to {dotted}() inside a traced function "
+                           f"runs at TRACE time only (or reorders under "
+                           f"compilation) — hoist it out of the jitted "
+                           f"region")
+            elif dotted == "print":
+                self._emit(JIT_PURITY, node,
+                           "print() inside a traced function fires at "
+                           "trace time only; use jax.debug.print")
+            elif dotted == "os.getenv":
+                self._emit(JIT_PURITY, node,
+                           "os.getenv inside a traced function is a "
+                           "trace-time constant; read it before tracing")
+        if isinstance(node, ast.Attribute) and \
+                _dotted(node) == "os.environ":
+            self._emit(JIT_PURITY, node,
+                       "os.environ inside a traced function is a "
+                       "trace-time constant; read it before tracing")
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    if _self_attr(el) is not None:
+                        self._emit(JIT_PURITY, node,
+                                   f"mutation of self.{_self_attr(el)} "
+                                   f"inside a traced function happens at "
+                                   f"trace time, not per call")
+        if isinstance(node, (ast.If, ast.While)):
+            if self._branches_on_param(node.test, params):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self._emit(JIT_PURITY, node,
+                           f"Python `{kind}` on a traced argument forces "
+                           f"concretization (TracerBoolConversionError at "
+                           f"runtime); use lax.cond/jnp.where")
+
+    def _branches_on_param(self, test, params):
+        """Bare-name truthiness / value comparison on a traced parameter.
+        Identity and containment checks (``is None``, ``in``) are static
+        pytree-structure tests and stay legal."""
+        if isinstance(test, ast.Name):
+            return test.id in params
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branches_on_param(test.operand, params)
+        if isinstance(test, ast.BoolOp):
+            return any(self._branches_on_param(v, params) for v in test.values)
+        if isinstance(test, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in test.ops):
+                return False
+            return any(isinstance(e, ast.Name) and e.id in params
+                       for e in [test.left] + test.comparators)
+        return False
+
+    # -- rule 2: host-sync -------------------------------------------------
+    def check_host_sync(self):
+        hot = None
+        for suffix, names in _HOT_PATHS.items():
+            if self.relpath.endswith(suffix):
+                hot = names
+                break
+        if hot is None:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if self._qualname(node) not in hot:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                if isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _SYNC_ATTRS:
+                    self._emit(HOST_SYNC, sub,
+                               f".{sub.func.attr}() in a serving hot path "
+                               f"blocks on the device — keep this path "
+                               f"async")
+                elif dotted in _SYNC_DOTTED:
+                    self._emit(HOST_SYNC, sub,
+                               f"{dotted}() in a serving hot path copies "
+                               f"device data to host (implicit sync)")
+                elif dotted in _SYNC_BUILTINS and sub.args and isinstance(
+                        sub.args[0], (ast.Name, ast.Attribute, ast.Subscript)):
+                    self._emit(HOST_SYNC, sub,
+                               f"{dotted}() on an array in a serving hot "
+                               f"path forces a device sync")
+
+    # -- rule 3: thread-shared-state --------------------------------------
+    def check_thread_shared(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = THREAD_SHARED_REGISTRY.get(node.name)
+            if not attrs:
+                continue
+            for method in node.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__":
+                    continue  # not yet published to other threads
+                self._check_method_writes(method, attrs)
+
+    def _check_method_writes(self, method, attrs):
+        for node in ast.walk(method):
+            written = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                        a = _self_attr(el)
+                        if a in attrs:
+                            written = a
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                a = _self_attr(node.func.value)
+                if a in attrs:
+                    written = a
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a in attrs:
+                        written = a
+            if written is not None and not self._under_lock(node):
+                self._emit(THREAD_SHARED, node,
+                           f"write to shared self.{written} outside a "
+                           f"`with self.<lock>:` block "
+                           f"(class is touched by multiple threads)")
+
+    def _under_lock(self, node):
+        p = self._parents.get(node)
+        while p is not None:
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call):
+                        ctx = ctx.func  # e.g. self._lock.acquire_timeout()
+                    d = _dotted(ctx)
+                    if d and d.startswith("self.") and "lock" in d.lower():
+                        return True
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break  # don't credit an outer function's lock
+            p = self._parents.get(p)
+        return False
+
+    # -- rule 4: spec-consistency ------------------------------------------
+    def check_spec_consistency(self):
+        spec_ctors = {"PartitionSpec"}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "PartitionSpec" and alias.asname:
+                        spec_ctors.add(alias.asname)
+        allowed = set(MESH_AXES)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last(_dotted(node.func))
+            if name in spec_ctors:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    for el in (arg.elts if isinstance(arg, (ast.Tuple,
+                                                            ast.List))
+                               else [arg]):
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str) and \
+                                el.value not in allowed:
+                            self._emit(SPEC_CONSISTENCY, el,
+                                       f"PartitionSpec axis {el.value!r} is "
+                                       f"not a declared mesh axis "
+                                       f"{MESH_AXES}")
+            if any(self.relpath.rpartition("deepspeed_tpu/")[2]
+                   .startswith(d) for d in _DTYPE_DIRS):
+                dotted = _dotted(node.func)
+                arity = _JNP_CTORS.get(dotted)
+                if arity is not None and len(node.args) < arity and \
+                        not any(kw.arg == "dtype" for kw in node.keywords):
+                    value_args = node.args[-1:] if dotted == "jnp.full" \
+                        else node.args[:1]
+                    if any(_has_float_literal(a) for a in value_args):
+                        self._emit(SPEC_CONSISTENCY, node,
+                                   f"{dotted}() on a float literal without "
+                                   f"dtype= materializes fp32 and promotes "
+                                   f"bf16 arithmetic — pass dtype explicitly")
+
+    # -- rule 5: env-registry ----------------------------------------------
+    def check_env_registry(self):
+        if self.relpath.endswith("utils/env_registry.py"):
+            return
+        for node in ast.walk(self.tree):
+            key = None
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                if dotted in ("os.environ.get", "os.getenv") and node.args:
+                    key = node.args[0]
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _dotted(node.value) == "os.environ":
+                key = node.slice
+            elif isinstance(node, ast.Compare) and \
+                    len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    _dotted(node.comparators[0]) == "os.environ":
+                key = node.left
+            if isinstance(key, ast.Constant) and \
+                    isinstance(key.value, str) and \
+                    key.value.startswith("DS_"):
+                self._emit(ENV_REGISTRY, node,
+                           f"read of {key.value} bypasses "
+                           f"deepspeed_tpu/utils/env_registry.py — use "
+                           f"env_bool/env_int/env_str/env_raw")
+
+    # -- driver ------------------------------------------------------------
+    def run(self):
+        self.check_jit_purity()
+        self.check_host_sync()
+        self.check_thread_shared()
+        self.check_spec_consistency()
+        self.check_env_registry()
+        pragmas = _parse_pragmas(self.source)
+        kept = []
+        for v in self.violations:
+            disabled = pragmas.get(v.line, ())
+            if v.rule in disabled or "all" in disabled:
+                continue
+            kept.append(v)
+        kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        return kept
+
+
+def lint_file(path, source=None, relpath=None):
+    """All unsuppressed-by-pragma violations for one file."""
+    if source is None:
+        with open(path) as fd:
+            source = fd.read()
+    return FileLinter(path, source, relpath=relpath).run()
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths, baseline=None, root=None):
+    """Lint every .py file under ``paths``. → (violations, baselined)
+    where ``baselined`` counts suppressions consumed from the baseline
+    set of (rule, relpath, symbol) triples."""
+    baseline = baseline or set()
+    root = root or os.getcwd()
+    violations, baselined = [], 0
+    for path in _iter_py_files(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        for v in lint_file(path, relpath=rel):
+            if (v.rule, v.path, v.symbol) in baseline:
+                baselined += 1
+                continue
+            violations.append(v)
+    return violations, baselined
