@@ -356,6 +356,110 @@ def bench_serving_2b_prefix(n_req=8, sys_len=512, sfx_len=32, new_tokens=64):
                     "not a wall-clock proxy"}
 
 
+def bench_serving_2b_fleet(n_req=8, prompt_len=256, new_tokens=32):
+    """Fault-tolerant serving fleet on the same ~2.5B model: N=2
+    gateway replicas behind a FleetRouter, a recorded request trace
+    replayed in three phases — (A) healthy, (B) replica 0 KILLED
+    mid-trace with streams in flight, (C) after rolling-restart
+    recovery. The contract being measured: ZERO lost requests (every
+    handle completes or fails typed — asserted, not reported), and the
+    throughput cost of failover + recovery. The two engines share one
+    immutable param tree, so the fleet pays HBM for two KV pools but
+    only one copy of the weights."""
+    import threading
+
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.serving import ServingConfig, ServingError
+    from deepspeed_tpu.serving.fleet import FleetConfig, FleetRouter, GatewayReplica
+
+    groups.destroy_mesh()
+    model = build_llama("7b", hidden_size=3072, intermediate_size=8192,
+                        num_hidden_layers=22, num_attention_heads=24,
+                        num_key_value_heads=8, max_position_embeddings=2048,
+                        vocab_size=32000, remat=False)
+    budget = prompt_len + n_req
+    shared = {}  # one param tree for both replicas (jax arrays are immutable)
+
+    def factory():
+        cfg = RaggedInferenceEngineConfig(
+            kv_block_size=32,
+            state_manager=DSStateManagerConfig(
+                max_ragged_batch_size=budget,
+                max_ragged_sequence_count=n_req,
+                max_tracked_sequences=n_req,
+                max_context=prompt_len + new_tokens))
+        eng = InferenceEngineV2(model=model, config=cfg,
+                                params=shared.get("params"))
+        shared.setdefault("params", eng.params)
+        return eng
+
+    scfg = ServingConfig(token_budget=budget, max_burst=16)
+    r0 = GatewayReplica("r0", factory, serving_config=scfg)
+    r1 = GatewayReplica("r1", factory, serving_config=scfg)
+    router = FleetRouter(
+        [r0, r1],
+        config=FleetConfig(heartbeat_interval_s=0.2, retry_backoff_s=0.05,
+                           stream_token_timeout_s=120.0))
+    rng = np.random.RandomState(0)
+    trace = [rng.randint(0, 32000, size=prompt_len).astype(np.int32)
+             for _ in range(3 * n_req)]
+
+    def run_phase(prompts, kill_replica=None):
+        """Replay one trace slice → (wall_s, completed, typed_failures,
+        lost). ``kill_replica`` dies once the phase has streams open."""
+        handles = [router.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        t0 = time.perf_counter()
+        if kill_replica is not None:
+            while not any(h._collected for h in handles):
+                time.sleep(0.005)
+            kill_replica.kill()
+        completed = typed = lost = 0
+        for h in handles:
+            try:
+                h.result(timeout=600)
+                completed += 1
+            except ServingError:
+                typed += 1
+            except Exception:
+                lost += 1  # hung or untyped — the failure this lane gates
+        return time.perf_counter() - t0, completed, typed, lost
+
+    # warmup compiles both replicas' put/burst programs
+    run_phase(trace[:2])
+    a_dt, a_ok, a_typed, a_lost = run_phase(trace[:n_req])
+    b_dt, b_ok, b_typed, b_lost = run_phase(trace[n_req:2 * n_req],
+                                            kill_replica=r0)
+    recovered = router.restart_replica("r0", timeout=300)
+    c_dt, c_ok, c_typed, c_lost = run_phase(trace[2 * n_req:3 * n_req])
+    lost = a_lost + b_lost + c_lost
+    counters = router.snapshot()["counters"]
+    router.shutdown()
+    assert lost == 0, f"{lost} request(s) neither completed nor failed typed"
+    assert b_ok + b_typed == n_req, "mid-fault phase dropped a request"
+    n_params = _param_count(shared["params"])
+    gen = new_tokens
+    return {"params": n_params, "replicas": 2, "requests_per_phase": n_req,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "lost_requests": lost,
+            "replica_recovered": bool(recovered),
+            "tput_before_tok_s": round(a_ok * gen / a_dt, 1),
+            "tput_during_tok_s": round(b_ok * gen / b_dt, 1),
+            "tput_after_tok_s": round(c_ok * gen / c_dt, 1),
+            "completed": [a_ok, b_ok, c_ok],
+            "typed_failures": [a_typed, b_typed, c_typed],
+            "failovers": counters["failovers"],
+            "retries": counters["retries"],
+            "restarts": counters["restarts"],
+            "note": "N=2 replica fleet, replica 0 killed mid-trace then "
+                    "rolling-restarted; zero-lost is asserted (every request "
+                    "completes on a survivor or fails typed), tput_during "
+                    "shows the failover cost, tput_after the recovery"}
+
+
 def bench_train_long_seq():
     """Long-context training on one chip: the same ~551M model as the
     headline bench at seq 16384 (8x its 2048), micro-batch 1. The Pallas
@@ -703,6 +807,7 @@ def main():
         ("serving_2b_fp6", bench_serving_2b, {"quant_scheme": "fp6"}),
         ("serving_v2_ragged", bench_serving_v2_ragged, {}),
         ("serving_2b_prefix", bench_serving_2b_prefix, {}),
+        ("serving_2b_fleet", bench_serving_2b_fleet, {}),
         ("offload", bench_offload_probe, {}),
         ("checkpoint", bench_checkpoint, {}),
     ]
@@ -780,6 +885,10 @@ def main():
             "serve_ragged_tok_s": _pick("serving_v2_ragged", "gen_tokens_per_sec"),
             "prefix_warm_frac": _pick("serving_2b_prefix", "warm_prefill_frac"),
             "prefix_warm_speedup": _pick("serving_2b_prefix", "warm_vs_cold_speedup"),
+            "fleet_lost_requests": _pick("serving_2b_fleet", "lost_requests"),
+            "fleet_tok_s_before": _pick("serving_2b_fleet", "tput_before_tok_s"),
+            "fleet_tok_s_during_fault": _pick("serving_2b_fleet", "tput_during_tok_s"),
+            "fleet_tok_s_after_recovery": _pick("serving_2b_fleet", "tput_after_tok_s"),
             "ckpt_stall_ratio": _pick("checkpoint", "stall_ratio_async_vs_sync"),
             "full_results": out_path,
         },

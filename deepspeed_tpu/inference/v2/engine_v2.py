@@ -499,6 +499,16 @@ class InferenceEngineV2:
         desc = self.state_manager.get_or_create_sequence(uid, prompt_tokens=prompt)
         return desc.cached_tokens
 
+    def prefix_match_len(self, prompt_tokens):
+        """Read-only twin of :meth:`prefix_match` for placement probes:
+        → leading tokens of ``prompt_tokens`` whose KV is cached, WITHOUT
+        creating a sequence, taking a lease, or touching hit-rate stats.
+        0 when the prefix cache is off."""
+        if self.prefix_cache is None:
+            return 0
+        prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
+        return self.prefix_cache.match_len(prompt)
+
     def query(self, uid):
         """→ (seen_tokens, max_new_before_realloc) parity surface."""
         desc = self.state_manager.query(uid)

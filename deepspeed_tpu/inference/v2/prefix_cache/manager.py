@@ -115,6 +115,18 @@ class PrefixCacheManager:
             self._check()
             return [node.block_id for node in path], cached
 
+    def match_len(self, prompt_tokens):
+        """Read-only probe: how many leading tokens of ``prompt_tokens``
+        this cache already holds. Takes no lease, bumps no refcount and
+        skews no hit-rate stats — the fleet router calls this on every
+        placement decision, and a routing probe must not look like
+        traffic. Capped one token short like :meth:`acquire` (the match
+        an admitted request would actually get)."""
+        with self._lock:
+            max_blocks = (len(prompt_tokens) - 1) // self.block_size
+            return len(self.index.match(prompt_tokens, max_blocks)) * \
+                self.block_size
+
     def release_lease(self, uid):
         """Drop ``uid``'s prefix refs without inserting anything (the
         suspend path — its blocks are leaving the pool, not retiring)."""

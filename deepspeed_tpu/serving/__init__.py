@@ -13,6 +13,10 @@ from deepspeed_tpu.serving.admission import (AdmissionQueue, CapacityGate,
                                              RequestCancelledError, RequestShedError,
                                              RequestTooLargeError, ServingError)
 from deepspeed_tpu.serving.config import ServingConfig, get_serving_config
+from deepspeed_tpu.serving.fleet import (FaultyReplica, FleetConfig,
+                                         FleetRouter, GatewayReplica,
+                                         Replica, ReplicaHealth,
+                                         get_fleet_config)
 from deepspeed_tpu.serving.gateway import RequestHandle, ServingGateway
 from deepspeed_tpu.serving.metrics import ServingMetrics
 
@@ -22,4 +26,6 @@ __all__ = [
     "GatewayClosedError", "GatewayFailedError", "QueueFullError",
     "RequestTooLargeError", "RequestShedError", "RequestCancelledError",
     "DeadlineExceededError",
+    "FleetRouter", "FleetConfig", "get_fleet_config", "Replica",
+    "GatewayReplica", "FaultyReplica", "ReplicaHealth",
 ]

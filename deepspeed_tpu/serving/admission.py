@@ -24,35 +24,76 @@ import time
 
 # ---------------------------------------------------------------------- errors
 class ServingError(RuntimeError):
-    """Base for all gateway-surfaced request errors."""
+    """Base for all gateway-surfaced request errors.
+
+    Every serving error is machine-readable so routing layers (the fleet
+    router) can act on it without string matching:
+
+    - ``reason`` — a stable snake_case identifier for the failure class;
+    - ``retry_elsewhere`` — whether a *different* replica could
+      plausibly serve this request (a full queue here is not a full
+      queue everywhere) or the condition is fleet-wide / terminal
+      (too large for the model, cancelled, deadline blown);
+    - ``details`` — numeric hints attached at the raise site (queue
+      depth, evictable KV blocks, estimated wait) that let a router
+      pick between "retry elsewhere", "back off and retry here", and
+      "shed fleet-wide".
+    """
+
+    reason = "serving_error"
+    retry_elsewhere = False
+
+    def __init__(self, message, **details):
+        super().__init__(message)
+        self.details = details
 
 
 class GatewayClosedError(ServingError):
     """submit() after drain()/shutdown() began."""
+    reason = "gateway_closed"
+    retry_elsewhere = True  # this replica is leaving; peers may accept
 
 
 class QueueFullError(ServingError):
-    """The admission queue is full and the policy could not make room."""
+    """The admission queue is full and the policy could not make room.
+
+    ``details`` carries ``queue_depth`` (entries waiting here) and — when
+    raised through ``ServingGateway.submit`` — ``evictable_blocks`` and
+    ``est_wait_s`` so a router can weigh waiting against rerouting."""
+    reason = "queue_full"
+    retry_elsewhere = True
 
 
 class RequestTooLargeError(ServingError):
-    """The request can never fit this engine's KV pool / context window."""
+    """The request can never fit this engine's KV pool / context window.
+    Fleet-wide shed for homogeneous replicas — retrying elsewhere cannot
+    help."""
+    reason = "too_large"
+    retry_elsewhere = False
 
 
 class RequestShedError(ServingError):
     """This queued request was evicted to admit a higher-priority one."""
+    reason = "shed"
+    retry_elsewhere = True
 
 
 class RequestCancelledError(ServingError):
     """The client cancelled the request before completion."""
+    reason = "cancelled"
+    retry_elsewhere = False
 
 
 class DeadlineExceededError(ServingError):
     """deadline_ms expired before the request completed."""
+    reason = "deadline"
+    retry_elsewhere = False
 
 
 class GatewayFailedError(ServingError):
     """The pump thread died; the engine state is no longer trustworthy."""
+    reason = "gateway_failed"
+    retry_elsewhere = True
 
 
 # ---------------------------------------------------------------- capacity
@@ -96,13 +137,15 @@ class CapacityGate:
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) = "
                 f"{total} tokens exceeds the engine context window "
                 f"({self.max_ctx_tokens}); shorten the prompt or lower "
-                f"max_new_tokens")
+                f"max_new_tokens",
+                total_tokens=total, max_ctx_tokens=self.max_ctx_tokens)
         need = self.footprint(prompt_len, max_new_tokens)
         if need > self.usable_blocks:
             raise RequestTooLargeError(
                 f"request needs {need} KV blocks ({total} tokens at block size "
                 f"{self.block_size}) but the pool only has {self.usable_blocks} "
-                f"— raise num_kv_blocks or shrink the request")
+                f"— raise num_kv_blocks or shrink the request",
+                needed_blocks=need, usable_blocks=self.usable_blocks)
 
     def try_commit(self, prompt_len, max_new_tokens):
         """Reserve the request's footprint; False when it doesn't fit
@@ -166,7 +209,8 @@ class AdmissionQueue:
             if self.policy == "reject":
                 raise QueueFullError(
                     f"admission queue full ({self.max_depth} waiting); retry "
-                    f"later or raise serving.max_queue_depth")
+                    f"later or raise serving.max_queue_depth",
+                    queue_depth=len(self._entries), policy=self.policy)
             if self.policy == "shed":
                 # evict the LOWEST-priority queued entry, youngest among
                 # ties (older requests of equal priority keep their spot)
@@ -175,7 +219,8 @@ class AdmissionQueue:
                 if victim.priority >= entry.priority:
                     raise QueueFullError(
                         f"admission queue full ({self.max_depth} waiting) and no "
-                        f"queued request has priority < {entry.priority}")
+                        f"queued request has priority < {entry.priority}",
+                        queue_depth=len(self._entries), policy=self.policy)
                 self._entries.remove(victim)
                 self._entries.append(entry)
                 entry._depth_at_enqueue = len(self._entries)
@@ -188,7 +233,8 @@ class AdmissionQueue:
                 if remaining <= 0:
                     raise QueueFullError(
                         f"admission queue stayed full for {self.block_timeout_s}s "
-                        f"(policy=block)")
+                        f"(policy=block)",
+                        queue_depth=len(self._entries), policy=self.policy)
                 self._space.wait(timeout=remaining)
                 if self.closed:
                     raise GatewayClosedError(

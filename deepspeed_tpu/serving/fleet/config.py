@@ -1,0 +1,78 @@
+"""Fleet router config (the ``fleet`` ds_config block).
+
+Same validation discipline as :mod:`deepspeed_tpu.serving.config`:
+field-level constraints plus cross-field checks that refuse loudly at
+construction.
+"""
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+def get_fleet_config(param_dict):
+    """Extract + validate the ``fleet`` block of a ds_config dict."""
+    return FleetConfig(**param_dict.get("fleet", {}))
+
+
+class FleetConfig(DeepSpeedConfigModel):
+    """Knobs for :class:`FleetRouter` and per-replica health tracking.
+
+    Health model: ``degraded_after`` consecutive failures moves a
+    replica HEALTHY -> DEGRADED (still routable, deprioritized);
+    ``down_after`` — or any *fatal* failure (replica process death) —
+    moves it to DOWN. A DOWN replica is probed half-open: after
+    ``probe_backoff_s`` (doubling by ``probe_backoff_mult`` per failed
+    probe, capped at ``probe_backoff_max_s``) one probe is sent;
+    ``recovery_probes`` consecutive successes restore HEALTHY.
+
+    Retry model: a request gets ``max_attempts`` placements total. Each
+    failover waits ``retry_backoff_s * retry_backoff_mult**(attempt-1)``
+    (capped at ``retry_backoff_max_s``) scaled by up to ``retry_jitter``
+    relative jitter, and is abandoned with the *original* typed error
+    semantics if the request deadline would be blown first.
+    """
+
+    # -- health state machine ----------------------------------------
+    heartbeat_interval_s: float = Field(0.5, gt=0)
+    degraded_after: int = Field(2, ge=1)
+    down_after: int = Field(4, ge=1)
+    probe_backoff_s: float = Field(0.25, gt=0)
+    probe_backoff_mult: float = Field(2.0, ge=1.0)
+    probe_backoff_max_s: float = Field(30.0, gt=0)
+    recovery_probes: int = Field(2, ge=1)
+
+    # -- failover / retry --------------------------------------------
+    max_attempts: int = Field(4, ge=1)
+    retry_backoff_s: float = Field(0.02, ge=0)
+    retry_backoff_mult: float = Field(2.0, ge=1.0)
+    retry_backoff_max_s: float = Field(2.0, gt=0)
+    retry_jitter: float = Field(0.25, ge=0, le=1.0)
+    # a live stream that produces nothing for this long is declared
+    # stalled: the attempt is cancelled and failed over (hang detection)
+    stream_token_timeout_s: float = Field(30.0, gt=0)
+
+    # -- placement ---------------------------------------------------
+    prefix_routing: bool = True  # also gated by DS_FLEET_PREFIX_ROUTING
+
+    # -- rolling restart ---------------------------------------------
+    restart_drain_timeout_s: float = Field(120.0, gt=0)
+
+    # -- request defaults (resolved at the ROUTER so every failover
+    #    attempt replays with identical parameters even across replicas
+    #    with different ServingConfig defaults) -----------------------
+    default_max_new_tokens: int = Field(16, ge=1)
+    default_priority: int = 0
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.degraded_after > self.down_after:
+            raise ValueError(
+                f"fleet.degraded_after ({self.degraded_after}) must be <= "
+                f"fleet.down_after ({self.down_after}) — a replica cannot go "
+                f"DOWN before it is DEGRADED")
+        if self.probe_backoff_s > self.probe_backoff_max_s:
+            raise ValueError(
+                f"fleet.probe_backoff_s ({self.probe_backoff_s}) exceeds "
+                f"fleet.probe_backoff_max_s ({self.probe_backoff_max_s})")
+        return self

@@ -1,0 +1,380 @@
+"""The ``Replica`` seam between the fleet router and serving gateways.
+
+A :class:`Replica` is the engine-facing half of the serving stack viewed
+from above: something you can submit to, probe, measure, drain and
+restart. :class:`GatewayReplica` is the real implementation — it owns a
+:class:`ServingGateway` (and, via an injected factory, the engine under
+it) and can rebuild the whole stack for rolling restarts.
+Single-replica serving is just the N=1 case of the router over one of
+these.
+
+:class:`FaultyReplica` wraps any replica with *deterministic, scripted*
+failures — crash on the k-th generated token, hang mid-stream, decode in
+slow motion, reject a burst of submits — so every failover path in the
+router is exercised by tests rather than hoped about. It composes with
+the shared :class:`FaultInjector` harness (``hook=``) used by the nebula
+checkpoint tests.
+"""
+
+import queue as _queue
+import threading
+import time
+
+from deepspeed_tpu.serving.admission import QueueFullError, ServingError
+from deepspeed_tpu.serving.gateway import ServingGateway
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------- errors
+class ReplicaDiedError(ServingError):
+    """The replica process/engine died; in-flight streams are torn."""
+    reason = "replica_died"
+    retry_elsewhere = True
+
+
+class ReplicaRestartingError(ServingError):
+    """The replica is being restarted; queued work was handed back."""
+    reason = "replica_restarting"
+    retry_elsewhere = True
+
+
+class StreamStalledError(ServingError):
+    """A live stream produced nothing for stream_token_timeout_s — the
+    replica is presumed hung; the attempt is failed over."""
+    reason = "stream_stalled"
+    retry_elsewhere = True
+
+
+# ----------------------------------------------------------------- interface
+class Replica:
+    """What the router needs from one serving replica. Implementations
+    must be thread-safe: ``submit`` arrives from per-request relay
+    threads while ``probe``/``load`` arrive from the heartbeat thread."""
+
+    name = "replica"
+
+    def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
+               deadline_ms=None):
+        """→ a :class:`RequestHandle`-shaped streaming handle. Raises a
+        :class:`ServingError` subclass when not accepted."""
+        raise NotImplementedError
+
+    def prefix_match_len(self, prompt_tokens):
+        """Read-only: leading prompt tokens whose KV this replica
+        already caches (the placement signal). Must never create state."""
+        raise NotImplementedError
+
+    def load(self):
+        """Scalar load estimate (queued + active requests)."""
+        raise NotImplementedError
+
+    def alive(self):
+        """Cheap liveness: is the replica accepting work right now?"""
+        raise NotImplementedError
+
+    def probe(self):
+        """Active health probe (heartbeat / half-open recovery check)."""
+        raise NotImplementedError
+
+    def drain(self, timeout=None):
+        raise NotImplementedError
+
+    def shutdown(self):
+        raise NotImplementedError
+
+    def kill(self, error=None):
+        """Simulated/forced ungraceful death (fails all in-flight)."""
+        raise NotImplementedError
+
+    def restart(self, timeout=None, shed_error=None):
+        """Rolling-restart this replica: hand queued work back to the
+        caller (typed retryable errors), drain active work, rebuild."""
+        raise NotImplementedError
+
+    def stats(self):
+        return {}
+
+
+# ------------------------------------------------------------- gateway-backed
+class GatewayReplica(Replica):
+    """A :class:`ServingGateway` (plus the engine it owns) as a fleet
+    replica. ``engine_factory`` is called for the initial build and for
+    every restart — the nebula-style "resume from persistent state"
+    hook lives inside the factory (build engine, restore weights/KV)."""
+
+    def __init__(self, name, engine_factory, serving_config=None,
+                 monitor=None, auto_start=True):
+        self.name = name
+        self._factory = engine_factory
+        self._serving_config = serving_config
+        self._monitor = monitor
+        self._auto_start = auto_start
+        self._lock = threading.Lock()
+        self.gateway = None
+        self.restarts = 0  # completed rebuilds, for snapshots/tests
+        self._build()
+
+    def _build(self):
+        gw = ServingGateway(self._factory(), config=self._serving_config,
+                            monitor=self._monitor,
+                            auto_start=self._auto_start)
+        with self._lock:
+            self.gateway = gw
+
+    # ------------------------------------------------------------ routing API
+    def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
+               deadline_ms=None):
+        return self.gateway.submit(prompt_tokens, max_new_tokens=max_new_tokens,
+                                   priority=priority, deadline_ms=deadline_ms)
+
+    def prefix_match_len(self, prompt_tokens):
+        try:
+            return self.gateway.prefix_match_len(prompt_tokens)
+        except Exception:
+            return 0  # a broken replica just stops being a prefix target
+
+    def load(self):
+        counts = self.gateway.inflight()
+        return counts["queued"] + counts["active"]
+
+    def alive(self):
+        return self.gateway._state == "running"
+
+    def probe(self):
+        """Liveness = accepting state AND (when threaded) a live pump.
+        A dead pump with state still 'running' is exactly the wedged
+        case heartbeats exist to catch."""
+        gw = self.gateway
+        if gw._state != "running":
+            return False
+        thread = gw._pump_thread
+        return thread is None or thread.is_alive()
+
+    # -------------------------------------------------------------- lifecycle
+    def drain(self, timeout=None):
+        self.gateway.drain(timeout=timeout)
+
+    def shutdown(self):
+        self.gateway.shutdown()
+
+    def kill(self, error=None):
+        self.gateway.kill(error or ReplicaDiedError(
+            f"replica {self.name} killed"))
+
+    def restart(self, timeout=None, shed_error=None):
+        """Drain-and-rebuild. Queued (not yet running) requests are shed
+        with a retryable typed error so the router replays them on peers
+        immediately instead of waiting out the drain; active streams are
+        allowed to finish; then the serving stack is rebuilt from the
+        engine factory."""
+        gw = self.gateway
+        gw.shed_queued(shed_error or ReplicaRestartingError(
+            f"replica {self.name} restarting — resubmit elsewhere"))
+        try:
+            gw.drain(timeout=timeout)
+        except TimeoutError:
+            # laggards get a retryable GatewayClosedError instead of
+            # blocking the restart forever
+            logger.warning("replica %s: drain timed out, forcing shutdown",
+                           self.name)
+            gw.shutdown()
+        with self._lock:
+            self.restarts += 1
+        self._build()
+
+    def stats(self):
+        out = dict(self.gateway.inflight())
+        out["restarts"] = self.restarts
+        out["state"] = self.gateway._state
+        return out
+
+
+# ------------------------------------------------------------ fault injection
+class FaultyReplica(Replica):
+    """Deterministic failure wrapper around any :class:`Replica`.
+
+    Scripted faults (all optional, all exact — no randomness):
+
+    - ``crash_at_token=k``: the first request to reach its k-th
+      generated token kills the WHOLE replica mid-stream (every
+      in-flight handle fails with :class:`ReplicaDiedError`) — the
+      replica-process-death case.
+    - ``hang_at_token=k``: streams stop producing at token k without
+      dying — the wedged-pump case hang detection must catch.
+    - ``slow_token_s=s``: every token is delayed by ``s`` — the
+      slow-decode / degraded case.
+    - ``reject_next=n``: the next ``n`` submits raise
+      :class:`QueueFullError` (``injected=True`` in details) — the
+      overload burst case.
+    - ``crash_on_submit=n``: the n-th submit (1-based) kills the
+      replica instead of accepting.
+    - ``hook``: a ``FaultInjector``-shaped callable ``hook(point,
+      detail)`` invoked at ``("submit", i)``, ``("token", j)`` and
+      ``("probe", None)``; anything it raises kills the replica. This is
+      how the shared checkpoint fault harness drives serving faults.
+    """
+
+    def __init__(self, inner, crash_at_token=None, hang_at_token=None,
+                 slow_token_s=0.0, reject_next=0, crash_on_submit=None,
+                 hook=None):
+        self.inner = inner
+        self.name = inner.name
+        self.crash_at_token = crash_at_token
+        self.hang_at_token = hang_at_token
+        self.slow_token_s = float(slow_token_s)
+        self.crash_on_submit = crash_on_submit
+        self.hook = hook
+        self._lock = threading.Lock()
+        self._killed = False
+        self._reject_left = int(reject_next)
+        self._submits = 0  # lifetime submit count (1-based in faults)
+
+    def _die(self, why):
+        """Simulate replica process death: fail everything in flight on
+        the inner replica, then raise for the caller that tripped it."""
+        err = ReplicaDiedError(f"replica {self.name} died: {why}")
+        with self._lock:
+            already = self._killed
+            self._killed = True
+        if not already:
+            try:
+                self.inner.kill(err)
+            except Exception:
+                logger.exception("FaultyReplica: inner kill failed")
+        raise err
+
+    # ------------------------------------------------------------ routing API
+    def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
+               deadline_ms=None):
+        with self._lock:
+            if self._killed:
+                raise ReplicaDiedError(f"replica {self.name} is dead")
+            self._submits += 1
+            nth = self._submits
+            if self._reject_left > 0:
+                self._reject_left -= 1
+                raise QueueFullError(
+                    f"replica {self.name}: injected admission rejection",
+                    injected=True, queue_depth=0)
+        if self.hook is not None:
+            try:
+                self.hook("submit", nth)
+            except Exception as e:
+                self._die(f"hook tripped at submit #{nth}: {e}")
+        if self.crash_on_submit is not None and nth >= self.crash_on_submit:
+            self._die(f"scripted crash on submit #{nth}")
+        inner_handle = self.inner.submit(prompt_tokens,
+                                         max_new_tokens=max_new_tokens,
+                                         priority=priority,
+                                         deadline_ms=deadline_ms)
+        return _FaultyHandle(inner_handle, self)
+
+    def prefix_match_len(self, prompt_tokens):
+        return 0 if self._killed else self.inner.prefix_match_len(prompt_tokens)
+
+    def load(self):
+        return self.inner.load()
+
+    def alive(self):
+        return (not self._killed) and self.inner.alive()
+
+    def probe(self):
+        if self._killed:
+            return False
+        if self.hook is not None:
+            try:
+                self.hook("probe", None)
+            except Exception:
+                return False
+        return self.inner.probe()
+
+    # -------------------------------------------------------------- lifecycle
+    def drain(self, timeout=None):
+        self.inner.drain(timeout=timeout)
+
+    def shutdown(self):
+        self.inner.shutdown()
+
+    def kill(self, error=None):
+        with self._lock:
+            self._killed = True
+        self.inner.kill(error)
+
+    def restart(self, timeout=None, shed_error=None):
+        """Restarting a faulty replica clears its scripted faults — the
+        'process was replaced' semantics a real restart would have."""
+        self.inner.restart(timeout=timeout, shed_error=shed_error)
+        with self._lock:
+            self._killed = False
+        self.crash_at_token = None
+        self.hang_at_token = None
+        self.slow_token_s = 0.0
+        self.crash_on_submit = None
+
+    def stats(self):
+        out = dict(self.inner.stats())
+        out["killed"] = self._killed
+        return out
+
+
+class _FaultyHandle:
+    """Streaming-handle proxy that applies per-token faults. Everything
+    the router touches on a handle is forwarded; ``tokens()`` is where
+    crash/hang/slow scripts fire, indexed by the number of tokens THIS
+    handle has yielded (deterministic per request)."""
+
+    def __init__(self, inner, replica):
+        self._inner = inner
+        self._replica = replica
+
+    def tokens(self, timeout=None):
+        rep = self._replica
+        it = self._inner.tokens(timeout=timeout)
+        idx = 0
+        while True:
+            if rep.hang_at_token is not None and idx >= rep.hang_at_token:
+                # wedged pump: nothing arrives, nothing dies — surface
+                # the same timeout the real stream would
+                time.sleep(timeout if timeout is not None else 0.05)
+                raise _queue.Empty()
+            try:
+                tok = next(it)
+            except StopIteration:
+                return
+            if rep.hook is not None:
+                try:
+                    rep.hook("token", idx)
+                except Exception as e:
+                    rep._die(f"hook tripped at token {idx}: {e}")
+            if rep.crash_at_token is not None and idx >= rep.crash_at_token:
+                rep._die(f"scripted crash at token {idx}")
+            if rep.slow_token_s:
+                time.sleep(rep.slow_token_s)
+            yield tok
+            idx += 1
+
+    def cancel(self):
+        self._inner.cancel()
+
+    def result(self, timeout=None):
+        return self._inner.result(timeout=timeout)
+
+    @property
+    def done(self):
+        return self._inner.done
+
+    @property
+    def status(self):
+        return self._inner.status
+
+    @property
+    def error(self):
+        return self._inner.error
+
+    @property
+    def uid(self):
+        return self._inner.uid
+
+    @property
+    def _collected(self):
+        return self._inner._collected

@@ -1,0 +1,537 @@
+"""Fleet router: health-checked, prefix-aware routing over N replicas.
+
+``FleetRouter.submit()`` looks exactly like ``ServingGateway.submit()``
+— same arguments, same streaming :class:`RequestHandle` contract — but
+behind it a per-request *relay thread* places the request on the best
+replica and, when that replica fails mid-flight, **fails the request
+over**: replays it from the prompt on a surviving replica and resumes
+the client's stream where it left off. Greedy decoding is deterministic
+and batch-composition independent (the gateway test suite proves it), so
+the replay re-produces the already-streamed prefix token for token; the
+relay swallows those replayed tokens instead of re-emitting them, and
+treats any mismatch as :class:`ReplayDivergenceError` rather than ever
+forking a client-visible stream.
+
+Placement: among routable replicas (HEALTHY preferred over DEGRADED),
+route to the one whose radix prefix cache reports the longest match for
+the prompt (break ties on load); no match anywhere → least-loaded.
+Health: per-replica :class:`ReplicaHealth` state machines driven by both
+request outcomes and an active heartbeat (``tick()``), with half-open
+probing to bring DOWN replicas back. Rolling restart:
+``restart_replica()`` sheds a replica's queued work back through the
+retry path, drains its active streams, rebuilds it from its engine
+factory, and only marks it routable again after a readiness probe.
+"""
+
+import itertools
+import queue as _queue
+import random
+import threading
+import time
+
+import numpy as np
+
+from deepspeed_tpu.serving.admission import (DeadlineExceededError,
+                                             GatewayClosedError,
+                                             RequestCancelledError,
+                                             ServingError)
+from deepspeed_tpu.serving.fleet.config import FleetConfig
+from deepspeed_tpu.serving.fleet.health import (DOWN, HEALTHY, RESTARTING,
+                                                ReplicaHealth)
+from deepspeed_tpu.serving.fleet.replica import StreamStalledError
+from deepspeed_tpu.serving.gateway import RequestHandle
+from deepspeed_tpu.utils.env_registry import env_bool
+from deepspeed_tpu.utils.logging import logger
+
+# relay-attempt outcomes
+_OK = "ok"        # stream finished cleanly
+_RETRY = "retry"  # replica-local failure; another replica may serve it
+_FATAL = "fatal"  # request-terminal (cancelled / deadline / divergence)
+
+_COUNTERS = ("submitted", "completed", "failed", "cancelled",
+             "deadline_expired", "retries", "failovers", "restarts",
+             "recoveries", "prefix_routed", "tokens_relayed")
+
+
+# ---------------------------------------------------------------------- errors
+class NoReplicaAvailableError(ServingError):
+    """Every replica is DOWN/RESTARTING/dead — nothing can be placed."""
+    reason = "no_replica"
+    retry_elsewhere = False
+
+
+class FleetFailedError(ServingError):
+    """The retry budget (max_attempts) ran out without completion."""
+    reason = "attempts_exhausted"
+    retry_elsewhere = False
+
+
+class ReplayDivergenceError(ServingError):
+    """A failover replay produced different tokens than were already
+    streamed to the client — the stream cannot be continued without
+    forking it, so the request fails loudly instead."""
+    reason = "replay_divergence"
+    retry_elsewhere = False
+
+
+class FleetHandle(RequestHandle):
+    """A :class:`RequestHandle` whose producer is a router relay thread
+    instead of a gateway pump. Adds the failover breadcrumbs tests and
+    operators want: which replicas served it, how many attempts."""
+
+    def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s):
+        super().__init__(uid, prompt, max_new_tokens, priority, deadline_s)
+        self.replica_trail = []  # replica names, one per attempt
+        self.attempts = 0
+        self._cancelled = False
+        self._inner = None  # current replica-level handle (if any)
+
+
+class FleetRouter:
+    """Routes requests over ``replicas`` (a list of :class:`Replica`).
+
+    ``auto_heartbeat=False`` disables the background heartbeat thread;
+    tests drive health explicitly via :meth:`tick`. ``now_fn``/``seed``
+    make timing and jitter injectable."""
+
+    def __init__(self, replicas, config=None, monitor=None, seed=0,
+                 now_fn=None, auto_heartbeat=True):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = {}
+        for rep in replicas:
+            if rep.name in self.replicas:
+                raise ValueError(f"duplicate replica name {rep.name!r}")
+            self.replicas[rep.name] = rep
+        self.config = config or FleetConfig()
+        self.monitor = monitor
+        self._now = now_fn or time.monotonic
+        self._seed = seed
+        self.health = {name: ReplicaHealth(self.config, now_fn=self._now,
+                                           name=name)
+                       for name in self.replicas}
+        self._failover_enabled = env_bool("DS_FLEET_FAILOVER")
+        self._prefix_routing = (self.config.prefix_routing
+                                and env_bool("DS_FLEET_PREFIX_ROUTING"))
+        self._uids = itertools.count()
+        self._lock = threading.Lock()
+        self._counters = {k: 0 for k in _COUNTERS}
+        self._relays = set()   # live per-request relay threads
+        self._closed = False
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        if auto_heartbeat:
+            self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                               name="ds-fleet-heartbeat",
+                                               daemon=True)
+            self._hb_thread.start()
+
+    # ---------------------------------------------------------------- client
+    def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
+               deadline_ms=None):
+        """Gateway-compatible submit: → a streaming :class:`FleetHandle`.
+        Placement, retries and failover all happen on a per-request
+        relay thread; the caller just consumes ``handle.tokens()``.
+
+        Defaults resolve HERE (from :class:`FleetConfig`), not per
+        replica — every failover attempt must replay with identical
+        parameters or greedy replay equivalence breaks."""
+        prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.config.default_max_new_tokens)
+        prio = int(priority if priority is not None
+                   else self.config.default_priority)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        with self._lock:
+            if self._closed:
+                raise GatewayClosedError(
+                    "fleet router is closed — not accepting requests")
+        handle = FleetHandle(next(self._uids), prompt, max_new, prio,
+                             deadline_ms / 1e3 if deadline_ms is not None
+                             else None)
+        handle._cancel_cb = self._request_cancel
+        self._count("submitted")
+        thread = threading.Thread(target=self._serve, args=(handle,),
+                                  name=f"ds-fleet-relay-{handle.uid}",
+                                  daemon=True)
+        with self._lock:
+            self._relays.add(thread)
+        thread.start()
+        return handle
+
+    def _request_cancel(self, handle):
+        handle._cancelled = True
+        inner = handle._inner
+        if inner is not None:
+            try:
+                inner.cancel()
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------------- relay
+    def _serve(self, handle):
+        """Relay-thread main: place → stream → (on replica failure)
+        back off and fail over, until done, fatal, or out of budget.
+        Structured so NO exit path leaves the handle unfinished."""
+        cfg = self.config
+        excluded = set()  # replicas that already failed THIS request
+        rng = random.Random(hash((self._seed, handle.uid)))
+        try:
+            while True:
+                handle.attempts += 1
+                if handle._cancelled:
+                    self._fail(handle, RequestCancelledError(
+                        f"request {handle.uid} cancelled"))
+                    return
+                if handle.deadline is not None and \
+                        self._now() >= handle.deadline:
+                    self._fail(handle, DeadlineExceededError(
+                        f"request {handle.uid} deadline expired before "
+                        f"attempt {handle.attempts}"))
+                    return
+                replica = self._place(handle.prompt, excluded)
+                if replica is None and excluded:
+                    # every un-failed replica is unroutable; a replica
+                    # that failed this request earlier may have recovered
+                    excluded.clear()
+                    replica = self._place(handle.prompt, excluded)
+                if replica is None:
+                    self._fail(handle, NoReplicaAvailableError(
+                        f"no routable replica for request {handle.uid} "
+                        f"(attempt {handle.attempts}/{cfg.max_attempts})"))
+                    return
+                handle.replica_trail.append(replica.name)
+                outcome, err = self._attempt(handle, replica)
+                if outcome is _OK:
+                    if handle._finish("completed"):
+                        self._count("completed")
+                    return
+                if outcome is _FATAL:
+                    self._fail(handle, err)
+                    return
+                # _RETRY: replica-local failure
+                if not self._failover_enabled:
+                    self._fail(handle, err)
+                    return
+                excluded.add(replica.name)
+                if handle.attempts >= cfg.max_attempts:
+                    self._fail(handle, FleetFailedError(
+                        f"request {handle.uid} failed on "
+                        f"{len(set(handle.replica_trail))} replica(s) after "
+                        f"{handle.attempts} attempts; last error: "
+                        f"[{err.reason}] {err}", last_reason=err.reason))
+                    return
+                backoff = min(
+                    cfg.retry_backoff_s *
+                    cfg.retry_backoff_mult ** (handle.attempts - 1),
+                    cfg.retry_backoff_max_s)
+                backoff *= 1.0 + cfg.retry_jitter * rng.random()
+                if handle.deadline is not None and \
+                        self._now() + backoff >= handle.deadline:
+                    self._fail(handle, DeadlineExceededError(
+                        f"request {handle.uid}: deadline would expire "
+                        f"during failover backoff; last error: "
+                        f"[{err.reason}] {err}"))
+                    return
+                self._count("retries")
+                if getattr(err, "retry_elsewhere", False):
+                    self._count("failovers")
+                time.sleep(backoff)
+        except Exception as e:
+            # relay bug — never hang the client
+            logger.exception("fleet relay died for request %s", handle.uid)
+            self._fail(handle, FleetFailedError(
+                f"fleet relay crashed: {type(e).__name__}: {e}"))
+        finally:
+            with self._lock:
+                self._relays.discard(threading.current_thread())
+
+    def _attempt(self, handle, replica):
+        """One placement attempt on ``replica`` → (outcome, error).
+        Replays ``handle._collected`` silently (failover continuation):
+        tokens the client already saw are verified, never re-emitted."""
+        cfg = self.config
+        deadline_ms = None
+        if handle.deadline is not None:
+            remaining = handle.deadline - self._now()
+            if remaining <= 0:
+                return _FATAL, DeadlineExceededError(
+                    f"request {handle.uid} deadline expired")
+            deadline_ms = remaining * 1e3
+        try:
+            inner = replica.submit(handle.prompt,
+                                   max_new_tokens=handle.max_new_tokens,
+                                   priority=handle.priority,
+                                   deadline_ms=deadline_ms)
+        except ServingError as e:
+            self._note_failure(replica, e)
+            return (_RETRY if e.retry_elsewhere else _FATAL), e
+        handle._inner = inner
+        if handle._cancelled:  # raced with cancel during placement
+            try:
+                inner.cancel()
+            except Exception:
+                pass
+            return _FATAL, RequestCancelledError(
+                f"request {handle.uid} cancelled")
+        replay = len(handle._collected)  # tokens the client already saw
+        idx = 0
+        stream = inner.tokens(timeout=cfg.stream_token_timeout_s)
+        while True:
+            try:
+                tok = next(stream)
+            except StopIteration:
+                if idx < replay:
+                    return _FATAL, ReplayDivergenceError(
+                        f"request {handle.uid}: replay on {replica.name} "
+                        f"ended after {idx} tokens but {replay} were "
+                        f"already streamed")
+                self.health[replica.name].record_success()
+                return _OK, None
+            except _queue.Empty:
+                # hang detection: a live stream that went silent
+                try:
+                    inner.cancel()
+                except Exception:
+                    pass
+                err = StreamStalledError(
+                    f"request {handle.uid}: no token from {replica.name} "
+                    f"for {cfg.stream_token_timeout_s}s (after {idx})",
+                    tokens_seen=idx)
+                self._note_failure(replica, err)
+                return _RETRY, err
+            except ServingError as e:
+                self._note_failure(replica, e)
+                return (_RETRY if e.retry_elsewhere else _FATAL), e
+            if handle._cancelled:
+                try:
+                    inner.cancel()
+                except Exception:
+                    pass
+                return _FATAL, RequestCancelledError(
+                    f"request {handle.uid} cancelled after "
+                    f"{len(handle._collected)} tokens")
+            tok = int(tok)
+            if idx < replay:
+                if tok != handle._collected[idx]:
+                    return _FATAL, ReplayDivergenceError(
+                        f"request {handle.uid}: replay token {idx} on "
+                        f"{replica.name} is {tok}, client already saw "
+                        f"{handle._collected[idx]}")
+            else:
+                handle._emit(tok)
+                self._count("tokens_relayed")
+            idx += 1
+
+    def _fail(self, handle, err):
+        """Finish ``handle`` abnormally with the status/counter its
+        error reason maps to (same vocabulary as the gateway)."""
+        reason = getattr(err, "reason", "")
+        if reason == "cancelled":
+            status, counter = "cancelled", "cancelled"
+        elif reason == "deadline":
+            status, counter = "deadline", "deadline_expired"
+        else:
+            status, counter = "failed", "failed"
+        if handle._finish(status, err):
+            self._count(counter)
+
+    def _note_failure(self, replica, err):
+        """Map a request-attempt error onto the replica's health.
+        Replica-death class → straight to DOWN; stalls count toward the
+        degraded/down thresholds; administrative + load errors
+        (restarting, closed, queue full, shed) carry NO health penalty —
+        a full queue is a busy replica, not a sick one; everything else
+        (too_large, deadline, cancelled) says nothing about the replica."""
+        reason = getattr(err, "reason", "")
+        health = self.health[replica.name]
+        if reason in ("replica_died", "gateway_failed"):
+            health.record_failure(why=f"[{reason}] {err}", fatal=True)
+        elif reason == "stream_stalled":
+            health.record_failure(why=f"[{reason}] {err}")
+
+    # ------------------------------------------------------------- placement
+    def _place(self, prompt, excluded):
+        """Pick a replica for ``prompt``: routable + alive, HEALTHY
+        preferred over DEGRADED, then longest prefix-cache match (ties
+        to lighter load), then least-loaded."""
+        candidates = []
+        for name, rep in self.replicas.items():
+            if name in excluded or not self.health[name].routable:
+                continue
+            try:
+                if not rep.alive():
+                    continue
+            except Exception:
+                continue
+            candidates.append(rep)
+        if not candidates:
+            return None
+        healthy = [r for r in candidates
+                   if self.health[r.name].state == HEALTHY]
+        pool = healthy or candidates
+        if self._prefix_routing and len(prompt) > 1:
+            best, best_key = None, None
+            for rep in pool:
+                try:
+                    match = int(rep.prefix_match_len(prompt))
+                except Exception:
+                    match = 0
+                key = (match, -self._load(rep))
+                if best_key is None or key > best_key:
+                    best, best_key = rep, key
+            if best_key is not None and best_key[0] > 0:
+                self._count("prefix_routed")
+                return best
+        return min(pool, key=self._load)
+
+    def _load(self, rep):
+        try:
+            return int(rep.load())
+        except Exception:
+            return 1 << 30  # unmeasurable → last resort
+
+    # ---------------------------------------------------------------- health
+    def tick(self):
+        """One heartbeat sweep: probe DOWN replicas whose half-open
+        window is open; actively verify liveness of routable ones (a
+        wedged pump with no traffic would otherwise never be noticed)."""
+        for name, rep in self.replicas.items():
+            health = self.health[name]
+            state = health.state
+            if state == RESTARTING:
+                continue
+            if state == DOWN:
+                if health.probe_due():
+                    if health.record_probe(self._probe(rep)):
+                        self._count("recoveries")
+                        logger.info("fleet: replica %s recovered", name)
+                continue
+            if not self._probe(rep):
+                health.record_failure(why="heartbeat probe failed",
+                                      fatal=True)
+                logger.warning("fleet: replica %s failed heartbeat -> down",
+                               name)
+
+    def _probe(self, rep):
+        try:
+            return bool(rep.probe())
+        except Exception:
+            return False
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(timeout=self.config.heartbeat_interval_s):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("fleet heartbeat sweep failed")
+
+    # --------------------------------------------------------------- restart
+    def restart_replica(self, name, timeout=None):
+        """Rolling-restart one replica while the rest keep serving:
+        mark RESTARTING (so drain noise is not misread as a crash), shed
+        its queued work back through the failover path, drain + rebuild,
+        then readmit only after a readiness probe. → True when the
+        replica came back healthy."""
+        replica = self.replicas[name]
+        health = self.health[name]
+        health.begin_restart()
+        self._count("restarts")
+        ok = False
+        try:
+            replica.restart(timeout=timeout if timeout is not None
+                            else self.config.restart_drain_timeout_s)
+            ok = self._probe(replica)
+        finally:
+            health.end_restart(ok)
+        return ok
+
+    def rolling_restart(self, timeout=None):
+        """Restart every replica one at a time → {name: came_back_ok}."""
+        return {name: self.restart_replica(name, timeout=timeout)
+                for name in list(self.replicas)}
+
+    # -------------------------------------------------------------- lifecycle
+    def drain(self, timeout=None):
+        """Stop admitting, let every relay finish (their requests
+        complete or fail typed), then drain the replicas."""
+        timeout = (self.config.restart_drain_timeout_s if timeout is None
+                   else timeout)
+        with self._lock:
+            self._closed = True
+            relays = list(self._relays)
+        deadline = time.monotonic() + timeout
+        for thread in relays:
+            thread.join(timeout=max(0.0, deadline - time.monotonic()))
+        stuck = [t.name for t in relays if t.is_alive()]
+        if stuck:
+            raise TimeoutError(
+                f"fleet drain: {len(stuck)} relay(s) still running after "
+                f"{timeout}s: {stuck}")
+        self._stop_heartbeat()
+        for rep in self.replicas.values():
+            rep.drain(timeout=max(0.1, deadline - time.monotonic()))
+
+    def shutdown(self):
+        """Hard stop: replicas die first (their typed errors unblock any
+        relays mid-stream), then relays are reaped."""
+        with self._lock:
+            self._closed = True
+        self._stop_heartbeat()
+        for rep in self.replicas.values():
+            try:
+                rep.shutdown()
+            except Exception:
+                logger.exception("fleet shutdown: replica %s", rep.name)
+        with self._lock:
+            relays = list(self._relays)
+        for thread in relays:
+            thread.join(timeout=30)
+
+    def _stop_heartbeat(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.drain()
+        else:
+            self.shutdown()
+        return False
+
+    # --------------------------------------------------------------- metrics
+    def _count(self, key, n=1):
+        with self._lock:
+            self._counters[key] += n
+
+    def snapshot(self):
+        with self._lock:
+            counters = dict(self._counters)
+        replicas = {}
+        for name, rep in self.replicas.items():
+            try:
+                stats = rep.stats()
+            except Exception:
+                stats = {}
+            replicas[name] = {"health": self.health[name].snapshot(),
+                              "load": self._load(rep), **stats}
+        return {"counters": counters, "replicas": replicas}
+
+    def write_events(self, monitor, step=0):
+        snap = self.snapshot()
+        events = [(f"Fleet/{k}", v, step)
+                  for k, v in sorted(snap["counters"].items())]
+        for name, info in sorted(snap["replicas"].items()):
+            state = info["health"]["state"]
+            events.append((f"Fleet/{name}/healthy",
+                           1 if state == HEALTHY else 0, step))
+            events.append((f"Fleet/{name}/load", info["load"], step))
+        monitor.write_events(events)

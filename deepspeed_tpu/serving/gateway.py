@@ -191,6 +191,19 @@ class ServingGateway:
             from deepspeed_tpu.serving.admission import QueueFullError
             if isinstance(e, QueueFullError):
                 self.metrics.count("rejected_queue_full")
+                # estimated-wait hints for routing layers: how deep the
+                # line is, how much KV the prefix cache could give back,
+                # and a rough wait guess from observed queue-wait times —
+                # enough for a router to pick "retry elsewhere" over
+                # "shed fleet-wide" without string-matching the message
+                qw = self.metrics.queue_wait
+                e.details.setdefault("queue_depth", len(self.queue))
+                e.details.update(
+                    evictable_blocks=int(getattr(self.engine,
+                                                 "evictable_blocks", 0)),
+                    active=self.gate.active,
+                    est_wait_s=round(qw.total_ms / qw.count / 1e3, 4)
+                    if qw.count else None)
             raise
         self.metrics.count("submitted")
         self.metrics.gauge_peak("queue_depth_peak",
@@ -268,6 +281,59 @@ class ServingGateway:
         with self._state_lock:
             self._state = "stopped"
         self.engine.destroy()
+
+    def kill(self, error=None):
+        """Hard, ungraceful death — the fault-injection / fleet-crash
+        primitive. Stops the pump, fails EVERY outstanding request with
+        ``error`` (default :class:`GatewayFailedError`), marks the
+        gateway ``failed`` (a killed replica is not a cleanly stopped
+        one) and releases engine HBM. Unlike a real pump crash this is
+        synchronous: when it returns, no handle is left hanging."""
+        with self._state_lock:
+            if self._state in ("stopped", "failed"):
+                return
+            self._state = "failed"
+        self.queue.close()
+        self._stop_pump()
+        self._fail_outstanding(error or GatewayFailedError("gateway killed"))
+        try:
+            self.engine.destroy()
+        except Exception:
+            logger.exception("engine destroy failed during kill()")
+
+    def shed_queued(self, error):
+        """Fail every request still WAITING in the admission queue with
+        the typed ``error``; active (streaming) requests are untouched.
+        This is the queued-work half of a rolling-restart handoff: the
+        fleet router sees a retry-elsewhere error and replays each shed
+        request on a peer replica from its prompt (nothing was streamed
+        yet, so nothing can double-emit). Returns the number shed."""
+        n = 0
+        for entry in self.queue.candidates():
+            if self.queue.remove(entry) and entry._finish("failed", error):
+                self.metrics.count("failed")
+                n += 1
+        return n
+
+    def prefix_match_len(self, prompt_tokens):
+        """Read-only placement signal: leading tokens of
+        ``prompt_tokens`` whose KV this gateway's engine already caches
+        (0 when the prefix cache is off or the gateway is not running).
+        Never creates a sequence, takes no leases, skews no hit-rate
+        stats — safe for a router to call on every placement."""
+        if self._state != "running":
+            return 0
+        engine = self.engine
+        fn = getattr(engine, "prefix_match_len", None) if engine is not None \
+            else None
+        return int(fn(prompt_tokens)) if fn is not None else 0
+
+    def inflight(self):
+        """Request counts by stage — the router's least-loaded signal.
+        Reads race the pump benignly (a load hint, not an invariant)."""
+        return {"queued": len(self.queue),
+                "active": len(self._active),
+                "paused": len(self._paused)}
 
     def _stop_pump(self):
         thread = self._pump_thread

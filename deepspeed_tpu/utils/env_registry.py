@@ -149,6 +149,14 @@ register("DS_PREFIX_CACHE", "optional_bool", None,
          "Kill switch for the radix prefix cache; set it wins in both "
          "directions, unset defers to the engine config.",
          "deepspeed_tpu/inference/v2/prefix_cache/manager.py")
+register("DS_FLEET_FAILOVER", "bool", True,
+         "Kill switch for cross-replica failover retries in the fleet "
+         "router; off, a failed attempt fails the request immediately.",
+         "deepspeed_tpu/serving/fleet/router.py")
+register("DS_FLEET_PREFIX_ROUTING", "bool", True,
+         "Kill switch for prefix-cache-aware replica placement; off, "
+         "the router always picks the least-loaded routable replica.",
+         "deepspeed_tpu/serving/fleet/router.py")
 register("DS_SANITIZE", "bool", False,
          "Enable runtime sanitizers: checkify NaN/OOB checks around "
          "the v2 model forward plus allocator/prefix-cache invariant "

@@ -19,9 +19,9 @@ import deepspeed_tpu
 from deepspeed_tpu.nebula.service import (CheckpointWriteError, resolve_load_tag, validate_tag)
 from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.runtime.checkpoint_engine import CheckpointCorruptionError
-from unit.checkpoint.fault_injection import (FaultInjector, WriterKilled, corrupt_json, delete_manifest, disarm,
-                                             fix_manifest_size, kill_writer_at, shard_data_files, shard_index_files,
-                                             truncate_file)
+from unit.common.fault_injection import (FaultInjector, WriterKilled, corrupt_json, delete_manifest, disarm,
+                                         fix_manifest_size, kill_writer_at, shard_data_files, shard_index_files,
+                                         truncate_file)
 from unit.simple_model import SimpleModel, random_dataloader
 
 HIDDEN = 32

@@ -1,4 +1,14 @@
-"""Fault-injection harness for the nebula async checkpoint service.
+"""Shared fault-injection harness.
+
+:class:`FaultInjector` is the generic piece: a callable hook that
+records every ``(point, detail)`` stage it reaches and raises
+:class:`WriterKilled` the first time the armed stage is hit. The nebula
+checkpoint service consumes it via ``service.test_hook`` (stages like
+``before_promote``); the serving fleet consumes the same shape via
+``FaultyReplica(hook=...)`` (stages ``("submit", n)`` / ``("token", k)``
+/ ``("probe", None)``) — one harness, every crash-consistency test.
+
+The rest is checkpoint-specific:
 
 Two kinds of faults:
 

@@ -260,6 +260,69 @@ class TestLifecycle:
             h.result(timeout=1)
 
 
+class TestErrorTaxonomy:
+    """Machine-readable rejection contract: every ServingError carries a
+    stable ``reason`` + ``retry_elsewhere`` routing verdict, and the
+    capacity/queue raise sites attach numeric hints — what the fleet
+    router consumes instead of string-matching messages."""
+
+    def test_reason_and_retry_elsewhere_matrix(self):
+        from deepspeed_tpu.serving import ServingError
+        matrix = {
+            GatewayClosedError: ("gateway_closed", True),
+            QueueFullError: ("queue_full", True),
+            RequestTooLargeError: ("too_large", False),
+            RequestShedError: ("shed", True),
+            RequestCancelledError: ("cancelled", False),
+            DeadlineExceededError: ("deadline", False),
+            GatewayFailedError: ("gateway_failed", True),
+        }
+        for cls, (reason, retry) in matrix.items():
+            err = cls("x")
+            assert isinstance(err, ServingError)
+            assert err.reason == reason, cls.__name__
+            assert err.retry_elsewhere is retry, cls.__name__
+            assert err.details == {}
+        assert ServingError("x", depth=3).details == {"depth": 3}
+
+    def test_queue_full_carries_wait_hints_through_submit(self):
+        gw = make_gateway(max_queue_depth=2)
+        gw.submit([1, 2])
+        gw.submit([3, 4])
+        with pytest.raises(QueueFullError) as ei:
+            gw.submit([5, 6])
+        d = ei.value.details
+        assert d["queue_depth"] == 2 and d["policy"] == "reject"
+        assert d["evictable_blocks"] == 0  # FakeEngine has no prefix cache
+        assert d["active"] == 0            # nothing admitted yet
+        assert d["est_wait_s"] is None     # no completed waits observed yet
+        # after traffic flows, the estimate turns numeric
+        pump_until(gw, lambda: gw.snapshot()["counters"]["completed"] == 2)
+        gw.submit([1, 2])
+        gw.submit([3, 4])
+        with pytest.raises(QueueFullError) as ei:
+            gw.submit([5, 6])
+        assert ei.value.details["est_wait_s"] >= 0.0
+
+    def test_too_large_carries_capacity_hints(self):
+        gate = CapacityGate(FakeEngine(max_ctx_tokens=64, free_blocks=4), 64)
+        with pytest.raises(RequestTooLargeError) as ei:
+            gate.check_feasible(60, 8)
+        assert ei.value.details == {"total_tokens": 68, "max_ctx_tokens": 64}
+        with pytest.raises(RequestTooLargeError) as ei:
+            gate.check_feasible(32, 16)
+        assert ei.value.details == {"needed_blocks": 6, "usable_blocks": 4}
+
+    def test_block_policy_timeout_carries_depth(self):
+        gw = make_gateway(max_queue_depth=1, admission_policy="block",
+                          block_timeout_s=0.05)
+        gw.submit([1, 2])
+        with pytest.raises(QueueFullError) as ei:
+            gw.submit([3, 4])
+        assert ei.value.details["queue_depth"] == 1
+        assert ei.value.details["policy"] == "block"
+
+
 class TestConfigAndMetrics:
 
     def test_serving_config_block_validates(self):

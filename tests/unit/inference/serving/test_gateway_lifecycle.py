@@ -1,0 +1,133 @@
+"""Gateway lifecycle with IN-FLIGHT streaming handles.
+
+``drain()``/``shutdown()``/``kill()``/``shed_queued()`` while clients
+hold live token iterators — previously only exercised indirectly. The
+contracts under test:
+
+- tokens already emitted are NEVER re-emitted (a client pulling its
+  iterator across a lifecycle transition sees each token exactly once);
+- handles terminate with a TYPED error (never hang, never a bare stop);
+- ``submit()`` after the transition is rejected typed.
+
+Engine-agnostic, so these run on the deterministic FakeEngine.
+"""
+
+import pytest
+
+from deepspeed_tpu.serving import (GatewayClosedError, GatewayFailedError,
+                                   QueueFullError)
+from deepspeed_tpu.serving.fleet import ReplicaDiedError
+from unit.inference.serving.test_admission import (FakeEngine, make_gateway,
+                                                   pump_until)
+
+
+def take(stream, n):
+    """Pull exactly n tokens off a live iterator."""
+    return [next(stream) for _ in range(n)]
+
+
+class TestDrainWithInflightStreams:
+
+    def test_drain_completes_streams_without_reemitting(self):
+        engine = FakeEngine()
+        gw = make_gateway(engine)
+        h = gw.submit([1, 2, 3], max_new_tokens=6)
+        stream = h.tokens(timeout=5)
+        pump_until(gw, lambda: len(h._collected) >= 2)
+        before = take(stream, 2)  # client consumed 2 tokens pre-drain
+        gw.drain(timeout=10)      # manual-pump drain finishes in-flight
+        after = list(stream)
+        # exactly-once delivery across the transition: the concatenation
+        # is the full reference stream, no token duplicated or dropped
+        assert before + after == FakeEngine.expected_tokens(h.uid, 3, 6)
+        assert h.status == "completed" and engine.destroyed
+        with pytest.raises(GatewayClosedError):
+            gw.submit([4, 5])
+
+    def test_drain_finishes_queued_requests_too(self):
+        gw = make_gateway()
+        handles = [gw.submit([i, i + 1], max_new_tokens=2) for i in range(3)]
+        gw.drain(timeout=10)  # none were admitted yet — still all finish
+        for h in handles:
+            assert h.status == "completed"
+            assert h.result(timeout=1) == FakeEngine.expected_tokens(
+                h.uid, 2, 2)
+
+
+class TestShutdownWithInflightStreams:
+
+    def test_shutdown_terminates_streams_typed(self):
+        engine = FakeEngine()
+        gw = make_gateway(engine)
+        h = gw.submit([1, 2, 3], max_new_tokens=8)
+        stream = h.tokens(timeout=5)
+        pump_until(gw, lambda: len(h._collected) >= 3)
+        got = take(stream, 3)
+        gw.shutdown()
+        with pytest.raises(GatewayClosedError):  # typed, not a hang
+            list(stream)
+        # the pre-shutdown prefix was delivered exactly once and is a
+        # strict prefix of what the full run would have produced
+        assert got == FakeEngine.expected_tokens(h.uid, 3, 8)[:3]
+        assert h.status == "failed" and h.done
+        assert engine.destroyed
+        with pytest.raises(GatewayClosedError):
+            gw.submit([4, 5])
+
+    def test_kill_fails_everything_with_given_error(self):
+        engine = FakeEngine()
+        gw = make_gateway(engine)
+        h_active = gw.submit([1, 2], max_new_tokens=8)
+        pump_until(gw, lambda: len(h_active._collected) >= 1)
+        h_queued = gw.submit([3, 4], max_new_tokens=2)
+        gw.kill(ReplicaDiedError("induced crash"))
+        for h in (h_active, h_queued):
+            assert h.done and h.status == "failed"
+            with pytest.raises(ReplicaDiedError):
+                h.result(timeout=1)
+        assert gw.state == "failed" and engine.destroyed
+        with pytest.raises(GatewayFailedError):  # dead, not draining
+            gw.submit([5, 6])
+        gw.kill()  # idempotent
+
+    def test_kill_default_error_is_gateway_failed(self):
+        gw = make_gateway()
+        h = gw.submit([1, 2], max_new_tokens=2)
+        gw.kill()
+        with pytest.raises(GatewayFailedError, match="killed"):
+            h.result(timeout=1)
+
+
+class TestShedQueued:
+
+    def test_shed_queued_spares_active_streams(self):
+        # pool of 2 blocks, 1-block requests -> 2 admitted, rest queued
+        engine = FakeEngine(free_blocks=2)
+        gw = make_gateway(engine)
+        handles = [gw.submit([9, 9, 9], max_new_tokens=2) for _ in range(4)]
+        gw._pump_once()
+        assert len(gw._active) == 2 and len(gw.queue) == 2
+        err = QueueFullError("handing off for restart")
+        assert gw.shed_queued(err) == 2
+        shed = [h for h in handles if h.done]
+        assert len(shed) == 2
+        for h in shed:
+            assert h.status == "failed" and h.error is err
+        # the two active streams are untouched and run to completion
+        pump_until(gw, lambda: all(h.done for h in handles))
+        live = [h for h in handles if h not in shed]
+        for h in live:
+            assert h.status == "completed"
+            assert h.result(timeout=1) == FakeEngine.expected_tokens(
+                h.uid, 3, 2)
+
+    def test_inflight_counts_by_stage(self):
+        engine = FakeEngine(free_blocks=2)
+        gw = make_gateway(engine)
+        assert gw.inflight() == {"queued": 0, "active": 0, "paused": 0}
+        for _ in range(3):
+            gw.submit([1, 2, 3], max_new_tokens=2)
+        assert gw.inflight()["queued"] == 3
+        gw._pump_once()
+        counts = gw.inflight()
+        assert counts["active"] == 2 and counts["queued"] == 1

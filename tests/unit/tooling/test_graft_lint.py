@@ -256,11 +256,17 @@ class TestThreadSharedState:
         from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401
         from deepspeed_tpu.nebula.service import \
             NebulaCheckpointService  # noqa: F401
+        from deepspeed_tpu.serving.fleet.health import \
+            ReplicaHealth  # noqa: F401
+        from deepspeed_tpu.serving.fleet.replica import (  # noqa: F401
+            FaultyReplica, GatewayReplica)
+        from deepspeed_tpu.serving.fleet.router import FleetRouter  # noqa: F401
         from deepspeed_tpu.serving.gateway import ServingGateway  # noqa: F401
         from deepspeed_tpu.serving.metrics import ServingMetrics  # noqa: F401
         from tools.graft_lint.linter import THREAD_SHARED_REGISTRY
         for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
-                    ServingMetrics, BlockedAllocator, PrefixCacheManager):
+                    ServingMetrics, BlockedAllocator, PrefixCacheManager,
+                    FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

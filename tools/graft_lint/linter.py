@@ -78,6 +78,13 @@ THREAD_SHARED_REGISTRY = {
     "BlockedAllocator": {"_free", "_free_set"},
     "PrefixCacheManager": {"_leases", "lookups", "hits", "tokens_saved",
                            "insertions"},
+    # fleet: relay threads + heartbeat thread + client threads all touch
+    # router/health/replica state
+    "FleetRouter": {"_counters", "_relays", "_closed"},
+    "ReplicaHealth": {"_state", "_consecutive_failures", "_half_open_ok",
+                      "_next_probe_at", "_probe_backoff", "transitions"},
+    "GatewayReplica": {"gateway", "restarts"},
+    "FaultyReplica": {"_killed", "_reject_left", "_submits"},
 }
 
 _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
