@@ -722,6 +722,109 @@ def bench_checkpoint():
                     "write + atomic commit run on the nebula writer thread"}
 
 
+def bench_train_elastic():
+    """Preemption recovery: steady-state step time, emergency-save stall
+    on SIGTERM, and end-to-end recovery time (rebuild + validated resume
+    + first post-resume step). Steps lost must be 0 — the in-flight step
+    finishes and lands in the emergency checkpoint before the exit. Runs
+    on CPU too (the lane exercises the signal/checkpoint/resume path,
+    not the MXU)."""
+    import os as _os
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import PREEMPT_RC, read_resume_marker
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.nebula.service import resolve_load_tag
+    from deepspeed_tpu.parallel import groups
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model = build_llama("160m", hidden_size=768, intermediate_size=2048,
+                            num_hidden_layers=8, num_attention_heads=12,
+                            num_key_value_heads=12, max_position_embeddings=512,
+                            remat=False)
+    else:
+        model = build_llama("debug")
+    ckpt_dir = tempfile.mkdtemp(prefix="elastic_bench_")
+    config = {
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 4,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000000,
+        "nebula": {"enabled": True, "persistent_time_interval": 0,
+                   "persistent_storage_path": ckpt_dir,
+                   "num_of_version_in_retention": 2},
+    }
+    ids = np.zeros((4, 128), np.int32)
+    batch = (jnp.asarray(ids), jnp.asarray(ids))
+    prev_elastic = _os.environ.get("DS_ELASTIC_ENABLED")
+    _os.environ["DS_ELASTIC_ENABLED"] = "1"
+    try:
+        groups.destroy_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        for _ in range(2):  # warm the compiled step
+            engine.train_batch(batch=batch)
+        jax.block_until_ready(engine.params)
+        steady = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.params)
+            steady.append(time.perf_counter() - t0)
+        steady_s = min(steady)
+
+        # preempt: the real SIGTERM -> flag -> finish-step -> emergency-
+        # save -> exit path, minus the process exit itself
+        _os.kill(_os.getpid(), _signal.SIGTERM)
+        t0 = time.perf_counter()
+        try:
+            engine.train_batch(batch=batch)
+            raise RuntimeError("preemption did not trigger")
+        except SystemExit as e:
+            assert e.code == PREEMPT_RC, f"unexpected exit rc {e.code}"
+        preempt_step_s = time.perf_counter() - t0
+        steps_at_exit = engine.global_steps
+        marker = read_resume_marker(ckpt_dir)
+        engine.destroy()
+
+        # recovery: rebuild + validated resume + first post-resume step
+        t0 = time.perf_counter()
+        groups.destroy_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        engine.train_batch(batch=batch)  # materialize shardings
+        engine.load_checkpoint()
+        steps_after_load = engine.global_steps
+        engine.train_batch(batch=batch)
+        jax.block_until_ready(engine.params)
+        recovery_s = time.perf_counter() - t0
+        steps_lost = steps_at_exit - steps_after_load
+        resumed_tag = resolve_load_tag(ckpt_dir)
+        engine.destroy()
+    finally:
+        if prev_elastic is None:
+            _os.environ.pop("DS_ELASTIC_ENABLED", None)
+        else:
+            _os.environ["DS_ELASTIC_ENABLED"] = prev_elastic
+        _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    assert steps_lost == 0, f"preemption lost {steps_lost} steps"
+    return {"steady_step_s": round(steady_s, 4),
+            "preempt_step_s": round(preempt_step_s, 4),
+            "emergency_save_s": round(preempt_step_s - steady_s, 4),
+            "recovery_s": round(recovery_s, 2),
+            "steps_lost": steps_lost,
+            "resumed_tag": resumed_tag,
+            "marker_tag": marker["tag"] if marker else None,
+            "note": "preempt_step_s = in-flight step + emergency save + exit; "
+                    "recovery_s = engine rebuild + validated resume + first "
+                    "post-resume step (compile included)"}
+
+
 def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import build_llama
@@ -810,6 +913,7 @@ def main():
         ("serving_2b_fleet", bench_serving_2b_fleet, {}),
         ("offload", bench_offload_probe, {}),
         ("checkpoint", bench_checkpoint, {}),
+        ("train_elastic", bench_train_elastic, {}),
     ]
     extras = {key: None for key, _, _ in lanes}
     if on_tpu:
@@ -822,12 +926,15 @@ def main():
             except Exception as e:
                 extras[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
     else:
-        # the checkpoint lane has no TPU dependency (host memcpy + disk):
-        # run it everywhere so the async-stall contract is measured in CI
-        try:
-            extras["checkpoint"] = bench_checkpoint()
-        except Exception as e:
-            extras["checkpoint"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        # the checkpoint + elastic lanes have no TPU dependency (host
+        # memcpy, disk, signals): run them everywhere so the async-stall
+        # and zero-steps-lost contracts are measured in CI
+        for key, fn in (("checkpoint", bench_checkpoint),
+                        ("train_elastic", bench_train_elastic)):
+            try:
+                extras[key] = fn()
+            except Exception as e:
+                extras[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     full = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -890,6 +997,8 @@ def main():
             "fleet_tok_s_during_fault": _pick("serving_2b_fleet", "tput_during_tok_s"),
             "fleet_tok_s_after_recovery": _pick("serving_2b_fleet", "tput_after_tok_s"),
             "ckpt_stall_ratio": _pick("checkpoint", "stall_ratio_async_vs_sync"),
+            "elastic_recovery_s": _pick("train_elastic", "recovery_s"),
+            "elastic_steps_lost": _pick("train_elastic", "steps_lost"),
             "full_results": out_path,
         },
     }))

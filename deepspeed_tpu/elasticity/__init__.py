@@ -5,3 +5,11 @@ from deepspeed_tpu.elasticity.elasticity import (
     get_compatible_gpus,
 )
 from deepspeed_tpu.elasticity.elastic_agent import is_elastic_restart
+from deepspeed_tpu.elasticity.preemption import (
+    PREEMPT_RC,
+    HeartbeatWriter,
+    PreemptionGuard,
+    clear_resume_marker,
+    read_resume_marker,
+    write_resume_marker,
+)

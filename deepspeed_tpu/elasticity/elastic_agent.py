@@ -17,15 +17,32 @@ supervisor loop —
 - the batch math stays valid across world sizes because
   ``compute_elastic_config`` (elasticity.py) pre-computed a divisor-rich
   global batch — the relaunched job just picks the new gas.
+
+On top of crash recovery the agent handles the two failure modes a
+non-zero rc never surfaces:
+
+- **hangs** — the worker beats its step counter into a heartbeat file
+  (``DS_HEARTBEAT_FILE``, written by
+  :class:`~deepspeed_tpu.elasticity.preemption.HeartbeatWriter`); no
+  progress for ``DS_WATCHDOG_TIMEOUT`` seconds → SIGTERM, grace wait,
+  SIGKILL, relaunch, charged to the same failure window as a crash;
+- **preemptions** — the agent's own SIGTERM is *forwarded* to the
+  worker with a ``DS_PREEMPT_GRACE_S`` budget instead of killing
+  immediately, giving it time to emergency-checkpoint; a worker
+  exiting with :data:`~deepspeed_tpu.elasticity.preemption.PREEMPT_RC`
+  relaunches without charging the failure window (repeated fleet
+  preemption is not a crash loop).
 """
 
 import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Callable, Optional, Sequence
 
+from deepspeed_tpu.elasticity.preemption import PREEMPT_RC, read_heartbeat
 from deepspeed_tpu.utils.env_registry import env_int
 from deepspeed_tpu.utils.logging import logger
 
@@ -48,25 +65,57 @@ class DSElasticAgent:
     seconds (i.e. up to ``max_restarts`` relaunches after the initial
     attempt — a steady crash loop should surface, not spin); failures
     outside the window age out of the budget.
+
+    ``watchdog_timeout`` (default ``DS_WATCHDOG_TIMEOUT``, 0=off) arms
+    hang detection; ``preempt_grace`` (default ``DS_PREEMPT_GRACE_S``)
+    is the SIGTERM→SIGKILL escalation budget for both the watchdog and
+    forwarded shutdowns.
     """
 
     def __init__(self, cmd: Sequence[str], env_fn: Optional[Callable[[], dict]] = None,
                  max_restarts: int = 3, failure_window: float = 300.0,
-                 monitor_interval: float = 1.0):
+                 monitor_interval: float = 1.0,
+                 watchdog_timeout: Optional[float] = None,
+                 preempt_grace: Optional[float] = None):
         self.cmd = list(cmd)
         self.env_fn = env_fn or (lambda: os.environ.copy())
         self.max_restarts = int(max_restarts)
         self.failure_window = float(failure_window)
         self.monitor_interval = float(monitor_interval)
+        self.watchdog_timeout = float(
+            watchdog_timeout if watchdog_timeout is not None
+            else env_int("DS_WATCHDOG_TIMEOUT"))
+        self.preempt_grace = float(
+            preempt_grace if preempt_grace is not None
+            else env_int("DS_PREEMPT_GRACE_S"))
         self.restart_count = 0
+        self.preempt_count = 0
+        self.hang_count = 0
         self._child = None
         self._shutdown = False
+        self._down_since = None  # unix time the previous worker died
+        self._heartbeat_file = None
 
     # ------------------------------------------------------------------
     def _spawn(self):
         env = dict(self.env_fn())
         env["DS_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
         env["DS_ELASTIC_ENABLED"] = "1"
+        if self.watchdog_timeout > 0 and self._heartbeat_file is None:
+            fd, self._heartbeat_file = tempfile.mkstemp(prefix="ds_heartbeat_",
+                                                        suffix=".json")
+            os.close(fd)
+            os.remove(self._heartbeat_file)  # worker creates it on first beat
+        if self._heartbeat_file is not None:
+            try:
+                # stale beat from the previous incarnation must not arm
+                # the watchdog against a still-starting replacement
+                os.remove(self._heartbeat_file)
+            except OSError:
+                pass
+            env["DS_HEARTBEAT_FILE"] = self._heartbeat_file
+        if self._down_since is not None:
+            env["DS_ELASTIC_DOWN_SINCE"] = repr(self._down_since)
         logger.info(f"[elastic] launching worker (restart {self.restart_count}/"
                     f"{self.max_restarts}): {self.cmd}")
         self._child = subprocess.Popen(self.cmd, env=env, start_new_session=True)
@@ -80,34 +129,95 @@ class DSElasticAgent:
         except ProcessLookupError:
             pass
 
+    def _terminate_with_grace(self, child, reason):
+        """SIGTERM, wait up to ``preempt_grace`` for the emergency
+        checkpoint, then SIGKILL. Returns the rc."""
+        logger.warning(f"[elastic] {reason}: SIGTERM with "
+                       f"{self.preempt_grace:.0f}s grace")
+        self._kill_child(signal.SIGTERM)
+        try:
+            return child.wait(timeout=max(self.preempt_grace, 0.05))
+        except subprocess.TimeoutExpired:
+            logger.error(f"[elastic] {reason}: grace expired, SIGKILL")
+            self._kill_child(signal.SIGKILL)
+            return child.wait()
+
     def shutdown(self, sig=signal.SIGTERM):
+        """Graceful stop: forward the signal and let ``run()`` finish
+        the escalation — the worker gets its preemption grace budget
+        before anyone resorts to SIGKILL."""
         self._shutdown = True
         self._shutdown_sig = sig
         self._kill_child(sig)
+
+    # ---------------------------------------------------------- watchdog
+    def _heartbeat_stalled(self, last_progress_t, last_payload):
+        """(stalled, progress_t, payload): progress is any change in the
+        heartbeat payload; the clock only starts once the worker has
+        beaten at least once (startup/compile time is not a hang)."""
+        payload = read_heartbeat(self._heartbeat_file)
+        now = time.monotonic()
+        if payload is None:
+            return False, last_progress_t, last_payload  # not armed yet
+        if payload != last_payload:
+            return False, now, payload
+        if last_progress_t is not None and now - last_progress_t > self.watchdog_timeout:
+            return True, last_progress_t, payload
+        return False, last_progress_t if last_progress_t is not None else now, payload
 
     # ------------------------------------------------------------------
     def run(self) -> int:
         """Supervise until clean exit, crash-loop abort, or shutdown().
         Returns the final worker exit code."""
         failures = []  # timestamps of recent failures
+        prev_handlers = {}
         for s in (signal.SIGINT, signal.SIGTERM):
             try:
-                signal.signal(s, lambda *_: self.shutdown())
+                prev_handlers[s] = signal.signal(s, lambda *_: self.shutdown())
             except ValueError:
                 pass  # not the main thread (tests)
+        try:
+            return self._run(failures)
+        finally:
+            for s, prev in prev_handlers.items():
+                try:
+                    signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+                except ValueError:
+                    pass
+            if self._heartbeat_file is not None:
+                try:
+                    os.remove(self._heartbeat_file)
+                except OSError:
+                    pass
 
+    def _run(self, failures) -> int:
         while not self._shutdown:
             child = self._spawn()
-            while child.poll() is None and not self._shutdown:
-                time.sleep(self.monitor_interval)
+            hang = False
+            hb_progress_t, hb_payload = None, None
+            while not self._shutdown:
+                try:
+                    child.wait(timeout=self.monitor_interval)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if self.watchdog_timeout > 0 and self._heartbeat_file:
+                    hang, hb_progress_t, hb_payload = self._heartbeat_stalled(
+                        hb_progress_t, hb_payload)
+                    if hang:
+                        self.hang_count += 1
+                        self._terminate_with_grace(
+                            child, f"worker hung (no heartbeat progress in "
+                                   f"{self.watchdog_timeout:.0f}s)")
+                        break
             if self._shutdown:
-                self._kill_child()
-                child.wait()
-                # intentional shutdown: only death by the signal WE sent is a
-                # clean exit — a crash (SIGSEGV, OOM kill) or failing rc that
-                # raced with the shutdown still propagates
-                rc = child.returncode
-                clean = {-signal.SIGTERM, -getattr(self, "_shutdown_sig", signal.SIGTERM)}
+                rc = self._terminate_with_grace(child, "shutdown requested")
+                # intentional shutdown: only death by the signal WE sent (or a
+                # completed preemption save) is a clean exit — a crash (SIGSEGV,
+                # OOM kill) or failing rc that raced with the shutdown still
+                # propagates
+                clean = {PREEMPT_RC, -signal.SIGTERM,
+                         -getattr(self, "_shutdown_sig", signal.SIGTERM)}
                 if rc is None or rc == 0 or rc in clean:
                     return 0
                 return 128 - rc if rc < 0 else rc
@@ -119,6 +229,16 @@ class DSElasticAgent:
             if rc == 0:
                 logger.info("[elastic] worker exited cleanly")
                 return 0
+            self._down_since = time.time()
+            if rc == PREEMPT_RC and not hang:
+                # preempted with an emergency checkpoint on disk: relaunch
+                # outside the failure budget — preemption is not a crash loop
+                self.preempt_count += 1
+                self.restart_count += 1
+                logger.warning(f"[elastic] worker preempted (rc={rc}); "
+                               f"relaunching to resume (preemption "
+                               f"#{self.preempt_count})")
+                continue
             now = time.monotonic()
             failures = [t for t in failures if now - t < self.failure_window] + [now]
             if len(failures) > self.max_restarts:
@@ -126,7 +246,8 @@ class DSElasticAgent:
                              f"{self.failure_window}s — giving up (rc={rc})")
                 return rc
             self.restart_count += 1
-            logger.warning(f"[elastic] worker died rc={rc}; relaunching "
+            kind = "hung" if hang else "died"
+            logger.warning(f"[elastic] worker {kind} rc={rc}; relaunching "
                            f"({len(failures)}/{self.max_restarts} recent failures)")
         return 0
 
@@ -137,13 +258,19 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description="DeepSpeedTPU elastic agent")
     parser.add_argument("--max-restarts", type=int, default=3)
     parser.add_argument("--failure-window", type=float, default=300.0)
+    parser.add_argument("--watchdog-timeout", type=float, default=None,
+                        help="hang watchdog seconds (default DS_WATCHDOG_TIMEOUT)")
+    parser.add_argument("--preempt-grace", type=float, default=None,
+                        help="SIGTERM→SIGKILL grace (default DS_PREEMPT_GRACE_S)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         parser.error("no worker command given")
     agent = DSElasticAgent(cmd, max_restarts=args.max_restarts,
-                           failure_window=args.failure_window)
+                           failure_window=args.failure_window,
+                           watchdog_timeout=args.watchdog_timeout,
+                           preempt_grace=args.preempt_grace)
     sys.exit(agent.run())
 
 

@@ -1,0 +1,194 @@
+"""Worker-side preemption handling: guard, heartbeat, resume marker.
+
+TPU maintenance events arrive as SIGTERM with a short grace budget
+(the agent forwards its own SIGTERM the same way). Checkpointing from
+inside a signal handler is unsafe — the handler may interrupt a JAX
+dispatch or the nebula writer mid-commit — so :class:`PreemptionGuard`
+only flips a flag; the engine checks it between steps, finishes the
+in-flight step, runs ``NebulaCheckpointService.emergency_save`` and
+exits with :data:`PREEMPT_RC` so the agent can tell a preemption from
+a crash.
+
+:class:`HeartbeatWriter` is the other half of the agent's hang
+watchdog: the engine beats a monotonic step counter into
+``DS_HEARTBEAT_FILE`` after every step; the agent declares a hang when
+the payload stops changing for ``DS_WATCHDOG_TIMEOUT`` seconds.
+
+The resume marker is a small JSON breadcrumb written next to the
+emergency checkpoint telling the relaunched worker (possibly at a
+different world size) which tag to resume from and which step it
+carries; ``engine.load_checkpoint`` clears it once resume succeeds.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from deepspeed_tpu.utils.env_registry import env_int, env_raw
+from deepspeed_tpu.utils.logging import logger
+
+# Distinguished worker exit code for "preempted, emergency checkpoint
+# committed". The agent relaunches on this rc without charging the
+# failure window — a fleet being preempted repeatedly is not a crash
+# loop. 13 avoids the shell's 128+N signal range and sysexits.h.
+PREEMPT_RC = 13
+
+RESUME_MARKER = ".preempt_resume"
+
+
+class PreemptionGuard:
+    """Deferred SIGTERM: ``install()`` hooks the signal, the handler
+    only records the request, and the training loop polls
+    ``preempted`` between steps. Re-entrant: ``uninstall()`` restores
+    whatever handlers were installed before us (tests install/uninstall
+    repeatedly in one process)."""
+
+    def __init__(self, grace_s: Optional[float] = None, test_hook=None):
+        self._lock = threading.Lock()
+        self._requested = False
+        self._requested_at = None
+        self._prev_handlers = {}
+        self._installed = False
+        self.grace_s = float(grace_s if grace_s is not None
+                             else env_int("DS_PREEMPT_GRACE_S"))
+        self.test_hook = test_hook
+
+    # ---------------------------------------------------------- signals
+    def install(self, signals=(signal.SIGTERM,)):
+        if self._installed:
+            return self
+        for s in signals:
+            try:
+                self._prev_handlers[s] = signal.signal(s, self._handler)
+            except ValueError:
+                # not the main thread (tests / embedded use): stay a
+                # poll-only guard — request() still works
+                logger.debug(f"[preempt] cannot hook signal {s} off the "
+                             "main thread; guard is poll-only")
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev_handlers.items():
+            try:
+                signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+            except ValueError:
+                pass
+        self._prev_handlers = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        logger.warning(f"[preempt] received signal {signum}; finishing the "
+                       f"in-flight step then emergency-checkpointing "
+                       f"(grace {self.grace_s:.0f}s)")
+        self.request()
+        if self.test_hook is not None:
+            self.test_hook("signal", signum)
+
+    # ------------------------------------------------------------ state
+    def request(self):
+        """Flag a preemption (signal handler, or tests calling directly)."""
+        with self._lock:
+            if not self._requested:
+                self._requested = True
+                self._requested_at = time.monotonic()
+
+    @property
+    def preempted(self) -> bool:
+        with self._lock:
+            return self._requested
+
+    def deadline_remaining(self) -> Optional[float]:
+        """Seconds left of the grace budget, or None when not preempted.
+        Clamped at 0 — callers treat <=0 as "skip anything optional"."""
+        with self._lock:
+            if not self._requested:
+                return None
+            return max(0.0, self.grace_s - (time.monotonic() - self._requested_at))
+
+    def reset(self):
+        with self._lock:
+            self._requested = False
+            self._requested_at = None
+
+
+class HeartbeatWriter:
+    """Beats ``{"step": N, "time": t}`` into ``DS_HEARTBEAT_FILE`` via
+    tmp+rename (the watchdog must never read a torn write). No-op when
+    the env knob is unset, so the engine can call ``beat()``
+    unconditionally."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.path = path if path is not None else env_raw("DS_HEARTBEAT_FILE")
+        self._last_step = None
+        self._last_beat_t = None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def beat(self, step: int):
+        if not self.path:
+            return
+        with self._lock:
+            if step == self._last_step:
+                return
+            self._last_step = step
+            self._last_beat_t = time.time()
+            payload = {"step": int(step), "time": self._last_beat_t}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fd:
+                json.dump(payload, fd)
+            os.replace(tmp, self.path)
+        except OSError as e:  # heartbeat loss must never kill training
+            logger.warning(f"[preempt] heartbeat write failed: {e}")
+
+
+def read_heartbeat(path: str) -> Optional[dict]:
+    """The watchdog-side reader: parsed payload, or None when the file
+    is missing/torn (atomic rename makes torn reads near-impossible,
+    but a worker dying mid-first-write leaves nothing)."""
+    try:
+        with open(path) as fd:
+            return json.load(fd)
+    except (OSError, ValueError):
+        return None
+
+
+# ----------------------------------------------------------------------
+# resume marker
+# ----------------------------------------------------------------------
+def resume_marker_path(save_dir: str) -> str:
+    return os.path.join(save_dir, RESUME_MARKER)
+
+
+def write_resume_marker(save_dir: str, tag: str, step: int) -> str:
+    """Atomically record which emergency tag the next launch should
+    resume from."""
+    path = resume_marker_path(save_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fd:
+        json.dump({"tag": tag, "step": int(step), "time": time.time()}, fd)
+    os.replace(tmp, path)
+    return path
+
+
+def read_resume_marker(save_dir: str) -> Optional[dict]:
+    try:
+        with open(resume_marker_path(save_dir)) as fd:
+            marker = json.load(fd)
+    except (OSError, ValueError):
+        return None
+    return marker if isinstance(marker, dict) and "tag" in marker else None
+
+
+def clear_resume_marker(save_dir: str):
+    try:
+        os.remove(resume_marker_path(save_dir))
+    except OSError:
+        pass

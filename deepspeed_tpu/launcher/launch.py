@@ -37,6 +37,14 @@ def parse_args(args=None):
                              "failure (reference launch.py --enable_elastic_training / "
                              "DSElasticAgent)")
     parser.add_argument("--max_elastic_restarts", type=int, default=3)
+    parser.add_argument("--watchdog_timeout", type=float, default=None,
+                        help="elastic agent hang watchdog: kill+relaunch the worker "
+                             "when its heartbeat step counter makes no progress for "
+                             "this many seconds (default DS_WATCHDOG_TIMEOUT; 0 off)")
+    parser.add_argument("--preempt_grace", type=float, default=None,
+                        help="seconds between the agent's SIGTERM and SIGKILL — the "
+                             "worker's emergency-checkpoint budget (default "
+                             "DS_PREEMPT_GRACE_S)")
     parser.add_argument("--elastic_rendezvous_file", type=str, default=None,
                         help="JSON file re-read before every elastic relaunch; keys "
                              "master_addr/master_port/node_rank/nnodes override the CLI "
@@ -117,7 +125,9 @@ def main(args=None):
             with open(args.save_pid, "w") as f:
                 f.write(str(os.getpid()))
         agent = DSElasticAgent(cmd, env_fn=resolve_env,
-                               max_restarts=args.max_elastic_restarts)
+                               max_restarts=args.max_elastic_restarts,
+                               watchdog_timeout=args.watchdog_timeout,
+                               preempt_grace=args.preempt_grace)
         sys.exit(agent.run())
     # new process group so signal forwarding reaches the whole subtree
     child = subprocess.Popen(cmd, env=env, start_new_session=True)

@@ -182,38 +182,63 @@ def validate_tag(save_dir, tag):
     return manifest
 
 
-def _manifest_tags(save_dir):
-    """Committed (manifest-bearing) tag dirs, newest manifest first."""
+PREEMPT_TAG_PREFIX = "preempt-"
+
+
+def _manifest_tag_entries(save_dir):
+    """``(manifest_mtime, name)`` for every committed (manifest-bearing)
+    tag dir, newest manifest first."""
     out = []
     for name in os.listdir(save_dir):
         tag_dir = os.path.join(save_dir, name)
         mpath = os.path.join(tag_dir, MANIFEST_NAME)
         if name != TMP_ROOT and os.path.isdir(tag_dir) and os.path.isfile(mpath):
             out.append((os.path.getmtime(mpath), name))
-    return [name for _, name in sorted(out, reverse=True)]
+    return sorted(out, reverse=True)
+
+
+def _manifest_tags(save_dir):
+    """Committed (manifest-bearing) tag dirs, newest manifest first."""
+    return [name for _, name in _manifest_tag_entries(save_dir)]
 
 
 def resolve_load_tag(load_dir):
     """Resume-side tag resolution: the newest *intact* tag.
 
-    Prefers the ``latest`` pointer when it validates; a torn/uncommitted
-    latest falls back to the newest tag whose manifest validates. Legacy
-    directories (no manifests anywhere) trust ``latest`` as-is, since
-    there is nothing to validate against."""
+    Prefers the ``latest`` pointer when it validates, with one carve-out:
+    an emergency (``preempt-*``) tag committed AFTER the tag ``latest``
+    names is tried first — a SIGKILL landing between the emergency
+    commit's promote and its ``latest`` rotation must not lose the
+    freshest state. A torn/uncommitted candidate falls back to the
+    newest tag whose manifest validates. Legacy directories (no
+    manifests anywhere) trust ``latest`` as-is, since there is nothing
+    to validate against."""
     if load_dir is None or not os.path.isdir(load_dir):
         return None
     latest = read_latest(load_dir)
-    candidates = _manifest_tags(load_dir)
+    entries = _manifest_tag_entries(load_dir)
+    candidates = [name for _, name in entries]
     if not candidates:
         return latest  # legacy layout: nothing validatable
     if latest is not None:
-        candidates = [latest] + [t for t in candidates if t != latest]
+        latest_mtime = next((m for m, n in entries if n == latest), None)
+        newer_preempts = [
+            n for m, n in entries
+            if n != latest and n.startswith(PREEMPT_TAG_PREFIX)
+            and (latest_mtime is None or m > latest_mtime)]
+        candidates = (newer_preempts + [latest]
+                      + [t for t in candidates
+                         if t != latest and t not in newer_preempts])
     for tag in candidates:
         try:
             validate_tag(load_dir, tag)
             if latest is not None and tag != latest:
-                logger.warning(f"[nebula] latest tag '{latest}' is torn or uncommitted; "
-                               f"resuming from newest intact tag '{tag}'")
+                if tag.startswith(PREEMPT_TAG_PREFIX):
+                    logger.warning(f"[nebula] resuming from emergency tag '{tag}' "
+                                   f"(newer than latest-pointed '{latest}')")
+                else:
+                    logger.warning(f"[nebula] latest tag '{latest}' is torn or uncommitted; "
+                                   f"resuming from newest intact tag '{tag}'")
             return tag
         except CheckpointCorruptionError as e:
             logger.warning(f"[nebula] skipping tag '{tag}': {e.reason}")
@@ -327,6 +352,32 @@ class NebulaCheckpointService:
         if not parts and not _is_rank0():
             return
         self._execute(_Job(save_dir, tag, parts, save_latest, snapshot_s, step, meta))
+
+    def emergency_save(self, save_dir, tag, parts, deadline_s=None,
+                       save_latest=True, snapshot_s=0.0, step=None, meta=None):
+        """Synchronous fast-path save for preemption: same snapshot →
+        commit protocol as ``save_sync``, but the drain of any in-flight
+        background write is bounded by ``deadline_s`` — past the
+        deadline we press on anyway (distinct tag dirs keep a concurrent
+        writer from colliding with the emergency payload; at worst the
+        ``latest`` pointer race leaves it naming either of two intact
+        tags, and ``resolve_load_tag`` prefers the newer ``preempt-*``
+        tag regardless). Returns the wall-clock seconds the save took;
+        raises inline on write failure — the caller decides whether a
+        failed emergency save still exits cleanly."""
+        drained = self.wait(timeout=deadline_s)
+        if not drained:
+            logger.warning(f"[nebula] emergency save '{tag}': background writer "
+                           f"still busy after {deadline_s}s; writing alongside it")
+        t0 = time.perf_counter()
+        if not parts and not _is_rank0():
+            return 0.0
+        self._execute(_Job(save_dir, tag, parts, save_latest, snapshot_s, step, meta))
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            self._stats["emergency_saves"] = self._stats.get("emergency_saves", 0) + 1
+        logger.info(f"[nebula] emergency save '{tag}' committed in {elapsed:.2f}s")
+        return elapsed
 
     def shutdown(self, wait=True):
         if wait:

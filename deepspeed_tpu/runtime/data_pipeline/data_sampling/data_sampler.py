@@ -33,6 +33,7 @@ class DeepSpeedDataSampler:
         self.seed = seed
         self.drop_last = drop_last
         self.global_step = 0
+        self.consumed_samples = 0
         self._rng = np.random.RandomState(seed)
 
     def _admitted(self):
@@ -47,6 +48,7 @@ class DeepSpeedDataSampler:
         idx = self._rng.choice(pool, size=self.batch_size,
                                replace=len(pool) < self.batch_size)
         self.global_step += 1
+        self.consumed_samples += self.batch_size
         return idx.astype(np.int64)
 
     def __iter__(self):
@@ -55,10 +57,14 @@ class DeepSpeedDataSampler:
 
     def state_dict(self):
         return {"global_step": self.global_step,
+                "consumed_samples": self.consumed_samples,
                 "rng": self._rng.get_state(),
                 "scheduler": self.scheduler.state_dict()}
 
     def load_state_dict(self, sd):
         self.global_step = sd["global_step"]
+        # legacy checkpoints predate consumed_samples: derive it
+        self.consumed_samples = int(sd.get(
+            "consumed_samples", sd["global_step"] * self.batch_size))
         self._rng.set_state(sd["rng"])
         self.scheduler.load_state_dict(sd["scheduler"])

@@ -37,7 +37,17 @@ class RepeatingLoader:
 
 
 class DistributedSampler:
-    """Deterministic strided sampler over dataset indices for a dp rank."""
+    """Deterministic strided sampler over dataset indices for a dp rank.
+
+    The *global* sample order is the seed+epoch permutation of the
+    dataset (padded to ``total_size``) — a function of the seed alone,
+    never of the replica count; each rank strides over it. That makes
+    ``consumed_samples`` (a count of globally consumed samples) a
+    world-size-independent resume coordinate: restoring it at a
+    different ``num_replicas`` neither repeats nor skips samples, as
+    long as the padded ``total_size`` is width-invariant (dataset size
+    divisible by every width, or ``drop_last`` layouts that agree).
+    """
 
     def __init__(self, num_samples, num_replicas, rank, shuffle=True, seed=0, drop_last=False):
         self.num_samples_total = num_samples
@@ -47,6 +57,7 @@ class DistributedSampler:
         self.seed = seed
         self.epoch = 0
         self.drop_last = drop_last
+        self.consumed_samples = 0  # global samples consumed since set_epoch
         if drop_last:
             self.num_samples = num_samples // num_replicas
         else:
@@ -54,11 +65,19 @@ class DistributedSampler:
         self.total_size = self.num_samples * num_replicas
 
     def set_epoch(self, epoch):
+        """Torch-style: start epoch ``epoch`` from its beginning."""
         self.epoch = epoch
+        self.consumed_samples = 0
 
-    def __iter__(self):
+    def advance(self, n_global_samples):
+        """Record ``n_global_samples`` consumed across ALL replicas (the
+        loader calls this per yielded batch); past ``total_size`` the
+        sampler rolls into the next epoch's permutation by itself."""
+        self.consumed_samples += int(n_global_samples)
+
+    def _global_order(self, epoch):
         if self.shuffle:
-            rng = np.random.RandomState(self.seed + self.epoch)
+            rng = np.random.RandomState(self.seed + epoch)
             indices = rng.permutation(self.num_samples_total).tolist()
         else:
             indices = list(range(self.num_samples_total))
@@ -68,10 +87,43 @@ class DistributedSampler:
                 indices += indices[:padding]
         else:
             indices = indices[:self.total_size]
-        return iter(indices[self.rank:self.total_size:self.num_replicas])
+        return indices
+
+    def __iter__(self):
+        # resume-aware: skip the globally-consumed prefix of the current
+        # effective epoch, then stride the unconsumed tail for this rank
+        epoch = self.epoch + self.consumed_samples // self.total_size
+        offset = self.consumed_samples % self.total_size
+        indices = self._global_order(epoch)[offset:]
+        return iter(indices[self.rank::self.num_replicas])
 
     def __len__(self):
         return self.num_samples
+
+    # -- checkpoint state ----------------------------------------------
+    def state_dict(self):
+        return {"epoch": self.epoch,
+                "consumed_samples": self.consumed_samples,
+                "seed": self.seed,
+                "shuffle": self.shuffle}
+
+    def load_state_dict(self, sd, num_replicas=None, rank=None):
+        """Restore the resume coordinate, optionally onto a different
+        replica layout (elastic re-mesh)."""
+        self.epoch = int(sd.get("epoch", 0))
+        self.consumed_samples = int(sd.get("consumed_samples", 0))
+        self.seed = sd.get("seed", self.seed)
+        self.shuffle = sd.get("shuffle", self.shuffle)
+        if num_replicas is not None:
+            self.num_replicas = int(num_replicas)
+        if rank is not None:
+            self.rank = int(rank)
+        if num_replicas is not None or rank is not None:
+            if self.drop_last:
+                self.num_samples = self.num_samples_total // self.num_replicas
+            else:
+                self.num_samples = math.ceil(self.num_samples_total / self.num_replicas)
+            self.total_size = self.num_samples * self.num_replicas
 
 
 class DeepSpeedDataLoader:
@@ -129,6 +181,13 @@ class DeepSpeedDataLoader:
             return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
         return np.stack([np.asarray(s) for s in samples])
 
+    def _advance(self, n_local):
+        """Account ``n_local`` samples yielded to THIS rank: every other
+        replica consumed the same count in the same global batch."""
+        if hasattr(self.data_sampler, "advance"):
+            replicas = getattr(self.data_sampler, "num_replicas", self.dp_world_size)
+            self.data_sampler.advance(n_local * replicas)
+
     def _create_dataloader(self):
         collate = self.collate_fn or self._default_collate
 
@@ -137,10 +196,41 @@ class DeepSpeedDataLoader:
             for idx in iter(self.data_sampler):
                 buf.append(self.dataset[idx])
                 if len(buf) == self.batch_size:
-                    yield collate(buf)
+                    batch = collate(buf)
+                    self._advance(len(buf))
                     buf = []
+                    yield batch
             if buf and not self.drop_last:
-                yield collate(buf)
+                batch = collate(buf)
+                self._advance(len(buf))
+                yield batch
 
         self.data = gen()
         return self.data
+
+    # -- checkpoint state ----------------------------------------------
+    def state_dict(self):
+        """Resume coordinate for the data stream: the sampler's consumed
+        count + RNG configuration (see ``DistributedSampler``); custom
+        samplers contribute their own ``state_dict``."""
+        sd = {"batch_size": self.batch_size}
+        if hasattr(self.data_sampler, "state_dict"):
+            sd["sampler"] = self.data_sampler.state_dict()
+        return sd
+
+    def load_state_dict(self, sd):
+        if not sd:
+            return
+        if sd.get("batch_size") not in (None, self.batch_size):
+            logger.warning(f"[dataloader] resuming with micro-batch "
+                           f"{self.batch_size} != checkpointed {sd['batch_size']}")
+        sampler_sd = sd.get("sampler")
+        if sampler_sd is not None and hasattr(self.data_sampler, "load_state_dict"):
+            try:
+                # DistributedSampler re-targets the current replica layout
+                self.data_sampler.load_state_dict(
+                    sampler_sd, num_replicas=self.dp_world_size, rank=self.dp_rank)
+            except TypeError:
+                self.data_sampler.load_state_dict(sampler_sd)
+        # any in-flight iterator predates the restored coordinate
+        self.data = None

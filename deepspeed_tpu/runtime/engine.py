@@ -54,7 +54,7 @@ from deepspeed_tpu.runtime.constants import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, 
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler, has_overflow, scaler_state, update_scale
 from deepspeed_tpu.runtime.zero.partitioning import ZeroShardingPolicy, batch_spec, path_tree_map
-from deepspeed_tpu.utils.env_registry import env_int
+from deepspeed_tpu.utils.env_registry import env_bool, env_int, env_raw
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER, FORWARD_GLOBAL_TIMER,
                                        FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER, STEP_MICRO_TIMER, TRAIN_BATCH_TIMER,
@@ -213,6 +213,18 @@ class DeepSpeedEngine:
 
         # Data loader
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
+
+        # Preemption tolerance: a SIGTERM (TPU maintenance / elastic agent
+        # forward) flips a flag; the step boundary finishes the in-flight
+        # step, emergency-saves, and exits PREEMPT_RC. The heartbeat is
+        # the agent-side hang watchdog's signal (no-op unless the agent
+        # exported DS_HEARTBEAT_FILE).
+        from deepspeed_tpu.elasticity.preemption import HeartbeatWriter, PreemptionGuard
+        self._heartbeat = HeartbeatWriter()
+        self._preemption_guard = None
+        self._last_ckpt_dir = None  # latest save/load dir — emergency-save fallback
+        if env_bool("DS_EMERGENCY_CKPT") and env_bool("DS_ELASTIC_ENABLED"):
+            self._preemption_guard = PreemptionGuard().install()
 
         # Legacy curriculum learning: the engine truncates each batch's
         # sequence dim to the scheduled difficulty (reference engine
@@ -489,6 +501,9 @@ class DeepSpeedEngine:
             # drain: an in-flight background checkpoint must commit (or
             # surface its failure) before the state it snapshots dies
             self._checkpoint_service.shutdown(wait=True)
+        if self._preemption_guard is not None:
+            self._preemption_guard.uninstall()
+            self._preemption_guard = None
         self._jit_cache.clear()
         self._grads_acc = None
         self._pending = None
@@ -1200,6 +1215,8 @@ class DeepSpeedEngine:
         self._write_monitor()
         if self.wall_clock_breakdown_enabled and self.global_steps % self.steps_per_print() == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER])
+        self._heartbeat.beat(self.global_steps)
+        self._maybe_handle_preemption()
 
     # ------------------------------------------------------------------
     # Fused train_batch hot path
@@ -1375,7 +1392,59 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True)
         self.losses = mean_loss
         self._write_monitor(loss=mean_loss)
+        self._heartbeat.beat(self.global_steps)
+        self._maybe_handle_preemption()
         return mean_loss
+
+    # ------------------------------------------------------------------
+    # Preemption (checked between steps; never inside a signal handler)
+    # ------------------------------------------------------------------
+    def _maybe_handle_preemption(self):
+        """Step-boundary preemption check: emergency-save, write the
+        resume marker, and exit :data:`PREEMPT_RC` so the elastic agent
+        relaunches outside the failure budget. A failed save still exits
+        — the grace budget is real and the last periodic checkpoint plus
+        its resume validation already cover the no-save case."""
+        guard = self._preemption_guard
+        if guard is None or not guard.preempted:
+            return
+        from deepspeed_tpu.elasticity.preemption import PREEMPT_RC, write_resume_marker
+        tag = f"preempt-{self.global_steps}"
+        deadline = guard.deadline_remaining()
+        save_dir = self._resolve_emergency_dir()
+        elapsed = None
+        if save_dir is None:
+            logger.error("[preempt] no checkpoint directory known (no nebula "
+                         "persistent_storage_path and no prior save) — exiting "
+                         "without an emergency checkpoint")
+        else:
+            try:
+                t0 = time.perf_counter()
+                self.save_checkpoint(save_dir, tag=tag, async_save=False,
+                                     _emergency_deadline_s=deadline)
+                elapsed = time.perf_counter() - t0
+                write_resume_marker(save_dir, tag, self.global_steps)
+                logger.warning(f"[preempt] emergency checkpoint '{tag}' committed "
+                               f"in {elapsed:.2f}s; exiting rc={PREEMPT_RC}")
+            except BaseException as e:
+                logger.error(f"[preempt] emergency checkpoint failed "
+                             f"({type(e).__name__}: {e}); exiting anyway — resume "
+                             f"falls back to the last periodic checkpoint")
+        if self.monitor.enabled:
+            events = [("Train/Elastic/preempt_step", self.global_steps, self.global_steps)]
+            if elapsed is not None:
+                events.append(("Train/Elastic/emergency_save_s", float(elapsed), self.global_steps))
+            try:
+                self.monitor.write_events(events)
+            except Exception:
+                pass
+        raise SystemExit(PREEMPT_RC)
+
+    def _resolve_emergency_dir(self):
+        ncfg = getattr(self._config, "nebula_config", None)
+        if ncfg is not None and ncfg.enabled and ncfg.persistent_storage_path:
+            return ncfg.persistent_storage_path
+        return self._last_ckpt_dir
 
     def _write_monitor(self, loss=None):
         if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
@@ -1456,11 +1525,14 @@ class DeepSpeedEngine:
                         client_state={},
                         save_latest=True,
                         exclude_frozen_parameters=False,
-                        async_save=None):
+                        async_save=None,
+                        _emergency_deadline_s=None):
         assert self._initialized, "cannot save before the first forward/train_batch"
+        emergency = _emergency_deadline_s is not None
         nebula = self._checkpoint_service
-        if nebula is not None:
-            # a failed background write surfaces here, never silently
+        if nebula is not None and not emergency:
+            # a failed background write surfaces here, never silently (an
+            # emergency save must not die on an unrelated earlier failure)
             nebula.raise_pending_failure()
         if save_dir is None:
             if nebula is not None and self._config.nebula_config.persistent_storage_path:
@@ -1468,7 +1540,10 @@ class DeepSpeedEngine:
             else:
                 raise ValueError("save_checkpoint requires save_dir "
                                  "(or nebula.persistent_storage_path in the config)")
-        if async_save is None:
+        self._last_ckpt_dir = save_dir
+        if emergency:
+            async_save = False
+        elif async_save is None:
             async_save = nebula is not None
         elif async_save and nebula is None:
             raise ValueError("async_save=True requires the nebula checkpoint service: "
@@ -1511,6 +1586,11 @@ class DeepSpeedEngine:
         }
         if self.lr_scheduler is not None:
             model_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+        if self.training_dataloader is not None and hasattr(self.training_dataloader, "state_dict"):
+            # consumed-samples + sampler RNG: resume at ANY dp width
+            # neither repeats nor skips samples (global sample order is
+            # world-size independent — see runtime/dataloader.py)
+            model_state["dataloader_state"] = self.training_dataloader.state_dict()
         # A sharded save is ONE logical chunk store for the whole mesh:
         # every process must target the same path (global coordinates make
         # per-mp-rank files meaningless), so pin the mp placeholder.
@@ -1541,9 +1621,15 @@ class DeepSpeedEngine:
             if sharded or dist.get_process_rank() == 0:
                 parts = [(model_state, os.path.relpath(ckpt_name, tag_dir)),
                          (optim_state, os.path.relpath(optim_name, tag_dir))]
-            submit = nebula.save_async if async_save else nebula.save_sync
-            submit(save_dir, tag, parts, save_latest=save_latest,
-                   snapshot_s=snapshot_s, step=self.global_steps)
+            if emergency:
+                nebula.emergency_save(save_dir, tag, parts,
+                                      deadline_s=_emergency_deadline_s,
+                                      save_latest=save_latest,
+                                      snapshot_s=snapshot_s, step=self.global_steps)
+            else:
+                submit = nebula.save_async if async_save else nebula.save_sync
+                submit(save_dir, tag, parts, save_latest=save_latest,
+                       snapshot_s=snapshot_s, step=self.global_steps)
             return True
 
         if sharded or dist.get_process_rank() == 0:
@@ -1661,7 +1747,14 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and "lr_scheduler" in model_state:
             self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
 
+        self._last_ckpt_dir = load_dir
+        if (model_state.get("dataloader_state") is not None
+                and self.training_dataloader is not None
+                and hasattr(self.training_dataloader, "load_state_dict")):
+            self.training_dataloader.load_state_dict(model_state["dataloader_state"])
+
         if load_module_only or not load_optimizer_states:
+            self._finish_elastic_resume(load_dir, tag, model_state)
             return load_dir, client_state
 
         optim_name = self._get_optimizer_ckpt_name(load_dir, tag, dp_rank=0)
@@ -1675,7 +1768,53 @@ class DeepSpeedEngine:
                 # so a sharded read can't place leaves (and an eager read
                 # would gather the world) — stash the path instead
                 self._pending_optim_state = ("__ckpt_path__", optim_name)
+        self._finish_elastic_resume(load_dir, tag, model_state)
         return load_dir, client_state
+
+    def _finish_elastic_resume(self, load_dir, tag, model_state):
+        """Post-load elastic bookkeeping: log the re-mesh (checkpoint dp
+        width N → current width M — the sharded engine already resharded
+        every leaf onto the current mesh; the global batch is invariant
+        because ``compute_elastic_config`` picked a divisor-rich batch,
+        so only gradient-accumulation changed), emit ``Train/Elastic/*``
+        recovery events, and clear the preemption resume marker."""
+        ckpt_dp = self.loaded_checkpoint_dp_world_size
+        cur_dp = self.dp_world_size()
+        if ckpt_dp is not None and int(ckpt_dp) != int(cur_dp):
+            ckpt_cfg = model_state.get("ds_config") or {}
+            ckpt_gbs = ckpt_cfg.get("train_batch_size")
+            cur_gbs = self.train_batch_size()
+            if ckpt_gbs is not None and int(ckpt_gbs) != int(cur_gbs):
+                logger.warning(
+                    f"[elastic] re-mesh resume dp {ckpt_dp}→{cur_dp} changes the "
+                    f"global batch ({ckpt_gbs}→{cur_gbs}): the loss curve will "
+                    f"diverge from the uninterrupted run. Enable elasticity so "
+                    f"compute_elastic_config keeps the global batch invariant.")
+            else:
+                logger.info(f"[elastic] re-mesh resume: checkpoint dp width {ckpt_dp} → "
+                            f"current {cur_dp} (global batch {cur_gbs} unchanged, "
+                            f"gas={self.gradient_accumulation_steps()})")
+        from deepspeed_tpu.elasticity import is_elastic_restart
+        from deepspeed_tpu.elasticity.preemption import clear_resume_marker, read_resume_marker
+        if read_resume_marker(load_dir) is not None:
+            clear_resume_marker(load_dir)
+        if is_elastic_restart() and self.monitor.enabled:
+            events = [("Train/Elastic/restart_count",
+                       env_int("DS_ELASTIC_RESTART_COUNT"), self.global_steps),
+                      ("Train/Elastic/resume_step", self.global_steps, self.global_steps),
+                      ("Train/Elastic/dp_world_size", int(cur_dp), self.global_steps)]
+            down_since = env_raw("DS_ELASTIC_DOWN_SINCE")
+            if down_since:
+                try:
+                    events.append(("Train/Elastic/recovery_s",
+                                   max(0.0, time.time() - float(down_since)),
+                                   self.global_steps))
+                except ValueError:
+                    pass
+            try:
+                self.monitor.write_events(events)
+            except Exception:
+                pass
 
     def _reader_engine(self, path):
         """Pick the engine matching the on-disk format (a sharded write is

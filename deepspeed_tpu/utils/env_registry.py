@@ -178,6 +178,31 @@ register("DS_ELASTIC_ENABLED", "bool", False,
          "Set by the elastic agent in worker environments when elastic "
          "training is active.",
          "deepspeed_tpu/elasticity/elastic_agent.py")
+register("DS_PREEMPT_GRACE_S", "int", 30,
+         "Grace budget (seconds) between SIGTERM and SIGKILL: the "
+         "worker's emergency-checkpoint deadline, and how long the "
+         "agent waits before escalating a forwarded/watchdog SIGTERM.",
+         "deepspeed_tpu/elasticity/preemption.py")
+register("DS_WATCHDOG_TIMEOUT", "int", 0,
+         "Hang watchdog: agent kills+relaunches the worker when the "
+         "heartbeat step counter makes no progress for this many "
+         "seconds. 0 disables the watchdog.",
+         "deepspeed_tpu/elasticity/elastic_agent.py")
+register("DS_EMERGENCY_CKPT", "bool", True,
+         "Kill switch for the SIGTERM emergency-checkpoint path; off, "
+         "a preempted worker exits without saving (resume falls back "
+         "to the last periodic checkpoint).",
+         "deepspeed_tpu/runtime/engine.py")
+register("DS_HEARTBEAT_FILE", "optional_str", None,
+         "Path the engine beats its step counter into for the agent's "
+         "hang watchdog; exported by the agent, unset disables "
+         "heartbeating.",
+         "deepspeed_tpu/elasticity/preemption.py")
+register("DS_ELASTIC_DOWN_SINCE", "optional_str", None,
+         "Unix time the agent detected the previous worker's death; "
+         "exported into relaunched workers so the engine can report "
+         "Train/Elastic/recovery_s.",
+         "deepspeed_tpu/runtime/engine.py")
 
 # Autotuning / build
 register("DS_FORCE_PLATFORM", "optional_str", None,

@@ -526,3 +526,94 @@ def test_sharded_resave_crash_preserves_previous_shards(tmpdir, monkeypatch):
     load_dir, _ = e.load_checkpoint(str(tmpdir), tag="t")
     assert load_dir is not None
     assert_trees_equal(params, host_tree(e.params))
+
+
+# ----------------------------------------------------------------------
+# emergency (preempt-*) tags
+# ----------------------------------------------------------------------
+def _age_manifest(save_dir, tag, older_by=10.0):
+    """Backdate a tag's manifest mtime (mtime orders resolve candidates)."""
+    mpath = os.path.join(str(save_dir), tag, "nebula_manifest.json")
+    t = os.path.getmtime(mpath) - older_by
+    os.utime(mpath, (t, t))
+
+
+def test_emergency_save_commits_and_validates(tmpdir):
+    """emergency_save: same commit protocol as save_sync, inline, tag
+    loadable immediately; resolve prefers it (latest rotated)."""
+    e, _ = _two_committed_tags(tmpdir)
+    params = host_tree(e.params)
+    e.save_checkpoint(tag="preempt-2", _emergency_deadline_s=30.0)
+    assert validate_tag(str(tmpdir), "preempt-2")
+    assert resolve_load_tag(str(tmpdir)) == "preempt-2"
+    assert e._checkpoint_service.stats["emergency_saves"] == 1
+    train(e, 1)
+    load_dir, _ = e.load_checkpoint(tag="preempt-2")
+    assert load_dir is not None
+    assert_trees_equal(params, host_tree(e.params))
+
+
+def test_newer_emergency_tag_beats_latest_pointer(tmpdir):
+    """SIGKILL between the emergency commit's promote and its `latest`
+    rotation: latest still names the periodic tag, but the newer intact
+    preempt-* tag must win resume."""
+    e, _ = _two_committed_tags(tmpdir)  # latest -> v2
+    svc = drain(e)
+    # emergency save whose latest rotation never landed
+    e.save_checkpoint(tag="preempt-9", save_latest=False, _emergency_deadline_s=30.0)
+    from deepspeed_tpu.nebula.service import read_latest
+    assert read_latest(str(tmpdir)) == "v2"
+    assert resolve_load_tag(str(tmpdir)) == "preempt-9"
+
+
+def test_older_emergency_tag_does_not_hijack_resume(tmpdir):
+    """A preempt-* tag OLDER than the latest periodic save (stale marker
+    from a previous preemption) must not override latest."""
+    e, _ = _two_committed_tags(tmpdir)
+    e.save_checkpoint(tag="preempt-1", save_latest=False, _emergency_deadline_s=30.0)
+    _age_manifest(tmpdir, "preempt-1", older_by=30.0)
+    assert resolve_load_tag(str(tmpdir)) == "v2"
+
+
+def test_torn_emergency_commit_falls_back_to_periodic(tmpdir):
+    """Truncated emergency manifest (worker died mid-commit after the
+    promote raced partway): resolve skips it cleanly and resumes from
+    the newest intact periodic tag."""
+    e, _ = _two_committed_tags(tmpdir)
+    e.save_checkpoint(tag="preempt-3", save_latest=False, _emergency_deadline_s=30.0)
+    corrupt_json(os.path.join(str(tmpdir), "preempt-3", "nebula_manifest.json"))
+    assert resolve_load_tag(str(tmpdir)) == "v2"
+    load_dir, _ = e.load_checkpoint()
+    assert load_dir is not None
+
+
+def test_emergency_save_with_busy_writer_still_commits(tmpdir):
+    """Deadline-bounded drain: a wedged background write does not block
+    the emergency save past its deadline; the emergency tag commits
+    alongside and wins resume."""
+    import time as _time
+    e, _ = _two_committed_tags(tmpdir)
+    svc = drain(e)
+    gate = threading.Event()
+    reached = threading.Event()
+
+    def slow_hook(point, detail=None):
+        # stall only the BACKGROUND writer; the emergency save runs
+        # inline on this thread and must pass through
+        if point == "before_manifest" and threading.current_thread().name == "nebula-writer":
+            reached.set()
+            gate.wait(timeout=20)
+
+    train(e, 1)
+    svc.test_hook = slow_hook
+    e.save_checkpoint(tag="v3")      # async: writer blocks at the gate
+    assert reached.wait(timeout=20)
+    deadline_t0 = _time.monotonic()
+    e.save_checkpoint(tag="preempt-4", _emergency_deadline_s=0.3)
+    assert _time.monotonic() - deadline_t0 < 10  # did not wait for the gate
+    assert validate_tag(str(tmpdir), "preempt-4")
+    svc.test_hook = None
+    gate.set()
+    svc.wait()
+    assert resolve_load_tag(str(tmpdir)) in ("preempt-4", "v3")
+    assert validate_tag(str(tmpdir), "v3")  # background write also completed

@@ -116,3 +116,36 @@ def fix_manifest_size(tag_dir, rel_or_abs):
     manifest["files"][rel]["bytes"] = os.path.getsize(os.path.join(tag_dir, rel))
     with open(mpath, "w") as fd:
         json.dump(manifest, fd)
+
+
+# ----------------------------------------------------------------------
+# training-step faults (elastic / preemption harness)
+# ----------------------------------------------------------------------
+# The elastic tests inject the three ways a training worker stops making
+# progress: hard death (SIGKILL — the OOM-killer shape), a preemption
+# notice (SIGTERM — TPU maintenance), and a hard hang (deadlocked
+# collective). Worker scripts call ``maybe_step_fault(kind, step,
+# at_step, armed)`` at a step boundary; ``armed`` is normally "only on
+# the first launch" so the relaunched worker runs clean.
+
+def maybe_step_fault(kind, step, at_step, armed=True):
+    """Inject fault ``kind`` ("kill" | "preempt" | "hang" | None) when
+    ``step == at_step`` and ``armed``. "kill" and "hang" never return;
+    "preempt" returns after raising SIGTERM in-process (the worker's
+    PreemptionGuard defers it to the next step boundary)."""
+    import signal
+    import time
+
+    if not armed or kind is None or step != at_step:
+        return False
+    if kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif kind == "preempt":
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+    elif kind == "hang":
+        while True:  # a deadlocked collective: no heartbeat, no exit
+            time.sleep(3600)
+    else:
+        raise ValueError(f"unknown step fault kind {kind!r}")
+    return True

@@ -254,6 +254,8 @@ class TestThreadSharedState:
         from deepspeed_tpu.inference.v2.ragged.blocked_allocator import \
             BlockedAllocator  # noqa: F401
         from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401
+        from deepspeed_tpu.elasticity.preemption import (  # noqa: F401
+            HeartbeatWriter, PreemptionGuard)
         from deepspeed_tpu.nebula.service import \
             NebulaCheckpointService  # noqa: F401
         from deepspeed_tpu.serving.fleet.health import \
@@ -266,7 +268,8 @@ class TestThreadSharedState:
         from tools.graft_lint.linter import THREAD_SHARED_REGISTRY
         for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
                     ServingMetrics, BlockedAllocator, PrefixCacheManager,
-                    FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica):
+                    FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica,
+                    PreemptionGuard, HeartbeatWriter):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

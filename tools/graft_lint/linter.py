@@ -85,6 +85,12 @@ THREAD_SHARED_REGISTRY = {
                       "_next_probe_at", "_probe_backoff", "transitions"},
     "GatewayReplica": {"gateway", "restarts"},
     "FaultyReplica": {"_killed", "_reject_left", "_submits"},
+    # preemption: the signal handler and the training thread race on the
+    # request flag; the heartbeat is beaten from the training thread and
+    # read by the agent process (file) but its bookkeeping is shared
+    # with any in-process watchdog probes
+    "PreemptionGuard": {"_requested", "_requested_at"},
+    "HeartbeatWriter": {"_last_step", "_last_beat_t"},
 }
 
 _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
