@@ -7,9 +7,11 @@ the reference ships via DeepSpeed-MII."""
 
 from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, PrefixCacheConfig,
                                                   QuantizationConfig,
-                                                  RaggedInferenceEngineConfig)
+                                                  RaggedInferenceEngineConfig,
+                                                  SpecDecodeConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 
 __all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig", "DSStateManagerConfig",
-           "QuantizationConfig", "PrefixCacheConfig", "DynamicSplitFuseScheduler"]
+           "QuantizationConfig", "PrefixCacheConfig", "SpecDecodeConfig",
+           "DynamicSplitFuseScheduler"]

@@ -32,6 +32,23 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
     max_cached_blocks: int = 0
 
 
+class SpecDecodeConfig(DeepSpeedConfigModel):
+    """Self-speculative decoding (n-gram prompt-lookup drafting + a
+    batched greedy verify forward). ``enabled`` is the config gate; the
+    ``DS_SPEC_DECODE`` env var overrides it in both directions (kill
+    switch) and ``DS_SPEC_DRAFT_LEN`` overrides ``draft_len``.
+    Greedy-only: schedulers fall back to plain bursts for stochastic
+    sampling (acceptance is exact token match, which only preserves the
+    output distribution under argmax decoding)."""
+    enabled: bool = False
+    draft_len: int = 4       # max draft tokens proposed per verify step
+    max_ngram: int = 3       # longest suffix n-gram the drafter looks up
+    min_ngram: int = 1       # shortest n-gram worth matching
+    ema_alpha: float = 0.4   # per-sequence accept-rate EMA smoothing
+    disable_below: float = 0.25  # EMA under this stops drafting for the seq
+    warmup_steps: int = 3    # verify steps before the EMA may disable
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel_degree: int = 1
     expert_parallel_degree: int = 1  # MoE expert sharding for serving
@@ -43,3 +60,8 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager: DSStateManagerConfig = DSStateManagerConfig()
     quantization: QuantizationConfig = QuantizationConfig()
     prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
+    spec_decode: SpecDecodeConfig = SpecDecodeConfig()
+    # compiled decode/verify programs kept per engine: each distinct
+    # (burst length k, sampling key) and (verify, draft length) compiles
+    # its own program; beyond the cap the least-recently-used is dropped
+    burst_fn_cache_cap: int = 32

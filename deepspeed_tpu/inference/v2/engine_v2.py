@@ -8,6 +8,8 @@ jitted step (compiled once, KV pool donated) consumes the padded flat
 batch from ``RaggedBatchWrapper``; mixed prefill chunks and decodes
 run in the same program — the Dynamic SplitFuse model."""
 
+from collections import OrderedDict
+
 import numpy as np
 
 import jax
@@ -33,6 +35,20 @@ def _burst_layout(ms, mb):
     this, so the layout cannot silently diverge."""
     o, lay = 0, {}
     for name, size in (("tokens0", ms), ("token_seq", ms), ("pos0", ms),
+                       ("tables", (ms + 1) * mb)):
+        lay[name] = (o, o + size)
+        o += size
+    return lay
+
+
+def _verify_layout(ms, mb, d):
+    """Wire format of the verify-burst metadata vector, ``_burst_layout``'s
+    twin for the speculative path: per sequence, the entry token plus
+    ``d`` (padded) draft tokens, the real draft count, and the usual
+    slot/position/block-table fields."""
+    o, lay = 0, {}
+    for name, size in (("tokens", ms * (d + 1)), ("dlen", ms),
+                       ("token_seq", ms), ("pos0", ms),
                        ("tables", (ms + 1) * mb)):
         lay[name] = (o, o + size)
         o += size
@@ -161,6 +177,18 @@ class InferenceEngineV2:
                 self.kv_cache,
                 max_cached_blocks=int(self._config.prefix_cache.max_cached_blocks))
             self.state_manager.attach_prefix_cache(self.prefix_cache)
+        # Self-speculative decoding (n-gram drafting + batched verify):
+        # config-gated with the DS_SPEC_DECODE env kill switch. When
+        # live, schedulers draft via propose_drafts() and score drafts
+        # in one forward via verify_burst().
+        from deepspeed_tpu.inference.v2.spec import (SpecDecodeState,
+                                                     spec_decode_enabled)
+        self.spec = None
+        if spec_decode_enabled(self._config.spec_decode):
+            self.spec = SpecDecodeState(self._config.spec_decode)
+        # the per-sequence KV-content token log feeds BOTH the prefix
+        # cache (retire-time content addressing) and the n-gram drafter
+        self._log_tokens = self.prefix_cache is not None or self.spec is not None
         # positions are bounded by BOTH the block table and the RoPE table
         self.max_ctx_tokens = min(self.max_blocks_per_seq * self.block_size,
                                   int(cfg.max_position_embeddings))
@@ -219,7 +247,13 @@ class InferenceEngineV2:
 
         self._make_step_sample = step_sample
         self._step_sample_fns = {}   # (temperature, top_k, top_p) -> jitted step
-        self._burst_fns = {}  # (k, sample_key|None) -> jitted multi-step program
+        # LRU of compiled multi-step programs: ("burst", k, sample_key)
+        # decode bursts and ("verify", d) speculative verifies. Bounded —
+        # spec decoding adds a draft-length dimension to the key space,
+        # and an unbounded map would pin every program's HLO forever.
+        self._burst_fns = OrderedDict()
+        self._burst_fn_cap = max(1, int(self._config.burst_fn_cache_cap))
+        self.burst_fn_evictions = 0
         self._suspended = {}  # uid -> {"handle": host KV, "seen_tokens": int}
         # sampling stream, decorrelated from the param-init key. When the
         # caller passed params but no rng, seed from OS entropy — parallel
@@ -297,8 +331,9 @@ class InferenceEngineV2:
             self.state_manager.allocate_for(desc, len(tokens))
             self._batch.insert_sequence(desc, tokens)
             desc.advance(len(tokens))
-            if self.prefix_cache is not None:
-                # content log for retire-time insertion into the trie
+            if self._log_tokens:
+                # content log: retire-time insertion into the prefix
+                # trie, and the n-gram drafter's lookup corpus
                 desc.tokens.extend(int(t) for t in tokens)
             slots.append(desc.slot)
         # decode bucket: a batch of ≤ max_seqs tokens (pure decode round)
@@ -324,21 +359,61 @@ class InferenceEngineV2:
                 self.params, self.kv_cache.k, self.kv_cache.v, arrays)
         return np.asarray(out)[np.asarray(slots)]  # ds-lint: disable=host-sync -- THE one intended sync per step: callers consume host tokens/logits
 
-    def can_burst(self, batch_uids, k):
-        """True when a ``decode_burst(uids, ·, k)`` can reserve KV blocks
-        for all ``k`` tokens per sequence right now — schedulers call
-        this to fall back to stepwise decoding on a tight pool instead
-        of catching exceptions (a failure inside the compiled burst
-        happens after state mutation and donation, so it is NOT safely
-        recoverable; only this pre-check is)."""
+    def _validate_burst(self, batch_uids, k):
+        """Shared pre-flight for the burst family (``can_burst``,
+        ``decode_burst``, ``verify_burst``): every sequence must exist
+        with prefilled context and room for ``k`` more tokens, and the
+        pool must cover the whole up-front reservation. → ``(descs,
+        None)`` on success, ``(None, exception)`` on failure — raising
+        is the caller's choice (``can_burst`` answers False, the burst
+        entry points raise), so the probe and the entry points cannot
+        drift."""
+        descs = []
         need = 0
         for uid in batch_uids:
             desc = self.state_manager.query(uid)
-            if desc is None or desc.seen_tokens == 0 \
-                    or desc.seen_tokens + k > self.max_ctx_tokens:
-                return False
+            if desc is None or desc.seen_tokens == 0:
+                return None, ValueError(
+                    f"sequence {uid} has no prefilled context — "
+                    f"bursts continue existing sequences only")
+            if desc.seen_tokens + k > self.max_ctx_tokens:
+                return None, ValueError(
+                    f"sequence {uid}: {desc.seen_tokens}+{k} tokens exceed "
+                    f"max_context={self.max_ctx_tokens}")
             need += desc.blocks_needed(k)
-        return need <= self._reclaimable_blocks()
+            descs.append(desc)
+        if need > self._reclaimable_blocks():
+            return None, RuntimeError(
+                f"KV pool exhausted: need {need} blocks, "
+                f"{self._reclaimable_blocks()} reclaimable — "
+                f"flush() sequences first")
+        return descs, None
+
+    def can_burst(self, batch_uids, k):
+        """True when a ``decode_burst(uids, ·, k)`` (or a ``verify_burst``
+        with ``k = d+1``) can reserve KV blocks for all ``k`` tokens per
+        sequence right now — schedulers call this to fall back to
+        stepwise decoding on a tight pool instead of catching exceptions
+        (a failure inside the compiled burst happens after state
+        mutation and donation, so it is NOT safely recoverable; only
+        this pre-check is)."""
+        _, err = self._validate_burst(batch_uids, int(k))
+        return err is None
+
+    def _get_burst_fn(self, key, make):
+        """LRU lookup in the compiled-program cache; ``make()`` builds on
+        miss, and the least-recently-used program is dropped past the
+        cap (its next use recompiles)."""
+        fn = self._burst_fns.get(key)
+        if fn is not None:
+            self._burst_fns.move_to_end(key)
+            return fn
+        fn = make()
+        self._burst_fns[key] = fn
+        while len(self._burst_fns) > self._burst_fn_cap:
+            self._burst_fns.popitem(last=False)
+            self.burst_fn_evictions += 1
+        return fn
 
     def decode_burst(self, batch_uids, batch_tokens, k, sample=None):
         """Run ``k`` decode steps for one current token per uid in ONE
@@ -366,22 +441,9 @@ class InferenceEngineV2:
                              f"max_ragged_sequence_count={self.max_seqs}")
         from deepspeed_tpu.inference.v2.ragged.kv_cache import NULL_BLOCK
         ms = self.max_seqs
-        descs = []
-        blocks_needed = 0
-        for uid in batch_uids:
-            desc = self.state_manager.query(uid)
-            if desc is None or desc.seen_tokens == 0:
-                raise ValueError(f"sequence {uid} has no prefilled context — "
-                                 f"decode_burst continues existing sequences only")
-            if desc.seen_tokens + k > self.max_ctx_tokens:
-                raise ValueError(f"sequence {uid}: {desc.seen_tokens}+{k} tokens exceed "
-                                 f"max_context={self.max_ctx_tokens}")
-            blocks_needed += desc.blocks_needed(k)
-            descs.append(desc)
-        if blocks_needed > self._reclaimable_blocks():
-            raise RuntimeError(f"KV pool exhausted: need {blocks_needed} blocks, "
-                               f"{self._reclaimable_blocks()} reclaimable — "
-                               f"flush() sequences first")
+        descs, err = self._validate_burst(batch_uids, k)
+        if err is not None:
+            raise err
 
         tokens0 = np.zeros(ms, np.int32)
         token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
@@ -399,9 +461,8 @@ class InferenceEngineV2:
         assert meta.shape[0] == sum(e - s for s, e in _burst_layout(ms, self.max_blocks_per_seq).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
-        fn = self._burst_fns.get((k, skey))
-        if fn is None:
-            fn = self._burst_fns[(k, skey)] = self._make_burst_fn(k, skey)
+        fn = self._get_burst_fn(("burst", k, skey),
+                                lambda: self._make_burst_fn(k, skey))
         if skey is None:
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, meta)
@@ -410,7 +471,7 @@ class InferenceEngineV2:
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, meta, sub)
         toks = np.asarray(out)[:, :len(batch_uids)]  # ds-lint: disable=host-sync -- THE one intended sync per k-step burst
-        if self.prefix_cache is not None:
+        if self._log_tokens:
             # log what the burst actually WROTE to the KV cache: step i
             # writes its input token's KV, so positions [seen, seen+k)
             # hold the entry token followed by the first k-1 outputs (the
@@ -464,6 +525,171 @@ class InferenceEngineV2:
                                       enabled=self._sanitize)
         return maybe_checkify_jit(burst, donate_argnums=(1, 2),
                                   enabled=self._sanitize)
+
+    # -------------------------------------------- speculative decoding
+    def propose_drafts(self, batch_uids, batch_tokens, max_lens=None):
+        """Host-side n-gram (prompt-lookup) drafting against each
+        sequence's KV-content token log plus its pending entry token.
+        → one (possibly empty) list of draft ids per uid; empty when
+        spec decoding is off, the per-sequence accept EMA disabled
+        drafting for that uid, ``max_lens[i]`` caps it to 0, or the log
+        holds no recurring suffix n-gram."""
+        if self.spec is None:
+            return [[] for _ in batch_uids]
+        out = []
+        for i, (uid, tok) in enumerate(zip(batch_uids, batch_tokens)):
+            desc = self.state_manager.query(uid)
+            cap = self.spec.draft_len(uid)
+            if max_lens is not None:
+                cap = min(cap, int(max_lens[i]))
+            if desc is None or cap < 1:
+                out.append([])
+                continue
+            entry = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous step's host copy
+            out.append(self.spec.drafter.propose(desc.tokens + [entry], cap))
+        return out
+
+    def verify_burst(self, batch_uids, batch_tokens, batch_drafts):
+        """Score each sequence's entry token plus its draft tokens in
+        ONE ragged forward — the drafts enter as a (d+1)-token ragged
+        chunk through the same packed-prefill path ``put`` uses — and
+        accept the longest draft prefix matching the model's own greedy
+        choices, followed by the model's next token at the first
+        mismatch. The emitted stream is therefore bit-identical to
+        stepwise greedy decoding by construction.
+
+        → ``(tokens [n, d+1] int32, accepted [n] int64)``: row ``i``
+        emits ``tokens[i, :accepted[i] + 1]``. KV blocks are reserved
+        for the full ``d+1`` tokens up front (static tables inside the
+        program), but ``seen_tokens``/token-log advance only by the
+        accepted count — the rejected tail is abandoned in place (the
+        block tables make it unreachable; the next tokens overwrite it)
+        and trailing whole blocks return to the pool."""
+        from deepspeed_tpu.inference.v2.ragged.kv_cache import NULL_BLOCK
+        if self.spec is None:
+            raise RuntimeError("speculative decoding is disabled "
+                               "(config.spec_decode / DS_SPEC_DECODE)")
+        if not (len(batch_uids) == len(batch_tokens) == len(batch_drafts)):
+            raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} "
+                             f"tokens vs {len(batch_drafts)} drafts")
+        if len(batch_uids) > self.max_seqs:
+            raise ValueError(f"{len(batch_uids)} sequences > "
+                             f"max_ragged_sequence_count={self.max_seqs}")
+        d = max((len(dr) for dr in batch_drafts), default=0)
+        if d < 1:
+            raise ValueError("verify_burst needs at least one draft token; "
+                             "use put()/decode_burst for draft-free decoding")
+        descs, err = self._validate_burst(batch_uids, d + 1)
+        if err is not None:
+            raise err
+        ms, mb = self.max_seqs, self.max_blocks_per_seq
+        toks = np.zeros((ms, d + 1), np.int32)
+        dlen = np.zeros(ms, np.int32)
+        token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
+        pos0 = np.zeros(ms, np.int32)
+        tables = np.full((ms + 1, mb), NULL_BLOCK, np.int32)
+        entries = []
+        for i, (desc, tok, drafts) in enumerate(
+                zip(descs, batch_tokens, batch_drafts)):
+            desc.slot = i
+            self.state_manager.allocate_for(desc, d + 1)
+            entry = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous step's host copy
+            entries.append(entry)
+            row = [entry] + [int(t) for t in drafts]
+            toks[i, :len(row)] = row
+            toks[i, len(row):] = entry  # inert pad: dlen masks acceptance
+            dlen[i] = len(drafts)
+            token_seq[i] = i
+            pos0[i] = desc.seen_tokens
+            tables[i, :len(desc.blocks)] = desc.blocks
+        meta = np.concatenate([toks.ravel(), dlen, token_seq, pos0,
+                               tables.ravel()])
+        assert meta.shape[0] == sum(e - s for s, e
+                                    in _verify_layout(ms, mb, d).values())
+        if self.mesh is not None:
+            meta = jax.device_put(meta, self._replicated)
+        fn = self._get_burst_fn(("verify", d), lambda: self._make_verify_fn(d))
+        out, acc, self.kv_cache.k, self.kv_cache.v = fn(
+            self.params, self.kv_cache.k, self.kv_cache.v, meta)
+        out = np.asarray(out)  # ds-lint: disable=host-sync -- THE one intended sync per verify burst
+        acc = np.asarray(acc)  # host copy of the device result above, already synced
+        n = len(batch_uids)
+        for i, desc in enumerate(descs):
+            a = int(acc[i])
+            # KV positions [seen, seen+a] hold the entry token and the a
+            # accepted drafts; the bonus token out[i, a] is the NEXT
+            # step's entry and was never written (same convention as the
+            # plain burst). Advance by accepted only, then return whole
+            # unused trailing blocks.
+            desc.advance(a + 1)
+            if self._log_tokens:
+                desc.tokens.append(entries[i])
+                desc.tokens.extend(int(t) for t in out[i, :a])
+            self.state_manager.release_unused_blocks(desc)
+            if int(dlen[i]):
+                self.spec.note(desc.uid, accepted=a, drafted=int(dlen[i]))
+        return out[:n], acc[:n]
+
+    def _make_verify_fn(self, d):
+        """One compiled greedy verify program for draft length ``d``: a
+        single ragged forward over ``max_seqs * (d+1)`` packed tokens
+        (``last_index = arange`` selects EVERY token's logits, so no
+        model-runner change is needed), per-position argmax, and
+        on-device longest-matching-prefix acceptance."""
+        from deepspeed_tpu.inference.v2.model_runner import ragged_forward
+        cfg, dtype, mesh = self.model_config, self.dtype, self.mesh
+        attn_impl = (self._config.implementation_overrides or {}).get("attention")
+        quantized = self._quantized
+        ms, mb = self.max_seqs, self.max_blocks_per_seq
+
+        def verify(p, kc, vc, meta):
+            if quantized:
+                from deepspeed_tpu.inference.quantization import dequantize_tree_except
+                p = dequantize_tree_except(p, dtype)
+            lay = _verify_layout(ms, mb, d)
+            toks = meta[slice(*lay["tokens"])].reshape(ms, d + 1)
+            dlen = meta[slice(*lay["dlen"])]
+            token_seq = meta[slice(*lay["token_seq"])]
+            pos0 = meta[slice(*lay["pos0"])]
+            tables = meta[slice(*lay["tables"])].reshape(ms + 1, mb)
+            T = ms * (d + 1)
+            steps = jnp.arange(d + 1, dtype=jnp.int32)
+            # each sequence enters as one (d+1)-token chunk at positions
+            # pos0..pos0+d — exactly a packed prefill chunk; the paged
+            # attention scatters the chunk's KV first and masks by
+            # position, so within-chunk causality holds as it does for
+            # split prefills
+            b = {"token_ids": toks.reshape(-1),
+                 "token_seq": jnp.repeat(token_seq, d + 1),
+                 "token_pos": (pos0[:, None] + steps[None, :]).reshape(-1),
+                 "block_tables": tables,
+                 "last_index": jnp.arange(T, dtype=jnp.int32)}
+            logits, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
+                                            attn_impl=attn_impl)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32).reshape(ms, d + 1)
+            # greedy acceptance: draft j survives iff every earlier
+            # draft did AND it equals the model's own next token there —
+            # sum of the running cumprod counts the matching prefix
+            match = (toks[:, 1:] == nxt[:, :-1]) & (steps[None, :d] < dlen[:, None])
+            acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            return nxt, acc, kc, vc
+
+        return maybe_checkify_jit(verify, donate_argnums=(1, 2),
+                                  enabled=self._sanitize)
+
+    def rewind(self, uid, n_tokens):
+        """Roll ``uid`` back by ``n_tokens`` of KV content: the token
+        log truncates to match, positions past the new length become
+        unreachable, and now-unused trailing blocks return to the pool.
+        Schedulers use this when EOS lands mid-burst — the burst
+        reserved and advanced past the end of generation, and without a
+        rewind the garbage tail would stay charged (and, with a prefix
+        cache, be content-addressed into the trie). → new seen_tokens."""
+        desc = self.state_manager.query(uid)
+        if desc is None:
+            raise KeyError(f"unknown sequence {uid}")
+        self.state_manager.rewind_sequence(desc, int(n_tokens))
+        return desc.seen_tokens
 
     def _reclaimable_blocks(self):
         """Blocks an allocation can actually obtain right now: the free
@@ -527,6 +753,8 @@ class InferenceEngineV2:
             self.state_manager.flush_sequence(uid)
         elif not suspended:
             raise KeyError(f"unknown sequence {uid}")
+        if self.spec is not None:
+            self.spec.forget(uid)
 
     def suspend(self, uid):
         """Swap a live sequence's KV blocks to host memory and release
@@ -606,8 +834,9 @@ class InferenceEngineV2:
         self.kv_cache = None
         self.state_manager = None
         self.prefix_cache = None
+        self.spec = None
         self._step = self._step_greedy = None
-        self._burst_fns = {}
+        self._burst_fns = OrderedDict()
         self._step_sample_fns = {}
         self._make_step_sample = None
         self._suspended = {}

@@ -71,6 +71,39 @@ class DSStateManager:
             else:
                 desc.extend_blocks(self.kv_cache.reserve(need))
 
+    def rewind_sequence(self, desc: DSSequenceDescriptor, n_tokens: int) -> None:
+        """Drop the last ``n_tokens`` of ``desc``'s KV content: the
+        positions past the new length are abandoned in place (the block
+        tables make them unreachable — the next tokens overwrite them),
+        the token log truncates to match, and trailing blocks beyond the
+        new length return to the pool. Never rewinds into cached
+        (shared) prefix content — those blocks are the trie's."""
+        if n_tokens < 0:
+            raise ValueError(f"cannot rewind by {n_tokens} tokens")
+        if desc.seen_tokens - n_tokens < desc.cached_tokens:
+            raise ValueError(
+                f"sequence {desc.uid}: rewinding {n_tokens} of "
+                f"{desc.seen_tokens} tokens would cross into the "
+                f"{desc.cached_tokens}-token shared prefix")
+        if n_tokens:
+            desc.rewind(n_tokens)
+        self.release_unused_blocks(desc)
+
+    def release_unused_blocks(self, desc: DSSequenceDescriptor) -> None:
+        """Free trailing blocks past ``desc``'s current length. Burst
+        and verify reservations cover the worst case up front; variable
+        acceptance and EOS-mid-burst rewinds can leave the tail unused,
+        and holding it would charge the pool for KV nobody will write.
+        Shared prefix blocks sit at the FRONT of the table and a live
+        sequence always spans them (``seen_tokens >= cached_tokens``),
+        so a trailing trim can never touch the trie's blocks."""
+        needed = -(-desc.seen_tokens // self.kv_cache.block_size)
+        needed = max(needed, desc.shared_blocks)
+        extra = desc.blocks[needed:]
+        if extra:
+            del desc.blocks[needed:]
+            self.kv_cache.free(extra)
+
     def flush_sequence(self, uid) -> None:
         desc = self._seqs.pop(uid, None)
         if desc is None:

@@ -44,6 +44,19 @@ class DSSequenceDescriptor:
     def advance(self, n_tokens: int) -> None:
         self.seen_tokens += n_tokens
 
+    def rewind(self, n_tokens: int) -> None:
+        """Roll back the last ``n_tokens`` of KV content (speculative-
+        decode rejection, EOS landing mid-burst): ``seen_tokens``
+        retreats and the token log truncates to stay equal to the KV
+        content over ``[0, seen_tokens)``. Releasing the now-unused
+        trailing blocks is the state manager's job — it owns the pool."""
+        if not 0 <= n_tokens <= self.seen_tokens:
+            raise ValueError(f"cannot rewind {n_tokens} of "
+                             f"{self.seen_tokens} seen tokens")
+        self.seen_tokens -= n_tokens
+        if len(self.tokens) > self.seen_tokens:
+            del self.tokens[self.seen_tokens:]
+
     def __repr__(self):
         return (f"DSSequenceDescriptor(uid={self.uid}, slot={self.slot}, "
                 f"seen={self.seen_tokens}, blocks={len(self.blocks)})")

@@ -15,11 +15,15 @@ import numpy as np
 
 class Request:
 
-    def __init__(self, uid, prompt_tokens, max_new_tokens, priority=0):
+    def __init__(self, uid, prompt_tokens, max_new_tokens, priority=0, spec=True):
         self.uid = uid
         self.prompt = list(np.atleast_1d(np.asarray(prompt_tokens)).tolist())
         self.max_new_tokens = max_new_tokens
         self.priority = int(priority)  # larger = scheduled first
+        # per-request speculative-decoding opt-out: False rides along in
+        # verify bursts without drafts of its own (engine-level spec
+        # support still decides whether drafting happens at all)
+        self.spec = bool(spec)
         self.prefill_cursor = 0  # prompt tokens already scheduled
         # radix prefix cache: leading prompt tokens whose KV was reused
         # from the cache (prefill skips them — the cursor starts there)
@@ -78,10 +82,12 @@ class DynamicSplitFuseScheduler:
         self.on_token = on_token
         self.requests = OrderedDict()  # uid -> Request
 
-    def add_request(self, uid, prompt_tokens, max_new_tokens=16, priority=0):
+    def add_request(self, uid, prompt_tokens, max_new_tokens=16, priority=0,
+                    spec=True):
         if uid in self.requests:
             raise ValueError(f"uid {uid} already queued")
-        req = Request(uid, prompt_tokens, max_new_tokens, priority=priority)
+        req = Request(uid, prompt_tokens, max_new_tokens, priority=priority,
+                      spec=spec)
         if not req.prompt:
             raise ValueError(f"uid {uid}: empty prompt can never be scheduled")
         self.requests[uid] = req
@@ -226,17 +232,82 @@ class DynamicSplitFuseScheduler:
             for j, r in enumerate(live):
                 if r.done:
                     continue  # hit EOS mid-burst; later rows are discarded
-                self._accept_token(r, int(toks[step_i, j]))
+                # the burst advanced KV by all k tokens; if generation
+                # ends HERE, positions past entry + the first step_i
+                # outputs hold post-EOS garbage the rewind reclaims
+                self._accept_token(r, int(toks[step_i, j]),
+                                   unused_tokens=k - step_i - 1)
         return uids
 
-    def _accept_token(self, r, tok):
+    def _try_spec_burst(self):
+        """All live requests decoding greedily on an engine with
+        speculative decoding armed → draft with the n-gram drafter and
+        score entry + drafts in ONE compiled verify forward; None when
+        the speculative path doesn't apply this round (no drafts found,
+        stochastic sampling, budget too tight…) — the plain k-step burst
+        then gets its chance."""
+        engine = self.engine
+        spec = getattr(engine, "spec", None)
+        if spec is None or self._sampling is not None or not self._device_greedy:
+            return None
+        live = self._live()
+        if (not live or len(live) > engine.max_seqs
+                or any(r.next_token is None for r in live)):
+            return None
+        n = len(live)
+        # each sequence enters the verify batch as a (d+1)-token chunk,
+        # so the shared d is bounded by the per-step token budget…
+        d_cap = self.budget // n - 1
+        # …and by context room for EVERY live sequence: all rows write
+        # d+1 KV positions regardless of their own draft count
+        for r in live:
+            d_cap = min(d_cap, engine.max_ctx_tokens
+                        - engine.query(r.uid)[0] - 1)
+        if d_cap < 1:
+            return None
+        max_lens = [min(d_cap, r.max_new_tokens - len(r.generated) - 1)
+                    if r.spec else 0 for r in live]
+        uids = [r.uid for r in live]
+        drafts = engine.propose_drafts(uids, [[r.next_token] for r in live],
+                                       max_lens)
+        d = max((len(dr) for dr in drafts), default=0)
+        if d < 1:
+            return None
+        # pad the shared draft length up to a power of two (within the
+        # caps): dlen masks the padding, so acceptance is unchanged, and
+        # the verify-program set stays log2-bounded instead of compiling
+        # once per distinct max-draft-length the drafter happens to find
+        d = min(1 << (d - 1).bit_length(), d_cap)
+        if not engine.can_burst(uids, d + 1):
+            return None  # pool too tight: fall back (see _try_burst)
+        toks, acc = engine.verify_burst(uids, [[r.next_token] for r in live],
+                                        drafts)
+        for r in live:
+            r.next_token = None
+        for j, r in enumerate(live):
+            a = int(acc[j])
+            for e in range(a + 1):
+                if r.done:
+                    break  # EOS among the accepted run; rest discarded
+                # the verify advanced KV by a+1; ending at emitted index
+                # e leaves a-e post-EOS tokens for the rewind to reclaim
+                self._accept_token(r, int(toks[j, e]), unused_tokens=a - e)
+        return uids
+
+    def _accept_token(self, r, tok, unused_tokens=0):
         """Record a generated token; finish + flush on EOS/max_new_tokens
-        (single copy of the completion semantics for both the stepwise
-        and burst paths)."""
+        (single copy of the completion semantics for the stepwise, burst
+        and speculative paths). ``unused_tokens``: KV positions the
+        engine advanced past this token (burst/verify reservations run
+        to their planned end); on completion they are rewound first so
+        retire frees them — and the prefix cache never content-addresses
+        post-EOS garbage."""
         r.generated.append(tok)
         if (self.eos_token_id is not None and tok == self.eos_token_id) \
                 or len(r.generated) >= r.max_new_tokens:
             r.done = True
+            if unused_tokens:
+                self.engine.rewind(r.uid, unused_tokens)
             self.engine.flush(r.uid)
         else:
             r.next_token = tok
@@ -245,6 +316,9 @@ class DynamicSplitFuseScheduler:
 
     def step(self):
         """Schedule + run one engine step; returns the uids stepped."""
+        stepped = self._try_spec_burst()
+        if stepped is not None:
+            return stepped
         burst = self._try_burst()
         if burst is not None:
             return burst

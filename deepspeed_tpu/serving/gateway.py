@@ -54,11 +54,15 @@ class RequestHandle:
     ``result()`` blocks to completion and returns the full token list.
     """
 
-    def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s):
+    def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s,
+                 spec=True):
         self.uid = uid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.priority = priority
+        # per-request speculative-decoding opt-out (engine support and
+        # the DS_SPEC_DECODE kill switch still gate it globally)
+        self.spec = bool(spec)
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + deadline_s
                          if deadline_s is not None else None)
@@ -157,8 +161,10 @@ class ServingGateway:
 
     # ---------------------------------------------------------------- client
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, spec=True):
         """Accept a request from any thread → :class:`RequestHandle`.
+        ``spec=False`` opts this request out of speculative decoding
+        (it still rides in verify batches, just without drafts).
 
         Raises :class:`RequestTooLargeError` when the request can never
         fit this engine, :class:`QueueFullError` per the admission
@@ -183,7 +189,8 @@ class ServingGateway:
             self.metrics.count("rejected_too_large")
             raise
         handle = RequestHandle(next(self._uids), prompt, max_new, prio,
-                               deadline_ms / 1e3 if deadline_ms is not None else None)
+                               deadline_ms / 1e3 if deadline_ms is not None else None,
+                               spec=spec)
         handle._cancel_cb = self._request_cancel
         try:
             shed = self.queue.push(handle)
@@ -407,6 +414,9 @@ class ServingGateway:
         prefix_cache = getattr(self.engine, "prefix_cache", None)
         if prefix_cache is not None:
             self.metrics.set_external("Serve/PrefixCache", prefix_cache.stats())
+        spec = getattr(self.engine, "spec", None)
+        if spec is not None:
+            self.metrics.set_external("Serve/Spec", spec.stats())
         interval = self.config.metrics_interval_steps
         if self.monitor is not None and interval and did:
             steps = self.metrics.snapshot()["counters"]["engine_steps"]
@@ -479,7 +489,8 @@ class ServingGateway:
                 continue
             self.scheduler.add_request(entry.uid, entry.prompt,
                                        max_new_tokens=max_new,
-                                       priority=entry.priority)
+                                       priority=entry.priority,
+                                       spec=getattr(entry, "spec", True))
             entry.status = "running"
             entry.queue_wait_s = time.monotonic() - entry.submitted_at
             self.metrics.observe_queue_wait(entry.queue_wait_s)

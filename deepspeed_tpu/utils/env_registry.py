@@ -149,6 +149,15 @@ register("DS_PREFIX_CACHE", "optional_bool", None,
          "Kill switch for the radix prefix cache; set it wins in both "
          "directions, unset defers to the engine config.",
          "deepspeed_tpu/inference/v2/prefix_cache/manager.py")
+register("DS_SPEC_DECODE", "optional_bool", None,
+         "Kill switch for self-speculative decoding (n-gram drafting + "
+         "batched verify); set it wins in both directions, unset defers "
+         "to the engine config.",
+         "deepspeed_tpu/inference/v2/spec/state.py")
+register("DS_SPEC_DRAFT_LEN", "int", 0,
+         "Override the max draft tokens proposed per verify step; 0 "
+         "defers to the engine config's spec_decode.draft_len.",
+         "deepspeed_tpu/inference/v2/spec/state.py")
 register("DS_FLEET_FAILOVER", "bool", True,
          "Kill switch for cross-replica failover retries in the fleet "
          "router; off, a failed attempt fails the request immediately.",

@@ -1,0 +1,480 @@
+"""Self-speculative decoding: n-gram drafting + batched verify.
+
+Contract under test: with speculation on, greedy outputs are
+BIT-IDENTICAL to the stepwise/burst reference (across burst boundaries
+and under partial draft acceptance) while the engine advances KV only
+by accepted tokens and returns reserved-but-unused blocks to the pool;
+the per-sequence accept-rate EMA turns drafting off where it loses; the
+``DS_SPEC_DECODE`` kill switch wins in both directions; rewind restores
+a sequence to an earlier length with decode continuing exactly as an
+uninterrupted run; EOS landing mid-burst reclaims the over-reserved
+tail (and never content-addresses post-EOS garbage into the prefix
+trie); and the compiled burst-program cache stays LRU-bounded."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, DynamicSplitFuseScheduler,
+                                        InferenceEngineV2, PrefixCacheConfig,
+                                        RaggedInferenceEngineConfig, SpecDecodeConfig)
+from deepspeed_tpu.inference.v2.spec import (NGramDrafter, SpecDecodeState,
+                                             spec_decode_enabled)
+from deepspeed_tpu.models import build_llama
+
+BS = 8  # KV block size used throughout
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = build_llama("debug")
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def make_engine(model_and_params, spec=True, prefix=False, num_kv_blocks=0,
+                max_context=128, n_seqs=4, batch=64, draft_len=4, **spec_kw):
+    model, params = model_and_params
+    cfg = RaggedInferenceEngineConfig(
+        kv_block_size=BS,
+        num_kv_blocks=num_kv_blocks,
+        spec_decode=SpecDecodeConfig(enabled=spec, draft_len=draft_len,
+                                     **spec_kw),
+        prefix_cache=PrefixCacheConfig(enabled=prefix),
+        state_manager=DSStateManagerConfig(max_ragged_batch_size=batch,
+                                           max_ragged_sequence_count=n_seqs,
+                                           max_tracked_sequences=n_seqs,
+                                           max_context=max_context))
+    return InferenceEngineV2(model=model, config=cfg, params=params,
+                             dtype=jnp.float32)
+
+
+def greedy_rollout(engine, uid, prompt, n):
+    """Stepwise greedy reference: prefill + n decode steps via put()."""
+    t = int(engine.put([uid], [prompt], sample="greedy")[0])
+    out = [t]
+    for _ in range(n - 1):
+        t = int(engine.put([uid], [[t]], sample="greedy")[0])
+        out.append(t)
+    return out
+
+
+PROMPT = (np.arange(1, 17) % 250).astype(np.int32)          # 16 tokens
+REPETITIVE = np.tile(np.array([7, 8, 9, 10], np.int32), 6)  # 24 tokens
+
+
+# -------------------------------------------------------------------- drafter
+class TestNGramDrafter:
+
+    def test_most_recent_longest_match_wins(self):
+        d = NGramDrafter(max_ngram=3)
+        #          0  1  2  3  4  5  6  7  8
+        h = [5, 1, 2, 3, 9, 1, 2, 3, 7, 1, 2, 3]
+        # suffix 3-gram (1,2,3) matched most recently at end-index 8
+        # (the occurrence followed by 7), not the earlier one (by 9)
+        assert d.propose(h, 2) == [7, 1]
+
+    def test_falls_back_to_shorter_ngrams(self):
+        d = NGramDrafter(max_ngram=3)
+        h = [1, 2, 42, 9, 9, 42]
+        # no 3/2-gram recurs; the 1-gram (42) does, followed by 9
+        assert d.propose(h, 3) == [9, 9, 42]
+
+    def test_no_match_and_degenerate_inputs(self):
+        d = NGramDrafter(max_ngram=3)
+        assert d.propose([1, 2, 3, 4], 4) == []   # no repetition
+        assert d.propose([1], 4) == []            # too short
+        assert d.propose([1, 1, 1], 0) == []      # no budget
+        with pytest.raises(ValueError):
+            NGramDrafter(max_ngram=0)
+
+    def test_proposal_truncated_at_history_end(self):
+        d = NGramDrafter(max_ngram=2)
+        h = [1, 2, 3, 1, 2]
+        # match ends right before position 2 → only [3, 1, 2] remain
+        assert d.propose(h, 8) == [3, 1, 2]
+
+
+# --------------------------------------------------------- state / env gating
+class TestSpecDecodeState:
+
+    def test_ema_auto_disable_and_forget(self):
+        st = SpecDecodeState(SpecDecodeConfig(enabled=True, draft_len=4,
+                                              warmup_steps=3,
+                                              disable_below=0.25))
+        assert st.draft_len(1) == 4
+        for _ in range(3):
+            st.note(1, accepted=0, drafted=4)
+        assert st.draft_len(1) == 0  # warmed-up EMA below threshold
+        assert st.stats()["disabled_sequences"] == 1
+        assert st.draft_len(2) == 4  # other sequences unaffected
+        st.forget(1)
+        assert st.draft_len(1) == 4  # a fresh sequence reusing the uid
+
+    def test_good_acceptance_never_disables(self):
+        st = SpecDecodeState(SpecDecodeConfig(enabled=True, draft_len=4))
+        for _ in range(20):
+            st.note(1, accepted=3, drafted=4)
+        assert st.draft_len(1) == 4
+        s = st.stats()
+        assert s["accept_rate"] == 0.75
+        assert s["accepted_per_step"] == 4.0  # 3 accepted + 1 bonus
+        assert s["draft_wasted"] == 20
+
+    def test_draft_free_rows_are_not_a_signal(self):
+        st = SpecDecodeState(SpecDecodeConfig(enabled=True, warmup_steps=1))
+        for _ in range(10):
+            st.note(1, accepted=0, drafted=0)  # rode along, never drafted
+        assert st.draft_len(1) > 0
+        assert st.stats()["verify_steps"] == 0
+
+    def test_env_kill_switch_wins_both_directions(self, monkeypatch):
+        on, off = SpecDecodeConfig(enabled=True), SpecDecodeConfig(enabled=False)
+        monkeypatch.delenv("DS_SPEC_DECODE", raising=False)
+        assert spec_decode_enabled(on) and not spec_decode_enabled(off)
+        monkeypatch.setenv("DS_SPEC_DECODE", "0")
+        assert not spec_decode_enabled(on)
+        monkeypatch.setenv("DS_SPEC_DECODE", "1")
+        assert spec_decode_enabled(off)
+
+    def test_env_draft_len_override(self, monkeypatch):
+        monkeypatch.setenv("DS_SPEC_DRAFT_LEN", "7")
+        st = SpecDecodeState(SpecDecodeConfig(enabled=True, draft_len=4))
+        assert st.draft_len(1) == 7
+        monkeypatch.setenv("DS_SPEC_DRAFT_LEN", "0")  # 0 defers to config
+        st = SpecDecodeState(SpecDecodeConfig(enabled=True, draft_len=4))
+        assert st.draft_len(1) == 4
+
+
+# --------------------------------------------------------------- verify burst
+class TestVerifyBurst:
+
+    def test_correct_drafts_accepted_bit_identical(self, model_and_params):
+        eng = make_engine(model_and_params)
+        ref = greedy_rollout(eng, 1, PROMPT, 9)
+        eng.flush(1)
+        t0 = int(eng.put([2], [PROMPT], sample="greedy")[0])
+        assert t0 == ref[0]
+        toks, acc = eng.verify_burst([2], [[t0]], [ref[1:4]])
+        assert acc[0] == 3
+        # 3 accepted drafts + the model's bonus token, all matching ref
+        assert list(toks[0]) == ref[1:5]
+        # continuation after the verify matches the uninterrupted run
+        t = int(toks[0, 3])
+        cont = [t]
+        for _ in range(3):
+            t = int(eng.put([2], [[t]], sample="greedy")[0])
+            cont.append(t)
+        assert [ref[0]] + list(toks[0]) + cont[1:] == ref[:8]
+        eng.flush(2)
+        eng.destroy()
+
+    def test_rejected_drafts_roll_back_blocks(self, model_and_params):
+        eng = make_engine(model_and_params)
+        ref = greedy_rollout(eng, 1, PROMPT, 2)
+        eng.flush(1)
+        free0 = eng.free_blocks
+        t0 = int(eng.put([2], [PROMPT], sample="greedy")[0])
+        # 7 wrong drafts force an extra block reservation (16+1+7 = 3
+        # blocks) that full rejection must hand back
+        wrong = [(ref[1] + 1) % 250] + [3] * 6
+        toks, acc = eng.verify_burst([2], [[t0]], [wrong])
+        assert acc[0] == 0
+        assert toks[0, 0] == ref[1]  # fallback is the model's own token
+        desc = eng.state_manager.query(2)
+        assert desc.seen_tokens == len(PROMPT) + 1  # entry only
+        assert len(desc.blocks) == -(-desc.seen_tokens // BS)
+        assert desc.tokens == list(PROMPT) + [t0]   # log == KV content
+        eng.flush(2)
+        assert eng.free_blocks == free0
+        eng.destroy()
+
+    def test_validation_shared_with_can_burst(self, model_and_params):
+        eng = make_engine(model_and_params, num_kv_blocks=4, max_context=64)
+        with pytest.raises(ValueError, match="no prefilled context"):
+            eng.verify_burst([99], [[1]], [[2]])
+        assert not eng.can_burst([99], 2)
+        int(eng.put([1], [PROMPT], sample="greedy")[0])  # 2 blocks of 3
+        # context overflow: same answer from the probe and the entry point
+        assert not eng.can_burst([1], 64)
+        with pytest.raises(ValueError, match="exceed"):
+            eng.verify_burst([1], [[1]], [[2] * 63])
+        # pool exhaustion: 9 new tokens need a 2nd extra block that the
+        # 4-block pool cannot provide
+        assert not eng.can_burst([1], 16)
+        with pytest.raises(RuntimeError, match="KV pool exhausted"):
+            eng.verify_burst([1], [[1]], [[2] * 15])
+        with pytest.raises(RuntimeError, match="KV pool exhausted"):
+            eng.decode_burst([1], [[1]], 16)
+        # what the probe approves, the entry points accept
+        assert eng.can_burst([1], 2)
+        eng.destroy()
+
+    def test_disabled_engine_refuses(self, model_and_params):
+        eng = make_engine(model_and_params, spec=False)
+        assert eng.spec is None
+        assert eng.propose_drafts([1], [[5]]) == [[]]
+        int(eng.put([1], [PROMPT], sample="greedy")[0])
+        with pytest.raises(RuntimeError, match="disabled"):
+            eng.verify_burst([1], [[1]], [[2]])
+        eng.destroy()
+
+    def test_empty_drafts_rejected(self, model_and_params):
+        eng = make_engine(model_and_params)
+        int(eng.put([1], [PROMPT], sample="greedy")[0])
+        with pytest.raises(ValueError, match="at least one draft"):
+            eng.verify_burst([1], [[1]], [[]])
+        eng.destroy()
+
+
+# ----------------------------------------------------------------- scheduler
+class TestSpecScheduler:
+
+    def _run(self, eng, uids, prompts, spec, max_new=20, max_burst=8):
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48,
+                                          max_burst=max_burst)
+        for uid, p in zip(uids, prompts):
+            sched.add_request(uid, p, max_new_tokens=max_new, spec=spec)
+        return sched.run_to_completion()
+
+    def test_bit_identical_across_burst_boundaries(self, model_and_params):
+        eng = make_engine(model_and_params)
+        prompts = [REPETITIVE, PROMPT]
+        want = self._run(eng, [10, 11], prompts, spec=False)
+        steps0 = eng.spec.stats()["verify_steps"]
+        got = self._run(eng, [20, 21], prompts, spec=True)
+        assert [got[20], got[21]] == [want[10], want[11]]
+        # the speculative path actually engaged (not a vacuous pass)
+        assert eng.spec.stats()["verify_steps"] > steps0
+        assert eng.spec.stats()["tokens_accepted"] > 0
+        eng.destroy()
+
+    def test_kill_switch_retraces_plain_bursts(self, model_and_params,
+                                               monkeypatch):
+        monkeypatch.setenv("DS_SPEC_DECODE", "0")
+        eng_off = make_engine(model_and_params)  # config says enabled
+        assert eng_off.spec is None              # env wins
+        want = self._run(eng_off, [1], [REPETITIVE], spec=True)[1]
+        # plain burst programs only — no verify compilation happened
+        assert all(key[0] == "burst" for key in eng_off._burst_fns)
+        eng_off.destroy()
+        monkeypatch.delenv("DS_SPEC_DECODE")
+        eng_on = make_engine(model_and_params)
+        got = self._run(eng_on, [1], [REPETITIVE], spec=True)[1]
+        assert got == want
+        eng_on.destroy()
+
+    def test_ema_auto_disables_losing_sequences(self, model_and_params):
+        eng = make_engine(model_and_params, warmup_steps=2, disable_below=0.25)
+        # rig the drafter: proposals that can never match greedy argmax
+        # are a pure loss, so the EMA must turn the sequence off
+        eng.spec.drafter.propose = lambda h, cap: [251, 252, 253][:cap]
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48, max_burst=1)
+        sched.add_request(1, PROMPT, max_new_tokens=12)
+        sched.run_to_completion()
+        assert eng.spec.stats()["disabled_sequences"] == 1
+        assert eng.spec.stats()["tokens_accepted"] == 0
+        # once disabled, proposals stop at the source
+        assert eng.propose_drafts([1], [[5]]) == [[]] or \
+            eng.state_manager.query(1) is None
+        eng.destroy()
+
+    def test_max_new_tokens_exact_with_spec(self, model_and_params):
+        eng = make_engine(model_and_params)
+        out = self._run(eng, [1], [REPETITIVE], spec=True, max_new=7)[1]
+        assert len(out) == 7  # acceptance never overshoots the request cap
+        eng.destroy()
+
+    def test_prefix_cache_token_log_integrity(self, model_and_params):
+        # partial acceptance must leave the token log == KV content, so
+        # the trie built at retire is identical to the non-spec engine's
+        outs, matches = [], []
+        for spec in (False, True):
+            eng = make_engine(model_and_params, spec=spec, prefix=True)
+            out = self._run(eng, [1], [REPETITIVE], spec=spec)[1]
+            hist = list(REPETITIVE) + out
+            outs.append(out)
+            matches.append(eng.prefix_match_len(hist))
+            assert eng.prefix_cache.cached_blocks > 0
+            eng.destroy()
+        assert outs[0] == outs[1]
+        assert matches[0] == matches[1] > 0
+
+
+# -------------------------------------------------------------------- rewind
+class TestRewind:
+
+    def test_rewind_then_continue_matches_uninterrupted(self, model_and_params):
+        eng = make_engine(model_and_params)
+        ref = greedy_rollout(eng, 1, PROMPT, 6)
+        eng.flush(1)
+        free0 = eng.free_blocks
+        # decode 4 tokens, rewind 2, re-feed: the continuation must be
+        # exactly what the uninterrupted run produced
+        greedy_rollout(eng, 2, PROMPT, 4)
+        desc = eng.state_manager.query(2)
+        assert desc.seen_tokens == len(PROMPT) + 3  # entry + ref[1:3] written
+        eng.rewind(2, 2)
+        assert desc.seen_tokens == len(PROMPT) + 1
+        assert desc.tokens == list(PROMPT) + [ref[0]]
+        assert len(desc.blocks) == -(-desc.seen_tokens // BS)  # tail freed
+        t = ref[1]  # re-feed from the new tip
+        redo = []
+        for _ in range(4):
+            t = int(eng.put([2], [[t]], sample="greedy")[0])
+            redo.append(t)
+        assert redo == ref[2:6]
+        eng.flush(2)
+        assert eng.free_blocks == free0
+        eng.destroy()
+
+    def test_rewind_validation(self, model_and_params):
+        eng = make_engine(model_and_params)
+        with pytest.raises(KeyError):
+            eng.rewind(404, 1)
+        greedy_rollout(eng, 1, PROMPT, 2)
+        with pytest.raises(ValueError):
+            eng.rewind(1, -1)
+        with pytest.raises(ValueError):
+            eng.rewind(1, len(PROMPT) + 999)
+        eng.rewind(1, 0)  # no-op trim is fine
+        eng.destroy()
+
+    def test_rewind_cannot_cross_shared_prefix(self, model_and_params):
+        eng = make_engine(model_and_params, prefix=True)
+        # retire a full-block prompt into the trie, then lease it back
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48, max_burst=1)
+        sched.add_request(1, PROMPT, max_new_tokens=2)
+        sched.run_to_completion()
+        assert eng.prefix_match(2, PROMPT) > 0
+        desc = eng.state_manager.query(2)
+        assert desc.cached_tokens > 0
+        with pytest.raises(ValueError, match="shared prefix"):
+            eng.state_manager.rewind_sequence(desc, desc.seen_tokens)
+        eng.flush(2)
+        eng.destroy()
+
+
+# ------------------------------------------------- EOS-mid-burst reclamation
+class TestEosMidBurstReclaim:
+
+    def test_burst_overrun_blocks_returned(self, model_and_params):
+        eng = make_engine(model_and_params, spec=False)
+        probe = greedy_rollout(eng, 1, PROMPT, 3)
+        eng.flush(1)
+        free0 = eng.free_blocks
+        # EOS = the 2nd generated token → lands mid-burst with 8-step
+        # bursts; the engine advanced all 8 and must give 6 back
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48, max_burst=8,
+                                          eos_token_id=probe[1])
+        sched.add_request(2, PROMPT, max_new_tokens=16)
+        out = sched.run_to_completion()[2]
+        assert out == probe[:2]
+        assert eng.free_blocks == free0  # nothing leaked or left charged
+        eng.destroy()
+
+    def test_post_eos_garbage_never_cached(self, model_and_params):
+        eng = make_engine(model_and_params, spec=False, prefix=True)
+        probe = greedy_rollout(eng, 1, PROMPT, 3)
+        eng.flush(1)
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48, max_burst=8,
+                                          eos_token_id=probe[1])
+        sched.add_request(2, PROMPT, max_new_tokens=16)
+        sched.run_to_completion()
+        # retire content-addressed ONLY [prompt, entry]: EOS's own KV is
+        # never written and the 6 post-EOS burst rows were rewound
+        assert eng.prefix_cache.cached_blocks == (len(PROMPT) + 1) // BS
+        usable = eng.kv_cache.num_blocks - 1  # minus the pinned null block
+        assert eng.free_blocks + eng.evictable_blocks == usable
+        eng.destroy()
+
+    def test_spec_eos_among_accepted_run(self, model_and_params):
+        eng = make_engine(model_and_params)
+        probe = self._spec_rollout(eng, 1, REPETITIVE, 12)
+        free0 = eng.free_blocks
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48, max_burst=8,
+                                          eos_token_id=probe[4])
+        sched.add_request(2, REPETITIVE, max_new_tokens=24, spec=True)
+        out = sched.run_to_completion()[2]
+        # generation stops at the FIRST occurrence of the EOS token
+        assert out == probe[:probe.index(probe[4]) + 1]
+        assert eng.free_blocks == free0
+        eng.destroy()
+
+    def _spec_rollout(self, eng, uid, prompt, n):
+        sched = DynamicSplitFuseScheduler(eng, token_budget=48, max_burst=8)
+        sched.add_request(uid, prompt, max_new_tokens=n, spec=True)
+        return sched.run_to_completion()[uid]
+
+    def test_release_unused_blocks_accounting(self, model_and_params):
+        eng = make_engine(model_and_params, spec=False)
+        int(eng.put([1], [PROMPT], sample="greedy")[0])
+        desc = eng.state_manager.query(1)
+        free0 = eng.free_blocks
+        eng.state_manager.allocate_for(desc, 3 * BS)  # reserve, never write
+        assert eng.free_blocks == free0 - 3
+        eng.state_manager.release_unused_blocks(desc)
+        assert eng.free_blocks == free0
+        assert len(desc.blocks) == -(-desc.seen_tokens // BS)
+        eng.destroy()
+
+
+# ------------------------------------------------------ burst-fn cache (LRU)
+class TestBurstFnCacheLRU:
+
+    def test_cap_holds_with_lru_eviction(self, model_and_params):
+        eng = make_engine(model_and_params)
+        eng._burst_fns.clear()
+        eng._burst_fn_cap = 3
+        made = []
+        for k in range(6):
+            eng._get_burst_fn(("burst", k, None), lambda k=k: made.append(k) or object())
+        assert len(eng._burst_fns) == 3
+        assert eng.burst_fn_evictions == 3
+        assert list(eng._burst_fns) == [("burst", k, None) for k in (3, 4, 5)]
+        # a hit refreshes recency: 3 survives the next insertion, 4 dies
+        eng._get_burst_fn(("burst", 3, None), lambda: pytest.fail("was cached"))
+        eng._get_burst_fn(("burst", 9, None), lambda: object())
+        assert ("burst", 3, None) in eng._burst_fns
+        assert ("burst", 4, None) not in eng._burst_fns
+        assert made == list(range(6))
+        eng.destroy()
+
+    def test_repeat_bursts_reuse_one_program(self, model_and_params):
+        eng = make_engine(model_and_params, spec=False)
+        greedy_rollout(eng, 1, PROMPT, 1)
+        for _ in range(3):
+            eng.decode_burst([1], [[5]], 4)
+        assert len(eng._burst_fns) == 1
+        assert eng.burst_fn_evictions == 0
+        eng.destroy()
+
+
+# ------------------------------------------------------------------- gateway
+class TestGatewaySpec:
+
+    def test_per_request_toggle_and_metrics(self, model_and_params):
+        from deepspeed_tpu.serving.config import ServingConfig
+        from deepspeed_tpu.serving.gateway import ServingGateway
+        eng = make_engine(model_and_params)
+        gw = ServingGateway(eng, config=ServingConfig(max_burst=8),
+                            auto_start=False)
+        h_on = gw.submit(REPETITIVE, max_new_tokens=10)
+        h_off = gw.submit(PROMPT, max_new_tokens=4, spec=False)
+        gw._pump_once()  # admission: the toggle reaches the scheduler
+        assert gw.scheduler.requests[h_on.uid].spec is True
+        assert gw.scheduler.requests[h_off.uid].spec is False
+        for _ in range(200):
+            if h_on.done and h_off.done:
+                break
+            gw._pump_once()
+        assert h_on.result() and h_off.result()
+        snap = gw.snapshot()
+        spec_stats = snap["external"]["Serve/Spec"]
+        assert spec_stats["verify_steps"] > 0
+        assert {"accept_rate", "accepted_per_step",
+                "draft_wasted"} <= set(spec_stats)
+        gw.drain()

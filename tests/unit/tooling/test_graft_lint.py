@@ -253,6 +253,8 @@ class TestThreadSharedState:
             PrefixCacheManager  # noqa: F401
         from deepspeed_tpu.inference.v2.ragged.blocked_allocator import \
             BlockedAllocator  # noqa: F401
+        from deepspeed_tpu.inference.v2.spec.state import \
+            SpecDecodeState  # noqa: F401
         from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401
         from deepspeed_tpu.elasticity.preemption import (  # noqa: F401
             HeartbeatWriter, PreemptionGuard)
@@ -269,7 +271,7 @@ class TestThreadSharedState:
         for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
                     ServingMetrics, BlockedAllocator, PrefixCacheManager,
                     FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica,
-                    PreemptionGuard, HeartbeatWriter):
+                    PreemptionGuard, HeartbeatWriter, SpecDecodeState):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

@@ -78,6 +78,10 @@ THREAD_SHARED_REGISTRY = {
     "BlockedAllocator": {"_free", "_free_set"},
     "PrefixCacheManager": {"_leases", "lookups", "hits", "tokens_saved",
                            "insertions"},
+    # spec decode: the gateway pump drafts/notes while client threads
+    # reach forget() through engine.flush (cancel / deadline / drain)
+    "SpecDecodeState": {"_ema", "_disabled", "steps", "accepted", "drafted",
+                        "emitted", "disables"},
     # fleet: relay threads + heartbeat thread + client threads all touch
     # router/health/replica state
     "FleetRouter": {"_counters", "_relays", "_closed"},
