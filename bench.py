@@ -356,6 +356,130 @@ def bench_serving_2b_prefix(n_req=8, sys_len=512, sfx_len=32, new_tokens=64):
                     "not a wall-clock proxy"}
 
 
+def bench_serving_2b_kv_tier(n_req=4, sys_len=512, sfx_len=32, new_tokens=64,
+                             vocab=32000):
+    """Host-RAM KV spill tier on the same ~2.5B ragged engine, over a
+    trace built to OVERFLOW the HBM block pool: fleet A shares a
+    ``sys_len``-token system prompt and retires into the trie; fleet B
+    (disjoint prompts) then needs more live blocks than remain, so the
+    prefix cache evicts A's chain — DROPPING it without the tier,
+    DEMOTING it to host RAM with the tier; returning fleet A' measures
+    what survived. The same trace runs on two identically-initialized
+    engines — tier forced off via the DS_KV_TIER kill switch, then on —
+    and all three phases' greedy streams are asserted BIT-IDENTICAL
+    (bf16 tier storage restores the exact evicted KV). The headline is
+    the A'-phase prefill tokens saved, tier-on over tier-off."""
+    import gc
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, DynamicSplitFuseScheduler,
+                                            InferenceEngineV2, KVTierConfig,
+                                            PrefixCacheConfig,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+
+    groups.destroy_mesh()
+    model = build_llama("7b", hidden_size=3072, intermediate_size=8192,
+                        num_hidden_layers=22, num_attention_heads=24,
+                        num_key_value_heads=8, max_position_embeddings=2048,
+                        vocab_size=vocab, remat=False)
+    bs = 32
+    prompt_len = sys_len + sfx_len
+    budget = prompt_len + n_req
+    # pool sizing is the experiment: the live fleet needs n_req chains
+    # of ceil((prompt+new)/bs) blocks, and the pool holds just a few
+    # more than that — fleet B's arrival MUST evict most of fleet A's
+    # retired trie (the shared system chain included)
+    per_seq = -(-(prompt_len + new_tokens) // bs)
+    num_kv_blocks = n_req * per_seq + 1 + 4
+
+    def make_cfg():
+        return RaggedInferenceEngineConfig(
+            kv_block_size=bs,
+            num_kv_blocks=num_kv_blocks,
+            prefix_cache=PrefixCacheConfig(enabled=True),
+            # config ON for both engines: the off run exercises the
+            # DS_KV_TIER=0 kill switch, which must leave the
+            # prefix-cache-only pipeline untouched
+            kv_tier=KVTierConfig(enabled=True, host_bytes=1 << 32),
+            state_manager=DSStateManagerConfig(
+                max_ragged_batch_size=budget,
+                max_ragged_sequence_count=n_req,
+                max_tracked_sequences=n_req,
+                max_context=prompt_len + new_tokens))
+
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, vocab, size=sys_len).astype(np.int32)
+    suffixes = [rng.randint(0, vocab, size=sfx_len).astype(np.int32)
+                for _ in range(2 * n_req)]
+    disjoint = [rng.randint(0, vocab, size=prompt_len).astype(np.int32)
+                for _ in range(n_req)]
+    phase_a = [np.concatenate([system, s]) for s in suffixes[:n_req]]
+    phase_back = [np.concatenate([system, s]) for s in suffixes[n_req:]]
+
+    def fleet(engine, uid0, reqs, ntok):
+        sched = DynamicSplitFuseScheduler(engine, token_budget=budget,
+                                          max_burst=16)
+        for i, p in enumerate(reqs):
+            sched.add_request(uid0 + i, p, max_new_tokens=ntok)
+        t0 = time.perf_counter()
+        out = sched.run_to_completion(max_steps=100_000)
+        dt = time.perf_counter() - t0
+        cached = sum(r.prefix_cached_tokens for r in sched.requests.values())
+        return dt, [out[uid0 + i] for i in range(len(reqs))], cached
+
+    def run(tier_off):
+        if tier_off:
+            os.environ["DS_KV_TIER"] = "0"
+        try:
+            engine = InferenceEngineV2(model=model, config=make_cfg())
+        finally:
+            os.environ.pop("DS_KV_TIER", None)
+        assert (engine.kv_tier is None) == tier_off
+        fleet(engine, 10_000, [p[:48] for p in disjoint[:2]], 16)  # warmup
+        _, out_a, _ = fleet(engine, 0, phase_a, new_tokens)
+        _, out_b, _ = fleet(engine, 100, disjoint, new_tokens)
+        dt, out_back, saved = fleet(engine, 200, phase_back, new_tokens)
+        tier_stats = engine.kv_tier.stats() if engine.kv_tier else None
+        pc_stats = engine.prefix_cache.stats()
+        n_params = _param_count(engine.params)
+        engine.destroy()
+        gc.collect()
+        return dt, out_a + out_b + out_back, saved, tier_stats, pc_stats, n_params
+
+    off_dt, off_outs, off_saved, _, _, n_params = run(tier_off=True)
+    on_dt, on_outs, on_saved, tier_stats, pc_stats, _ = run(tier_off=False)
+    assert on_outs == off_outs, \
+        "the KV spill tier changed the greedy token streams"
+    saved_ratio = round(on_saved / max(off_saved, 1), 2)
+    assert on_saved >= 2 * off_saved, \
+        f"tier-2 saved {on_saved} prefill tokens vs tier-1-only {off_saved} " \
+        f"— expected at least 2x"
+    gen = n_req * new_tokens
+    return {"params": n_params, "requests_per_phase": n_req,
+            "system_prompt_len": sys_len, "suffix_len": sfx_len,
+            "new_tokens": new_tokens, "num_kv_blocks": num_kv_blocks,
+            "return_prefill_saved_tier1_only": off_saved,
+            "return_prefill_saved_tiered": on_saved,
+            "tokens_saved_ratio": saved_ratio,
+            "tier2_hit_rate": tier_stats["tier2_hit_rate"],
+            "tier2_hits": pc_stats["tier2_hits"],
+            "tier2_tokens_saved": pc_stats["tier2_tokens_saved"],
+            "demoted_blocks": tier_stats["demoted_blocks"],
+            "promoted_blocks": tier_stats["promoted_blocks"],
+            "prefetched_blocks": tier_stats["prefetched_blocks"],
+            "prefetch_wait_ms": tier_stats["prefetch_wait_ms"],
+            "prefetch_timeouts": tier_stats["prefetch_timeouts"],
+            "return_gen_tok_s_tier1_only": round(gen / off_dt, 1),
+            "return_gen_tok_s_tiered": round(gen / on_dt, 1),
+            "bit_identical": True,  # asserted above
+            "note": "host-RAM KV spill tier: fleet B overflows the HBM pool "
+                    "and evicts fleet A's shared system prompt — dropped "
+                    "with DS_KV_TIER=0, demoted to host and promoted back "
+                    "for the returning fleet with the tier on; all greedy "
+                    "streams asserted bit-identical, prefill savings are "
+                    "exact allocator-side accounting"}
+
+
 def bench_serving_2b_spec(n_req=8, sys_len=256, tmpl_len=64, new_tokens=64,
                           vocab=32000):
     """Self-speculative decoding on the same ~2.5B ragged engine over a
@@ -1017,6 +1141,7 @@ def main():
         ("serving_2b_fp6", bench_serving_2b, {"quant_scheme": "fp6"}),
         ("serving_v2_ragged", bench_serving_v2_ragged, {}),
         ("serving_2b_prefix", bench_serving_2b_prefix, {}),
+        ("serving_2b_kv_tier", bench_serving_2b_kv_tier, {}),
         ("serving_2b_spec", bench_serving_2b_spec, {}),
         ("serving_2b_fleet", bench_serving_2b_fleet, {}),
         ("offload", bench_offload_probe, {}),
@@ -1100,6 +1225,9 @@ def main():
             "serve_ragged_tok_s": _pick("serving_v2_ragged", "gen_tokens_per_sec"),
             "prefix_warm_frac": _pick("serving_2b_prefix", "warm_prefill_frac"),
             "prefix_warm_speedup": _pick("serving_2b_prefix", "warm_vs_cold_speedup"),
+            "kv_tier_saved_ratio": _pick("serving_2b_kv_tier", "tokens_saved_ratio"),
+            "kv_tier_hit_rate": _pick("serving_2b_kv_tier", "tier2_hit_rate"),
+            "kv_tier_prefetch_wait_ms": _pick("serving_2b_kv_tier", "prefetch_wait_ms"),
             "spec_accepted_per_step": _pick("serving_2b_spec", "accepted_per_step"),
             "spec_vs_plain_speedup": _pick("serving_2b_spec", "spec_vs_plain_speedup"),
             "fleet_lost_requests": _pick("serving_2b_fleet", "lost_requests"),

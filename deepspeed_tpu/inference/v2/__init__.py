@@ -5,7 +5,8 @@ ragged/ (state manager, sequence descriptors, blocked KV cache,
 ragged batch), plus the Dynamic SplitFuse continuous-batching scheduler
 the reference ships via DeepSpeed-MII."""
 
-from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, PrefixCacheConfig,
+from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, KVTierConfig,
+                                                  PrefixCacheConfig,
                                                   QuantizationConfig,
                                                   RaggedInferenceEngineConfig,
                                                   SpecDecodeConfig)
@@ -13,5 +14,5 @@ from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 
 __all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig", "DSStateManagerConfig",
-           "QuantizationConfig", "PrefixCacheConfig", "SpecDecodeConfig",
-           "DynamicSplitFuseScheduler"]
+           "QuantizationConfig", "PrefixCacheConfig", "KVTierConfig",
+           "SpecDecodeConfig", "DynamicSplitFuseScheduler"]

@@ -32,6 +32,29 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
     max_cached_blocks: int = 0
 
 
+class KVTierConfig(DeepSpeedConfigModel):
+    """Host-RAM spill tier for the radix prefix cache (requires
+    ``prefix_cache.enabled``): trie eviction demotes full immutable KV
+    blocks into a byte-budgeted host store instead of dropping them, and
+    prompts whose cached prefix continues into demoted chains restore
+    them back. ``enabled`` is the config gate; the ``DS_KV_TIER`` env
+    var overrides it in both directions (kill switch).  ``host_bytes``
+    is the tier-2 budget (``DS_KV_TIER_BYTES`` overrides when > 0).
+    ``quantize`` stores tier-2 blocks as per-(layer, block)-grouped int8
+    instead of the pool dtype — ~2x more blocks per byte, lossy,
+    strictly opt-in (``DS_KV_TIER_QUANT`` overrides in both
+    directions); ``quant_group_size`` subdivides the per-block group
+    (0 = one scale per (layer, block) slab). ``prefetch`` stages
+    host→device copies on a background worker at admission so the copy
+    overlaps queueing (the restore itself always happens on the pump
+    thread behind a completion fence)."""
+    enabled: bool = False
+    host_bytes: int = 1 << 30
+    quantize: bool = False
+    quant_group_size: int = 0
+    prefetch: bool = True
+
+
 class SpecDecodeConfig(DeepSpeedConfigModel):
     """Self-speculative decoding (n-gram prompt-lookup drafting + a
     batched greedy verify forward). ``enabled`` is the config gate; the
@@ -60,6 +83,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager: DSStateManagerConfig = DSStateManagerConfig()
     quantization: QuantizationConfig = QuantizationConfig()
     prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
+    kv_tier: KVTierConfig = KVTierConfig()
     spec_decode: SpecDecodeConfig = SpecDecodeConfig()
     # compiled decode/verify programs kept per engine: each distinct
     # (burst length k, sampling key) and (verify, draft length) compiles

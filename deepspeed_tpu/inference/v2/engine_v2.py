@@ -177,6 +177,31 @@ class InferenceEngineV2:
                 self.kv_cache,
                 max_cached_blocks=int(self._config.prefix_cache.max_cached_blocks))
             self.state_manager.attach_prefix_cache(self.prefix_cache)
+        # Host-RAM KV spill tier (tier-2): trie eviction demotes blocks
+        # into a byte-budgeted host store instead of dropping them.
+        # Config-gated with the DS_KV_TIER env kill switch; layered on
+        # the prefix cache (tier-2 keys ARE the trie's chained hashes),
+        # so without a prefix cache it cannot exist.
+        from deepspeed_tpu.inference.v2.kv_tier import (TierManager,
+                                                        kv_tier_bytes,
+                                                        kv_tier_enabled,
+                                                        kv_tier_quantized)
+        self.kv_tier = None
+        if kv_tier_enabled(self._config.kv_tier):
+            if self.prefix_cache is None:
+                logger.warning(
+                    "kv_tier enabled but the prefix cache is off — the "
+                    "spill tier stores evicted TRIE blocks, so it is "
+                    "inert without one; skipping")
+            else:
+                tier_cfg = self._config.kv_tier
+                self.kv_tier = TierManager(
+                    self.prefix_cache,
+                    capacity_bytes=kv_tier_bytes(tier_cfg),
+                    quantize=kv_tier_quantized(tier_cfg),
+                    quant_group_size=int(tier_cfg.quant_group_size),
+                    prefetch=bool(tier_cfg.prefetch))
+                self.prefix_cache.attach_tier(self.kv_tier)
         # Self-speculative decoding (n-gram drafting + batched verify):
         # config-gated with the DS_SPEC_DECODE env kill switch. When
         # live, schedulers draft via propose_drafts() and score drafts
@@ -725,6 +750,16 @@ class InferenceEngineV2:
         desc = self.state_manager.get_or_create_sequence(uid, prompt_tokens=prompt)
         return desc.cached_tokens
 
+    def prefetch_prefix(self, prompt_tokens):
+        """Fire-and-forget: stage this prompt's tier-2 KV extension on
+        the spill tier's prefetch worker so the host→device copy
+        overlaps queueing (no-op without a tier). Safe from any thread
+        — staging never touches the donated pool; the restore happens
+        on the pump thread at ``acquire`` time behind the fence."""
+        if self.kv_tier is not None:
+            self.kv_tier.prefetch([int(t) for t in
+                                   np.atleast_1d(np.asarray(prompt_tokens))])
+
     def prefix_match_len(self, prompt_tokens):
         """Read-only twin of :meth:`prefix_match` for placement probes:
         → leading tokens of ``prompt_tokens`` whose KV is cached, WITHOUT
@@ -834,6 +869,9 @@ class InferenceEngineV2:
         self.kv_cache = None
         self.state_manager = None
         self.prefix_cache = None
+        if self.kv_tier is not None:
+            self.kv_tier.shutdown()  # stop the prefetch worker + drop host KV
+        self.kv_tier = None
         self.spec = None
         self._step = self._step_greedy = None
         self._burst_fns = OrderedDict()

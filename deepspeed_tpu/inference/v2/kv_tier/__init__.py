@@ -1,0 +1,55 @@
+"""Tiered KV cache: host-RAM spill tier for the radix prefix cache.
+
+The serving analog of the reference's offload tier (AIO /
+ZeRO-offload's ``AsyncPartitionedParameterSwapper`` applied to training
+state): the HBM block pool is tier-1, and blocks the prefix cache
+evicts under pressure DEMOTE into a much larger host-RAM tier-2
+(:class:`HostKVStore`) instead of being dropped. A later prompt whose
+trie match continues into demoted chains PROMOTES them back through
+the donated restore scatter, and prefill starts after the restored
+span. Storage is bf16 by default (bit-identical greedy outputs) and
+int8 per-(layer, block)-grouped under ``DS_KV_TIER_QUANT=1`` for a
+~2x capacity multiplier, with quantization error measured per block.
+"""
+
+from deepspeed_tpu.inference.v2.kv_tier.host_store import HostKVStore
+from deepspeed_tpu.inference.v2.kv_tier.quant import (dequantize_handle,
+                                                      handle_nbytes,
+                                                      quantize_handle)
+from deepspeed_tpu.inference.v2.kv_tier.tier_manager import TierManager
+from deepspeed_tpu.utils.env_registry import env_int, env_opt_bool
+
+
+def kv_tier_enabled(config) -> bool:
+    """Config gate plus the ``DS_KV_TIER`` kill switch: when the env var
+    is set it wins in BOTH directions (``0``/``false``/``off`` force the
+    tier off, anything else forces it on); unset defers to
+    ``config.enabled``."""
+    forced = env_opt_bool("DS_KV_TIER")
+    if forced is not None:
+        return forced
+    return bool(getattr(config, "enabled", False))
+
+
+def kv_tier_bytes(config) -> int:
+    """Host byte budget for tier-2: ``DS_KV_TIER_BYTES`` when set to a
+    positive value, else the config's ``host_bytes``."""
+    override = env_int("DS_KV_TIER_BYTES")
+    if override > 0:
+        return override
+    return int(getattr(config, "host_bytes", 1 << 30))
+
+
+def kv_tier_quantized(config) -> bool:
+    """int8 tier-2 storage gate (``DS_KV_TIER_QUANT`` wins in both
+    directions; unset defers to ``config.quantize``). Opt-in only —
+    lossy storage is never a silent default."""
+    forced = env_opt_bool("DS_KV_TIER_QUANT")
+    if forced is not None:
+        return forced
+    return bool(getattr(config, "quantize", False))
+
+
+__all__ = ["HostKVStore", "TierManager", "kv_tier_enabled", "kv_tier_bytes",
+           "kv_tier_quantized", "quantize_handle", "dequantize_handle",
+           "handle_nbytes"]

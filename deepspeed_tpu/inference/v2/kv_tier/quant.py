@@ -1,0 +1,113 @@
+"""Quantized KV offload handles: int8, per-(layer, block)-grouped.
+
+Tier-2 blocks are bf16 by default (bit-identical restore). Under
+``DS_KV_TIER_QUANT=1`` the spill tier stores int8 carriers instead —
+roughly half the host bytes of bf16 per block, so the same
+``DS_KV_TIER_BYTES`` budget holds ~2x the blocks (4x vs an fp32 pool).
+Quantization reuses the PR-3 group quantizers
+(``ops/pallas/quantization.py``): symmetric int8 with one fp32 scale
+per group, where a group defaults to one whole (layer, block) slab —
+``block_size * n_kv_heads * head_dim`` values — so scales index exactly
+``[num_layers, n_blocks]`` and a batched handle can be sliced/concatenated
+along the block axis without re-grouping.
+
+Quantization error is MEASURED at demotion time (max |dequant - orig|
+per block, reduced over layers) and reported through the tier's stats —
+lossy storage is never silent.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.quantization import dequantize_int8, quantize_int8
+
+
+def _quant_one(arr, group_size):
+    """int8-quantize one pool-layout array ``[L, n, bs, H, D]`` →
+    (values int8 same shape, scales fp32 [L, n, groups_per_block],
+    max-abs-error per block [n])."""
+    L, n, bs, H, D = arr.shape
+    slab = bs * H * D
+    gs = int(group_size) or slab
+    if slab % gs != 0:
+        raise ValueError(f"quant group size {gs} does not divide the "
+                         f"{slab}-value (layer, block) slab")
+    per_block = slab // gs
+    if n == 0:
+        return (np.zeros(arr.shape, np.int8),
+                np.zeros((L, 0, per_block), np.float32), np.zeros((0,)))
+    values, scales, shape = quantize_int8(arr, group_size=gs)
+    # flattening order is [L, n, bs, H, D], so group g maps to
+    # (layer, block, within-block group) = divmod chains — reshape only
+    values = np.asarray(values).reshape(L, n, bs, H, D)
+    scales = np.asarray(scales, np.float32).reshape(L, n, per_block)
+    back = np.asarray(dequantize_int8(jnp.asarray(values).reshape(-1, gs),
+                                      jnp.asarray(scales).reshape(-1),
+                                      shape, dtype=jnp.float32))
+    err = np.abs(back.reshape(L, n, slab) -
+                 np.asarray(arr, np.float32).reshape(L, n, slab))
+    return values, scales, err.max(axis=(0, 2))
+
+
+def quantize_handle(handle, group_size=0):
+    """→ a quantized offload handle: ``{"k", "v"}`` become int8 arrays in
+    the pool layout, ``{"k_scales", "v_scales"}`` carry the per-group
+    fp32 scales, ``"quantized": True`` marks the format for
+    ``BlockedKVCache._validate_handle``/``restore``, and
+    ``"quant_error"`` holds the measured max-abs error per block
+    ``[n_blocks]`` (max over k/v)."""
+    k = np.asarray(handle["k"])
+    v = np.asarray(handle["v"])
+    kv_vals, ks, kerr = _quant_one(k, group_size)
+    vv_vals, vs, verr = _quant_one(v, group_size)
+    return {"k": kv_vals, "v": vv_vals, "k_scales": ks, "v_scales": vs,
+            "quantized": True,
+            "quant_error": np.maximum(kerr, verr)}
+
+
+def dequantize_handle(handle, dtype):
+    """Inverse of :func:`quantize_handle` (host-side; the device path
+    dequantizes inside the jitted restore scatter instead)."""
+    out = {}
+    for name in ("k", "v"):
+        vals = np.asarray(handle[name], np.float32)
+        scales = np.asarray(handle[f"{name}_scales"], np.float32)
+        L, n, bs, H, D = vals.shape
+        per_block = scales.shape[-1]
+        gs = (bs * H * D) // per_block
+        deq = vals.reshape(L, n, per_block, gs) * scales[..., None]
+        out[name] = np.asarray(jnp.asarray(deq.reshape(L, n, bs, H, D), dtype))
+    return out
+
+
+def handle_nbytes(handle) -> int:
+    """Host bytes one offload handle occupies (arrays only)."""
+    return int(sum(np.asarray(handle[k]).nbytes for k in handle
+                   if k in ("k", "v", "k_scales", "v_scales")))
+
+
+def slice_handle(handle, i, j):
+    """Blocks ``[i, j)`` of a batched handle, preserving the format."""
+    out = {name: handle[name][:, i:j]
+           for name in ("k", "v", "k_scales", "v_scales") if name in handle}
+    if handle.get("quantized"):
+        out["quantized"] = True
+        if "quant_error" in handle:
+            out["quant_error"] = handle["quant_error"][i:j]
+    return out
+
+
+def concat_handles(handles):
+    """Concatenate per-block handles (same format) along the block axis.
+    Accepts a mix of host (numpy) and device (jax) arrays — staged
+    prefetch buffers ride next to store-resident records."""
+    if not handles:
+        raise ValueError("concat_handles needs at least one handle")
+    quant = bool(handles[0].get("quantized"))
+    names = ("k", "v") + (("k_scales", "v_scales") if quant else ())
+    out = {name: jnp.concatenate([h[name] for h in handles], axis=1)
+           for name in names}
+    if quant:
+        out["quantized"] = True
+    return out

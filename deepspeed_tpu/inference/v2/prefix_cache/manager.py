@@ -53,6 +53,11 @@ class PrefixCacheManager:
         self.hits = 0
         self.tokens_saved = 0
         self.insertions = 0
+        # host spill tier (kv_tier.TierManager), attached by the engine;
+        # None = eviction drops blocks (pre-tier behavior, bit for bit)
+        self.tier = None
+        self.tier2_hits = 0
+        self.tier2_tokens_saved = 0
         # the gateway pump thread and client threads (suspend/flush)
         # both mutate the trie + lease table; RLock because release()
         # re-enters release_lease()
@@ -74,13 +79,31 @@ class PrefixCacheManager:
     def cached_blocks(self):
         return self.index.num_nodes
 
+    def attach_tier(self, tier):
+        """Plug the host spill tier in (engine construction): trie
+        eviction becomes demotion, and acquires extend matches with
+        promoted tier-2 chains."""
+        with self._lock:
+            self.tier = tier
+
+    def _evict_locked(self, n_blocks, protect=frozenset()):
+        """Evict up to ``n_blocks`` from the trie, demoting the victims'
+        KV to tier-2 first when a tier is attached (the gather reads the
+        pool BEFORE the caller frees the ids). → freed block ids."""
+        if self.tier is None:
+            return self.index.evict(n_blocks, protect)
+        victims = self.index.evict_nodes(n_blocks, protect)
+        if victims:
+            self.tier.demote(victims)
+        return [block for _, _, block in victims]
+
     def ensure_free(self, num_blocks):
         """Evict unreferenced cached blocks (LRU) until the allocator has
         ``num_blocks`` free, or the trie has nothing left to give."""
         with self._lock:
             deficit = num_blocks - self.kv_cache.free_blocks
             if deficit > 0:
-                freed = self.index.evict(deficit)
+                freed = self._evict_locked(deficit)
                 if freed:
                     self.kv_cache.free(freed)
             self._check()
@@ -95,25 +118,92 @@ class PrefixCacheManager:
     def acquire(self, uid, prompt_tokens):
         """Match ``prompt_tokens``' longest cached block-aligned prefix
         and lease it to ``uid`` (refs held until :meth:`release` /
-        :meth:`release_lease`). → ``(block_ids, cached_tokens)``."""
+        :meth:`release_lease`). → ``(block_ids, cached_tokens)``. With a
+        spill tier attached, the trie match is first EXTENDED with any
+        contiguous tier-2 chain (restored into fresh pool blocks behind
+        the prefetch fence), so the lease covers both tiers."""
+        if self.tier is not None:
+            # fence BEFORE the manager lock: the prefetch worker needs
+            # this lock for its trie walk, so fencing under it deadlocks
+            self.tier.wait_prefetch(prompt_tokens)
         with self._lock:
             if uid in self._leases:
                 raise ValueError(f"sequence {uid} already holds a prefix lease")
             # never match the WHOLE prompt: the last prompt token must be
             # recomputed so its logits exist to sample the first new token
             max_blocks = (len(prompt_tokens) - 1) // self.block_size
+            if self.tier is not None:
+                self._promote_tier_hits_locked(prompt_tokens, max_blocks)
             path = self.index.match(prompt_tokens, max_blocks)
             self.lookups += 1
             if not path:
                 return [], 0
+            tier2_blocks = 0
             for node in path:
                 self.index.incref(node)
+                if node.tier2:
+                    # consume the promotion flag at first lease: each
+                    # restored block attributes to exactly one request
+                    node.tier2 = False
+                    tier2_blocks += 1
             self._leases[uid] = path
             cached = len(path) * self.block_size
             self.hits += 1
             self.tokens_saved += cached
+            if tier2_blocks:
+                self.tier2_hits += 1
+                self.tier2_tokens_saved += tier2_blocks * self.block_size
             self._check()
             return [node.block_id for node in path], cached
+
+    def _promote_tier_hits_locked(self, prompt_tokens, max_blocks):
+        """Restore the contiguous tier-2 chain extending this prompt's
+        trie match into freshly reserved pool blocks and insert them as
+        (tier2-flagged) trie nodes — the subsequent ``match`` then
+        leases them exactly like tier-1 content. Capacity for the
+        restore comes from evicting OTHER ref-0 blocks (the matched
+        path is protected: demoting the prefix being extended would be
+        self-defeating); when the pool stays short, only the head of
+        the chain is promoted and the rest goes back to the store."""
+        tier = self.tier
+        bs = self.block_size
+        path = self.index.match(prompt_tokens, max_blocks)
+        parent = path[-1] if path else self.index.root
+        start = len(path)
+        # claim the chain first (pops store records): eviction below may
+        # demote into the store and LRU-drop what a mere peek found
+        claimed = []
+        parent_key = parent.key
+        for i in range(start, max_blocks):
+            chunk = tuple(int(t) for t in prompt_tokens[i * bs:(i + 1) * bs])
+            item = tier.claim(parent_key, chunk)
+            if item is None:
+                break
+            claimed.append((chunk, item))
+            parent_key = item["record"]["key"]
+        if not claimed:
+            return
+        want = len(claimed)
+        if self.kv_cache.free_blocks < want:
+            freed = self._evict_locked(want - self.kv_cache.free_blocks,
+                                       protect=set(path))
+            if freed:
+                self.kv_cache.free(freed)
+        n = min(want, self.kv_cache.free_blocks)
+        for _chunk, item in claimed[n:]:
+            tier.unclaim(item)  # pool full: tail stays in tier-2
+        claimed = claimed[:n]
+        if not claimed:
+            return
+        from deepspeed_tpu.inference.v2.kv_tier.quant import concat_handles
+        handle = concat_handles([item["handle"] for _, item in claimed])
+        blocks = self.kv_cache.restore(handle)  # one donated scatter
+        node = parent
+        for (chunk, _item), block in zip(claimed, blocks):
+            node = self.index.insert_child(node, chunk, block)
+            node.tier2 = True
+        tier.note_promoted(len(claimed))
+        self._check()
 
     def match_len(self, prompt_tokens):
         """Read-only probe: how many leading tokens of ``prompt_tokens``
@@ -121,11 +211,18 @@ class PrefixCacheManager:
         skews no hit-rate stats — the fleet router calls this on every
         placement decision, and a routing probe must not look like
         traffic. Capped one token short like :meth:`acquire` (the match
-        an admitted request would actually get)."""
+        an admitted request would actually get). With a spill tier
+        attached the probe counts demoted chain extensions too, so
+        fleet routing sees both tiers."""
         with self._lock:
             max_blocks = (len(prompt_tokens) - 1) // self.block_size
-            return len(self.index.match(prompt_tokens, max_blocks)) * \
-                self.block_size
+            path = self.index.match(prompt_tokens, max_blocks)
+            n = len(path)
+            if self.tier is not None and n < max_blocks:
+                parent_key = path[-1].key if path else self.index.root.key
+                n += self.tier.probe_chain(parent_key, prompt_tokens, n,
+                                           max_blocks, touch=False)
+            return n * self.block_size
 
     def release_lease(self, uid):
         """Drop ``uid``'s prefix refs without inserting anything (the
@@ -163,7 +260,7 @@ class PrefixCacheManager:
                     continue
                 if self.max_cached_blocks and \
                         self.index.num_nodes >= self.max_cached_blocks:
-                    evicted = self.index.evict(1, protect=chain)
+                    evicted = self._evict_locked(1, protect=chain)
                     if not evicted:
                         # cache full of referenced blocks: stop chaining here
                         # (a gap would orphan deeper chunks) and free the rest
@@ -190,4 +287,8 @@ class PrefixCacheManager:
             "evictable_blocks": self.evictable_blocks,
             "lookups": self.lookups,
             "insertions": self.insertions,
+            # request/token attribution of the host spill tier (0s when
+            # no tier is attached — the schema stays stable for monitors)
+            "tier2_hits": self.tier2_hits,
+            "tier2_tokens_saved": self.tier2_tokens_saved,
         }

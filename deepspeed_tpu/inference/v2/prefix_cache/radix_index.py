@@ -29,7 +29,7 @@ def _chunk_key(parent_key, chunk):
 
 class RadixNode:
     __slots__ = ("key", "tokens", "block_id", "parent", "children", "ref",
-                 "last_used")
+                 "last_used", "tier2")
 
     def __init__(self, key, tokens, block_id, parent):
         self.key = key
@@ -39,6 +39,10 @@ class RadixNode:
         self.children = {}        # chained key -> [RadixNode] (collision bucket)
         self.ref = 0              # live sequences whose matched path crosses here
         self.last_used = 0
+        # promoted from the host spill tier and not yet leased: the first
+        # acquire that matches through here consumes the flag for
+        # tier-2-hit attribution (promotion metrics without double counts)
+        self.tier2 = False
 
     @property
     def is_leaf(self):
@@ -135,8 +139,15 @@ class RadixPrefixIndex:
         that must survive (e.g. a chain mid-insertion). Returns the
         freed physical block ids; shorter than ``n_blocks`` when the
         trie runs out of reclaimable leaves."""
-        freed = []
-        while len(freed) < n_blocks:
+        return [b for _, _, b in self.evict_nodes(n_blocks, protect)]
+
+    def evict_nodes(self, n_blocks, protect=frozenset()):
+        """:meth:`evict` returning each victim's full content identity:
+        ``(parent_key, tokens, block_id)`` tuples, captured BEFORE the
+        unlink severs ``parent``. The KV-tier demotion path re-chains a
+        spilled block's identity from exactly these fields."""
+        victims = []
+        while len(victims) < n_blocks:
             victim = None
             stack = [self.root]
             while stack:
@@ -154,6 +165,6 @@ class RadixPrefixIndex:
                             stack.append(child)
             if victim is None:
                 break
-            freed.append(victim.block_id)
+            victims.append((victim.parent.key, victim.tokens, victim.block_id))
             self._unlink(victim)
-        return freed
+        return victims

@@ -62,34 +62,65 @@ class BlockedKVCache:
     # raises NotImplementedError, kv_cache.py:166/176 "Offloading is not
     # yet supported"; here it is real — vLLM-style sequence swapping)
     # ------------------------------------------------------------------
-    def offload(self, blocks, keep=()):
-        """Move ``blocks``' KV to host memory and free them for reuse.
-        → opaque handle for :meth:`restore`. Blocks listed in ``keep``
-        are copied into the handle but NOT freed — the prefix-cache
-        suspend path, where a shared prefix block stays owned by the
-        radix trie while the suspended sequence carries its own copy."""
+    def gather(self, blocks):
+        """Copy ``blocks``' KV to host memory WITHOUT freeing them →
+        offload handle (the read half of :meth:`offload`; the KV-tier
+        demotion path gathers before the trie's ids are freed). The
+        gather runs through one cached jitted program per pool with the
+        id vector padded to a power of two (repeating the last id), so
+        arbitrary batch sizes reuse log2-many compiled programs instead
+        of retracing an eager ``jnp.take`` per distinct length."""
         blocks = [int(b) for b in blocks]
         for b in blocks:
             if b < 0 or b >= self.num_blocks:
                 raise KVCacheHandleError(f"invalid block id {b} for a "
                                          f"{self.num_blocks}-block pool")
-        ids = jnp.asarray(blocks, jnp.int32)
-        k_host, v_host = jax.device_get((jnp.take(self.k, ids, axis=1),
-                                         jnp.take(self.v, ids, axis=1)))
+        n = len(blocks)
+        if n == 0:
+            shape = (self.num_layers, 0, self.block_size, self.n_kv_heads,
+                     self.head_dim)
+            empty = jax.device_get(jnp.zeros(shape, self.dtype))
+            return {"k": empty, "v": empty.copy()}
+        padded = 1 << (n - 1).bit_length()
+        ids = jnp.asarray(blocks + [blocks[-1]] * (padded - n), jnp.int32)
+        k_host, v_host = jax.device_get(_gather_blocks(self.k, self.v, ids))
+        return {"k": k_host[:, :n], "v": v_host[:, :n]}
+
+    def offload(self, blocks, keep=()):
+        """Move ``blocks``' KV to host memory and free them for reuse.
+        → opaque handle for :meth:`restore`. Blocks listed in ``keep``
+        are copied into the handle but NOT freed — the prefix-cache
+        suspend path, where a shared prefix block stays owned by the
+        radix trie while the suspended sequence carries its own copy.
+        ``keep`` must be a subset of ``blocks``: an id outside the
+        offload set would silently stay allocated with nobody holding
+        it (a permanent pool leak), so it raises instead."""
+        blocks = [int(b) for b in blocks]
         keep = {int(b) for b in keep}
+        extra = keep - set(blocks)
+        if extra:
+            raise KVCacheHandleError(
+                f"keep ids {sorted(extra)} are not in the offloaded block "
+                f"set — each kept block must be part of this offload")
+        handle = self.gather(blocks)
         self.free(b for b in blocks if b not in keep)
-        return {"k": k_host, "v": v_host}
+        return handle
 
     def _validate_handle(self, handle):
         """Shape/dtype-check an offload handle against the pool layout
         (raises :class:`KVCacheHandleError`) so corruption surfaces as a
-        typed host error, never inside the jitted scatter."""
+        typed host error, never inside the jitted scatter. Accepts both
+        plain (pool-dtype) handles and quantized ones (``"quantized":
+        True`` — int8 k/v carriers plus per-group fp32 ``k_scales`` /
+        ``v_scales`` of shape ``[num_layers, n, groups_per_block]``)."""
         if not isinstance(handle, dict) or "k" not in handle or "v" not in handle:
             raise KVCacheHandleError("offload handle must be a dict with "
                                      "'k' and 'v' arrays")
+        quantized = bool(handle.get("quantized"))
         k, v = handle["k"], handle["v"]
         want = (self.num_layers, None, self.block_size, self.n_kv_heads,
                 self.head_dim)
+        want_dtype = jnp.dtype(jnp.int8) if quantized else jnp.dtype(self.dtype)
         for name, arr in (("k", k), ("v", v)):
             shape = getattr(arr, "shape", None)
             if shape is None or len(shape) != 5 or any(
@@ -99,30 +130,84 @@ class BlockedKVCache:
                     f"layout [num_layers={self.num_layers}, n, "
                     f"block_size={self.block_size}, n_kv_heads="
                     f"{self.n_kv_heads}, head_dim={self.head_dim}]")
-            if jnp.dtype(arr.dtype) != jnp.dtype(self.dtype):
+            if jnp.dtype(arr.dtype) != want_dtype:
                 raise KVCacheHandleError(
                     f"handle['{name}'] dtype {arr.dtype} does not match "
-                    f"pool dtype {jnp.dtype(self.dtype).name}")
+                    f"{'quantized carrier' if quantized else 'pool'} dtype "
+                    f"{want_dtype.name}")
         if k.shape != v.shape:
             raise KVCacheHandleError(
                 f"handle k/v shapes disagree: {k.shape} vs {v.shape}")
+        if quantized:
+            slab = self.block_size * self.n_kv_heads * self.head_dim
+            n = k.shape[1]
+            for name in ("k_scales", "v_scales"):
+                scales = handle.get(name)
+                shape = getattr(scales, "shape", None)
+                if scales is None or shape is None or len(shape) != 3 or \
+                        shape[0] != self.num_layers or shape[1] != n or \
+                        shape[2] < 1 or (n and slab % shape[2] != 0):
+                    raise KVCacheHandleError(
+                        f"quantized handle['{name}'] shape {shape} does not "
+                        f"match [num_layers={self.num_layers}, n={n}, "
+                        f"groups_per_block dividing {slab}]")
+                if jnp.dtype(scales.dtype) != jnp.dtype(jnp.float32):
+                    raise KVCacheHandleError(
+                        f"quantized handle['{name}'] dtype {scales.dtype} "
+                        f"must be float32")
 
     def restore(self, handle):
         """Bring offloaded KV back into freshly reserved blocks (ids may
         differ from the original ones — callers re-point their block
         tables). The pool arrays are donated through the jitted scatter,
-        so the update is in place, not a second pool copy."""
+        so the update is in place, not a second pool copy. Quantized
+        handles dequantize INSIDE the jitted scatter (int8 carriers +
+        scales cross to device; the fp32 expansion never exists on
+        host). An empty handle (``n == 0``) is a no-op returning ``[]``
+        — no reservation, no zero-block scatter through jit."""
         self._validate_handle(handle)
         n = handle["k"].shape[1]
+        if n == 0:
+            return []
         blocks = self.reserve(n)
         ids = jnp.asarray(blocks, jnp.int32)
-        self.k, self.v = _scatter_blocks(self.k, self.v, ids,
-                                         jnp.asarray(handle["k"], self.dtype),
-                                         jnp.asarray(handle["v"], self.dtype))
+        if handle.get("quantized"):
+            self.k, self.v = _scatter_blocks_q(
+                self.k, self.v, ids,
+                jnp.asarray(handle["k"]), jnp.asarray(handle["v"]),
+                jnp.asarray(handle["k_scales"], jnp.float32),
+                jnp.asarray(handle["v_scales"], jnp.float32))
+        else:
+            self.k, self.v = _scatter_blocks(self.k, self.v, ids,
+                                             jnp.asarray(handle["k"], self.dtype),
+                                             jnp.asarray(handle["v"], self.dtype))
         return blocks
 
 
 # donated pools: the functional .at[].set aliases in place, no pool copy
 _scatter_blocks = jax.jit(
     lambda pk, pv, ids, kv, vv: (pk.at[:, ids].set(kv), pv.at[:, ids].set(vv)),
+    donate_argnums=(0, 1))
+
+# cached batched gather for offload/demotion (ids pre-padded to a power
+# of two by the caller, bounding the compiled-program set to log2 sizes)
+_gather_blocks = jax.jit(
+    lambda pk, pv, ids: (jnp.take(pk, ids, axis=1), jnp.take(pv, ids, axis=1)))
+
+
+def _dequant_blocks(vals, scales, dtype):
+    """Per-group int8 dequant in pool layout (traced inside the restore
+    scatter): group ``g`` of block ``b`` in layer ``l`` scales by
+    ``scales[l, b, g]``."""
+    L, n, bs, H, D = vals.shape
+    groups = scales.shape[-1]
+    gs = (bs * H * D) // groups
+    deq = vals.astype(jnp.float32).reshape(L, n, groups, gs) * scales[..., None]
+    return deq.reshape(vals.shape).astype(dtype)
+
+
+_scatter_blocks_q = jax.jit(
+    lambda pk, pv, ids, kv, vv, ks, vs: (
+        pk.at[:, ids].set(_dequant_blocks(kv, ks, pk.dtype)),
+        pv.at[:, ids].set(_dequant_blocks(vv, vs, pv.dtype))),
     donate_argnums=(0, 1))

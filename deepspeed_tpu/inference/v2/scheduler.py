@@ -91,6 +91,12 @@ class DynamicSplitFuseScheduler:
         if not req.prompt:
             raise ValueError(f"uid {uid}: empty prompt can never be scheduled")
         self.requests[uid] = req
+        # KV-tier prefetch kick: stage any demoted prefix extension for
+        # this prompt off-thread NOW, so the host→device copy overlaps
+        # the wait until _plan first schedules the request
+        prefetch = getattr(self.engine, "prefetch_prefix", None)
+        if prefetch is not None:
+            prefetch(req.prompt)
         return req
 
     @property

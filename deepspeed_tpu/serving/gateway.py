@@ -220,6 +220,13 @@ class ServingGateway:
             shed._finish("shed", RequestShedError(
                 f"request {shed.uid} (priority {shed.priority}) evicted from a "
                 f"full queue by request {handle.uid} (priority {prio})"))
+        # KV-tier prefetch kick at ADMISSION, not at scheduling: the
+        # tier's worker stages host→device copies of this prompt's
+        # demoted prefix while the request waits in the queue, so the
+        # copy is already on device when the pump acquires the prefix
+        prefetch = getattr(self.engine, "prefetch_prefix", None)
+        if prefetch is not None:
+            prefetch(prompt)
         self._wake.set()
         return handle
 
@@ -414,6 +421,9 @@ class ServingGateway:
         prefix_cache = getattr(self.engine, "prefix_cache", None)
         if prefix_cache is not None:
             self.metrics.set_external("Serve/PrefixCache", prefix_cache.stats())
+        kv_tier = getattr(self.engine, "kv_tier", None)
+        if kv_tier is not None:
+            self.metrics.set_external("Serve/KVTier", kv_tier.stats())
         spec = getattr(self.engine, "spec", None)
         if spec is not None:
             self.metrics.set_external("Serve/Spec", spec.stats())

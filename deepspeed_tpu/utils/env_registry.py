@@ -149,6 +149,20 @@ register("DS_PREFIX_CACHE", "optional_bool", None,
          "Kill switch for the radix prefix cache; set it wins in both "
          "directions, unset defers to the engine config.",
          "deepspeed_tpu/inference/v2/prefix_cache/manager.py")
+register("DS_KV_TIER", "optional_bool", None,
+         "Kill switch for the host-RAM KV spill tier (tier-2 of the "
+         "prefix cache); set it wins in both directions, unset defers "
+         "to the engine config.",
+         "deepspeed_tpu/inference/v2/kv_tier/__init__.py")
+register("DS_KV_TIER_BYTES", "int", 0,
+         "Host byte budget for tier-2 KV blocks; 0 defers to the "
+         "engine config's kv_tier.host_bytes.",
+         "deepspeed_tpu/inference/v2/kv_tier/__init__.py")
+register("DS_KV_TIER_QUANT", "optional_bool", None,
+         "Store tier-2 KV blocks as per-(layer, block)-grouped int8 "
+         "(~2x blocks per byte, lossy, never silently on); set it wins "
+         "in both directions, unset defers to the engine config.",
+         "deepspeed_tpu/inference/v2/kv_tier/__init__.py")
 register("DS_SPEC_DECODE", "optional_bool", None,
          "Kill switch for self-speculative decoding (n-gram drafting + "
          "batched verify); set it wins in both directions, unset defers "
@@ -168,8 +182,8 @@ register("DS_FLEET_PREFIX_ROUTING", "bool", True,
          "deepspeed_tpu/serving/fleet/router.py")
 register("DS_SANITIZE", "bool", False,
          "Enable runtime sanitizers: checkify NaN/OOB checks around "
-         "the v2 model forward plus allocator/prefix-cache invariant "
-         "assertions. Off by default (zero hot-path cost).",
+         "the v2 model forward plus allocator/prefix-cache/KV-tier "
+         "invariant assertions. Off by default (zero hot-path cost).",
          "deepspeed_tpu/utils/sanitize.py")
 
 # Launcher / elasticity

@@ -41,6 +41,12 @@ class PrefixCacheCorruptionError(SanitizerError):
     """Radix trie refcount/reclaimable accounting disagreement."""
 
 
+class KVTierCorruptionError(SanitizerError):
+    """Host KV spill-tier record whose stored chained key no longer
+    re-derives from its (parent_key, tokens) identity — promotion would
+    graft wrong-content KV into the trie — or byte accounting drift."""
+
+
 def sanitize_enabled() -> bool:
     return env_bool("DS_SANITIZE")
 
@@ -92,6 +98,33 @@ def check_allocator(alloc) -> None:
             f"free-list/free-set mirror out of sync: list has "
             f"{len(free)} entries, set has {len(mirror)} "
             f"(symmetric difference: {sorted(set(free) ^ mirror)[:8]})")
+
+
+def check_kv_tier_store(store) -> None:
+    """Re-derive every tier-2 record's chained content key through the
+    SAME ``_chunk_key`` the radix trie uses and compare it to the key
+    captured at demotion time: a mismatch means a record's identity and
+    its KV content have come apart (promotion would extend a prompt's
+    trie match with someone else's KV). Also re-sums ``nbytes`` against
+    the O(1) ``bytes_resident`` counter the LRU budget trusts. Called
+    under the store lock after every mutation when DS_SANITIZE is on."""
+    # import here, not at module top: _chunk_key must resolve at CALL
+    # time so monkeypatched hashes (collision tests) stay consistent,
+    # and this module stays importable without the inference package
+    from deepspeed_tpu.inference.v2.prefix_cache.radix_index import _chunk_key
+    total = 0
+    for (parent_key, tokens), rec in store._records.items():
+        derived = _chunk_key(parent_key, tokens)
+        if rec["key"] != derived:
+            raise KVTierCorruptionError(
+                f"tier-2 record for parent_key={parent_key!r} re-derives "
+                f"chained key {derived!r} but stores {rec['key']!r} — "
+                f"identity/content mismatch")
+        total += rec["nbytes"]
+    if total != store.bytes_resident:
+        raise KVTierCorruptionError(
+            f"tier-2 records sum to {total} bytes but bytes_resident "
+            f"says {store.bytes_resident}")
 
 
 def check_prefix_index(index) -> None:

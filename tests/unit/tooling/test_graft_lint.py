@@ -249,6 +249,8 @@ class TestThreadSharedState:
     def test_registry_matches_mesh_of_real_classes(self):
         # the registry names real classes — catch silent renames
         import deepspeed_tpu  # noqa: F401  (package import side effects)
+        from deepspeed_tpu.inference.v2.kv_tier import (  # noqa: F401
+            HostKVStore, TierManager)
         from deepspeed_tpu.inference.v2.prefix_cache.manager import \
             PrefixCacheManager  # noqa: F401
         from deepspeed_tpu.inference.v2.ragged.blocked_allocator import \
@@ -271,7 +273,8 @@ class TestThreadSharedState:
         for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
                     ServingMetrics, BlockedAllocator, PrefixCacheManager,
                     FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica,
-                    PreemptionGuard, HeartbeatWriter, SpecDecodeState):
+                    PreemptionGuard, HeartbeatWriter, SpecDecodeState,
+                    TierManager, HostKVStore):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

@@ -77,7 +77,18 @@ THREAD_SHARED_REGISTRY = {
     "ServingMetrics": {"_counters", "_gauges", "_external"},
     "BlockedAllocator": {"_free", "_free_set"},
     "PrefixCacheManager": {"_leases", "lookups", "hits", "tokens_saved",
-                           "insertions"},
+                           "insertions", "tier", "tier2_hits",
+                           "tier2_tokens_saved"},
+    # kv tier: the prefetch worker stages/claims against state the pump
+    # thread (demote/promote) and client threads (prefetch kick, stats)
+    # also mutate
+    "TierManager": {"_staged", "_inflight", "demoted_blocks",
+                    "promoted_blocks", "prefetched_blocks", "stage_hits",
+                    "prefetch_waits", "prefetch_wait_ms",
+                    "prefetch_timeouts", "prefetch_errors",
+                    "quant_error_max"},
+    "HostKVStore": {"_records", "bytes_resident", "demotions", "promotions",
+                    "evictions", "lookups", "hits"},
     # spec decode: the gateway pump drafts/notes while client threads
     # reach forget() through engine.flush (cancel / deadline / drain)
     "SpecDecodeState": {"_ema", "_disabled", "steps", "accepted", "drafted",
