@@ -117,8 +117,9 @@ def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
     # Weight-only quantized serving: the scan sliced this layer's
     # quantized carriers; they stay quantized here and every projection
     # consumes them through the fused dequant-matmul in _proj (norm
-    # scales / biases are plain arrays). Only the MoE expert stack still
-    # dequantizes per slice, inside _moe_mlp.
+    # scales / biases are plain arrays). The MoE expert stacks stay
+    # boxed too — _moe_mlp feeds their carriers to the fused grouped
+    # GEMM (only the [D, E] router sliver dequantizes per slice).
     T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     attn = lp["self_attn"]
@@ -162,16 +163,25 @@ def _moe_mlp(x, p, k, mesh=None):
     feature shards over 'tensor'), routes every token assignment but
     masks the non-local ones, and a psum over ('expert', 'tensor')
     combines — expert weights never leave their shard, the serving
-    analogue of training's expert-axis dispatch."""
-    from deepspeed_tpu.ops.grouped_gemm import dropless_moe_ffn
-    # The stacked expert weights feed the grouped GEMM as dense arrays;
-    # dequantize this layer's MoE subtree at entry (transient, freed
-    # after the FFN — fusing dequant into the grouped GEMM is future
-    # work). No-op for full-precision params.
-    from deepspeed_tpu.inference.quantization import dequantize_tree
-    p = dequantize_tree(p, x.dtype)
+    analogue of training's expert-axis dispatch.
+
+    Quantized serving: the MoE subtree stays BOXED through the v2 scan
+    like every other projection — the expert stacks feed the grouped
+    GEMM as grouped-layout carriers and dequantize inside it (fused
+    kernel on TPU, gathered/ragged identical-math fallbacks elsewhere);
+    only the [D, E] router sliver dequantizes here (its fp32 matmul
+    needs the logits exactly as the unboxed path computed them).
+    ``DS_FUSED_GMM=0`` restores the old dequantize-at-entry subtree."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    from deepspeed_tpu.ops.grouped_gemm import dropless_moe_ffn, fused_gmm_enabled
+    if not fused_gmm_enabled():
+        from deepspeed_tpu.inference.quantization import dequantize_tree
+        p = dequantize_tree(p, x.dtype)
+    gk = p["gate"]["wg"]["kernel"]
+    if isinstance(gk, QuantizedWeight):
+        gk = gk.dequantized(x.dtype)
     gates = jax.nn.softmax(
-        (x.astype(jnp.float32) @ p["gate"]["wg"]["kernel"].astype(jnp.float32)), axis=-1)
+        (x.astype(jnp.float32) @ gk.astype(jnp.float32)), axis=-1)
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
     if k > 1:
         topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)

@@ -95,6 +95,71 @@ def shard_params(params, mesh, rule, dtype=None):
     return path_tree_map(place, params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
 
 
+def moe_expert_specs(mesh, w1, w3, w2):
+    """Shard plan for stacked MoE expert weights entering the dropless
+    shard_map (``ops/grouped_gemm.dropless_moe_ffn``): the expert dim
+    over the mesh's 'expert' axis — E/ep carriers per replica — and
+    features over 'tensor' when the geometry allows (columns of the
+    [E, D, I] gate/up stacks, rows of the [E, I, D] down stack).
+
+    Each weight may be dense or a grouped-layout ``QuantizedWeight``.
+    Quantized stacks shard their values AND scales: the scale group axis
+    must split evenly over 'tensor' (scales shard along with the
+    columns) or be a single group (scales replicate; every column shares
+    the one scale, so shard-local dequant still derives the right group
+    width); fp6 additionally needs the packed byte dim to split on whole
+    4-code triples. When any stack fails its check the plan drops to
+    feature-replicated experts with an 'expert'-only psum — summing a
+    replicated 'tensor' axis would overcount.
+
+    → ``(w_specs, psum_axes)`` where ``w_specs`` has one spec TUPLE per
+    weight, matching that weight's ``_split_stack`` decomposition
+    (``(values_spec, scales_spec)`` for quantized, ``(spec,)`` dense).
+    """
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = sizes.get("tensor", 1)
+
+    def logical_last(w):
+        if w.scheme == "fp6":
+            return w.values.shape[-1] * 4 // 3
+        return w.values.shape[-1]
+
+    def col_ok(w):  # shard the last (feature) dim of [E, K, N]
+        if not isinstance(w, QuantizedWeight):
+            return w.shape[-1] % tp == 0
+        n, ng = logical_last(w), w.scales.shape[-1]
+        if ng == 0 or n % ng or n % tp:
+            return False
+        if ng % tp and ng != 1:
+            return False
+        if w.scheme == "fp6" and (w.values.shape[-1] % tp or (n // tp) % 4):
+            return False
+        return True
+
+    def row_ok(w):  # shard the middle (contraction) dim of [E, I, D]
+        dim = w.values.shape[-2] if isinstance(w, QuantizedWeight) else w.shape[-2]
+        return dim % tp == 0
+
+    tensor_ok = col_ok(w1) and col_ok(w3) and row_ok(w2)
+
+    def specs(w, kind):
+        if tensor_ok:
+            val = P("expert", None, "tensor") if kind == "col" else P("expert", "tensor", None)
+        else:
+            val = P("expert", None, None)
+        if not isinstance(w, QuantizedWeight):
+            return (val,)
+        if kind == "col" and tensor_ok and w.scales.shape[-1] % tp == 0:
+            return (val, P("expert", None, "tensor"))
+        if kind == "row" and tensor_ok:
+            return (val, P("expert", "tensor", None))
+        return (val, P("expert", None, None))
+
+    psum_axes = ("expert", "tensor") if tensor_ok else ("expert",)
+    return (specs(w1, "col"), specs(w3, "col"), specs(w2, "row")), psum_axes
+
+
 def kv_pool_spec(mesh, n_kv_heads) -> P:
     """Blocked KV pool [L, NB, bs, Hkv, Dh]: shard the KV-head dim over
     'tensor' (reference sharding/attn.py shards KV heads per rank; MQA

@@ -196,6 +196,15 @@ class MOELayer(nn.Module):
             # non-local assignments, psum combines (the gather implied by
             # the replicated in_spec is over the expert axis only — batch
             # sharding on data/sequence stays automatic).
+            #
+            # Quantized (OptimizedLinear-style frozen-base) training:
+            # dropless_moe_ffn also accepts grouped-layout
+            # QuantizedWeight stacks and differentiates through them in
+            # x only (integer carriers get float0 cotangents, scales
+            # zeros). This flax path cannot hand them over itself —
+            # self.param unboxes AxisMetadata — so a frozen-base trainer
+            # passes the boxed stacks to dropless_moe_ffn directly, as
+            # the v2 runner does.
             from deepspeed_tpu.ops.grouped_gemm import dropless_moe_ffn
             from deepspeed_tpu.parallel import groups
             mesh = groups.get_mesh(required=False)

@@ -14,10 +14,92 @@ FFN over flat tokens; the capacity-based einsum dispatch in
 fixed shapes compose with GSPMD's expert-parallel all-to-all), while
 this grouped path serves inference and single-shard experts where
 dropless exactness matters.
+
+Every entry point also accepts grouped-layout ``QuantizedWeight``
+expert stacks (the reference's ``mixed_gemm`` next to ``moe_gemm``):
+on TPU the stacks feed the fused ``gmm_quant`` kernel, which
+dequantizes each expert slab tile-by-tile in VMEM; off TPU the
+identical-math fallbacks dequantize either the per-token GATHERED
+slabs (decode-scale batches) or inside a frozen-base custom_vjp around
+``lax.ragged_dot`` — in no fused path does a full-precision copy of an
+expert weight stack materialize in HBM. ``DS_FUSED_GMM=0`` restores
+dequantize-at-entry wholesale (the A/B baseline and escape hatch).
 """
+
+import functools
+import threading
 
 import jax
 import jax.numpy as jnp
+
+from deepspeed_tpu.utils.jax_compat import shard_map
+
+
+def fused_gmm_enabled():
+    """DS_FUSED_GMM tri-state kill switch for the fused quantized
+    grouped-GEMM paths: set wins in both directions (0 restores
+    dequantize-at-entry everywhere, 1 forces the boxed dispatch), unset
+    defaults to on."""
+    from deepspeed_tpu.utils.env_registry import env_opt_bool
+    v = env_opt_bool("DS_FUSED_GMM")
+    return True if v is None else v
+
+
+class GroupedGemmStats:
+    """Trace-time dispatch telemetry for the grouped GEMM.
+
+    Records which path each ``moe_grouped_mlp`` trace took
+    (pallas/gathered/ragged, quantized or dense) so bench lanes and the
+    parity suite can assert the path they think they measured is the
+    one that ran. Serving traces from gateway worker threads, so all
+    counter access takes the lock.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def count(self, path):
+        with self._lock:
+            self._counts[path] = self._counts.get(path, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+
+
+GMM_STATS = GroupedGemmStats()
+
+
+def _is_quantized(w):
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    return isinstance(w, QuantizedWeight)
+
+
+def _stack_dims(w):
+    """(K, N) of a stacked [E, K, N] expert weight — dense array or
+    grouped-layout QuantizedWeight (whose fp6 carriers pack N into 3/4
+    bytes). Shapes derive from the CARRIERS, never stored metadata:
+    per-layer slices of nn.scan-stacked leaves carry stale aux shapes."""
+    if _is_quantized(w):
+        n = w.values.shape[-1] * 4 // 3 if w.scheme == "fp6" else w.values.shape[-1]
+        return w.values.shape[-2], n
+    return w.shape[-2], w.shape[-1]
+
+
+def _cast_stack(w, dtype):
+    return w if _is_quantized(w) else w.astype(dtype)
+
+
+def _unbox_stack(w, dtype):
+    if not _is_quantized(w):
+        return w.astype(dtype)
+    from deepspeed_tpu.ops.pallas.fused_quant_matmul import dequantize_grouped
+    return dequantize_grouped(w.values, w.scales, w.scheme, dtype)
 
 
 def grouped_gemm(tokens, expert_weights, group_sizes, preferred_element_type=jnp.float32):
@@ -25,6 +107,58 @@ def grouped_gemm(tokens, expert_weights, group_sizes, preferred_element_type=jnp
     group_sizes: [E] with sum == T → [T, F]."""
     return jax.lax.ragged_dot(tokens, expert_weights, group_sizes.astype(jnp.int32),
                               preferred_element_type=preferred_element_type)
+
+
+def _ragged_qdot_impl(tokens, values, scales, group_sizes, scheme,
+                      dequant_dtype):
+    from deepspeed_tpu.ops.pallas.fused_quant_matmul import dequantize_grouped
+    w = dequantize_grouped(values, scales, scheme, dequant_dtype)
+    return jax.lax.ragged_dot(tokens, w, group_sizes.astype(jnp.int32),
+                              preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _ragged_qdot(tokens, values, scales, group_sizes, scheme, dequant_dtype):
+    """ragged_dot over grouped-layout carriers. The forward is literally
+    unbox-then-ragged_dot (same ops, same order — bit-identical to the
+    pre-fused path), wrapped so the backward keeps the quantized base
+    frozen: integer carriers get float0 cotangents and dx dequantizes a
+    backward-only transient against the transposed stack."""
+    return _ragged_qdot_impl(tokens, values, scales, group_sizes, scheme,
+                             dequant_dtype)
+
+
+def _ragged_qdot_fwd(tokens, values, scales, group_sizes, scheme,
+                     dequant_dtype):
+    y = _ragged_qdot_impl(tokens, values, scales, group_sizes, scheme,
+                          dequant_dtype)
+    # residuals must be JAX types: carry tokens' dtype as a 0-size array
+    return y, (values, scales, group_sizes, jnp.zeros((0,), tokens.dtype))
+
+
+def _ragged_qdot_bwd(scheme, dequant_dtype, res, dy):
+    values, scales, group_sizes, x_proto = res
+    from deepspeed_tpu.ops.pallas.fused_quant_matmul import (
+        _zero_carrier_cotangent, dequantize_grouped)
+    w = dequantize_grouped(values, scales, scheme, jnp.float32)
+    dx = jax.lax.ragged_dot(
+        dy.astype(jnp.float32), w.swapaxes(1, 2),
+        group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.float32).astype(x_proto.dtype)
+    return dx, _zero_carrier_cotangent(values), jnp.zeros_like(scales), None
+
+
+_ragged_qdot.defvjp(_ragged_qdot_fwd, _ragged_qdot_bwd)
+
+
+def grouped_gemm_any(tokens, w, group_sizes):
+    """:func:`grouped_gemm` over a dense [E, D, F] stack or a
+    grouped-layout ``QuantizedWeight`` stack (dequantized to
+    ``tokens.dtype``, matching what dequantize-at-entry produced)."""
+    if _is_quantized(w):
+        return _ragged_qdot(tokens, w.values, w.scales, group_sizes, w.scheme,
+                            jnp.dtype(tokens.dtype))
+    return grouped_gemm(tokens, w.astype(tokens.dtype), group_sizes)
 
 
 def sort_by_expert(x, expert_idx, num_experts):
@@ -46,7 +180,7 @@ _GMM_TILE_M = 256  # measured best on v5e at Mixtral training shapes:
 FORCE_INTERPRET = False
 
 
-def _use_pallas_gmm(num_rows, d_model, d_ff):
+def _use_pallas_gmm(num_rows, d_model, d_ff, quantized=False):
     """The Pallas grouped matmul wins on TPU at training batch sizes
     (~1.6x ragged_dot, 85% of bf16 peak on v5e); its per-group row-tile
     padding (up to E*tm rows) drowns tiny decode batches, where
@@ -57,7 +191,13 @@ def _use_pallas_gmm(num_rows, d_model, d_ff):
     128-wide lanes, and the gate/up GEMMs have N = d_ff while the down
     GEMM has N = d_model — a 128-aligned d_model with an unaligned d_ff
     (e.g. a debug preset with d_ff=344) would mosaic-fail inside the
-    kernel, so gate on both and let ragged_dot take those shapes."""
+    kernel, so gate on both and let ragged_dot take those shapes.
+
+    QUANTIZED stacks drop the row-count floor: ``gmm_quant`` is
+    bandwidth-bound on carrier bytes while every alternative first
+    materializes dequantized expert slabs, so the fused kernel wins on
+    TPU at any batch size (the caller shrinks the row tile at decode
+    scale instead of falling back)."""
     if FORCE_INTERPRET:
         return True
     try:
@@ -65,27 +205,99 @@ def _use_pallas_gmm(num_rows, d_model, d_ff):
             return False
     except Exception:
         return False
-    return (num_rows >= 8 * _GMM_TILE_M and d_model % 128 == 0
-            and d_ff % 128 == 0)
+    if d_model % 128 or d_ff % 128:
+        return False
+    return quantized or num_rows >= 8 * _GMM_TILE_M
+
+
+def _gathered_moe_mlp(x, expert_idx, w_gate, w_up, w_down, activation):
+    """Decode-scale dispatch (rows < experts): gather each row's expert
+    slab and contract per row. With quantized stacks the gather happens
+    on the CARRIERS, so only the T selected slabs are ever dequantized —
+    the non-Pallas analogue of the fused kernel's no-full-stack
+    contract. Gather and grouped dequant commute elementwise, so this
+    is bit-identical to dequantize-then-gather; and at tiny T the
+    weight traffic is T slabs instead of all E, which is where the
+    fused path's CPU/debug speedup comes from."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    def take(w):
+        if _is_quantized(w):
+            from deepspeed_tpu.ops.pallas.fused_quant_matmul import \
+                dequantize_grouped
+            return dequantize_grouped(jnp.take(w.values, expert_idx, axis=0),
+                                      jnp.take(w.scales, expert_idx, axis=0),
+                                      w.scheme, x.dtype)
+        return jnp.take(w, expert_idx, axis=0).astype(x.dtype)
+
+    gate = checkpoint_name(
+        jnp.einsum("td,tdf->tf", x, take(w_gate),
+                   preferred_element_type=jnp.float32).astype(x.dtype),
+        "moe_gate")
+    up = checkpoint_name(
+        jnp.einsum("td,tdf->tf", x, take(w_up),
+                   preferred_element_type=jnp.float32).astype(x.dtype),
+        "moe_up")
+    inter = activation(gate) * up
+    return jnp.einsum("tf,tfd->td", inter, take(w_down),
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _gmm_dispatch(xp, w, te, tm, interp):
+    """One grouped GEMM on the tile-aligned layout: dense stacks hit
+    :func:`gmm`, quantized stacks the fused :func:`gmm_quant` (dequant
+    target = the activation dtype, matching dequantize-at-entry)."""
+    from deepspeed_tpu.ops.pallas.grouped_matmul import gmm, gmm_quant
+    if _is_quantized(w):
+        return gmm_quant(xp, w.values, w.scales, te, w.scheme,
+                         jnp.dtype(xp.dtype), tm, 512, 256, interp)
+    return gmm(xp, w, te, tm, 512, 256, interp)
 
 
 def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation=jax.nn.silu):
     """Dropless top-1 MoE FFN: x [T, D]; expert_idx [T]; weights
     [E, D, F] / [E, D, F] / [E, F, D] → [T, D]. Every token reaches its
-    expert (no capacity drops — the grouped-GEMM advantage).
+    expert (no capacity drops — the grouped-GEMM advantage). Each
+    weight may be a dense stack or a grouped-layout ``QuantizedWeight``
+    stack (see module docstring).
 
     On TPU at training sizes the three GEMMs run in the Pallas grouped
     matmul (``ops/pallas/grouped_matmul.py``) over a tile-aligned padded
-    row layout; elsewhere ``lax.ragged_dot`` is the dispatch. The sorted
-    rows and gate/up activations carry ``checkpoint_name`` tags: under
-    the ``remat_policy="moe"`` training policy exactly these are saved,
+    row layout; elsewhere ``lax.ragged_dot`` is the dispatch, except at
+    decode scale (rows < experts) where the gathered per-row contraction
+    is both faster and — for quantized stacks — the path that never
+    dequantizes more than the selected slabs. The sorted rows and
+    gate/up activations carry ``checkpoint_name`` tags: under the
+    ``remat_policy="moe"`` training policy exactly these are saved,
     which is the full residual set the backward needs to skip re-running
     all three grouped GEMMs (``inter`` rebuilds elementwise from
     gate/up; the down GEMM's forward is dead code in the rebuild)."""
     from jax.ad_checkpoint import checkpoint_name
-    if _use_pallas_gmm(x.shape[0], x.shape[1], w_gate.shape[-1]):
-        from deepspeed_tpu.ops.pallas.grouped_matmul import gmm
-        tm = min(_GMM_TILE_M, max(8, x.shape[0] // 8)) if FORCE_INTERPRET else _GMM_TILE_M
+    quantized = any(_is_quantized(w) for w in (w_gate, w_up, w_down))
+    if quantized and not fused_gmm_enabled():
+        # DS_FUSED_GMM=0: restore dequantize-then-dispatch wholesale
+        w_gate, w_up, w_down = (_unbox_stack(w, x.dtype)
+                                for w in (w_gate, w_up, w_down))
+        quantized = False
+    d_ff = _stack_dims(w_gate)[1]
+    use_pallas = _use_pallas_gmm(x.shape[0], x.shape[1], d_ff,
+                                 quantized=quantized)
+    if use_pallas and quantized:
+        from deepspeed_tpu.ops.pallas.grouped_matmul import gmm_quant_supported
+        use_pallas = all(
+            not _is_quantized(w)
+            or gmm_quant_supported(w.values, w.scales, w.scheme)
+            for w in (w_gate, w_up, w_down))
+    if use_pallas:
+        GMM_STATS.count("pallas_quant" if quantized else "pallas")
+        if FORCE_INTERPRET:
+            tm = min(_GMM_TILE_M, max(8, x.shape[0] // 8))
+        elif quantized and x.shape[0] < 8 * _GMM_TILE_M:
+            # decode scale: ~one row tile per routed expert keeps the
+            # kernel bound on carrier bytes instead of pad compute
+            tm = max(16, -(-x.shape[0] // 8) * 8)
+        else:
+            tm = _GMM_TILE_M
         M = x.shape[0]
         E = num_experts
         # Rank-based routing — no argsort: each row's slot within its
@@ -112,18 +324,53 @@ def moe_grouped_mlp(x, expert_idx, w_gate, w_up, w_down, num_experts, activation
             x, unique_indices=True)
         xp = checkpoint_name(xp, "moe_xs")
         interp = FORCE_INTERPRET
-        gate = checkpoint_name(gmm(xp, w_gate, te, tm, 512, 256, interp), "moe_gate")
-        up = checkpoint_name(gmm(xp, w_up, te, tm, 512, 256, interp), "moe_up")
+        gate = checkpoint_name(_gmm_dispatch(xp, w_gate, te, tm, interp), "moe_gate")
+        up = checkpoint_name(_gmm_dispatch(xp, w_up, te, tm, interp), "moe_up")
         inter = activation(gate) * up
-        return jnp.take(gmm(inter, w_down, te, tm, 512, 256, interp), pdst,
+        return jnp.take(_gmm_dispatch(inter, w_down, te, tm, interp), pdst,
                         axis=0, unique_indices=True)
+    if x.shape[0] < num_experts:
+        GMM_STATS.count("gathered_quant" if quantized else "gathered")
+        return _gathered_moe_mlp(x, expert_idx, w_gate, w_up, w_down,
+                                 activation)
+    GMM_STATS.count("ragged_quant" if quantized else "ragged")
     xs, sizes, unsort = sort_by_expert(x, expert_idx, num_experts)
     xs = checkpoint_name(xs, "moe_xs")
-    gate = checkpoint_name(grouped_gemm(xs, w_gate, sizes).astype(x.dtype), "moe_gate")
-    up = checkpoint_name(grouped_gemm(xs, w_up, sizes).astype(x.dtype), "moe_up")
+    gate = checkpoint_name(grouped_gemm_any(xs, w_gate, sizes).astype(x.dtype), "moe_gate")
+    up = checkpoint_name(grouped_gemm_any(xs, w_up, sizes).astype(x.dtype), "moe_up")
     inter = activation(gate) * up
-    out = grouped_gemm(inter, w_down, sizes).astype(x.dtype)
+    out = grouped_gemm_any(inter, w_down, sizes).astype(x.dtype)
     return jnp.take(out, unsort, axis=0)
+
+
+def _split_stack(w):
+    """QuantizedWeight stack → its carrier leaves + a rebuild tag; dense
+    stack → a 1-tuple. shard_map broadcasts ONE PartitionSpec over every
+    pytree leaf of an operand, and carrier values/scales need different
+    specs — so stacks cross the shard_map boundary destructured."""
+    if _is_quantized(w):
+        return (w.values, w.scales), ("q", w.scheme, w.dequant_dtype)
+    return (w,), ("d",)
+
+
+def _join_stacks(flat, tags):
+    """Inverse of :func:`_split_stack` over the flattened operand list —
+    rebuilds each QuantizedWeight from its (now shard-local) carriers,
+    deriving the logical shape from the carrier shapes (the pre-split
+    aux shape would be wrong for an E/ep, feature-sharded slice)."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    out, i = [], 0
+    for tag in tags:
+        if tag[0] == "q":
+            v, s = flat[i], flat[i + 1]
+            i += 2
+            n = v.shape[-1] * 4 // 3 if tag[1] == "fp6" else v.shape[-1]
+            out.append(QuantizedWeight(v, s, v.shape[:-1] + (n,), tag[1],
+                                       layout="grouped", dequant_dtype=tag[2]))
+        else:
+            out.append(flat[i])
+            i += 1
+    return out
 
 
 def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None,
@@ -144,38 +391,50 @@ def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None,
     the gather implied by the replicated in_spec is over the expert
     axis only. Differentiable end-to-end (ragged_dot has grad rules;
     psum transposes), so the same dispatch trains Mixtral-style
-    dropless models."""
+    dropless models.
+
+    Expert weights may be grouped-layout ``QuantizedWeight`` stacks.
+    Under a mesh they cross the shard_map boundary DESTRUCTURED into
+    their carrier leaves (shard_map broadcasts one spec over every leaf
+    of an operand, and values/scales need different specs) with the
+    shard plan from ``inference/v2/sharding.moe_expert_specs``: E over
+    'expert' (E/ep carriers per replica), features over 'tensor' when
+    the carrier geometry allows, and the same psum combine either way."""
     T, k = topk_idx.shape
     idx_rep = topk_idx.reshape(-1)  # [T*k]
+    if not fused_gmm_enabled():
+        # DS_FUSED_GMM=0: unbox quantized stacks up front — everything
+        # below (including the shard plan) then sees dense stacks, which
+        # is exactly the pre-fused execution model.
+        w1, w3, w2 = (_unbox_stack(w, x.dtype) for w in (w1, w3, w2))
 
     if mesh is not None and mesh.size > 1:
-        from deepspeed_tpu.ops.pallas import spec_divides
         from jax.sharding import PartitionSpec as P
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         ep = sizes.get("expert", 1)
         if ep > 1 or sizes.get("tensor", 1) > 1:
             E = num_experts
-            col = P("expert", None, "tensor")
-            row = P("expert", "tensor", None)
-            psum_axes = ("expert", "tensor")
-            if not (spec_divides(mesh, col, w1.shape) and spec_divides(mesh, row, w2.shape)):
-                # features replicated over 'tensor': every tensor-shard
-                # computes the full output; summing over it would overcount
-                col = P("expert", None, None)
-                row = P("expert", None, None)
-                psum_axes = ("expert",)
+            from deepspeed_tpu.inference.v2.sharding import moe_expert_specs
+            w_specs, psum_axes = moe_expert_specs(mesh, w1, w3, w2)
             if E % ep == 0:
                 dtype = x.dtype
+                parts, tags, flat_specs = [], [], []
+                for w, sp in zip((w1, w3, w2), w_specs):
+                    ps, tag = _split_stack(w)
+                    parts.extend(ps)
+                    tags.append(tag)
+                    flat_specs.extend(sp)
 
-                def shard_body(x_full, idx, w1s, w3s, w2s):
+                def shard_body(x_full, idx, *wflat):
+                    w1s, w3s, w2s = _join_stacks(wflat, tags)
                     e_local = E // ep
                     off = jax.lax.axis_index("expert") * e_local
                     local = (idx >= off) & (idx < off + e_local)
                     lidx = jnp.where(local, idx - off, 0)
                     x_rep = jnp.repeat(x_full.astype(dtype), k, axis=0)
-                    out = moe_grouped_mlp(x_rep, lidx, w1s.astype(dtype),
-                                          w3s.astype(dtype),
-                                          w2s.astype(dtype),
+                    out = moe_grouped_mlp(x_rep, lidx, _cast_stack(w1s, dtype),
+                                          _cast_stack(w3s, dtype),
+                                          _cast_stack(w2s, dtype),
                                           num_experts=e_local)
                     out = jnp.where(local[:, None], out, 0)
                     # combine partial expert/feature sums in fp32 (also
@@ -195,16 +454,18 @@ def dropless_moe_ffn(x, topk_idx, topk_vals, w1, w3, w2, num_experts, mesh=None,
                 # Forward-only serving passes widen_boundary=False and
                 # keeps the bf16 (half-traffic) expert-axis gather.
                 x_in = x.astype(jnp.float32) if widen_boundary else x
-                out_rep = jax.shard_map(
-                    shard_body, mesh=mesh, in_specs=(P(), P(), col, col, row),
+                out_rep = shard_map(
+                    shard_body, mesh=mesh,
+                    in_specs=(P(), P(), *flat_specs),
                     out_specs=P(), axis_names={"expert", "tensor"},
-                    check_vma=False)(x_in, idx_rep, w1, w3, w2)
+                    check_vma=False)(x_in, idx_rep, *parts)
                 out_k = out_rep.reshape(T, k, -1)
                 return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
 
     x_rep = jnp.repeat(x, k, axis=0)  # [T*k, D]
-    out_rep = moe_grouped_mlp(x_rep, idx_rep, w1.astype(x.dtype), w3.astype(x.dtype),
-                              w2.astype(x.dtype), num_experts=num_experts)
+    out_rep = moe_grouped_mlp(x_rep, idx_rep, _cast_stack(w1, x.dtype),
+                              _cast_stack(w3, x.dtype), _cast_stack(w2, x.dtype),
+                              num_experts=num_experts)
     out_k = out_rep.reshape(T, k, -1)
     return jnp.einsum("tk,tkd->td", topk_vals.astype(x.dtype), out_k)
 

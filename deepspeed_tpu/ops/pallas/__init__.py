@@ -119,11 +119,13 @@ def shard_map_kernel(fn, mesh, in_specs, out_specs):
         finally:
             _local_kernel_ctx.reset(tok)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=False)
+    from deepspeed_tpu.utils.jax_compat import shard_map
+    return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
 
 
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402,F401
 from deepspeed_tpu.ops.pallas.fused_norms import fused_layer_norm, fused_rms_norm  # noqa: E402,F401
 from deepspeed_tpu.ops.pallas.fused_quant_matmul import dequantize_grouped, quant_matmul  # noqa: E402,F401
+from deepspeed_tpu.ops.pallas.grouped_matmul import gmm, gmm_quant  # noqa: E402,F401
 from deepspeed_tpu.ops.pallas.quantization import dequantize_int8, quantize_int8  # noqa: E402,F401

@@ -128,8 +128,15 @@ def _pick_tiles(M, K, N, g, scheme, x_dtype, v_dtype):
     # candidate bn = t*g with t | ng, preferring ~512 lanes; if a single
     # group is already wider than that, the tile is one group.
     ts = sorted([t for t in _divisors(ng) if t * g <= 512], reverse=True) or [1]
-    bks = sorted({_fit_tile(c, K) for c in (512, 256, 128, 64, 32, 16, 8)},
-                 reverse=True)
+    bks = set()
+    for c in (512, 256, 128, 64, 32, 16, 8):
+        try:
+            bks.add(_fit_tile(c, K))
+        except ValueError:
+            pass  # no ladder tile under this cap divides K
+    if not bks:
+        return None  # pathological K: jnp reference path
+    bks = sorted(bks, reverse=True)
 
     def vmem_bytes(bk, bn):
         xb = bm * bk * jnp.dtype(x_dtype).itemsize

@@ -20,6 +20,13 @@ step, which keeps the kernel compute-bound.
 The backward splits per operand: dx is the same kernel against
 ``w.swapaxes(1, 2)``; dw accumulates ``x_tileᵀ @ dy_tile`` into a
 revisited output block, initialized on each group's first row tile.
+
+:func:`gmm_quant` is the mixed-precision variant (the reference's
+``mixed_gemm`` next to ``moe_gemm``): the expert stack arrives as
+grouped-layout quantized carriers and each slab is dequantized in VMEM
+inside the K-loop, with the same scalar-prefetched ``tile_experts``
+steering both the carrier and the scale DMA — quantized MoE serving
+pays quantized HBM bandwidth, never a dequantized expert stack.
 """
 
 import functools
@@ -53,12 +60,25 @@ def _gmm_dw_kernel(te_ref, x_ref, dy_ref, o_ref):
 def _fit_tile(t, dim):
     """Largest divisor of ``dim`` that is ≤ t and a multiple of 128 (the
     lane width) when possible — tiles MUST divide the dim exactly or the
-    grid silently drops the remainder."""
+    grid silently drops the remainder.
+
+    When nothing on the search ladder (multiples of 128 below ``t``,
+    then multiples of 8 below 128) divides ``dim``, raise instead of
+    quietly shipping a degenerate tile: an 8-row (or worse, 1-row) tile
+    turns one matmul into hundreds of grid steps, and past callers only
+    discovered the cliff in profiles.
+    """
     t = min(t, dim)
+    start = t
     while dim % t:
         t -= 128 if t > 128 else 8
         if t <= 8:
-            return 8 if dim % 8 == 0 else 1
+            raise ValueError(
+                f"_fit_tile: no legal kernel tile for dim {dim}: nothing "
+                f"on the search ladder below {start} (multiples of 128, "
+                f"then of 8, down to the tile floor of 8) divides it. "
+                "Pad the dim to a multiple of 8 or dispatch this shape "
+                "to the non-Pallas fallback.")
     return t
 
 
@@ -158,6 +178,210 @@ def _gmm_bwd(tm, tn, tk, interpret, res, dy):
 
 
 gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# quantized-carrier variant: dequantize each expert slab in VMEM, in the
+# K-loop (the grouped analogue of ops/pallas/fused_quant_matmul.py)
+# ---------------------------------------------------------------------------
+
+def _fit_group_tile(t, dim, group):
+    """Largest multiple of ``group`` ≤ max(t, group) that divides
+    ``dim`` — quantized column tiles must cover whole scale groups so
+    the scale BlockSpec stays aligned with the carrier BlockSpec."""
+    ng = dim // group
+    best = group
+    for c in range(1, ng + 1):
+        if ng % c == 0 and c * group <= max(t, group):
+            best = c * group
+    return best
+
+
+def _gmm_quant_kernel(te_ref, x_ref, v_ref, s_ref, o_ref, acc_ref, *,
+                      scheme, group, n_k, dequant_dtype):
+    """One (row tile i, col tile j, K step) cell: the owning expert's
+    quantized weight tile streams in (``te_ref`` steered both the
+    carrier and the scale DMA), is decoded + scaled in registers, and
+    accumulates into the fp32 VMEM scratch — the full-precision expert
+    matrix never exists beyond one [tk, tn] tile."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    v = v_ref[0]
+    if scheme == "fp6":
+        from deepspeed_tpu.ops.fp_quantizer.quantize import _decode_e3m2
+        from deepspeed_tpu.ops.pallas.fused_quant_matmul import _unpack_fp6_tile
+        w = _decode_e3m2(_unpack_fp6_tile(v))
+    else:
+        w = v.astype(jnp.float32)
+    tk, tn = w.shape
+    s = s_ref[0]
+    w = (w.reshape(tk, tn // group, group) * s[:, :, None]).reshape(tk, tn)
+    ct = jnp.result_type(x_ref.dtype, dequant_dtype)
+    acc_ref[...] += jnp.dot(x_ref[...].astype(ct),
+                            w.astype(dequant_dtype).astype(ct),
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == n_k - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _gmm_quant_raw(x, values, scales, tile_experts, scheme, dequant_dtype,
+                   tm, tn, tk, interpret=False):
+    """x [Mp, K] (rows tile-aligned by group), grouped-layout carriers
+    ``values`` [E, K, N] (fp6: [E, K, N*3//4] packed uint8) and
+    ``scales`` [E, K, ng] → y [Mp, N] (x.dtype). K-innermost grid with
+    an fp32 VMEM accumulator per (row, col) tile."""
+    Mp, K = x.shape
+    ng = scales.shape[-1]
+    N = values.shape[-1] * 4 // 3 if scheme == "fp6" else values.shape[-1]
+    g = N // ng
+    tn = _fit_group_tile(tn, N, g)
+    tk = _fit_tile(tk, K)
+    vtn = tn * 3 // 4 if scheme == "fp6" else tn
+    n_k = K // tk
+    grid = (Mp // tm, N // tn, n_k)
+    return pl.pallas_call(
+        functools.partial(_gmm_quant_kernel, scheme=scheme, group=g, n_k=n_k,
+                          dequant_dtype=dequant_dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda i, j, k, te: (i, k)),
+                pl.BlockSpec((1, tk, vtn), lambda i, j, k, te: (te[i], k, j)),
+                pl.BlockSpec((1, tk, tn // g), lambda i, j, k, te: (te[i], k, j)),
+            ],
+            out_specs=pl.BlockSpec((tm, tn), lambda i, j, k, te: (i, j)),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), x.dtype),
+        interpret=interpret,
+    )(tile_experts, x, values, scales)
+
+
+def _gmm_quant_dx_kernel(te_ref, dy_ref, v_ref, s_ref, o_ref, acc_ref, *,
+                         scheme, group, n_n, dequant_dtype):
+    """Backward-input cell: decode the same carrier tile and contract on
+    its N axis (``dy_tile @ w_tileᵀ``) into a [tm, tk] accumulator — the
+    backward pass stays carrier-resident too (no transient dequantized
+    stack even for training)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    v = v_ref[0]
+    if scheme == "fp6":
+        from deepspeed_tpu.ops.fp_quantizer.quantize import _decode_e3m2
+        from deepspeed_tpu.ops.pallas.fused_quant_matmul import _unpack_fp6_tile
+        w = _decode_e3m2(_unpack_fp6_tile(v))
+    else:
+        w = v.astype(jnp.float32)
+    tk, tn = w.shape
+    s = s_ref[0]
+    w = (w.reshape(tk, tn // group, group) * s[:, :, None]).reshape(tk, tn)
+    ct = jnp.result_type(dy_ref.dtype, dequant_dtype)
+    acc_ref[...] += jax.lax.dot_general(
+        dy_ref[...].astype(ct), w.astype(dequant_dtype).astype(ct),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == n_n - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _gmm_quant_dx_raw(dy, values, scales, tile_experts, scheme, dequant_dtype,
+                      tm, tn, tk, interpret=False):
+    """dx [Mp, K] = dy [Mp, N] @ dequant(w)ᵀ, carriers streamed per
+    (row tile, K tile, N step) with the N sweep innermost."""
+    Mp, N = dy.shape
+    K = values.shape[-2]
+    ng = scales.shape[-1]
+    g = N // ng
+    tn = _fit_group_tile(tn, N, g)
+    tk = _fit_tile(tk, K)
+    vtn = tn * 3 // 4 if scheme == "fp6" else tn
+    n_n = N // tn
+    grid = (Mp // tm, K // tk, n_n)
+    return pl.pallas_call(
+        functools.partial(_gmm_quant_dx_kernel, scheme=scheme, group=g,
+                          n_n=n_n, dequant_dtype=dequant_dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tn), lambda i, j, n, te: (i, n)),
+                pl.BlockSpec((1, tk, vtn), lambda i, j, n, te: (te[i], j, n)),
+                pl.BlockSpec((1, tk, tn // g), lambda i, j, n, te: (te[i], j, n)),
+            ],
+            out_specs=pl.BlockSpec((tm, tk), lambda i, j, n, te: (i, j)),
+            scratch_shapes=[pltpu.VMEM((tm, tk), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((Mp, K), dy.dtype),
+        interpret=interpret,
+    )(tile_experts, dy, values, scales)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def gmm_quant(x, values, scales, tile_experts, scheme,
+              dequant_dtype=jnp.bfloat16, tm=256, tn=512, tk=256,
+              interpret=False):
+    """Grouped matmul over quantized expert carriers (fused dequant).
+
+    Same tile-aligned row layout as :func:`gmm`; the [E, K, N] expert
+    stack is consumed as grouped-layout carriers (``values`` int8/fp8,
+    or packed fp6 uint8 [E, K, N*3//4]; ``scales`` fp32 [E, K, ng]) and
+    each expert slab is dequantized one [tk, tn] tile at a time inside
+    the K-loop — the full-precision expert stack never materializes in
+    HBM, forward or backward. Differentiable in x only (frozen
+    quantized base, the ``OptimizedLinear`` training contract):
+    integer carriers get float0 cotangents.
+    """
+    return _gmm_quant_raw(x, values, scales, tile_experts, scheme,
+                          dequant_dtype, tm, tn, tk, interpret)
+
+
+def _gmm_quant_fwd(x, values, scales, tile_experts, scheme, dequant_dtype,
+                   tm, tn, tk, interpret):
+    y = _gmm_quant_raw(x, values, scales, tile_experts, scheme, dequant_dtype,
+                       tm, tn, tk, interpret)
+    # residuals must be JAX types: carry x's dtype as a 0-size array
+    return y, (values, scales, tile_experts, jnp.zeros((0,), x.dtype))
+
+
+def _gmm_quant_bwd(scheme, dequant_dtype, tm, tn, tk, interpret, res, dy):
+    values, scales, tile_experts, x_proto = res
+    from deepspeed_tpu.ops.pallas.fused_quant_matmul import \
+        _zero_carrier_cotangent
+    dx = _gmm_quant_dx_raw(dy.astype(x_proto.dtype), values, scales,
+                           tile_experts, scheme, dequant_dtype, tm, tn, tk,
+                           interpret)
+    return (dx, _zero_carrier_cotangent(values), jnp.zeros_like(scales), None)
+
+
+gmm_quant.defvjp(_gmm_quant_fwd, _gmm_quant_bwd)
+
+
+def gmm_quant_supported(values, scales, scheme):
+    """Static legality check for :func:`gmm_quant` carriers — callers
+    dispatch to the ragged/jnp fallback when False."""
+    if values.ndim != 3 or scales.ndim != 3:
+        return False
+    ng = scales.shape[-1]
+    N = values.shape[-1] * 4 // 3 if scheme == "fp6" else values.shape[-1]
+    if ng == 0 or N % ng:
+        return False
+    g = N // ng
+    if scheme == "fp6" and (g % 4 or values.shape[-1] * 4 != N * 3):
+        return False
+    try:
+        _fit_tile(256, values.shape[-2])
+    except ValueError:
+        return False
+    return True
 
 
 def tile_layout(sizes, num_rows, tm):

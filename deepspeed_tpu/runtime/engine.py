@@ -55,6 +55,7 @@ from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoade
 from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler, has_overflow, scaler_state, update_scale
 from deepspeed_tpu.runtime.zero.partitioning import ZeroShardingPolicy, batch_spec, path_tree_map
 from deepspeed_tpu.utils.env_registry import env_bool, env_int, env_raw
+from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER, FORWARD_GLOBAL_TIMER,
                                        FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER, STEP_MICRO_TIMER, TRAIN_BATCH_TIMER,
@@ -805,7 +806,7 @@ class DeepSpeedEngine:
 
         def core(params, scale, rng, args, kwargs, efb):
             params = self._hop_offloaded_to_device(params)
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 body, mesh=self.mesh,
                 in_specs=(param_in_specs, P(), P(),
                           jax.tree.map(batch_spec_of, args),
@@ -922,7 +923,7 @@ class DeepSpeedEngine:
 
         def core(params, scale, rng, args, kwargs):
             params = self._hop_offloaded_to_device(params)
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 body, mesh=self.mesh,
                 in_specs=(param_in_specs, P(), P(),
                           jax.tree.map(batch_spec_of, args),

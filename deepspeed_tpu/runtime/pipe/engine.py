@@ -43,6 +43,7 @@ from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _is_float
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.schedule import InferenceSchedule, TrainSchedule
 from deepspeed_tpu.runtime.zero.partitioning import batch_spec, path_tree_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.utils.timer import TRAIN_BATCH_TIMER
 
@@ -240,7 +241,7 @@ class PipelineEngine(DeepSpeedEngine):
             param_specs = path_tree_map(
                 lambda path, _: P("pipe") if (module.is_stacked and path.startswith("blocks/")) else P(),
                 self.master_params)
-            return jax.shard_map(inner, mesh=mesh,
+            return shard_map(inner, mesh=mesh,
                                  in_specs=(param_specs, P(), P(), P()),
                                  out_specs=P(), axis_names={"pipe"}, check_vma=False)
         return inner

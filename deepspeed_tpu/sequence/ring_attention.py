@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 NEG_INF = -jnp.inf
 
@@ -123,7 +124,7 @@ def ring_attention(q, k, v, causal=True, sm_scale=None, axis="sequence", mesh=No
     body = functools.partial(_ring_body, axis=axis, causal=causal, sm_scale=sm_scale)
     # fully-manual region (the repo's shard_map idiom): batch/heads are
     # simply partitioned; only the 'sequence' axis communicates (ppermute)
-    mapped = jax.shard_map(lambda a, b, c: body(a, b, c),
+    mapped = shard_map(lambda a, b, c: body(a, b, c),
                            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                            check_vma=False)
     return mapped(q, k, v)

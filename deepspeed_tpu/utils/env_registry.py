@@ -145,6 +145,12 @@ register("DS_FUSED_QMM", "bool", True,
          "Kill switch for the fused dequant-matmul Pallas kernels in "
          "quantized serving.",
          "deepspeed_tpu/inference/quantization/quantization.py")
+register("DS_FUSED_GMM", "optional_bool", None,
+         "Kill switch for the fused quantized grouped (MoE expert) "
+         "GEMM: 0 restores dequantize-at-entry for the whole MoE "
+         "subtree, 1 forces the boxed fused dispatch; set it wins in "
+         "both directions, unset defaults to on.",
+         "deepspeed_tpu/ops/grouped_gemm.py")
 register("DS_PREFIX_CACHE", "optional_bool", None,
          "Kill switch for the radix prefix cache; set it wins in both "
          "directions, unset defers to the engine config.",

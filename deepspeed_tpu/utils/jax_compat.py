@@ -1,0 +1,51 @@
+"""Version-compat shims for jax APIs the framework leans on.
+
+The framework targets the current jax surface (``jax.shard_map`` with
+``check_vma``/``axis_names``). Older jax releases (<= 0.4.x) only ship
+the op as ``jax.experimental.shard_map.shard_map`` with the previous
+spelling of the same knobs (``check_rep``; ``auto`` = the complement of
+``axis_names``). Every manual-region call site in the package routes
+through :func:`shard_map` below so the whole repo tracks exactly one
+translation of that rename instead of six.
+
+Keep this module tiny and jax-only: it is imported by the runtime
+engine, the pipeline engine, the Pallas dispatch layer, ring attention
+and the grouped-GEMM MoE path — all of which must not grow extra
+dependencies through it.
+"""
+
+import jax
+
+# Resolved once at import: the modern attribute raises AttributeError on
+# old jax (accelerated deprecation shim in jax._src.deprecations).
+_NATIVE = getattr(jax, "shard_map", None)
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=True):
+    """``jax.shard_map`` with the modern signature on any jax.
+
+    ``axis_names`` — mesh axes to manualize (None = all of them);
+    ``check_vma`` — replication/varying-mesh-axes checking, forwarded as
+    ``check_rep`` on old jax. Returns the mapped callable, exactly like
+    the native op.
+    """
+    if _NATIVE is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return _NATIVE(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _legacy
+    # Partial manualization (``auto`` = the complement of ``axis_names``)
+    # is unusable on old jax: eager dispatch raises NotImplementedError
+    # outright, and the jitted lowering leans on a PartitionId op the
+    # XLA:CPU SPMD partitioner rejects. Fall back to a fully-manual
+    # region instead: the left-out axes become manual with whatever the
+    # specs say (specs may only name manual axes, so they are simply
+    # replicated). That is numerically identical as long as the body
+    # performs no collectives over the auto axes — which partial specs
+    # could not have expressed either — at the cost of replicating the
+    # would-be-auto operands into the region.
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=bool(check_vma))

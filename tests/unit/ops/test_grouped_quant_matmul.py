@@ -1,0 +1,342 @@
+"""Quantized grouped (MoE expert) GEMM parity — ops/pallas/grouped_matmul.py
+``gmm_quant`` and the identical-math fallbacks in ops/grouped_gemm.py.
+
+The fused dispatch is the default quantized-MoE serving path, so its
+numerics are pinned against dequantize-then-``ragged_dot`` for every
+scheme, across expert counts and ragged group splits (empty groups
+included). The ragged/gathered fallbacks must be BIT-identical to
+dequantize-at-entry (same decode, same ops, same order); the Pallas
+kernel runs in interpret mode (tier-1 is CPU) against the same
+reference. A poison monkeypatch proves serving never falls back to
+whole-tree dequantization, and a TP+EP mesh case pins the sharded
+carrier plan.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu.ops.grouped_gemm as gg
+from deepspeed_tpu.inference.quantization.quantization import (QuantizedWeight,
+                                                               _quantize_grouped)
+from deepspeed_tpu.ops.grouped_gemm import (dropless_moe_ffn, grouped_gemm,
+                                            grouped_gemm_any, moe_grouped_mlp)
+from deepspeed_tpu.ops.pallas.grouped_matmul import _fit_tile, gmm_quant_supported
+
+SCHEMES = ("int8", "fp8", "fp6")
+
+
+def _qstack(rng, e, k, n, scheme, group, scale=0.1):
+    w = jnp.asarray(rng.randn(e, k, n).astype(np.float32) * scale)
+    qw = _quantize_grouped(w, scheme, group, dequant_dtype=jnp.float32)
+    assert isinstance(qw, QuantizedWeight), (scheme, e, k, n, group)
+    return qw
+
+
+def _idx_from_sizes(sizes):
+    """Expert index vector realizing exact per-expert group sizes."""
+    return jnp.asarray(np.repeat(np.arange(len(sizes)), sizes), jnp.int32)
+
+
+class TestRaggedParity:
+    """grouped_gemm_any over carriers vs dequantize-then-ragged_dot must
+    be BIT-identical: the quantized forward is literally the same decode
+    feeding the same ragged_dot."""
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    @pytest.mark.parametrize("sizes", [
+        (5, 3, 8),          # plain ragged
+        (0, 9, 0, 7),       # empty experts interleaved
+        (16,),              # single expert
+        (1, 1, 1, 1, 1, 1, 1, 1),  # all-singleton groups
+    ])
+    def test_bit_identical_to_dequant_then_ragged(self, scheme, sizes):
+        rng = np.random.RandomState(hash((scheme, sizes)) % 2**31)
+        E, D, F = len(sizes), 48, 64
+        qw = _qstack(rng, E, D, F, scheme, 16)
+        x = jnp.asarray(rng.randn(int(sum(sizes)), D).astype(np.float32))
+        gs = jnp.asarray(sizes, jnp.int32)
+        ref = grouped_gemm(x, qw.dequantized(jnp.float32), gs)
+        got = grouped_gemm_any(x, qw, gs)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    @pytest.mark.parametrize("num_experts,t", [(4, 32), (7, 21), (16, 64)])
+    def test_moe_mlp_bit_identical(self, scheme, num_experts, t):
+        """Full quantized MoE FFN (ragged path, T >= E) vs the same MLP
+        over dequantize-at-entry stacks."""
+        rng = np.random.RandomState(hash((scheme, num_experts, t)) % 2**31)
+        D, F = 32, 48
+        wg = _qstack(rng, num_experts, D, F, scheme, 16)
+        wu = _qstack(rng, num_experts, D, F, scheme, 16)
+        wd = _qstack(rng, num_experts, F, D, scheme, 16)
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        idx = jnp.asarray(rng.randint(0, num_experts, (t,)), jnp.int32)
+        ref = moe_grouped_mlp(x, idx, wg.dequantized(jnp.float32),
+                              wu.dequantized(jnp.float32),
+                              wd.dequantized(jnp.float32), num_experts)
+        got = moe_grouped_mlp(x, idx, wg, wu, wd, num_experts)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_gathered_decode_path_bit_identical(self, scheme):
+        """rows < experts routes to the gathered contraction; gather
+        commutes with elementwise dequant, so still bitwise equal."""
+        rng = np.random.RandomState(hash(scheme) % 2**31)
+        E, D, F, t = 16, 32, 48, 3
+        wg = _qstack(rng, E, D, F, scheme, 16)
+        wu = _qstack(rng, E, D, F, scheme, 16)
+        wd = _qstack(rng, E, F, D, scheme, 16)
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        idx = jnp.asarray(rng.randint(0, E, (t,)), jnp.int32)
+        gg.GMM_STATS.reset()
+        ref = moe_grouped_mlp(x, idx, wg.dequantized(jnp.float32),
+                              wu.dequantized(jnp.float32),
+                              wd.dequantized(jnp.float32), E)
+        got = moe_grouped_mlp(x, idx, wg, wu, wd, E)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        snap = gg.GMM_STATS.snapshot()
+        assert snap.get("gathered_quant") and snap.get("gathered")
+
+
+class TestPallasInterpret:
+    """The fused ``gmm_quant`` kernel (interpret mode on CPU) against the
+    ragged dequant reference — fp32 dequant target, so the only
+    difference is dot accumulation order."""
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_kernel_matches_ragged(self, scheme):
+        rng = np.random.RandomState(hash(("pallas", scheme)) % 2**31)
+        E, D, F, t = 4, 128, 128, 64
+        wg = _qstack(rng, E, D, F, scheme, 32)
+        wu = _qstack(rng, E, D, F, scheme, 32)
+        wd = _qstack(rng, E, F, D, scheme, 32)
+        assert gmm_quant_supported(wg.values, wg.scales, scheme)
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        idx = jnp.asarray(rng.randint(0, E, (t,)), jnp.int32)
+        ref = moe_grouped_mlp(x, idx, wg.dequantized(jnp.float32),
+                              wu.dequantized(jnp.float32),
+                              wd.dequantized(jnp.float32), E)
+        gg.GMM_STATS.reset()
+        gg.FORCE_INTERPRET = True
+        try:
+            got = moe_grouped_mlp(x, idx, wg, wu, wd, E)
+        finally:
+            gg.FORCE_INTERPRET = False
+        assert gg.GMM_STATS.snapshot().get("pallas_quant")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_kernel_empty_expert_and_grad(self):
+        rng = np.random.RandomState(41)
+        E, D, F = 4, 128, 128
+        wg = _qstack(rng, E, D, F, "int8", 32)
+        wu = _qstack(rng, E, D, F, "int8", 32)
+        wd = _qstack(rng, E, F, D, "int8", 32)
+        t = 48
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        idx = jnp.asarray(rng.randint(0, E - 1, (t,)), jnp.int32)  # expert 3 empty
+
+        def loss(x, w1, w3, w2):
+            return (moe_grouped_mlp(x, idx, w1, w3, w2, E) ** 2).sum()
+
+        ref = jax.grad(loss)(x, wg.dequantized(jnp.float32),
+                             wu.dequantized(jnp.float32),
+                             wd.dequantized(jnp.float32))
+        gg.FORCE_INTERPRET = True
+        try:
+            got = jax.grad(loss)(x, wg, wu, wd)
+        finally:
+            gg.FORCE_INTERPRET = False
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFrozenBaseGrad:
+    """The quantized base is frozen: dx flows, carriers get float0/zero
+    cotangents (the OptimizedLinear training contract)."""
+
+    def test_ragged_dx_matches_dense_reference(self):
+        rng = np.random.RandomState(43)
+        E, D, F, t = 4, 32, 48, 24
+        qw = _qstack(rng, E, D, F, "int8", 16)
+        gs = jnp.asarray([8, 0, 10, 6], jnp.int32)
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+
+        def loss_q(x):
+            return (grouped_gemm_any(x, qw, gs) ** 2).sum()
+
+        def loss_d(x):
+            return (grouped_gemm(x, qw.dequantized(jnp.float32), gs) ** 2).sum()
+
+        np.testing.assert_allclose(np.asarray(jax.grad(loss_q)(x)),
+                                   np.asarray(jax.grad(loss_d)(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_carriers_receive_no_cotangent(self):
+        rng = np.random.RandomState(47)
+        qw = _qstack(rng, 2, 16, 32, "fp8", 16)
+        gs = jnp.asarray([3, 5], jnp.int32)
+        x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+
+        def loss(values, scales):
+            return (gg._ragged_qdot(x, values, scales, gs, "fp8",
+                                    jnp.dtype(jnp.float32)) ** 2).sum()
+
+        dv, ds = jax.grad(loss, argnums=(0, 1), allow_int=True)(qw.values,
+                                                                qw.scales)
+        # fp8 carriers: zeros of the carrier dtype; scales: exact zeros
+        assert not np.asarray(ds).any()
+        assert not np.asarray(dv, np.float32).any()
+
+
+class TestFitTileRaises:
+    """_fit_tile must fail loudly (naming dim and floor) instead of
+    silently degrading to unusable 8-row tiles."""
+
+    def test_undivisible_dim_raises(self):
+        with pytest.raises(ValueError, match="1042"):
+            _fit_tile(256, 1042)
+
+    def test_aligned_dims_still_resolve(self):
+        assert _fit_tile(256, 1024) == 256
+        assert _fit_tile(256, 8) == 8
+        assert _fit_tile(8, 1048) == 8  # 1048 % 8 == 0: floor tile is legal
+
+
+class TestKillSwitch:
+    """DS_FUSED_GMM=0 restores dequantize-at-entry; outputs stay
+    bit-identical either way (the A/B contract the bench lane relies
+    on)."""
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_off_matches_on_bitwise(self, scheme, monkeypatch):
+        rng = np.random.RandomState(hash(("ks", scheme)) % 2**31)
+        E, D, F, t = 4, 32, 48, 20
+        wg = _qstack(rng, E, D, F, scheme, 16)
+        wu = _qstack(rng, E, D, F, scheme, 16)
+        wd = _qstack(rng, E, F, D, scheme, 16)
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        idx = jnp.asarray(rng.randint(0, E, (t, 2)), jnp.int32)
+        vals = jnp.full((t, 2), 0.5, jnp.float32)
+        on = dropless_moe_ffn(x, idx, vals, wg, wu, wd, E)
+        monkeypatch.setenv("DS_FUSED_GMM", "0")
+        assert not gg.fused_gmm_enabled()
+        off = dropless_moe_ffn(x, idx, vals, wg, wu, wd, E)
+        np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+
+
+class TestUnboxNeverCalled:
+    """Serving must keep the MoE subtree boxed: whole-tree
+    dequantization is poisoned and the fused path must not trip it."""
+
+    def _poison(self, monkeypatch):
+        def boom(tree, dtype=jnp.bfloat16):
+            raise AssertionError("dequantize_tree called on the fused MoE path")
+        import deepspeed_tpu.inference.quantization as qpkg
+        import deepspeed_tpu.inference.quantization.quantization as qmod
+        monkeypatch.setattr(qmod, "dequantize_tree", boom)
+        if hasattr(qpkg, "dequantize_tree"):
+            monkeypatch.setattr(qpkg, "dequantize_tree", boom)
+
+    def test_v2_moe_mlp_never_unboxes_tree(self, monkeypatch):
+        from deepspeed_tpu.inference.v2.model_runner import _moe_mlp
+        rng = np.random.RandomState(53)
+        E, D, F, t, k = 4, 32, 48, 10, 2
+        p = {
+            "gate": {"wg": {"kernel": _quantize_grouped(
+                jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.1),
+                "int8", 16, dequant_dtype=jnp.float32)}},
+            "experts_w1": _qstack(rng, E, D, F, "int8", 16),
+            "experts_w3": _qstack(rng, E, D, F, "int8", 16),
+            "experts_w2": _qstack(rng, E, F, D, "int8", 16),
+        }
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        from deepspeed_tpu.inference.quantization.quantization import dequantize_tree
+        ref = _moe_mlp(x, dequantize_tree(p, jnp.float32), k)
+        self._poison(monkeypatch)
+        got = _moe_mlp(x, p, k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_kill_switch_still_unboxes(self, monkeypatch):
+        """DS_FUSED_GMM=0 must take the poisoned entry path — proves the
+        poison actually guards the branch the fused path skips."""
+        from deepspeed_tpu.inference.v2.model_runner import _moe_mlp
+        rng = np.random.RandomState(59)
+        E, D = 4, 32
+        p = {
+            "gate": {"wg": {"kernel": jnp.asarray(
+                rng.randn(D, E).astype(np.float32))}},
+            "experts_w1": _qstack(rng, E, D, 48, "int8", 16),
+            "experts_w3": _qstack(rng, E, D, 48, "int8", 16),
+            "experts_w2": _qstack(rng, E, 48, D, "int8", 16),
+        }
+        x = jnp.asarray(rng.randn(6, D).astype(np.float32))
+        self._poison(monkeypatch)
+        monkeypatch.setenv("DS_FUSED_GMM", "0")
+        with pytest.raises(AssertionError, match="dequantize_tree"):
+            _moe_mlp(x, p, 2)
+
+
+class TestShardedCarriers:
+    """TP+EP mesh: stacked carriers cross the shard_map boundary
+    destructured, E/ep per expert shard, psum combine — against the
+    single-shard dense reference."""
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_tp_ep_matches_single_shard(self, scheme):
+        from deepspeed_tpu.parallel import groups
+        from deepspeed_tpu.parallel.topology import make_mesh_topology
+        rng = np.random.RandomState(hash(("tp_ep", scheme)) % 2**31)
+        E, D, F, t, k = 4, 64, 128, 16, 2
+        wg = _qstack(rng, E, D, F, scheme, 32)
+        wu = _qstack(rng, E, D, F, scheme, 32)
+        wd = _qstack(rng, E, F, D, scheme, 32)
+        x = jnp.asarray(rng.randn(t, D).astype(np.float32))
+        idx = jnp.asarray(rng.randint(0, E, (t, k)), jnp.int32)
+        vals = jnp.asarray(rng.rand(t, k).astype(np.float32))
+        vals = vals / vals.sum(-1, keepdims=True)
+        ref = dropless_moe_ffn(x, idx, vals, wg.dequantized(jnp.float32),
+                               wu.dequantized(jnp.float32),
+                               wd.dequantized(jnp.float32), E)
+        mesh = make_mesh_topology(expert=2, tensor=2, data=1,
+                                  devices=jax.devices()[:4])
+        groups.set_mesh(mesh)
+        try:
+            got = dropless_moe_ffn(x, idx, vals, wg, wu, wd, E, mesh=mesh)
+            dense = dropless_moe_ffn(x, idx, vals,
+                                     wg.dequantized(jnp.float32),
+                                     wu.dequantized(jnp.float32),
+                                     wd.dequantized(jnp.float32), E,
+                                     mesh=mesh)
+        finally:
+            groups.destroy_mesh()
+        # quantized-sharded vs dense-sharded: identical math modulo the
+        # fp32 psum; both sit within reduction-order noise of the
+        # single-shard reference
+        np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_expert_only_psum_when_tensor_unshardable(self):
+        """F not divisible by tp → the plan degrades to expert-only
+        sharding (replicated features) and still matches."""
+        from deepspeed_tpu.inference.v2.sharding import moe_expert_specs
+        from deepspeed_tpu.parallel.topology import make_mesh_topology
+        rng = np.random.RandomState(61)
+        E, D = 4, 64
+        mesh = make_mesh_topology(expert=2, tensor=2, data=1,
+                                  devices=jax.devices()[:4])
+        # ng = 60/12 = 5 groups: neither divisible by tp=2 nor a single
+        # group, so the column scales cannot follow a tensor split
+        wg = _qstack(rng, E, D, 60, "int8", 12)
+        wu = _qstack(rng, E, D, 60, "int8", 12)
+        wd = _qstack(rng, E, 60, D, "int8", 12)
+        specs, psum_axes = moe_expert_specs(mesh, wg, wu, wd)
+        assert psum_axes == ("expert",)
+        for w_specs in specs:
+            for sp in w_specs:
+                assert "tensor" not in jax.tree.leaves(tuple(sp))

@@ -269,12 +269,13 @@ class TestThreadSharedState:
         from deepspeed_tpu.serving.fleet.router import FleetRouter  # noqa: F401
         from deepspeed_tpu.serving.gateway import ServingGateway  # noqa: F401
         from deepspeed_tpu.serving.metrics import ServingMetrics  # noqa: F401
+        from deepspeed_tpu.ops.grouped_gemm import GroupedGemmStats  # noqa: F401
         from tools.graft_lint.linter import THREAD_SHARED_REGISTRY
         for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
                     ServingMetrics, BlockedAllocator, PrefixCacheManager,
                     FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica,
                     PreemptionGuard, HeartbeatWriter, SpecDecodeState,
-                    TierManager, HostKVStore):
+                    TierManager, HostKVStore, GroupedGemmStats):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

@@ -106,6 +106,9 @@ THREAD_SHARED_REGISTRY = {
     # with any in-process watchdog probes
     "PreemptionGuard": {"_requested", "_requested_at"},
     "HeartbeatWriter": {"_last_step", "_last_beat_t"},
+    # grouped GEMM dispatch telemetry: serving traces from gateway pump
+    # threads while bench/test readers snapshot from the main thread
+    "GroupedGemmStats": {"_counts"},
 }
 
 _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
@@ -113,8 +116,10 @@ _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
              "difference_update", "appendleft"}
 
 # spec-consistency dtype-leak scope (fp32 Python constants materialized
-# as arrays in bf16 arithmetic): kernel and model code only.
-_DTYPE_DIRS = ("ops/pallas/", "models/")
+# as arrays in bf16 arithmetic): kernel and model code only (plus the
+# grouped-GEMM dispatch, which sits one level up from ops/pallas but
+# builds the kernel's padded layouts in the activation dtype).
+_DTYPE_DIRS = ("ops/pallas/", "models/", "ops/grouped_gemm")
 _JNP_CTORS = {"jnp.array": 2, "jnp.asarray": 2, "jnp.ones": 2,
               "jnp.zeros": 2, "jnp.full": 3}  # value -> positional arity
 #  with dtype
