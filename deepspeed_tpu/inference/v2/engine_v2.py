@@ -760,6 +760,24 @@ class InferenceEngineV2:
             self.kv_tier.prefetch([int(t) for t in
                                    np.atleast_1d(np.asarray(prompt_tokens))])
 
+    def export_prefix(self, prompt_tokens, max_blocks=None):
+        """Serialize this prompt's cached KV chain into a process-
+        portable handoff record (disaggregated prefill→decode serving).
+        PUMP-THREAD ONLY — the export gathers from the donated pool.
+        None when no spill tier is attached or nothing is cached."""
+        if self.kv_tier is None:
+            return None
+        prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
+        return self.kv_tier.export_chain(prompt, max_blocks=max_blocks)
+
+    def import_prefix(self, record):
+        """Adopt a peer replica's exported KV chain into the local spill
+        tier (validated; raises KVTierCorruptionError on a forged/torn
+        record). Safe from any thread. → blocks adopted (0 tierless)."""
+        if self.kv_tier is None or record is None:
+            return 0
+        return self.kv_tier.import_chain(record)
+
     def prefix_match_len(self, prompt_tokens):
         """Read-only twin of :meth:`prefix_match` for placement probes:
         → leading tokens of ``prompt_tokens`` whose KV is cached, WITHOUT

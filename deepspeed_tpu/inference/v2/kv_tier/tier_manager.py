@@ -75,6 +75,9 @@ class TierManager:
         self.prefetch_timeouts = 0
         self.prefetch_errors = 0
         self.quant_error_max = 0.0
+        self.exported_blocks = 0
+        self.imported_blocks = 0
+        self.import_rejects = 0
         self._lock = threading.RLock()
 
     # ------------------------------------------------------------- demotion
@@ -155,6 +158,75 @@ class TierManager:
     def note_promoted(self, n_blocks):
         with self._lock:
             self.promoted_blocks += int(n_blocks)
+
+    # ----------------------------------------------------- handoff (export)
+    def export_chain(self, prompt_tokens, max_blocks=None):
+        """Build a process-portable handoff record for this prompt's
+        cached prefix: the trie chain is gathered to host block by block
+        and serialized with its chained-key identities, which are
+        replica-independent (``_chunk_key`` hashes int tuples, immune to
+        PYTHONHASHSEED), so a peer replica's ``import_chain`` re-derives
+        and verifies the exact same keys. PUMP-THREAD ONLY — the gather
+        reads the pool, and the jitted steps donate the pool arrays.
+        Returns None when nothing block-aligned is cached."""
+        prompt = [int(t) for t in prompt_tokens]
+        bs = self.block_size
+        mgr = self.manager
+        with mgr._lock:
+            limit = (len(prompt) - 1) // bs if max_blocks is None \
+                else min(max_blocks, (len(prompt) - 1) // bs)
+            path = mgr.index.match(prompt, limit)
+            if not path:
+                return None
+            root_key = mgr.index.root.key
+            idents = [(node.parent.key, node.tokens, node.key)
+                      for node in path]
+            handle = self.kv_cache.gather([node.block_id for node in path])
+        if self.quantize:
+            handle = quantize_handle(handle, self.quant_group_size)
+        entries = []
+        for i, (parent_key, tokens, key) in enumerate(idents):
+            one = slice_handle(handle, i, i + 1)
+            host = {name: np.asarray(one[name]) for name in
+                    ("k", "v", "k_scales", "v_scales") if name in one}
+            if one.get("quantized"):
+                host["quantized"] = True
+            err = float(one["quant_error"][0]) if self.quantize else None
+            entries.append({"key": key, "parent_key": parent_key,
+                            "tokens": tuple(tokens), "handle": host,
+                            "nbytes": handle_nbytes(host),
+                            "quant_error": err})
+        with self._lock:
+            self.exported_blocks += len(entries)
+        return {"version": 1, "block_size": bs, "root_key": root_key,
+                "quantized": self.quantize, "entries": entries}
+
+    def import_chain(self, record):
+        """Adopt a peer replica's exported chain into the local tier-2
+        store; a later acquire (or prefetch) promotes it into the pool,
+        so prefill is skipped past the imported span. Thread-safe (store
+        lock only; never touches the pool). The record crossed a process
+        boundary, so it is ALWAYS validated — chained-key re-derivation,
+        chain continuity, field presence — before any entry is adopted;
+        a forged/torn record raises :class:`KVTierCorruptionError` and
+        adopts nothing. Returns the number of blocks adopted."""
+        from deepspeed_tpu.utils.sanitize import check_handoff_record
+        try:
+            check_handoff_record(record, block_size=self.block_size,
+                                 root_key=self.manager.index.root.key)
+        except Exception:
+            with self._lock:
+                self.import_rejects += 1
+            raise
+        n = 0
+        for entry in record["entries"]:
+            if self.store.put(entry["parent_key"], tuple(entry["tokens"]),
+                              entry["handle"], entry["nbytes"],
+                              quant_error=entry.get("quant_error")):
+                n += 1
+        with self._lock:
+            self.imported_blocks += n
+        return n
 
     # ------------------------------------------------------------- prefetch
     def prefetch(self, prompt_tokens):
@@ -298,6 +370,9 @@ class TierManager:
                 if waits else 0.0,
                 "prefetch_timeouts": self.prefetch_timeouts,
                 "prefetch_errors": self.prefetch_errors,
+                "exported_blocks": self.exported_blocks,
+                "imported_blocks": self.imported_blocks,
+                "import_rejects": self.import_rejects,
                 "quantized": int(self.quantize),
                 "quant_error_max": self.quant_error_max,
             })

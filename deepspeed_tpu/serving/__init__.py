@@ -15,8 +15,9 @@ from deepspeed_tpu.serving.admission import (AdmissionQueue, CapacityGate,
 from deepspeed_tpu.serving.config import ServingConfig, get_serving_config
 from deepspeed_tpu.serving.fleet import (FaultyReplica, FleetConfig,
                                          FleetRouter, GatewayReplica,
-                                         Replica, ReplicaHealth,
-                                         get_fleet_config)
+                                         HandoffFailedError, HandoffManager,
+                                         PoolScheduler, Replica,
+                                         ReplicaHealth, get_fleet_config)
 from deepspeed_tpu.serving.gateway import RequestHandle, ServingGateway
 from deepspeed_tpu.serving.metrics import ServingMetrics
 
@@ -28,4 +29,5 @@ __all__ = [
     "DeadlineExceededError",
     "FleetRouter", "FleetConfig", "get_fleet_config", "Replica",
     "GatewayReplica", "FaultyReplica", "ReplicaHealth",
+    "PoolScheduler", "HandoffManager", "HandoffFailedError",
 ]

@@ -108,7 +108,12 @@ class CapacityGate:
     pool and crash the pump mid-step.
     """
 
-    def __init__(self, engine, token_budget):
+    def __init__(self, engine, token_budget, pool="unified"):
+        # which fleet pool this gate protects ("unified" | "prefill" |
+        # "decode") — stamped into every rejection's details so the
+        # router can steer (a saturated prefill pool means degrade or
+        # re-pool, NOT retry the same gate)
+        self.pool = str(pool)
         self.block_size = int(engine.block_size)
         # evictable prefix-cache blocks are RECLAIMABLE capacity: the
         # allocator takes them back (LRU) on demand, so a warm cache must
@@ -138,14 +143,16 @@ class CapacityGate:
                 f"{total} tokens exceeds the engine context window "
                 f"({self.max_ctx_tokens}); shorten the prompt or lower "
                 f"max_new_tokens",
-                total_tokens=total, max_ctx_tokens=self.max_ctx_tokens)
+                total_tokens=total, max_ctx_tokens=self.max_ctx_tokens,
+                pool=self.pool)
         need = self.footprint(prompt_len, max_new_tokens)
         if need > self.usable_blocks:
             raise RequestTooLargeError(
                 f"request needs {need} KV blocks ({total} tokens at block size "
                 f"{self.block_size}) but the pool only has {self.usable_blocks} "
                 f"— raise num_kv_blocks or shrink the request",
-                needed_blocks=need, usable_blocks=self.usable_blocks)
+                needed_blocks=need, usable_blocks=self.usable_blocks,
+                pool=self.pool)
 
     def try_commit(self, prompt_len, max_new_tokens):
         """Reserve the request's footprint; False when it doesn't fit

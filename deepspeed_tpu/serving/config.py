@@ -33,6 +33,12 @@ class ServingConfig(DeepSpeedConfigModel):
       ``block_timeout_s``, then raise :class:`QueueFullError`.
     """
 
+    # -- pool role (disaggregated serving) ---------------------------
+    # "unified" serves prefill+decode; "prefill" gateways export a KV
+    # handoff record when a request finishes; "decode" gateways import
+    # peer records before admission. The fleet router sets this.
+    role: str = "unified"
+
     # -- admission / backpressure ------------------------------------
     max_queue_depth: int = Field(256, ge=1)
     admission_policy: str = "reject"
@@ -61,6 +67,10 @@ class ServingConfig(DeepSpeedConfigModel):
 
     @model_validator(mode="after")
     def _check(self):
+        if self.role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"serving.role={self.role!r}: must be one of "
+                f"('unified', 'prefill', 'decode')")
         if self.admission_policy not in ADMISSION_POLICIES:
             raise ValueError(
                 f"serving.admission_policy={self.admission_policy!r}: must be one "

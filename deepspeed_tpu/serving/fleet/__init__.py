@@ -9,12 +9,18 @@ deployment-layer analogue above :class:`ServingGateway`).
   deadline-budgeted failover retries that replay mid-stream crashes on a
   surviving replica without double-emitting tokens, and rolling restart.
 - :class:`FaultyReplica` — deterministic scripted fault injection
-  (crash-at-token-k, hang, slow decode, reject bursts) so every failure
-  path above is tested.
+  (crash-at-token-k, hang, slow decode, reject bursts, dropped/torn/
+  delayed KV handoffs) so every failure path above is tested.
+- :class:`PoolScheduler` / :class:`HandoffManager` — disaggregated
+  prefill/decode pool policy (hysteresis-gated unified fallback) and the
+  deadline-bounded prefill→decode KV handoff ledger.
 
 See ``docs/MIGRATING.md`` ("Multi-replica serving fleet")."""
 
 from deepspeed_tpu.serving.fleet.config import FleetConfig, get_fleet_config
+from deepspeed_tpu.serving.fleet.handoff import (HandoffFailedError,
+                                                 HandoffManager,
+                                                 PoolScheduler)
 from deepspeed_tpu.serving.fleet.health import (DEGRADED, DOWN, HEALTHY,
                                                 RESTARTING, ReplicaHealth)
 from deepspeed_tpu.serving.fleet.replica import (FaultyReplica,
@@ -33,4 +39,5 @@ __all__ = [
     "HEALTHY", "DEGRADED", "DOWN", "RESTARTING",
     "ReplicaDiedError", "ReplicaRestartingError", "StreamStalledError",
     "NoReplicaAvailableError", "FleetFailedError", "ReplayDivergenceError",
+    "PoolScheduler", "HandoffManager", "HandoffFailedError",
 ]

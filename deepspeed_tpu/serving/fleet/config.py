@@ -5,9 +5,13 @@ field-level constraints plus cross-field checks that refuse loudly at
 construction.
 """
 
+from typing import Dict
+
 from pydantic import Field, model_validator
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+_ROLES = ("prefill", "decode", "unified")
 
 
 def get_fleet_config(param_dict):
@@ -58,6 +62,26 @@ class FleetConfig(DeepSpeedConfigModel):
     # -- rolling restart ---------------------------------------------
     restart_drain_timeout_s: float = Field(120.0, gt=0)
 
+    # -- disaggregated prefill/decode serving ------------------------
+    # also gated by DS_DISAGG (tri-state env override, wins both ways)
+    disagg: bool = False
+    # replica name -> pool role; replicas not listed here fall back to
+    # the replica object's own ``role`` attribute ("unified" default)
+    roles: Dict[str, str] = {}
+    # tokens the prefill stage emits before handing off (>=1 so first-
+    # token logits exist and the decode stage has a prefix to verify)
+    prefill_max_tokens: int = Field(1, ge=1)
+    # a published handoff the decode stage cannot claim within this
+    # budget is expired and the request re-planned (DS_DISAGG_HANDOFF_
+    # DEADLINE_S overrides when > 0)
+    handoff_deadline_s: float = Field(5.0, gt=0)
+    # hysteresis: consecutive disagg failures before degrading to
+    # unified mode / consecutive probe successes before recovering /
+    # probe cadence while degraded
+    disagg_fallback_after: int = Field(2, ge=1)
+    disagg_recover_after: int = Field(2, ge=1)
+    disagg_probe_every: int = Field(4, ge=1)
+
     # -- request defaults (resolved at the ROUTER so every failover
     #    attempt replays with identical parameters even across replicas
     #    with different ServingConfig defaults) -----------------------
@@ -75,4 +99,9 @@ class FleetConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"fleet.probe_backoff_s ({self.probe_backoff_s}) exceeds "
                 f"fleet.probe_backoff_max_s ({self.probe_backoff_max_s})")
+        for name, role in self.roles.items():
+            if role not in _ROLES:
+                raise ValueError(
+                    f"fleet.roles[{name!r}] = {role!r} is not one of "
+                    f"{_ROLES}")
         return self

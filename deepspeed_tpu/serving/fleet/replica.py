@@ -52,12 +52,25 @@ class Replica:
     threads while ``probe``/``load`` arrive from the heartbeat thread."""
 
     name = "replica"
+    # disagg pool membership hint ("unified" | "prefill" | "decode");
+    # FleetConfig.roles overrides per name at the router
+    role = "unified"
 
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
                deadline_ms=None):
         """→ a :class:`RequestHandle`-shaped streaming handle. Raises a
         :class:`ServingError` subclass when not accepted."""
         raise NotImplementedError
+
+    def take_handoff(self, uid):
+        """Claim the exported KV handoff record for gateway-local
+        ``uid`` (prefill role); None when none was published."""
+        return None
+
+    def import_handoff(self, record):
+        """Adopt a peer's KV handoff record (decode role). → blocks
+        adopted; validation errors propagate."""
+        return 0
 
     def prefix_match_len(self, prompt_tokens):
         """Read-only: leading prompt tokens whose KV this replica
@@ -103,10 +116,18 @@ class GatewayReplica(Replica):
     hook lives inside the factory (build engine, restore weights/KV)."""
 
     def __init__(self, name, engine_factory, serving_config=None,
-                 monitor=None, auto_start=True):
+                 monitor=None, auto_start=True, role=None):
         self.name = name
         self._factory = engine_factory
+        if role is not None:
+            # the role must reach the GATEWAY too: a prefill gateway
+            # exports its KV handoff at request finish (pump thread)
+            from deepspeed_tpu.serving.config import ServingConfig
+            base = serving_config or ServingConfig()
+            serving_config = base.model_copy(update={"role": str(role)})
         self._serving_config = serving_config
+        self.role = (serving_config.role if serving_config is not None
+                     else "unified")
         self._monitor = monitor
         self._auto_start = auto_start
         self._lock = threading.Lock()
@@ -126,6 +147,12 @@ class GatewayReplica(Replica):
                deadline_ms=None):
         return self.gateway.submit(prompt_tokens, max_new_tokens=max_new_tokens,
                                    priority=priority, deadline_ms=deadline_ms)
+
+    def take_handoff(self, uid):
+        return self.gateway.take_handoff(uid)
+
+    def import_handoff(self, record):
+        return self.gateway.import_handoff(record)
 
     def prefix_match_len(self, prompt_tokens):
         try:
@@ -208,21 +235,43 @@ class FaultyReplica(Replica):
       overload burst case.
     - ``crash_on_submit=n``: the n-th submit (1-based) kills the
       replica instead of accepting.
+    Handoff faults (disaggregated prefill→decode serving), composable
+    with all of the above:
+
+    - ``drop_handoff=True``: ``take_handoff`` returns None — the
+      published record was lost (network drop / outbox rotation).
+    - ``handoff_delay_s=s``: ``take_handoff`` sleeps ``s`` before
+      returning — set it past the router's handoff deadline to exercise
+      expiry.
+    - ``corrupt_handoff=True``: the returned record is torn (truncated
+      entries + a mangled chain key) so the importer's chained-key
+      re-derivation must reject it.
+    - ``crash_after_publish=True``: the record IS returned, then the
+      replica dies — the crash-after-publish-before-ack window.
+
     - ``hook``: a ``FaultInjector``-shaped callable ``hook(point,
-      detail)`` invoked at ``("submit", i)``, ``("token", j)`` and
-      ``("probe", None)``; anything it raises kills the replica. This is
-      how the shared checkpoint fault harness drives serving faults.
+      detail)`` invoked at ``("submit", i)``, ``("token", j)``,
+      ``("handoff", uid)`` and ``("probe", None)``; anything it raises
+      kills the replica. This is how the shared checkpoint fault
+      harness drives serving faults.
     """
 
     def __init__(self, inner, crash_at_token=None, hang_at_token=None,
                  slow_token_s=0.0, reject_next=0, crash_on_submit=None,
+                 drop_handoff=False, handoff_delay_s=0.0,
+                 corrupt_handoff=False, crash_after_publish=False,
                  hook=None):
         self.inner = inner
         self.name = inner.name
+        self.role = getattr(inner, "role", "unified")
         self.crash_at_token = crash_at_token
         self.hang_at_token = hang_at_token
         self.slow_token_s = float(slow_token_s)
         self.crash_on_submit = crash_on_submit
+        self.drop_handoff = bool(drop_handoff)
+        self.handoff_delay_s = float(handoff_delay_s)
+        self.corrupt_handoff = bool(corrupt_handoff)
+        self.crash_after_publish = bool(crash_after_publish)
         self.hook = hook
         self._lock = threading.Lock()
         self._killed = False
@@ -269,6 +318,51 @@ class FaultyReplica(Replica):
                                          deadline_ms=deadline_ms)
         return _FaultyHandle(inner_handle, self)
 
+    def take_handoff(self, uid):
+        with self._lock:
+            if self._killed:
+                raise ReplicaDiedError(f"replica {self.name} is dead")
+        if self.hook is not None:
+            try:
+                self.hook("handoff", uid)
+            except Exception as e:
+                self._die(f"hook tripped at handoff for uid {uid}: {e}")
+        if self.drop_handoff:
+            self.inner.take_handoff(uid)  # record consumed, then "lost"
+            return None
+        if self.handoff_delay_s:
+            time.sleep(self.handoff_delay_s)
+        record = self.inner.take_handoff(uid)
+        if self.corrupt_handoff and record is not None:
+            record = self._tear(record)
+        if self.crash_after_publish:
+            # the record is delivered, THEN the replica dies: the
+            # crash-after-publish-before-ack window — decode must still
+            # complete from the published record
+            try:
+                self._die("scripted crash after handoff publish")
+            except ReplicaDiedError:
+                pass
+        return record
+
+    @staticmethod
+    def _tear(record):
+        """Torn/truncated handoff: drop required fields from the last
+        entry and mangle a chain key so validation MUST reject it."""
+        torn = dict(record)
+        entries = [dict(e) for e in record.get("entries", [])]
+        if entries:
+            entries[-1].pop("handle", None)
+            entries[0] = dict(entries[0], key="torn")
+        torn["entries"] = entries
+        return torn
+
+    def import_handoff(self, record):
+        with self._lock:
+            if self._killed:
+                raise ReplicaDiedError(f"replica {self.name} is dead")
+        return self.inner.import_handoff(record)
+
     def prefix_match_len(self, prompt_tokens):
         return 0 if self._killed else self.inner.prefix_match_len(prompt_tokens)
 
@@ -310,6 +404,10 @@ class FaultyReplica(Replica):
         self.hang_at_token = None
         self.slow_token_s = 0.0
         self.crash_on_submit = None
+        self.drop_handoff = False
+        self.handoff_delay_s = 0.0
+        self.corrupt_handoff = False
+        self.crash_after_publish = False
 
     def stats(self):
         out = dict(self.inner.stats())

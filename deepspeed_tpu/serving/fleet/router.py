@@ -36,11 +36,14 @@ from deepspeed_tpu.serving.admission import (DeadlineExceededError,
                                              RequestCancelledError,
                                              ServingError)
 from deepspeed_tpu.serving.fleet.config import FleetConfig
+from deepspeed_tpu.serving.fleet.handoff import (HandoffFailedError,
+                                                 HandoffManager,
+                                                 PoolScheduler)
 from deepspeed_tpu.serving.fleet.health import (DOWN, HEALTHY, RESTARTING,
                                                 ReplicaHealth)
 from deepspeed_tpu.serving.fleet.replica import StreamStalledError
 from deepspeed_tpu.serving.gateway import RequestHandle
-from deepspeed_tpu.utils.env_registry import env_bool
+from deepspeed_tpu.utils.env_registry import env_bool, env_int, env_opt_bool
 from deepspeed_tpu.utils.logging import logger
 
 # relay-attempt outcomes
@@ -50,7 +53,9 @@ _FATAL = "fatal"  # request-terminal (cancelled / deadline / divergence)
 
 _COUNTERS = ("submitted", "completed", "failed", "cancelled",
              "deadline_expired", "retries", "failovers", "restarts",
-             "recoveries", "prefix_routed", "tokens_relayed")
+             "recoveries", "prefix_routed", "tokens_relayed",
+             "disagg_requests", "disagg_completed", "unified_fallbacks",
+             "handoff_failures")
 
 
 # ---------------------------------------------------------------------- errors
@@ -113,6 +118,28 @@ class FleetRouter:
         self._failover_enabled = env_bool("DS_FLEET_FAILOVER")
         self._prefix_routing = (self.config.prefix_routing
                                 and env_bool("DS_FLEET_PREFIX_ROUTING"))
+        # disaggregated prefill/decode serving: DS_DISAGG wins in both
+        # directions over config.disagg when set
+        disagg_env = env_opt_bool("DS_DISAGG")
+        self._disagg_enabled = (disagg_env if disagg_env is not None
+                                else self.config.disagg)
+        self._fallback_enabled = env_bool("DS_DISAGG_FALLBACK")
+        self.pools = None
+        self.handoffs = None
+        if self._disagg_enabled:
+            roles = {name: self.config.roles.get(
+                         name, getattr(rep, "role", "unified"))
+                     for name, rep in self.replicas.items()}
+            deadline = (env_int("DS_DISAGG_HANDOFF_DEADLINE_S")
+                        or self.config.handoff_deadline_s)
+            self.pools = PoolScheduler(
+                roles,
+                fallback_after=self.config.disagg_fallback_after,
+                recover_after=self.config.disagg_recover_after,
+                probe_every=self.config.disagg_probe_every,
+                now_fn=self._now)
+            self.handoffs = HandoffManager(deadline_s=deadline,
+                                           now_fn=self._now)
         self._uids = itertools.count()
         self._lock = threading.Lock()
         self._counters = {k: 0 for k in _COUNTERS}
@@ -173,13 +200,22 @@ class FleetRouter:
 
     # ----------------------------------------------------------------- relay
     def _serve(self, handle):
-        """Relay-thread main: place → stream → (on replica failure)
-        back off and fail over, until done, fatal, or out of budget.
-        Structured so NO exit path leaves the handle unfinished."""
+        """Relay-thread main. With disagg pools the request first rides
+        the two-stage prefill→handoff→decode path; any disagg failure
+        either finished the handle (typed) or gracefully degrades into
+        the unified loop below — the replay verification in ``_attempt``
+        makes the transition exact (tokens the prefill stage already
+        emitted are verified, never re-emitted). Structured so NO exit
+        path leaves the handle unfinished."""
         cfg = self.config
         excluded = set()  # replicas that already failed THIS request
         rng = random.Random(hash((self._seed, handle.uid)))
         try:
+            if self.pools is not None:
+                if self._serve_disagg(handle, rng, excluded):
+                    return
+                # graceful degradation: fall through to unified serving
+                # (replicas that failed the disagg stages stay excluded)
             while True:
                 handle.attempts += 1
                 if handle._cancelled:
@@ -249,10 +285,227 @@ class FleetRouter:
             with self._lock:
                 self._relays.discard(threading.current_thread())
 
-    def _attempt(self, handle, replica):
+    def _serve_disagg(self, handle, rng, excluded):
+        """Two-stage disaggregated serve: prefill-pool attempt (short
+        burst) → KV handoff via the content-addressed export record →
+        decode-pool continuation that verifies the emitted prefix.
+        → True when the handle was finished here (completed or typed
+        failure); False to gracefully degrade into the unified loop.
+        ``excluded`` is the request-scoped failure set shared with the
+        unified loop: a replica that dropped, tore, or stalled this
+        request's handoff path is added so the fallback never lands on
+        it (and cannot launder its health blame with an instant
+        unified success).
+        Every failure branch is pool-aware: a dead prefill re-prefills
+        on a survivor, a saturated/stalled/DOWN pool degrades instead of
+        queueing to death, and the PoolScheduler's hysteresis decides
+        when to stop even trying."""
+        cfg = self.config
+        pools = self.pools
+        if pools.decide() != "disagg":
+            self._count("unified_fallbacks")
+            return False
+        self._count("disagg_requests")
+
+        # ---- stage P: prefill a short burst, then claim the handoff.
+        # The override must cover any previously emitted tokens so the
+        # replay verification can consume them (re-prefill after a
+        # mid-handoff crash replays, never re-emits).
+        prefill_tokens = min(max(cfg.prefill_max_tokens,
+                                 len(handle._collected)),
+                             handle.max_new_tokens)
+        excluded_p = set()
+        record = None
+        source = None
+        for _ in range(cfg.max_attempts):
+            if handle._cancelled:
+                self._fail(handle, RequestCancelledError(
+                    f"request {handle.uid} cancelled"))
+                return True
+            prefill = self._place(handle.prompt, excluded_p,
+                                  roles=("prefill",))
+            if prefill is None:
+                pools.note_failure("prefill_pool_unroutable")
+                return self._degrade(handle, "no routable prefill replica")
+            handle.attempts += 1
+            handle.replica_trail.append(prefill.name)
+            outcome, err = self._attempt(handle, prefill,
+                                         max_new_override=prefill_tokens,
+                                         defer_success=True)
+            if outcome is _FATAL:
+                self._fail(handle, err)
+                return True
+            if outcome is _RETRY:
+                if not self._failover_enabled:
+                    self._fail(handle, err)
+                    return True
+                excluded_p.add(prefill.name)
+                excluded.add(prefill.name)
+                if getattr(err, "reason", "") == "queue_full" and \
+                        err.details.get("pool") == "prefill":
+                    # pool-aware hint: a saturated prefill gate means
+                    # degrade or re-pool, never retry the same gate
+                    pools.note_failure("prefill_pool_saturated")
+                    return self._degrade(handle, "prefill pool saturated")
+                if not self._backoff(handle, rng, err):
+                    return True
+                continue
+            # _OK: the prefill burst finished
+            if len(handle._collected) >= handle.max_new_tokens:
+                # the whole request fit inside the prefill burst
+                self.health[prefill.name].record_success()
+                pools.note_success()
+                if handle._finish("completed"):
+                    self._count("completed")
+                return True
+            try:
+                record = prefill.take_handoff(handle._inner.uid)
+            except Exception as e:
+                record = None
+                self._note_failure(prefill, HandoffFailedError(
+                    f"request {handle.uid}: handoff claim on "
+                    f"{prefill.name} raised {type(e).__name__}: {e}"))
+            if record is None:
+                # dropped/never-published handoff: counts toward the
+                # prefill replica's DEGRADED threshold (it prefills
+                # fine but cannot publish) and we re-prefill elsewhere
+                hf = HandoffFailedError(
+                    f"request {handle.uid}: no handoff record from "
+                    f"{prefill.name}")
+                self._count("handoff_failures")
+                self._note_failure(prefill, hf)
+                excluded_p.add(prefill.name)
+                excluded.add(prefill.name)
+                pools.note_failure("handoff_dropped")
+                if not self._backoff(handle, rng, hf):
+                    return True
+                continue
+            source = prefill
+            self.health[prefill.name].record_success()
+            break
+        if record is None or source is None:
+            pools.note_failure("prefill_attempts_exhausted")
+            return self._degrade(handle, "prefill attempts exhausted")
+        self.handoffs.publish(handle.uid, record, source.name)
+
+        # ---- stage D: deliver the record, continue on the decode pool
+        excluded_d = set()
+        for _ in range(cfg.max_attempts):
+            if handle._cancelled:
+                self.handoffs.fail(handle.uid, "cancelled")
+                self._fail(handle, RequestCancelledError(
+                    f"request {handle.uid} cancelled"))
+                return True
+            decode = self._place(handle.prompt, excluded_d,
+                                 roles=("decode",))
+            if decode is None:
+                self.handoffs.fail(handle.uid, "decode_pool_unroutable")
+                pools.note_failure("decode_pool_unroutable")
+                return self._degrade(handle, "no routable decode replica")
+            entry = self.handoffs.record(handle.uid)
+            if entry is None:
+                # published but expired past the handoff deadline —
+                # re-plan instead of waiting on a record that may never
+                # be claimable (delay-past-deadline fault mode)
+                self._count("handoff_failures")
+                pools.note_failure("handoff_expired")
+                return self._degrade(handle, "handoff deadline expired")
+            try:
+                decode.import_handoff(entry["record"])
+            except Exception as e:
+                # torn/forged record rejected by the chained-key
+                # re-derivation — blame the SOURCE that published it
+                hf = HandoffFailedError(
+                    f"request {handle.uid}: decode {decode.name} rejected "
+                    f"the handoff from {source.name}: "
+                    f"{type(e).__name__}: {e}")
+                self._count("handoff_failures")
+                self._note_failure(source, hf)
+                excluded.add(source.name)
+                self.handoffs.fail(handle.uid, "record_rejected")
+                pools.note_failure("handoff_corrupt")
+                return self._degrade(handle, "handoff record rejected")
+            handle.attempts += 1
+            handle.replica_trail.append(decode.name)
+            outcome, err = self._attempt(handle, decode)
+            if outcome is _OK:
+                self.handoffs.ack(handle.uid)
+                pools.note_success()
+                self._count("disagg_completed")
+                if handle._finish("completed"):
+                    self._count("completed")
+                return True
+            if outcome is _FATAL:
+                self.handoffs.fail(handle.uid, err.reason)
+                self._fail(handle, err)
+                return True
+            if not self._failover_enabled:
+                self.handoffs.fail(handle.uid, err.reason)
+                self._fail(handle, err)
+                return True
+            excluded_d.add(decode.name)
+            excluded.add(decode.name)
+            if getattr(err, "reason", "") == "queue_full" and \
+                    err.details.get("pool") == "decode":
+                self.handoffs.fail(handle.uid, "decode_pool_saturated")
+                pools.note_failure("decode_pool_saturated")
+                return self._degrade(handle, "decode pool saturated")
+            if not self._backoff(handle, rng, err):
+                self.handoffs.fail(handle.uid, "deadline")
+                return True
+        self.handoffs.fail(handle.uid, "decode_attempts_exhausted")
+        pools.note_failure("decode_pool_stalled")
+        return self._degrade(handle, "decode attempts exhausted")
+
+    def _degrade(self, handle, why):
+        """The disagg path cannot serve this request. With fallback on
+        (DS_DISAGG_FALLBACK, default) → False: the caller's unified
+        loop takes over on any full replica, replaying/verifying
+        whatever the prefill stage already emitted — zero lost
+        requests, zero double-emits. With fallback off → the request
+        fails with the typed handoff error (True)."""
+        if self._fallback_enabled:
+            self._count("unified_fallbacks")
+            logger.warning("fleet: request %s degrading to unified "
+                           "serving: %s", handle.uid, why)
+            return False
+        self._fail(handle, HandoffFailedError(
+            f"request {handle.uid}: disaggregated serving failed ({why}) "
+            f"and DS_DISAGG_FALLBACK is off"))
+        return True
+
+    def _backoff(self, handle, rng, err):
+        """Seeded-jitter retry backoff shared by the disagg stages
+        (same formula as the unified loop). → False when the handle was
+        failed because the deadline would expire mid-backoff."""
+        cfg = self.config
+        backoff = min(cfg.retry_backoff_s *
+                      cfg.retry_backoff_mult ** (handle.attempts - 1),
+                      cfg.retry_backoff_max_s)
+        backoff *= 1.0 + cfg.retry_jitter * rng.random()
+        if handle.deadline is not None and \
+                self._now() + backoff >= handle.deadline:
+            self._fail(handle, DeadlineExceededError(
+                f"request {handle.uid}: deadline would expire during "
+                f"failover backoff; last error: [{err.reason}] {err}"))
+            return False
+        self._count("retries")
+        if getattr(err, "retry_elsewhere", False):
+            self._count("failovers")
+        time.sleep(backoff)
+        return True
+
+    def _attempt(self, handle, replica, max_new_override=None,
+                 defer_success=False):
         """One placement attempt on ``replica`` → (outcome, error).
         Replays ``handle._collected`` silently (failover continuation):
-        tokens the client already saw are verified, never re-emitted."""
+        tokens the client already saw are verified, never re-emitted.
+        ``max_new_override`` caps the burst (the disagg prefill stage
+        asks for a handful of tokens, not the full request).
+        ``defer_success`` withholds the health credit for a clean burst
+        — the disagg prefill stage only credits the replica once its
+        handoff is claimed, so a replica that prefills fine but drops
+        every handoff still accumulates consecutive failures."""
         cfg = self.config
         deadline_ms = None
         if handle.deadline is not None:
@@ -261,9 +514,11 @@ class FleetRouter:
                 return _FATAL, DeadlineExceededError(
                     f"request {handle.uid} deadline expired")
             deadline_ms = remaining * 1e3
+        max_new = (max_new_override if max_new_override is not None
+                   else handle.max_new_tokens)
         try:
             inner = replica.submit(handle.prompt,
-                                   max_new_tokens=handle.max_new_tokens,
+                                   max_new_tokens=max_new,
                                    priority=handle.priority,
                                    deadline_ms=deadline_ms)
         except ServingError as e:
@@ -289,7 +544,8 @@ class FleetRouter:
                         f"request {handle.uid}: replay on {replica.name} "
                         f"ended after {idx} tokens but {replay} were "
                         f"already streamed")
-                self.health[replica.name].record_success()
+                if not defer_success:
+                    self.health[replica.name].record_success()
                 return _OK, None
             except _queue.Empty:
                 # hang detection: a live stream that went silent
@@ -345,22 +601,30 @@ class FleetRouter:
         degraded/down thresholds; administrative + load errors
         (restarting, closed, queue full, shed) carry NO health penalty —
         a full queue is a busy replica, not a sick one; everything else
-        (too_large, deadline, cancelled) says nothing about the replica."""
+        (too_large, deadline, cancelled) says nothing about the replica.
+        Handoff failures count like stalls: a replica that prefills
+        fine but cannot publish its KV must rotate out of the prefill
+        pool via the same DEGRADED threshold."""
         reason = getattr(err, "reason", "")
         health = self.health[replica.name]
         if reason in ("replica_died", "gateway_failed"):
             health.record_failure(why=f"[{reason}] {err}", fatal=True)
-        elif reason == "stream_stalled":
+        elif reason in ("stream_stalled", "handoff_failed"):
             health.record_failure(why=f"[{reason}] {err}")
 
     # ------------------------------------------------------------- placement
-    def _place(self, prompt, excluded):
+    def _place(self, prompt, excluded, roles=None):
         """Pick a replica for ``prompt``: routable + alive, HEALTHY
         preferred over DEGRADED, then longest prefix-cache match (ties
-        to lighter load), then least-loaded."""
+        to lighter load), then least-loaded. ``roles`` restricts
+        placement to the named disagg pool(s); None means any replica
+        (unified serving and degraded-mode fallback)."""
         candidates = []
         for name, rep in self.replicas.items():
             if name in excluded or not self.health[name].routable:
+                continue
+            if roles is not None and self.pools is not None and \
+                    self.pools.role_of(name) not in roles:
                 continue
             try:
                 if not rep.alive():
@@ -523,7 +787,11 @@ class FleetRouter:
                 stats = {}
             replicas[name] = {"health": self.health[name].snapshot(),
                               "load": self._load(rep), **stats}
-        return {"counters": counters, "replicas": replicas}
+        out = {"counters": counters, "replicas": replicas}
+        if self.pools is not None:
+            out["disagg"] = {"pools": self.pools.stats(),
+                             "handoffs": self.handoffs.stats()}
+        return out
 
     def write_events(self, monitor, step=0):
         snap = self.snapshot()
@@ -534,4 +802,11 @@ class FleetRouter:
             events.append((f"Fleet/{name}/healthy",
                            1 if state == HEALTHY else 0, step))
             events.append((f"Fleet/{name}/load", info["load"], step))
+        if self.pools is not None:
+            for k, v in sorted(self.pools.stats().items()):
+                if isinstance(v, (int, float)):
+                    events.append((f"Serve/Disagg/{k}", v, step))
+            for k, v in sorted(self.handoffs.stats().items()):
+                if isinstance(v, (int, float)):
+                    events.append((f"Serve/Disagg/handoff_{k}", v, step))
         monitor.write_events(events)

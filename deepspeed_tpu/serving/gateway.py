@@ -30,6 +30,7 @@ import itertools
 import queue as _queue
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -43,6 +44,7 @@ from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.utils.logging import logger
 
 _DONE = object()  # stream sentinel
+_HANDOFF_OUTBOX = 64  # exported records kept (LRU) awaiting router pickup
 
 
 class RequestHandle:
@@ -142,7 +144,14 @@ class ServingGateway:
             sampling=cfg.sampling,
             on_token=self._on_token)
         self.metrics = ServingMetrics(window=cfg.metrics_window)
-        self.gate = CapacityGate(engine, self.scheduler.budget)
+        # disaggregated serving: a "prefill" gateway exports a KV
+        # handoff record into a bounded outbox when a request finishes;
+        # the fleet router claims it via take_handoff() and delivers it
+        # to a "decode" gateway's import_handoff()
+        self.role = cfg.role
+        self._handoffs = OrderedDict()   # uid -> exported handoff record
+        self._handoff_lock = threading.Lock()
+        self.gate = CapacityGate(engine, self.scheduler.budget, pool=cfg.role)
         self.queue = AdmissionQueue(cfg.max_queue_depth, cfg.admission_policy,
                                     cfg.block_timeout_s)
         self._uids = itertools.count()
@@ -206,6 +215,7 @@ class ServingGateway:
                 qw = self.metrics.queue_wait
                 e.details.setdefault("queue_depth", len(self.queue))
                 e.details.update(
+                    pool=self.gate.pool,
                     evictable_blocks=int(getattr(self.engine,
                                                  "evictable_blocks", 0)),
                     active=self.gate.active,
@@ -559,10 +569,57 @@ class ServingGateway:
                 continue
             self.scheduler.retire(uid)
             self._release(handle)
+            if self.role == "prefill":
+                # retire first: the release path folds the request's
+                # full blocks into the trie, which is what export walks
+                self._export_handoff(handle)
             if handle._finish("completed"):
                 self.metrics.count("completed")
         self._finished = []
         return True
+
+    def _export_handoff(self, handle):
+        """Prefill-role finish hook (pump thread only — the export
+        gathers from the donated pool): serialize the request's cached
+        prompt KV into the outbox for the router to claim via
+        :meth:`take_handoff` and deliver to a decode replica. An export
+        failure is contained — the router re-plans the request; it must
+        never take down the pump."""
+        exporter = getattr(self.engine, "export_prefix", None)
+        if exporter is None:
+            return
+        try:
+            record = exporter(handle.prompt)
+        except Exception:
+            logger.exception(
+                f"handoff export failed for request {handle.uid}")
+            return
+        if record is None:
+            return
+        with self._handoff_lock:
+            self._handoffs[handle.uid] = record
+            while len(self._handoffs) > _HANDOFF_OUTBOX:
+                self._handoffs.popitem(last=False)
+        self.metrics.count("handoffs_exported")
+
+    def take_handoff(self, uid):
+        """Claim (pop) the exported handoff record for ``uid``; None
+        when no export landed (tierless engine, export failure, or the
+        outbox rotated it out). Safe from any thread."""
+        with self._handoff_lock:
+            return self._handoffs.pop(uid, None)
+
+    def import_handoff(self, record):
+        """Adopt a peer prefill replica's KV handoff record into this
+        engine's spill tier (decode role). Validation errors propagate
+        to the caller — a forged/torn record must fail the handoff, not
+        be half-adopted. → blocks adopted. Safe from any thread."""
+        importer = getattr(self.engine, "import_prefix", None)
+        if importer is None or record is None:
+            return 0
+        n = int(importer(record))
+        self.metrics.count("handoffs_imported")
+        return n
 
     def _on_token(self, uid, token, done):
         """Streaming hook, called by the scheduler for every accepted

@@ -65,7 +65,8 @@ class ServingMetrics:
     COUNTERS = ("submitted", "admitted", "completed", "cancelled",
                 "rejected_queue_full", "rejected_too_large", "shed",
                 "deadline_expired", "preemptions", "resumes",
-                "tokens_generated", "engine_steps", "failed")
+                "tokens_generated", "engine_steps", "failed",
+                "handoffs_exported", "handoffs_imported")
 
     def __init__(self, window=1024):
         self._lock = threading.Lock()

@@ -186,6 +186,20 @@ register("DS_FLEET_PREFIX_ROUTING", "bool", True,
          "Kill switch for prefix-cache-aware replica placement; off, "
          "the router always picks the least-loaded routable replica.",
          "deepspeed_tpu/serving/fleet/router.py")
+register("DS_DISAGG", "optional_bool", None,
+         "Kill switch for disaggregated prefill/decode serving; set it "
+         "wins in both directions, unset defers to fleet.disagg.",
+         "deepspeed_tpu/serving/fleet/router.py")
+register("DS_DISAGG_HANDOFF_DEADLINE_S", "int", 0,
+         "Deadline (seconds) a published prefill->decode KV handoff may "
+         "wait before it expires and the request is re-planned; 0 "
+         "defers to fleet.handoff_deadline_s.",
+         "deepspeed_tpu/serving/fleet/router.py")
+register("DS_DISAGG_FALLBACK", "bool", True,
+         "Kill switch for graceful degradation to unified serving when "
+         "the disagg path fails; off, a failed handoff fails the "
+         "request with a typed error instead of falling back.",
+         "deepspeed_tpu/serving/fleet/router.py")
 register("DS_SANITIZE", "bool", False,
          "Enable runtime sanitizers: checkify NaN/OOB checks around "
          "the v2 model forward plus allocator/prefix-cache/KV-tier "

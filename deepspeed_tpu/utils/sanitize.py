@@ -127,6 +127,66 @@ def check_kv_tier_store(store) -> None:
             f"says {store.bytes_resident}")
 
 
+def check_handoff_record(record, block_size=None, root_key=None) -> None:
+    """Validate a cross-process KV handoff record (TierManager
+    ``export_chain`` → ``import_chain``) BEFORE any entry is adopted.
+    Unlike the other checks this one is unconditional — the record
+    crossed a process boundary, so it is untrusted input: a torn or
+    truncated write surfaces as missing fields, and a forged entry
+    fails the chained-key re-derivation exactly like an in-store
+    corruption would under DS_SANITIZE."""
+    from deepspeed_tpu.inference.v2.prefix_cache.radix_index import _chunk_key
+    if not isinstance(record, dict) or "entries" not in record:
+        raise KVTierCorruptionError(
+            "handoff record is not a dict with an 'entries' list — "
+            "torn or truncated handoff")
+    if record.get("version") != 1:
+        raise KVTierCorruptionError(
+            f"handoff record version {record.get('version')!r} is not 1")
+    if block_size is not None and record.get("block_size") != block_size:
+        raise KVTierCorruptionError(
+            f"handoff record block_size {record.get('block_size')!r} does "
+            f"not match the importing pool's {block_size}")
+    if root_key is not None and record.get("root_key") != root_key:
+        raise KVTierCorruptionError(
+            f"handoff record root_key {record.get('root_key')!r} does not "
+            f"match the importing trie's {root_key!r}")
+    pk = record.get("root_key")
+    bs = record.get("block_size")
+    for i, entry in enumerate(record["entries"]):
+        if not isinstance(entry, dict):
+            raise KVTierCorruptionError(
+                f"handoff entry {i} is not a dict — torn record")
+        missing = [f for f in ("key", "parent_key", "tokens", "handle",
+                               "nbytes") if f not in entry]
+        if missing:
+            raise KVTierCorruptionError(
+                f"handoff entry {i} is missing {missing} — torn or "
+                f"truncated record")
+        tokens = tuple(entry["tokens"])
+        if bs is not None and len(tokens) != bs:
+            raise KVTierCorruptionError(
+                f"handoff entry {i} carries {len(tokens)} tokens, not a "
+                f"full {bs}-token block — truncated record")
+        if entry["parent_key"] != pk:
+            raise KVTierCorruptionError(
+                f"handoff entry {i} parent_key {entry['parent_key']!r} "
+                f"breaks the chain (expected {pk!r})")
+        derived = _chunk_key(pk, tokens)
+        if entry["key"] != derived:
+            raise KVTierCorruptionError(
+                f"handoff entry {i} re-derives chained key {derived!r} "
+                f"but claims {entry['key']!r} — forged or corrupt "
+                f"identity/content pair")
+        handle = entry["handle"]
+        if not isinstance(handle, dict) or "k" not in handle \
+                or "v" not in handle:
+            raise KVTierCorruptionError(
+                f"handoff entry {i} handle lacks k/v carriers — torn "
+                f"record")
+        pk = entry["key"]
+
+
 def check_prefix_index(index) -> None:
     """Walk the radix trie and re-derive the cached accounting: node
     count, ref-0 (reclaimable) count, and non-negative refcounts must

@@ -6,7 +6,8 @@ records every ``(point, detail)`` stage it reaches and raises
 checkpoint service consumes it via ``service.test_hook`` (stages like
 ``before_promote``); the serving fleet consumes the same shape via
 ``FaultyReplica(hook=...)`` (stages ``("submit", n)`` / ``("token", k)``
-/ ``("probe", None)``) — one harness, every crash-consistency test.
+/ ``("probe", None)`` / ``("handoff", uid)``) — one harness, every
+crash-consistency test.
 
 The rest is checkpoint-specific:
 

@@ -308,10 +308,12 @@ class TestErrorTaxonomy:
         gate = CapacityGate(FakeEngine(max_ctx_tokens=64, free_blocks=4), 64)
         with pytest.raises(RequestTooLargeError) as ei:
             gate.check_feasible(60, 8)
-        assert ei.value.details == {"total_tokens": 68, "max_ctx_tokens": 64}
+        assert ei.value.details == {"total_tokens": 68, "max_ctx_tokens": 64,
+                                    "pool": "unified"}
         with pytest.raises(RequestTooLargeError) as ei:
             gate.check_feasible(32, 16)
-        assert ei.value.details == {"needed_blocks": 6, "usable_blocks": 4}
+        assert ei.value.details == {"needed_blocks": 6, "usable_blocks": 4,
+                                    "pool": "unified"}
 
     def test_block_policy_timeout_carries_depth(self):
         gw = make_gateway(max_queue_depth=1, admission_policy="block",

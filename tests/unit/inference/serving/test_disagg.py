@@ -1,0 +1,622 @@
+"""Disaggregated prefill/decode serving: pools, handoff, degradation.
+
+Two layers, mirroring ``test_fleet.py``:
+
+- **Logic tests** on a deterministic uid-independent FakeEngine variant
+  (token stream is a pure function of tokens ingested — the property
+  real greedy decoding has, and the one that makes prefill→decode
+  replay verification meaningful): the two-stage router path, every
+  scripted handoff fault (drop / delay-past-deadline / torn record /
+  crash-after-publish), pool-aware admission hints, graceful
+  degradation to unified mode, the hysteresis state machine, and the
+  ``DS_DISAGG*`` kill switches.
+- **Real-engine tests** over the v2 ragged engine with the KV spill
+  tier enabled: prefill replicas export real KV handoff records, decode
+  replicas adopt and continue from them, and the chaos acceptance run
+  (kill prefill mid-handoff + stall decode mid-stream + forced decode
+  saturation) loses zero requests and double-emits zero tokens.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2 import (DSStateManagerConfig,
+                                        DynamicSplitFuseScheduler,
+                                        InferenceEngineV2, KVTierConfig,
+                                        PrefixCacheConfig,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.prefix_cache.radix_index import _chunk_key
+from deepspeed_tpu.models import build_llama
+from deepspeed_tpu.serving import (CapacityGate, QueueFullError,
+                                   RequestTooLargeError, ServingConfig,
+                                   ServingGateway)
+from deepspeed_tpu.serving.fleet import (DEGRADED, DOWN, FaultyReplica,
+                                         FleetConfig, FleetRouter,
+                                         GatewayReplica, HandoffFailedError,
+                                         HandoffManager, PoolScheduler,
+                                         ReplayDivergenceError)
+from deepspeed_tpu.utils.sanitize import check_handoff_record
+from unit.inference.serving.test_admission import FakeEngine
+
+BS = 8  # fake block size used by the fabricated handoff records
+PROMPT = list(range(1, 13))  # 12 tokens
+
+
+# ======================================================================
+# harness
+# ======================================================================
+class UidFreeEngine(FakeEngine):
+    """FakeEngine whose token stream ignores the gateway-local uid —
+    a pure function of tokens ingested, like deterministic greedy
+    decoding. This is the property that lets a decode replica's
+    continuation re-produce (and the router verify) the prefix a
+    prefill replica already emitted."""
+
+    def put(self, uids, chunks, sample=None):
+        out = []
+        for uid, toks in zip(uids, chunks):
+            self._seen[uid] = self._seen.get(uid, 0) + len(toks)
+            out.append(self._seen[uid] % 97)
+        return np.asarray(out, np.int32)
+
+    @staticmethod
+    def stream(prompt_len, n):
+        return [(prompt_len + i) % 97 for i in range(n)]
+
+
+def valid_record(prompt, block_size=BS):
+    """A handoff record that passes ``check_handoff_record`` (real
+    chained-key identity over the prompt's first block)."""
+    toks = tuple(int(t) for t in prompt[:block_size])
+    return {"version": 1, "block_size": block_size, "root_key": 0,
+            "quantized": False,
+            "entries": [{"key": _chunk_key(0, toks), "parent_key": 0,
+                         "tokens": toks, "handle": {"k": 1, "v": 1},
+                         "nbytes": 64}]}
+
+
+def disagg_engine(validate_import=False):
+    """UidFreeEngine wearing the engine-level handoff surface the
+    gateway probes for (``export_prefix`` / ``import_prefix``)."""
+    eng = UidFreeEngine()
+    eng.export_prefix = lambda prompt, max_blocks=None: valid_record(prompt)
+    if validate_import:
+        def _imp(record):
+            check_handoff_record(record, block_size=BS, root_key=0)
+            return len(record["entries"])
+        eng.import_prefix = _imp
+    else:
+        eng.import_prefix = lambda record: len(record["entries"])
+    return eng
+
+
+def pool_replica(name, role, engine=None, auto_start=True, **scfg):
+    scfg.setdefault("max_burst", 1)
+    eng = engine or disagg_engine(validate_import=True)
+    return GatewayReplica(name, lambda: eng,
+                          serving_config=ServingConfig(**scfg),
+                          auto_start=auto_start, role=role)
+
+
+def disagg_router(replicas, now_fn=None, **cfg):
+    cfg.setdefault("retry_backoff_s", 0.005)
+    cfg.setdefault("disagg", True)
+    return FleetRouter(replicas, config=FleetConfig(**cfg),
+                       now_fn=now_fn, auto_heartbeat=False)
+
+
+# ======================================================================
+# unit: HandoffManager / PoolScheduler
+# ======================================================================
+class TestHandoffManager:
+
+    def test_publish_claim_ack_lifecycle(self):
+        clock = [0.0]
+        hm = HandoffManager(deadline_s=5.0, now_fn=lambda: clock[0])
+        hm.publish(7, {"v": 1}, "p0")
+        assert hm.inflight() == 1
+        entry = hm.record(7)
+        assert entry["record"] == {"v": 1} and entry["source"] == "p0"
+        hm.ack(7)
+        s = hm.stats()
+        assert s["published"] == 1 and s["delivered"] == 1
+        assert s["acked"] == 1 and s["inflight"] == 0
+        assert hm.record(7) is None  # acked entries are gone
+
+    def test_deadline_expiry_drops_and_counts(self):
+        clock = [0.0]
+        hm = HandoffManager(deadline_s=2.0, now_fn=lambda: clock[0])
+        hm.publish(1, {"v": 1}, "p0")
+        clock[0] = 2.5
+        assert hm.record(1) is None
+        s = hm.stats()
+        assert s["expired"] == 1 and s["inflight"] == 0
+        assert s["delivered"] == 0
+
+    def test_fail_drops_entry(self):
+        hm = HandoffManager(deadline_s=5.0, now_fn=lambda: 0.0)
+        hm.publish(3, {"v": 1}, "p0")
+        hm.fail(3, "record_rejected")
+        assert hm.stats()["failed"] == 1 and hm.inflight() == 0
+
+
+class TestPoolScheduler:
+
+    def test_hysteresis_enter_probe_recover(self):
+        ps = PoolScheduler({"p0": "prefill", "d0": "decode"},
+                           fallback_after=2, recover_after=2, probe_every=3,
+                           now_fn=lambda: 0.0)
+        assert ps.decide() == "disagg"
+        ps.note_failure("handoff_dropped")
+        assert ps.mode == ps.NORMAL  # one failure is noise
+        ps.note_failure("handoff_dropped")
+        assert ps.mode == ps.DEGRADED and ps.stats()["degraded_entries"] == 1
+        # degraded: unified except every probe_every-th request
+        assert [ps.decide() for _ in range(6)] == \
+            ["unified", "unified", "disagg", "unified", "unified", "disagg"]
+        ps.note_success()
+        ps.note_failure("flap")      # failure resets the success streak
+        ps.note_success()
+        assert ps.mode == ps.DEGRADED
+        ps.note_success()
+        assert ps.mode == ps.NORMAL and ps.stats()["degraded_exits"] == 1
+        assert ps.decide() == "disagg"
+
+    def test_roles_and_pools(self):
+        ps = PoolScheduler({"a": "prefill", "b": "prefill", "c": "decode"})
+        assert ps.role_of("a") == "prefill" and ps.role_of("zz") == "unified"
+        assert sorted(ps.pool("prefill")) == ["a", "b"]
+        assert ps.stats()["prefill_replicas"] == 2
+        assert ps.stats()["decode_replicas"] == 1
+
+
+# ======================================================================
+# satellite: pool-aware admission hints
+# ======================================================================
+class TestPoolAwareAdmission:
+
+    def test_capacity_gate_stamps_pool_into_rejections(self):
+        gate = CapacityGate(FakeEngine(max_ctx_tokens=64), 64, pool="prefill")
+        assert gate.pool == "prefill"
+        with pytest.raises(RequestTooLargeError) as ei:
+            gate.check_feasible(60, 8)
+        assert ei.value.details["pool"] == "prefill"
+        # default stays unified so single-replica serving is unchanged
+        assert CapacityGate(FakeEngine(), 64).pool == "unified"
+
+    def test_gateway_queue_full_carries_pool(self):
+        gw = ServingGateway(UidFreeEngine(),
+                            config=ServingConfig(role="prefill",
+                                                 max_queue_depth=1,
+                                                 max_burst=1),
+                            auto_start=False)
+        gw.submit(PROMPT, max_new_tokens=1)
+        with pytest.raises(QueueFullError) as ei:
+            gw.submit(PROMPT, max_new_tokens=1)
+        assert ei.value.details["pool"] == "prefill"
+        gw.shutdown()
+
+
+# ======================================================================
+# two-stage routing (FakeEngine)
+# ======================================================================
+class TestDisaggRouting:
+
+    def test_happy_path_prefill_handoff_decode(self):
+        p0 = pool_replica("p0", "prefill")
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0])
+        h = router.submit(PROMPT, max_new_tokens=4)
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 4)
+        assert h.replica_trail == ["p0", "d0"]
+        counters = router.snapshot()["counters"]
+        assert counters["disagg_requests"] == 1
+        assert counters["disagg_completed"] == 1
+        assert counters["completed"] == 1
+        assert counters["handoff_failures"] == 0
+        hs = router.snapshot()["disagg"]["handoffs"]
+        assert hs["published"] == 1 and hs["acked"] == 1
+        assert hs["inflight"] == 0
+        # the gateways saw the export/import (Serve metrics surface)
+        assert p0.gateway.metrics.snapshot()["counters"][
+            "handoffs_exported"] == 1
+        assert d0.gateway.metrics.snapshot()["counters"][
+            "handoffs_imported"] == 1
+        router.shutdown()
+
+    def test_request_fitting_in_prefill_burst_skips_handoff(self):
+        p0 = pool_replica("p0", "prefill")
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0])
+        h = router.submit(PROMPT, max_new_tokens=1)
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 1)
+        assert h.replica_trail == ["p0"]
+        hs = router.snapshot()["disagg"]["handoffs"]
+        assert hs["published"] == 0 and hs["acked"] == 0
+        assert router.snapshot()["counters"]["completed"] == 1
+        router.shutdown()
+
+    def test_ds_disagg_env_wins_both_directions(self, monkeypatch):
+        monkeypatch.setenv("DS_DISAGG", "0")
+        router = disagg_router([pool_replica("p0", "prefill"),
+                                pool_replica("d0", "decode")])
+        assert router.pools is None  # env off beats config on
+        router.shutdown()
+        monkeypatch.setenv("DS_DISAGG", "1")
+        router = disagg_router([pool_replica("p0", "prefill"),
+                                pool_replica("d0", "decode")],
+                               disagg=False)
+        assert router.pools is not None  # env on beats config off
+        router.shutdown()
+
+    def test_snapshot_and_events_expose_disagg_metrics(self):
+        router = disagg_router([pool_replica("p0", "prefill"),
+                                pool_replica("d0", "decode")])
+        router.submit(PROMPT, max_new_tokens=3).result(timeout=10)
+        snap = router.snapshot()
+        assert snap["disagg"]["pools"]["mode"] == "normal"
+        assert snap["disagg"]["handoffs"]["acked"] == 1
+
+        class Sink:
+            def __init__(self):
+                self.events = []
+
+            def write_events(self, events):
+                self.events.extend(events)
+
+        sink = Sink()
+        router.write_events(sink)
+        tags = {t for t, _, _ in sink.events}
+        assert "Serve/Disagg/degraded" in tags
+        assert "Serve/Disagg/handoff_acked" in tags
+        router.shutdown()
+
+    def test_divergent_decode_fails_typed_never_double_emits(self):
+        """Token-by-token verification across the handoff boundary: a
+        decode continuation that does not re-produce the emitted prefix
+        must fail typed with exactly the prefill prefix delivered."""
+        p_eng = FakeEngine()  # uid-DEPENDENT tokens: divergence stand-in
+        p_eng.export_prefix = lambda prompt, max_blocks=None: \
+            valid_record(prompt)
+        d_eng = FakeEngine()
+        d_eng.import_prefix = lambda record: len(record["entries"])
+        p0 = pool_replica("p0", "prefill", engine=p_eng)
+        d0 = pool_replica("d0", "decode", engine=d_eng)
+        # burn d0's uid 0 so its stream for the fleet request diverges
+        d0.gateway.submit(PROMPT, max_new_tokens=1).result(timeout=10)
+        router = disagg_router([p0, d0])
+        h = router.submit(PROMPT, max_new_tokens=4)
+        with pytest.raises(ReplayDivergenceError):
+            h.result(timeout=10)
+        assert h.error.reason == "replay_divergence"
+        # the client saw exactly the prefill burst, nothing forked
+        assert h._collected == FakeEngine.expected_tokens(0, len(PROMPT), 1)
+        assert router.snapshot()["disagg"]["handoffs"]["failed"] == 1
+        router.shutdown()
+
+
+# ======================================================================
+# handoff fault modes (FakeEngine)
+# ======================================================================
+class TestHandoffFaults:
+
+    def test_dropped_handoff_reprefills_on_survivor(self):
+        """Satellites 1+2: a replica that prefills fine but drops its
+        handoff rotates out via the consecutive-failure DEGRADED
+        threshold while every request still completes."""
+        p0 = FaultyReplica(pool_replica("p0", "prefill"), drop_handoff=True)
+        p1 = pool_replica("p1", "prefill")
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, p1, d0], disagg_fallback_after=10)
+        for _ in range(2):
+            h = router.submit(PROMPT, max_new_tokens=4)
+            assert h.result(timeout=10) == \
+                UidFreeEngine.stream(len(PROMPT), 4)
+            # dropped on p0, re-prefilled on p1, decoded on d0
+            assert h.replica_trail == ["p0", "p1", "d0"]
+        counters = router.snapshot()["counters"]
+        assert counters["handoff_failures"] == 2
+        assert counters["disagg_completed"] == 2
+        # satellite 2: handoff failures drive the health threshold
+        assert router.health["p0"].state == DEGRADED
+        # DEGRADED prefill is fallback-only: the healthy peer wins now
+        h = router.submit(PROMPT, max_new_tokens=4)
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 4)
+        assert h.replica_trail == ["p1", "d0"]
+        router.shutdown()
+
+    def test_crash_after_publish_decode_still_completes(self):
+        """The crash-after-publish-before-ack window: the record was
+        delivered, so decode finishes the request even though the
+        prefill replica is dead."""
+        p0 = FaultyReplica(pool_replica("p0", "prefill"),
+                           crash_after_publish=True)
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0])
+        h = router.submit(PROMPT, max_new_tokens=4)
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 4)
+        assert not p0.alive()
+        router.tick()
+        assert router.health["p0"].state == DOWN
+        counters = router.snapshot()["counters"]
+        assert counters["disagg_completed"] == 1 and counters["failed"] == 0
+        assert router.snapshot()["disagg"]["handoffs"]["acked"] == 1
+        router.shutdown()
+
+    def test_torn_record_rejected_blames_source_and_degrades(self):
+        p0 = FaultyReplica(pool_replica("p0", "prefill"),
+                           corrupt_handoff=True)
+        d0 = pool_replica("d0", "decode")  # validating import
+        router = disagg_router([p0, d0], disagg_fallback_after=10)
+        h = router.submit(PROMPT, max_new_tokens=4)
+        # unified fallback still delivers the exact stream
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 4)
+        counters = router.snapshot()["counters"]
+        assert counters["handoff_failures"] == 1
+        assert counters["unified_fallbacks"] >= 1
+        assert counters["disagg_completed"] == 0
+        assert router.snapshot()["disagg"]["handoffs"]["failed"] == 1
+        # the SOURCE that published garbage takes the health hit
+        assert router.health["p0"].snapshot()["consecutive_failures"] == 1
+        assert router.health["d0"].snapshot()["consecutive_failures"] == 0
+        router.shutdown()
+
+    def test_handoff_past_deadline_expires_and_replans(self):
+        # a clock that advances 1s per observation: the record is
+        # always claimed past its 0.5s deadline (delay fault mode)
+        ticks = itertools.count()
+        p0 = pool_replica("p0", "prefill")
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0], handoff_deadline_s=0.5,
+                               now_fn=lambda: float(next(ticks)))
+        h = router.submit(PROMPT, max_new_tokens=4)
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 4)
+        counters = router.snapshot()["counters"]
+        assert counters["handoff_failures"] == 1
+        assert counters["unified_fallbacks"] >= 1
+        assert router.snapshot()["disagg"]["handoffs"]["expired"] == 1
+        router.shutdown()
+
+    def test_fallback_kill_switch_fails_typed(self, monkeypatch):
+        monkeypatch.setenv("DS_DISAGG_FALLBACK", "0")
+        p0 = FaultyReplica(pool_replica("p0", "prefill"), drop_handoff=True)
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0])
+        h = router.submit(PROMPT, max_new_tokens=4)
+        with pytest.raises(HandoffFailedError):
+            h.result(timeout=10)
+        assert h.status == "failed" and h.error.reason == "handoff_failed"
+        router.shutdown()
+
+
+# ======================================================================
+# graceful degradation + hysteresis (FakeEngine)
+# ======================================================================
+class TestGracefulDegradation:
+
+    def test_saturated_prefill_pool_degrades_to_unified(self):
+        """Satellite 3 end-to-end: the pool-stamped QueueFullError from
+        a saturated prefill gate steers the router to unified serving
+        instead of retrying the same gate."""
+        p0 = pool_replica("p0", "prefill", auto_start=False,
+                          max_queue_depth=1)
+        p0.gateway.submit(PROMPT, max_new_tokens=1)  # queue now full
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0])
+        h = router.submit(PROMPT, max_new_tokens=3)
+        assert h.result(timeout=10) == UidFreeEngine.stream(len(PROMPT), 3)
+        assert h.replica_trail[-1] == "d0"
+        counters = router.snapshot()["counters"]
+        assert counters["unified_fallbacks"] == 1
+        assert counters["completed"] == 1
+        # one failure: hysteresis has not flipped the mode yet
+        assert router.snapshot()["disagg"]["pools"]["mode"] == "normal"
+        router.shutdown()
+
+    def test_hysteresis_degrades_probes_and_recovers(self):
+        """Persistent prefill failures flip the scheduler DEGRADED
+        (every request serves unified); periodic probes retry disagg
+        and only consecutive successes restore NORMAL."""
+        p0 = FaultyReplica(pool_replica("p0", "prefill"), reject_next=100)
+        d0 = pool_replica("d0", "decode")
+        router = disagg_router([p0, d0], max_attempts=6,
+                               disagg_fallback_after=2,
+                               disagg_recover_after=2,
+                               disagg_probe_every=4)
+        want = UidFreeEngine.stream(len(PROMPT), 3)
+
+        def serve_one():
+            h = router.submit(PROMPT, max_new_tokens=3)
+            assert h.result(timeout=10) == want
+
+        for _ in range(2):  # two disagg failures -> DEGRADED
+            serve_one()
+        assert router.snapshot()["disagg"]["pools"]["mode"] == "degraded"
+        for _ in range(4):  # three unified + one (failed) probe
+            serve_one()
+        assert router.snapshot()["disagg"]["pools"]["mode"] == "degraded"
+        p0._reject_left = 0  # the prefill pool heals
+        for _ in range(8):  # probes at the 8th and 12th degraded request
+            serve_one()
+        snap = router.snapshot()["disagg"]["pools"]
+        assert snap["mode"] == "normal"
+        assert snap["degraded_entries"] == 1 and snap["degraded_exits"] == 1
+        serve_one()  # NORMAL again: straight down the disagg path
+        counters = router.snapshot()["counters"]
+        assert counters["completed"] == 15 and counters["failed"] == 0
+        assert counters["disagg_completed"] == 3  # two probes + the last
+        assert counters["unified_fallbacks"] >= 10
+        router.shutdown()
+
+
+# ======================================================================
+# real-engine acceptance (v2 ragged engine + KV tier, CPU mesh)
+# ======================================================================
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = build_llama("debug")
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def tiered_engine_factory(model_and_params):
+    model, params = model_and_params
+
+    def factory():
+        cfg = RaggedInferenceEngineConfig(
+            kv_block_size=8,
+            num_kv_blocks=0,
+            prefix_cache=PrefixCacheConfig(enabled=True),
+            kv_tier=KVTierConfig(enabled=True, host_bytes=1 << 22),
+            state_manager=DSStateManagerConfig(max_ragged_batch_size=96,
+                                               max_ragged_sequence_count=16,
+                                               max_tracked_sequences=16,
+                                               max_context=32))
+        return InferenceEngineV2(model=model, config=cfg, params=params,
+                                 dtype=jnp.float32)
+
+    return factory
+
+
+@pytest.fixture(scope="module")
+def reference(model_and_params):
+    """Prompts (long enough to export at least one full KV block) and
+    the no-fault greedy streams from a direct scheduler run."""
+    rng = np.random.RandomState(7)
+    n = 6
+    prompts = [rng.randint(0, 250, size=9 + i % 5).astype(np.int32)
+               for i in range(n)]
+    max_new = [2 + i % 3 for i in range(n)]
+    engine = tiered_engine_factory(model_and_params)()
+    direct = DynamicSplitFuseScheduler(engine, token_budget=48, max_burst=4)
+    for i in range(n):
+        direct.add_request(i, prompts[i], max_new_tokens=max_new[i])
+    want = direct.run_to_completion()
+    engine.destroy()
+    return prompts, max_new, {i: want[i] for i in range(n)}
+
+
+def _consume_all(handles):
+    streams, errors = {}, {}
+
+    def client(i, h):
+        try:
+            streams[i] = list(h.tokens(timeout=120))
+        except Exception as e:
+            errors[i] = e
+
+    threads = [threading.Thread(target=client, args=(i, h))
+               for i, h in enumerate(handles)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), "hung client stream"
+    return streams, errors
+
+
+def test_disagg_fleet_bit_identical_with_real_kv_handoff(model_and_params,
+                                                         reference):
+    """Prefill replica exports real tier records, decode replica adopts
+    them and continues — every greedy stream bit-identical to the
+    unified direct run, every handoff acked."""
+    prompts, max_new, want = reference
+    factory = tiered_engine_factory(model_and_params)
+    scfg = ServingConfig(token_budget=48, max_burst=4)
+    p0 = GatewayReplica("p0", factory, serving_config=scfg, role="prefill")
+    d0 = GatewayReplica("d0", factory, serving_config=scfg, role="decode")
+    router = FleetRouter([p0, d0],
+                         config=FleetConfig(disagg=True,
+                                            retry_backoff_s=0.01),
+                         auto_heartbeat=False)
+    handles = [router.submit(prompts[i], max_new_tokens=max_new[i])
+               for i in range(len(prompts))]
+    streams, errors = _consume_all(handles)
+    assert not errors, {i: str(e) for i, e in errors.items()}
+    for i in range(len(prompts)):
+        assert streams[i] == want[i], f"request {i} not bit-identical"
+    counters = router.snapshot()["counters"]
+    assert counters["completed"] == len(prompts)
+    assert counters["disagg_completed"] == len(prompts)
+    assert counters["failed"] == 0
+    hs = router.snapshot()["disagg"]["handoffs"]
+    assert hs["acked"] == len(prompts) and hs["inflight"] == 0
+    # real KV crossed the boundary, not just bookkeeping
+    assert d0.gateway.metrics.snapshot()["counters"][
+        "handoffs_imported"] == len(prompts)
+    router.drain(timeout=60)
+
+
+def test_chaos_kill_prefill_stall_decode_saturate_recover(model_and_params,
+                                                          reference):
+    """THE acceptance test: under live traffic, the first handoff kills
+    its prefill replica (crash-after-publish) and one decode replica
+    stalls mid-stream; then the whole decode pool is killed (forced
+    saturation) and later healed. Zero lost requests, zero
+    double-emitted tokens (bit-identical streams), degraded unified
+    mode enters and hysteresis recovery exits."""
+    prompts, max_new, want = reference
+    factory = tiered_engine_factory(model_and_params)
+    scfg = ServingConfig(token_budget=48, max_burst=4)
+    p0 = FaultyReplica(GatewayReplica("p0", factory, serving_config=scfg,
+                                      role="prefill"),
+                       crash_after_publish=True)
+    p1 = GatewayReplica("p1", factory, serving_config=scfg, role="prefill")
+    d0 = FaultyReplica(GatewayReplica("d0", factory, serving_config=scfg,
+                                      role="decode"),
+                       hang_at_token=1)
+    d1 = GatewayReplica("d1", factory, serving_config=scfg, role="decode")
+    router = FleetRouter(
+        [p0, p1, d0, d1],
+        config=FleetConfig(disagg=True, retry_backoff_s=0.01,
+                           max_attempts=5,
+                           # generous: first-put compile pauses on a cold
+                           # CPU engine must not read as decode stalls
+                           stream_token_timeout_s=3.0,
+                           disagg_fallback_after=2, disagg_recover_after=1,
+                           disagg_probe_every=2),
+        auto_heartbeat=False)
+
+    # phase 1: live traffic through the dying prefill + stalling decode
+    handles = [router.submit(prompts[i], max_new_tokens=max_new[i])
+               for i in range(len(prompts))]
+    streams, errors = _consume_all(handles)
+    assert not errors, {i: str(e) for i, e in errors.items()}
+    for i in range(len(prompts)):
+        assert streams[i] == want[i], f"request {i} lost or double-emitted"
+    assert not p0.alive()  # died in its crash-after-publish window
+    counters = router.snapshot()["counters"]
+    assert counters["completed"] == len(prompts)
+    assert counters["failed"] == 0
+
+    # phase 2: forced decode-pool saturation -> degraded unified mode
+    d0.kill()
+    d1.kill()
+    for i in range(2):
+        h = router.submit(prompts[i], max_new_tokens=max_new[i])
+        assert list(h.tokens(timeout=120)) == want[i]
+    snap = router.snapshot()["disagg"]
+    assert snap["pools"]["mode"] == "degraded"
+    assert router.snapshot()["counters"]["unified_fallbacks"] >= 2
+
+    # phase 3: the decode pool heals; a probe recovers NORMAL mode
+    d1.restart(timeout=60)
+    for i in range(2):  # first degraded request unified, second probes
+        h = router.submit(prompts[i], max_new_tokens=max_new[i])
+        assert list(h.tokens(timeout=120)) == want[i]
+    snap = router.snapshot()["disagg"]["pools"]
+    assert snap["mode"] == "normal"
+    # phase-1 chaos may trip the hysteresis too; every entry must have
+    # a matching probe-driven recovery
+    assert snap["degraded_entries"] >= 1
+    assert snap["degraded_exits"] == snap["degraded_entries"]
+    assert router.snapshot()["counters"]["failed"] == 0
+    router.shutdown()

@@ -675,3 +675,136 @@ class TestKVTierEngine:
         assert s["quantized"] == 1
         assert s["demoted_blocks"] >= 2 and s["quant_error_max"] > 0
         engine.destroy()
+
+
+# ------------------------------------------- cross-process handoff symmetry
+class TestCrossProcessHandoff:
+    """``export_chain`` on replica A / ``import_chain`` on replica B is
+    the demote/promote pair made symmetric across processes: the
+    chained-key identities are replica-independent, the KV crosses the
+    boundary bit-identical (fp32) or within the quant bound (int8), and
+    a forged or truncated record is rejected by chained-key
+    re-derivation before anything is adopted."""
+
+    def _stack(self, num_blocks=10, quantize=False):
+        cache = small_pool(num_blocks)
+        mgr = DSStateManager(cache, max_tracked_sequences=4)
+        pc = PrefixCacheManager(cache)
+        mgr.attach_prefix_cache(pc)
+        tier = TierManager(pc, 1 << 20, quantize=quantize, prefetch=False)
+        pc.attach_tier(tier)
+        return cache, mgr, pc, tier
+
+    def _seed(self, cache, mgr, tokens, uid=1):
+        d = mgr.get_or_create_sequence(uid)
+        mgr.allocate_for(d, len(tokens))
+        d.advance(len(tokens))
+        d.tokens = list(tokens)
+        full = len(tokens) // cache.block_size
+        want = fill_blocks(cache, [int(b) for b in d.blocks[:full]])
+        mgr.flush_sequence(uid)
+        return want
+
+    TOKENS = list(range(12))      # 3 full blocks at block_size 4
+    PROBE = list(range(13))       # one past the chain: export needs it
+
+    def test_export_import_bit_identical_fp32(self):
+        cache_a, mgr_a, pc_a, tier_a = self._stack()
+        want = self._seed(cache_a, mgr_a, self.TOKENS)
+        record = tier_a.export_chain(self.PROBE)
+        assert record is not None and len(record["entries"]) == 3
+        assert tier_a.stats()["exported_blocks"] == 3
+        # replica independence: a separately built, identically seeded
+        # stack derives the exact same chained keys
+        cache_a2, mgr_a2, _, tier_a2 = self._stack()
+        self._seed(cache_a2, mgr_a2, self.TOKENS)
+        record2 = tier_a2.export_chain(self.PROBE)
+        assert [e["key"] for e in record["entries"]] == \
+            [e["key"] for e in record2["entries"]]
+
+        cache_b, mgr_b, pc_b, tier_b = self._stack()
+        assert tier_b.import_chain(record) == 3
+        assert len(tier_b.store) == 3
+        assert tier_b.stats()["imported_blocks"] == 3
+        assert pc_b.match_len(self.PROBE) == 12
+        blocks, cached = pc_b.acquire(2, self.PROBE)
+        assert cached == 12 and len(blocks) == 3
+        got = cache_b.gather(blocks)
+        np.testing.assert_array_equal(got["k"], want["k"])
+        np.testing.assert_array_equal(got["v"], want["v"])
+
+    def test_export_import_int8_replicas_agree(self):
+        """The same int8 record adopted by two decode replicas promotes
+        to bit-equal KV on both (the record is the ground truth), and
+        both stay within the symmetric-quant bound of the original."""
+        cache_a, mgr_a, pc_a, tier_a = self._stack(quantize=True)
+        want = self._seed(cache_a, mgr_a, self.TOKENS)
+        record = tier_a.export_chain(self.PROBE)
+        assert record["quantized"] is True
+        assert all(e["handle"].get("quantized") for e in record["entries"])
+
+        got = {}
+        for name in ("b", "c"):
+            cache_x, _, pc_x, tier_x = self._stack(quantize=True)
+            assert tier_x.import_chain(record) == 3
+            blocks, cached = pc_x.acquire(2, self.PROBE)
+            assert cached == 12
+            got[name] = cache_x.gather(blocks)
+        for field in ("k", "v"):
+            np.testing.assert_array_equal(got["b"][field], got["c"][field])
+            bound = np.abs(want[field]).max() / 127.0 / 2.0 + 1e-5
+            assert np.abs(got["b"][field] - want[field]).max() <= bound
+
+    def test_forged_record_rejected_nothing_adopted(self):
+        cache_a, mgr_a, _, tier_a = self._stack()
+        self._seed(cache_a, mgr_a, self.TOKENS)
+        record = tier_a.export_chain(self.PROBE)
+        record["entries"][1]["tokens"] = (9, 9, 9, 9)  # identity forged
+        _, _, _, tier_b = self._stack()
+        with pytest.raises(KVTierCorruptionError, match="forged or corrupt"):
+            tier_b.import_chain(record)
+        assert len(tier_b.store) == 0
+        assert tier_b.stats()["import_rejects"] == 1
+        assert tier_b.stats()["imported_blocks"] == 0
+
+    def test_torn_record_rejected_nothing_adopted(self):
+        cache_a, mgr_a, _, tier_a = self._stack()
+        self._seed(cache_a, mgr_a, self.TOKENS)
+        # missing field (torn serialization)
+        rec = tier_a.export_chain(self.PROBE)
+        del rec["entries"][2]["handle"]
+        _, _, _, tier_b = self._stack()
+        with pytest.raises(KVTierCorruptionError, match="torn or truncated"):
+            tier_b.import_chain(rec)
+        # truncated block (short tokens)
+        rec = tier_a.export_chain(self.PROBE)
+        rec["entries"][0]["tokens"] = rec["entries"][0]["tokens"][:2]
+        with pytest.raises(KVTierCorruptionError, match="truncated"):
+            tier_b.import_chain(rec)
+        # broken chain (entry dropped from the middle)
+        rec = tier_a.export_chain(self.PROBE)
+        del rec["entries"][1]
+        with pytest.raises(KVTierCorruptionError, match="breaks the chain"):
+            tier_b.import_chain(rec)
+        assert len(tier_b.store) == 0
+        assert tier_b.stats()["import_rejects"] == 3
+
+    def test_engine_level_export_import_continues(self, model_and_params):
+        """Engine A prefills, exports; engine B imports and serves the
+        same prompt bit-identically with the prefill skipped past the
+        imported span."""
+        a = make_engine(model_and_params)
+        want, _ = run_one(a, 1, PROMPT)
+        record = a.export_prefix(PROMPT)
+        assert record is not None
+        assert len(record["entries"]) == (len(PROMPT) - 1) // BS  # 2 blocks
+
+        b = make_engine(model_and_params)
+        assert b.import_prefix(record) == 2
+        assert b.prefix_match_len(PROMPT) == 16
+        got, req = run_one(b, 7, PROMPT)
+        assert got == want                       # bit-identical continuation
+        assert req.prefix_cached_tokens == 16    # prefill skipped the span
+        assert b.kv_tier.stats()["imported_blocks"] == 2
+        a.destroy()
+        b.destroy()

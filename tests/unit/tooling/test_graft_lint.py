@@ -262,6 +262,8 @@ class TestThreadSharedState:
             HeartbeatWriter, PreemptionGuard)
         from deepspeed_tpu.nebula.service import \
             NebulaCheckpointService  # noqa: F401
+        from deepspeed_tpu.serving.fleet.handoff import (  # noqa: F401
+            HandoffManager, PoolScheduler)
         from deepspeed_tpu.serving.fleet.health import \
             ReplicaHealth  # noqa: F401
         from deepspeed_tpu.serving.fleet.replica import (  # noqa: F401
@@ -275,7 +277,8 @@ class TestThreadSharedState:
                     ServingMetrics, BlockedAllocator, PrefixCacheManager,
                     FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica,
                     PreemptionGuard, HeartbeatWriter, SpecDecodeState,
-                    TierManager, HostKVStore, GroupedGemmStats):
+                    TierManager, HostKVStore, GroupedGemmStats,
+                    HandoffManager, PoolScheduler):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

@@ -70,7 +70,7 @@ _SYNC_BUILTINS = {"float", "bool"}
 # than one thread. Writes outside ``with self.<*lock*>:`` are flagged
 # (``__init__`` is exempt — the object is not yet published).
 THREAD_SHARED_REGISTRY = {
-    "ServingGateway": {"_cancels", "_state", "_pump_stop"},
+    "ServingGateway": {"_cancels", "_state", "_pump_stop", "_handoffs"},
     "NebulaCheckpointService": {"_pending_job", "_failure", "_last_persist",
                                 "_stats", "_thread"},
     "MonitorMaster": {"backends"},
@@ -86,7 +86,8 @@ THREAD_SHARED_REGISTRY = {
                     "promoted_blocks", "prefetched_blocks", "stage_hits",
                     "prefetch_waits", "prefetch_wait_ms",
                     "prefetch_timeouts", "prefetch_errors",
-                    "quant_error_max"},
+                    "quant_error_max", "exported_blocks", "imported_blocks",
+                    "import_rejects"},
     "HostKVStore": {"_records", "bytes_resident", "demotions", "promotions",
                     "evictions", "lookups", "hits"},
     # spec decode: the gateway pump drafts/notes while client threads
@@ -100,6 +101,13 @@ THREAD_SHARED_REGISTRY = {
                       "_next_probe_at", "_probe_backoff", "transitions"},
     "GatewayReplica": {"gateway", "restarts"},
     "FaultyReplica": {"_killed", "_reject_left", "_submits"},
+    # disagg serving: relay threads publish/claim handoffs and note
+    # pool outcomes concurrently; the router snapshot reads both
+    "HandoffManager": {"_inflight", "published", "delivered", "acked",
+                       "failed", "expired"},
+    "PoolScheduler": {"mode", "_consecutive_failures",
+                      "_consecutive_successes", "_requests_while_degraded",
+                      "degraded_entries", "degraded_exits", "transitions"},
     # preemption: the signal handler and the training thread race on the
     # request flag; the heartbeat is beaten from the training thread and
     # read by the agent process (file) but its bookkeeping is shared
