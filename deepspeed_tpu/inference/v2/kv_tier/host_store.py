@@ -26,7 +26,8 @@ import threading
 from collections import OrderedDict
 
 from deepspeed_tpu.inference.v2.prefix_cache.radix_index import _chunk_key
-from deepspeed_tpu.utils.sanitize import check_kv_tier_store, sanitize_enabled
+from deepspeed_tpu.utils.sanitize import (check_kv_tier_store,
+                                          sanitize_enabled, tracked_lock)
 
 
 class HostKVStore:
@@ -41,7 +42,7 @@ class HostKVStore:
         self.evictions = 0   # blocks dropped for the byte budget
         self.lookups = 0     # contains/peek probes
         self.hits = 0
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(threading.RLock(), "HostKVStore._lock")
         self._sanitize = sanitize_enabled()
 
     def __len__(self):

@@ -36,6 +36,7 @@ import numpy as np
 import jax
 
 from deepspeed_tpu.inference.v2.kv_tier.host_store import HostKVStore
+from deepspeed_tpu.utils.sanitize import tracked_lock
 from deepspeed_tpu.inference.v2.kv_tier.quant import (handle_nbytes,
                                                       quantize_handle,
                                                       slice_handle)
@@ -78,7 +79,7 @@ class TierManager:
         self.exported_blocks = 0
         self.imported_blocks = 0
         self.import_rejects = 0
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(threading.RLock(), "TierManager._lock")
 
     # ------------------------------------------------------------- demotion
     def demote(self, victims):

@@ -25,7 +25,8 @@ import threading
 
 from deepspeed_tpu.inference.v2.prefix_cache.radix_index import RadixPrefixIndex
 from deepspeed_tpu.utils.env_registry import env_opt_bool
-from deepspeed_tpu.utils.sanitize import check_prefix_index, sanitize_enabled
+from deepspeed_tpu.utils.sanitize import (check_prefix_index,
+                                          sanitize_enabled, tracked_lock)
 
 
 def prefix_cache_enabled(config) -> bool:
@@ -61,7 +62,8 @@ class PrefixCacheManager:
         # the gateway pump thread and client threads (suspend/flush)
         # both mutate the trie + lease table; RLock because release()
         # re-enters release_lease()
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(threading.RLock(),
+                                  "PrefixCacheManager._lock")
         self._sanitize = sanitize_enabled()
 
     def _check(self):

@@ -54,6 +54,7 @@ import numpy as np
 from deepspeed_tpu.nebula.config import DeepSpeedNebulaConfig
 from deepspeed_tpu.runtime.checkpoint_engine import CheckpointCorruptionError, HostShardSnapshot
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.sanitize import tracked_lock
 
 MANIFEST_NAME = "nebula_manifest.json"
 TMP_ROOT = ".nebula_tmp"
@@ -270,7 +271,10 @@ class NebulaCheckpointService:
         self.config = config
         self.checkpoint_engine = checkpoint_engine
         self.monitor = monitor
-        self._lock = threading.Lock()
+        # plain Lock (the Condition below aliases it); tracked proxies
+        # around plain Locks compose with Condition — see _TrackedLock
+        self._lock = tracked_lock(threading.Lock(),
+                                  "NebulaCheckpointService._lock")
         self._idle = threading.Event()
         self._idle.set()
         self._pending_job = None

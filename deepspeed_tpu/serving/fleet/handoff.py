@@ -28,6 +28,7 @@ import threading
 import time
 
 from deepspeed_tpu.serving.admission import ServingError
+from deepspeed_tpu.utils.sanitize import tracked_lock
 
 
 class HandoffFailedError(ServingError):
@@ -44,7 +45,7 @@ class HandoffManager:
     def __init__(self, deadline_s=5.0, now_fn=None):
         self.deadline_s = float(deadline_s)
         self._now = now_fn or time.monotonic
-        self._lock = threading.Lock()
+        self._lock = tracked_lock(threading.Lock(), "HandoffManager._lock")
         self._inflight = {}   # uid -> {record, source, published_at, deadline}
         self.published = 0
         self.delivered = 0
@@ -122,7 +123,8 @@ class PoolScheduler:
         self.recover_after = int(recover_after)
         self.probe_every = int(probe_every)
         self._now = now_fn or time.monotonic
-        self._lock = threading.RLock()  # _to() re-acquires under callers
+        # _to() re-acquires under callers, hence RLock
+        self._lock = tracked_lock(threading.RLock(), "PoolScheduler._lock")
         self.mode = self.NORMAL
         self._consecutive_failures = 0
         self._consecutive_successes = 0

@@ -31,6 +31,7 @@ import threading
 import time
 
 from deepspeed_tpu.serving.fleet.config import FleetConfig
+from deepspeed_tpu.utils.sanitize import tracked_lock
 
 HEALTHY = "healthy"
 DEGRADED = "degraded"
@@ -47,7 +48,7 @@ class ReplicaHealth:
         self.config = config or FleetConfig()
         self.name = name
         self._now = now_fn or time.monotonic  # injectable for tests
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(threading.RLock(), "ReplicaHealth._lock")
         self._state = HEALTHY
         self._consecutive_failures = 0
         self._half_open_ok = 0        # consecutive good probes while DOWN

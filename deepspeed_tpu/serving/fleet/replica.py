@@ -23,6 +23,7 @@ import time
 from deepspeed_tpu.serving.admission import QueueFullError, ServingError
 from deepspeed_tpu.serving.gateway import ServingGateway
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.sanitize import tracked_lock
 
 
 # ---------------------------------------------------------------------- errors
@@ -130,7 +131,7 @@ class GatewayReplica(Replica):
                      else "unified")
         self._monitor = monitor
         self._auto_start = auto_start
-        self._lock = threading.Lock()
+        self._lock = tracked_lock(threading.Lock(), "GatewayReplica._lock")
         self.gateway = None
         self.restarts = 0  # completed rebuilds, for snapshots/tests
         self._build()
@@ -273,7 +274,7 @@ class FaultyReplica(Replica):
         self.corrupt_handoff = bool(corrupt_handoff)
         self.crash_after_publish = bool(crash_after_publish)
         self.hook = hook
-        self._lock = threading.Lock()
+        self._lock = tracked_lock(threading.Lock(), "FaultyReplica._lock")
         self._killed = False
         self._reject_left = int(reject_next)
         self._submits = 0  # lifetime submit count (1-based in faults)

@@ -43,6 +43,7 @@ from deepspeed_tpu.serving.fleet.health import (DOWN, HEALTHY, RESTARTING,
                                                 ReplicaHealth)
 from deepspeed_tpu.serving.fleet.replica import StreamStalledError
 from deepspeed_tpu.serving.gateway import RequestHandle
+from deepspeed_tpu.utils.sanitize import tracked_lock
 from deepspeed_tpu.utils.env_registry import env_bool, env_int, env_opt_bool
 from deepspeed_tpu.utils.logging import logger
 
@@ -141,7 +142,7 @@ class FleetRouter:
             self.handoffs = HandoffManager(deadline_s=deadline,
                                            now_fn=self._now)
         self._uids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = tracked_lock(threading.Lock(), "FleetRouter._lock")
         self._counters = {k: 0 for k in _COUNTERS}
         self._relays = set()   # live per-request relay threads
         self._closed = False

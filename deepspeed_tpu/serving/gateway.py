@@ -42,6 +42,7 @@ from deepspeed_tpu.serving.admission import (AdmissionQueue, CapacityGate,
 from deepspeed_tpu.serving.config import ServingConfig
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.sanitize import tracked_lock
 
 _DONE = object()  # stream sentinel
 _HANDOFF_OUTBOX = 64  # exported records kept (LRU) awaiting router pickup
@@ -150,7 +151,8 @@ class ServingGateway:
         # to a "decode" gateway's import_handoff()
         self.role = cfg.role
         self._handoffs = OrderedDict()   # uid -> exported handoff record
-        self._handoff_lock = threading.Lock()
+        self._handoff_lock = tracked_lock(threading.Lock(),
+                                          "ServingGateway._handoff_lock")
         self.gate = CapacityGate(engine, self.scheduler.budget, pool=cfg.role)
         self.queue = AdmissionQueue(cfg.max_queue_depth, cfg.admission_policy,
                                     cfg.block_timeout_s)
@@ -159,9 +161,11 @@ class ServingGateway:
         self._paused = []    # uids preempted (KV suspended), admission order
         self._finished = []  # uids completed during the current step
         self._cancels = []   # handles with a pending cancel request
-        self._cancel_lock = threading.Lock()
+        self._cancel_lock = tracked_lock(threading.Lock(),
+                                         "ServingGateway._cancel_lock")
         self._state = "running"  # running|draining|stopped|failed
-        self._state_lock = threading.Lock()
+        self._state_lock = tracked_lock(threading.Lock(),
+                                        "ServingGateway._state_lock")
         self._wake = threading.Event()
         self._pump_stop = False
         self._pump_thread = None

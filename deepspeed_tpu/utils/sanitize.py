@@ -19,6 +19,9 @@ manager), not per call, so flipping the env var mid-run does not
 resurrect checks on live objects.
 """
 
+import threading
+import traceback
+
 import jax
 
 from deepspeed_tpu.utils.env_registry import env_bool
@@ -45,6 +48,14 @@ class KVTierCorruptionError(SanitizerError):
     """Host KV spill-tier record whose stored chained key no longer
     re-derives from its (parent_key, tokens) identity — promotion would
     graft wrong-content KV into the trie — or byte accounting drift."""
+
+
+class LockOrderViolationError(SanitizerError):
+    """An acquisition closed a cycle in the global lock-order graph
+    (two threads can take the same two locks in opposite orders), or a
+    non-reentrant lock was blocking-re-acquired by its holder. The
+    message names both acquisition stacks: the current thread's and the
+    recorded one that established the conflicting edge."""
 
 
 def sanitize_enabled() -> bool:
@@ -215,3 +226,210 @@ def check_prefix_index(index) -> None:
         raise PrefixCacheCorruptionError(
             f"trie has {ref0} ref-0 (reclaimable) blocks but the "
             f"evictable counter says {index.evictable_blocks}")
+
+
+# -------------------------------------------------- lock-order sanitizer
+# Runtime twin of the graft-lint ``lock-order`` rule: under DS_SANITIZE=1
+# every registered lock is wrapped in an order-tracking proxy. Each
+# acquisition while other tracked locks are held merges directed edges
+# (held -> acquiring) into one process-global graph; the first
+# acquisition that would close a cycle raises LockOrderViolationError
+# BEFORE touching the underlying lock — naming the current thread's
+# stack and the recorded stack of the conflicting edge — so the test
+# suite reports the inversion instead of deadlocking on it.
+#
+# The graph is guarded by a plain (untracked) module lock and persists
+# across objects: edges recorded by a TierManager in one test conflict
+# with inversions from another, which is exactly what makes the tier-1
+# suite a dynamic deadlock harness. Tests isolate via reset_lock_graph().
+
+_LOCK_GRAPH_GUARD = threading.Lock()
+_LOCK_GRAPH = {}  # src name -> {dst name: {"thread", "held", "stack"}}
+_HELD = threading.local()  # .stack: list of (proxy, name) per thread
+
+
+def reset_lock_graph() -> None:
+    """Drop all recorded acquisition edges (test isolation)."""
+    with _LOCK_GRAPH_GUARD:
+        _LOCK_GRAPH.clear()
+
+
+def lock_graph_snapshot():
+    """{src: {dst: owning thread name}} copy of the global edge set."""
+    with _LOCK_GRAPH_GUARD:
+        return {src: {dst: info["thread"] for dst, info in dsts.items()}
+                for src, dsts in _LOCK_GRAPH.items()}
+
+
+def _held_stack():
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+    return stack
+
+
+def _reaches(src, dst):
+    """True if ``dst`` is reachable from ``src`` in _LOCK_GRAPH (caller
+    holds _LOCK_GRAPH_GUARD)."""
+    seen = set()
+    frontier = [src]
+    while frontier:
+        node = frontier.pop()
+        if node == dst:
+            return True
+        if node in seen:
+            continue
+        seen.add(node)
+        frontier.extend(_LOCK_GRAPH.get(node, ()))
+    return False
+
+
+class _TrackedLock:
+    """Order-tracking proxy around a Lock/RLock. Forwards everything to
+    the wrapped lock; acquire/release additionally maintain the
+    per-thread held stack and the global acquisition graph.
+
+    Reentrancy: re-acquiring a lock already on this thread's held stack
+    records no edges (an RLock holder re-entering is legal and must not
+    self-edge); a BLOCKING re-acquire of a plain non-reentrant Lock is
+    raised as a guaranteed self-deadlock instead of hanging.
+
+    ``threading.Condition(tracked_plain_lock)`` is supported: Condition
+    probes the lock for ``_release_save``/``_acquire_restore``, the
+    proxy's ``__getattr__`` raises AttributeError for them (plain Locks
+    have none), and Condition falls back to plain ``release()`` /
+    ``acquire()`` — which keep the held stack correct across ``wait()``.
+    Do NOT hand a tracked RLock to a Condition: the probe would find the
+    real RLock's ``_release_save`` via forwarding and bypass tracking.
+    """
+
+    __slots__ = ("_inner", "_name")
+
+    def __init__(self, inner, name):
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, blocking=True, timeout=-1):
+        held = _held_stack()
+        reentrant = any(entry[0] is self for entry in held)
+        if reentrant:
+            if blocking and isinstance(self._inner,
+                                       type(threading.Lock())):
+                raise LockOrderViolationError(
+                    f"self-deadlock: thread "
+                    f"{threading.current_thread().name!r} blocking-"
+                    f"re-acquires non-reentrant {self._name} it already "
+                    f"holds\n--- current stack ---\n"
+                    + "".join(traceback.format_stack()))
+        else:
+            self._check_and_record(held)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            held.append((self, self._name))
+        elif not reentrant:
+            # nothing was pushed; recorded edges stay — the ATTEMPTED
+            # ordering is what matters for deadlock potential
+            pass
+        return ok
+
+    def _check_and_record(self, held):
+        if not held:
+            return
+        me = self._name
+        held_names = [name for _proxy, name in held]
+        with _LOCK_GRAPH_GUARD:
+            for src in held_names:
+                if src == me:
+                    continue
+                # would edge (src -> me) close a cycle? i.e. me -> src
+                # already reachable through recorded edges
+                if _reaches(me, src):
+                    info = self._conflict_info(me, src)
+                    raise LockOrderViolationError(
+                        f"lock-order cycle: thread "
+                        f"{threading.current_thread().name!r} acquires "
+                        f"{me} while holding {held_names} but the "
+                        f"reverse order {me} -> {src} is already on "
+                        f"record (thread {info['thread']!r} held "
+                        f"{info['held']})\n"
+                        f"--- current acquisition stack ---\n"
+                        f"{''.join(traceback.format_stack())}"
+                        f"--- conflicting acquisition stack "
+                        f"(thread {info['thread']!r}) ---\n"
+                        f"{''.join(info['stack'])}")
+            stack = traceback.format_stack()
+            thread = threading.current_thread().name
+            for src in held_names:
+                if src == me:
+                    continue
+                _LOCK_GRAPH.setdefault(src, {}).setdefault(
+                    me, {"thread": thread, "held": list(held_names),
+                         "stack": stack})
+
+    @staticmethod
+    def _conflict_info(src, dst):
+        """First recorded edge on some path src -> ... -> dst (caller
+        holds the guard); falls back to the direct edge if present."""
+        direct = _LOCK_GRAPH.get(src, {}).get(dst)
+        if direct is not None:
+            return direct
+        seen = set()
+        frontier = [(src, None)]
+        while frontier:
+            node, first = frontier.pop()
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt, info in _LOCK_GRAPH.get(node, {}).items():
+                carried = first or info
+                if nxt == dst:
+                    return carried
+                frontier.append((nxt, carried))
+        return {"thread": "?", "held": [], "stack": []}
+
+    def release(self):
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                del held[i]
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # _release_save/_acquire_restore/_is_owned must NOT forward to a
+        # wrapped RLock (Condition would bypass held tracking); plain
+        # Locks lack them, so AttributeError here preserves Condition's
+        # documented fallback to acquire()/release()
+        if name in ("_release_save", "_acquire_restore", "_is_owned"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<_TrackedLock {self._name} wrapping {self._inner!r}>"
+
+
+def tracked_lock(lock, name, enabled=None):
+    """Wrap ``lock`` in an order-tracking proxy under DS_SANITIZE=1.
+
+    Off-state returns ``lock`` VERBATIM (identity-asserted by
+    tests/unit/tooling/test_lock_sanitizer.py) — zero wrapper, zero
+    per-acquire branch, same discipline as :func:`maybe_checkify_jit`.
+    ``name`` must be the ``Class.attr`` key the graft-lint LOCK_ORDER
+    table uses, so static and runtime reports speak the same language.
+    """
+    if enabled is None:
+        enabled = sanitize_enabled()
+    if not enabled:
+        return lock
+    return _TrackedLock(lock, name)

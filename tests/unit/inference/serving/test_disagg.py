@@ -42,7 +42,8 @@ from deepspeed_tpu.serving.fleet import (DEGRADED, DOWN, FaultyReplica,
                                          GatewayReplica, HandoffFailedError,
                                          HandoffManager, PoolScheduler,
                                          ReplayDivergenceError)
-from deepspeed_tpu.utils.sanitize import check_handoff_record
+from deepspeed_tpu.utils.sanitize import (check_handoff_record,
+                                          reset_lock_graph)
 from unit.inference.serving.test_admission import FakeEngine
 
 BS = 8  # fake block size used by the fabricated handoff records
@@ -556,13 +557,23 @@ def test_disagg_fleet_bit_identical_with_real_kv_handoff(model_and_params,
 
 
 def test_chaos_kill_prefill_stall_decode_saturate_recover(model_and_params,
-                                                          reference):
+                                                          reference,
+                                                          monkeypatch):
     """THE acceptance test: under live traffic, the first handoff kills
     its prefill replica (crash-after-publish) and one decode replica
     stalls mid-stream; then the whole decode pool is killed (forced
     saturation) and later healed. Zero lost requests, zero
     double-emitted tokens (bit-identical streams), degraded unified
-    mode enters and hysteresis recovery exits."""
+    mode enters and hysteresis recovery exits.
+
+    Runs under DS_SANITIZE=1 so every registered lock is order-tracked:
+    the chaos phases exercise router/gateway/handoff/tier locking from
+    many threads at once, doubling this test as a dynamic deadlock
+    harness (an inversion raises LockOrderViolationError instead of
+    hanging). checkify preserves values, so the bit-identical stream
+    assertions are unchanged."""
+    monkeypatch.setenv("DS_SANITIZE", "1")
+    reset_lock_graph()
     prompts, max_new, want = reference
     factory = tiered_engine_factory(model_and_params)
     scfg = ServingConfig(token_budget=48, max_burst=4)
@@ -579,8 +590,10 @@ def test_chaos_kill_prefill_stall_decode_saturate_recover(model_and_params,
         config=FleetConfig(disagg=True, retry_backoff_s=0.01,
                            max_attempts=5,
                            # generous: first-put compile pauses on a cold
-                           # CPU engine must not read as decode stalls
-                           stream_token_timeout_s=3.0,
+                           # CPU engine must not read as decode stalls —
+                           # and under DS_SANITIZE the compile is the
+                           # slower checkified step
+                           stream_token_timeout_s=9.0,
                            disagg_fallback_after=2, disagg_recover_after=1,
                            disagg_probe_every=2),
         auto_heartbeat=False)

@@ -457,3 +457,313 @@ class TestCli:
                        "v = os.environ.get('DS_X')\n", relpath="x.py")
         assert isinstance(vs[0], Violation)
         assert vs[0].line == 2 and vs[0].symbol == "<module>"
+
+
+# ---------------------------------------------------------------- lock-order
+class TestLockOrder:
+
+    def test_inverted_tier_then_mgr_flagged(self):
+        # THE acceptance fixture: taking the manager lock while holding
+        # the tier lock inverts the canonical mgr->tier order (the
+        # runtime twin catches the same inversion dynamically in
+        # test_lock_sanitizer.py)
+        vs = lint_src("""
+            class TierManager:
+                def bad(self):
+                    with self._lock:
+                        mgr = self.manager
+                        with mgr._lock:
+                            pass
+        """)
+        assert rules_of(vs) == ["lock-order"]
+        assert "inverts the canonical lock order" in vs[0].message
+        assert "PrefixCacheManager._lock" in vs[0].message
+
+    def test_canonical_order_clean_and_edges_recorded(self):
+        src = textwrap.dedent("""
+            class TierManager:
+                def good(self):
+                    with self._lock:
+                        with self.store._lock:
+                            pass
+        """)
+        lt = FileLinter("f.py", src, relpath="deepspeed_tpu/x.py")
+        assert lt.run() == []
+        assert [(e["src"], e["dst"]) for e in lt.lock_edges] == \
+            [("TierManager._lock", "HostKVStore._lock")]
+
+    def test_join_under_lock_flagged(self):
+        vs = lint_src("""
+            class FleetRouter:
+                def bad(self):
+                    with self._lock:
+                        self._relay_thread.join()
+        """)
+        assert rules_of(vs) == ["lock-order"]
+        assert "join" in vs[0].message
+
+    def test_untimed_get_under_lock_flagged(self):
+        vs = lint_src("""
+            class FleetRouter:
+                def bad(self):
+                    with self._lock:
+                        item = self._inbox.get()
+        """)
+        assert rules_of(vs) == ["lock-order"]
+
+    def test_sleep_under_lock_thresholded(self):
+        vs = lint_src("""
+            import time
+
+            class FleetRouter:
+                def bad(self):
+                    with self._lock:
+                        time.sleep(0.5)
+
+                def fine(self):
+                    with self._lock:
+                        time.sleep(0.001)
+        """)
+        assert rules_of(vs) == ["lock-order"]
+        assert vs[0].symbol == "FleetRouter.bad"
+
+    def test_own_condition_wait_exempt_foreign_flagged(self):
+        # a Condition built over the class's own lock may wait untimed
+        # while that lock is the ONLY one held (wait releases it); any
+        # second held lock stays pinned through the sleep -> flagged
+        clean = lint_src("""
+            import threading
+
+            class NebulaCheckpointService:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._wake = threading.Condition(self._lock)
+
+                def _run(self):
+                    with self._lock:
+                        while self._job is None:
+                            self._wake.wait()
+        """)
+        assert clean == []
+        vs = lint_src("""
+            import threading
+
+            class NebulaCheckpointService:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._io_lock = threading.Lock()
+                    self._wake = threading.Condition(self._lock)
+
+                def bad(self):
+                    with self._lock:
+                        with self._io_lock:
+                            self._wake.wait()
+        """)
+        assert rules_of(vs) == ["lock-order"]
+        assert "wait" in vs[0].message
+
+    def test_nonreentrant_reacquire_flagged_rlock_ok(self):
+        vs = lint_src("""
+            import threading
+
+            class FleetRouter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        assert rules_of(vs) == ["lock-order"]
+        assert "re-acquisition of non-reentrant" in vs[0].message
+        assert lint_src("""
+            import threading
+
+            class ReplicaHealth:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def fine(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """) == []
+
+    def test_tracked_lock_wrapper_unwrapped_in_discovery(self):
+        # production wiring wraps constructors in tracked_lock(...);
+        # discovery must see through it to the real Lock kind
+        vs = lint_src("""
+            import threading
+            from deepspeed_tpu.utils.sanitize import tracked_lock
+
+            class FleetRouter:
+                def __init__(self):
+                    self._lock = tracked_lock(threading.Lock(),
+                                              "FleetRouter._lock")
+
+                def bad(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        assert rules_of(vs) == ["lock-order"]
+
+    def test_locked_suffix_method_seeded_as_holding(self):
+        # foo_locked() methods run under the caller's self._lock by
+        # convention -> blocking inside them is blocking-under-lock
+        vs = lint_src("""
+            import time, threading
+
+            class TierManager:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def _demote_locked(self):
+                    time.sleep(1.0)
+        """)
+        assert rules_of(vs) == ["lock-order"]
+        assert "TierManager._lock" in vs[0].message
+
+    def test_pragma_suppresses(self):
+        assert lint_src("""
+            import time
+
+            class FleetRouter:
+                def retry(self):
+                    with self._lock:
+                        time.sleep(0.5)  # ds-lint: disable=lock-order -- bounded startup backoff
+        """) == []
+
+    def test_in_file_cycle_between_unranked_locks(self):
+        # two locks with no LOCK_ORDER rank taken in both orders: the
+        # per-edge rank check can't fire, the cycle pass must
+        src = textwrap.dedent("""
+            class MonitorMaster:
+                def a(self):
+                    with self._write_lock:
+                        with self._flush_lock:
+                            pass
+
+                def b(self):
+                    with self._flush_lock:
+                        with self._write_lock:
+                            pass
+        """)
+        vs = lint_file("f.py", source=src, relpath="deepspeed_tpu/x.py")
+        assert rules_of(vs) == ["lock-order"]
+        assert "cycle" in vs[0].message
+
+    def test_cross_file_cycle_merged_in_lint_paths(self, tmp_path):
+        # each file alone is a consistent order; together they invert
+        (tmp_path / "one.py").write_text(textwrap.dedent("""
+            class MonitorMaster:
+                def a(self):
+                    with self._write_lock:
+                        with self._flush_lock:
+                            pass
+        """))
+        (tmp_path / "two.py").write_text(textwrap.dedent("""
+            class MonitorMaster:
+                def b(self):
+                    with self._flush_lock:
+                        with self._write_lock:
+                            pass
+        """))
+        for f in ("one.py", "two.py"):
+            assert lint_file(str(tmp_path / f), relpath=f) == []
+        vs, _ = lint_paths([str(tmp_path)], root=str(tmp_path))
+        assert rules_of(vs) == ["lock-order"]
+        assert "cycle" in vs[0].message
+
+    def test_lock_order_table_names_registered_classes(self):
+        from tools.graft_lint.linter import (LOCK_ORDER,
+                                             THREAD_SHARED_REGISTRY)
+        for key in LOCK_ORDER:
+            cls, _, attr = key.partition(".")
+            assert cls in THREAD_SHARED_REGISTRY, key
+            assert attr.startswith("_") and "lock" in attr, key
+
+
+# ----------------------------------------------------------------- knob-docs
+class TestKnobDocs:
+
+    def test_repo_docs_in_sync(self):
+        from tools.graft_lint.cli import check_knob_docs
+        assert check_knob_docs() == []
+
+    def test_missing_and_stale_rows_flagged(self, tmp_path):
+        from tools.graft_lint.cli import check_knob_docs, \
+            format_knobs_markdown
+        table = format_knobs_markdown().splitlines()
+        # drop the DS_SANITIZE row, add a retired knob's row
+        table = [ln for ln in table if "DS_SANITIZE" not in ln]
+        table.append("| `DS_RETIRED_KNOB` | bool | `0` | gone |")
+        docs = tmp_path / "MIGRATING.md"
+        docs.write_text("\n".join(table) + "\n")
+        vs = check_knob_docs(docs_path=str(docs))
+        assert rules_of(vs) == ["knob-docs"] * 2
+        assert {v.symbol for v in vs} == {"DS_SANITIZE", "DS_RETIRED_KNOB"}
+
+
+# ------------------------------------------------- CLI baseline & rule filter
+class TestCliBaselineAndFilters:
+
+    BAD_SRC = ("import jax\n@jax.jit\ndef f(x):\n    print(x)\n"
+               "    return x\n")
+
+    def test_malformed_baseline_typed_error_exit_2(self, tmp_path, capsys):
+        from tools.graft_lint.cli import main
+        from tools.graft_lint.linter import BaselineError
+        bl = tmp_path / "baseline.json"
+        bl.write_text("{not json")
+        with pytest.raises(BaselineError):
+            load_baseline(str(bl))
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main(["--baseline", str(bl), str(good)]) == 2
+        assert "malformed baseline" in capsys.readouterr().err
+        for bad_payload in ([1, 2], {"version": 1, "suppressions": "no"},
+                            {"version": 1, "suppressions": [{"rule": "x"}]}):
+            bl.write_text(json.dumps(bad_payload))
+            with pytest.raises(BaselineError):
+                load_baseline(str(bl))
+
+    def test_update_baseline_roundtrip(self, tmp_path, capsys):
+        from tools.graft_lint.cli import main
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD_SRC)
+        bl = tmp_path / "baseline.json"
+        assert main(["--update-baseline", "--baseline", str(bl),
+                     str(bad)]) == 0
+        entries = load_baseline(str(bl))
+        assert len(entries) == 1 and next(iter(entries))[0] == "jit-purity"
+        capsys.readouterr()
+        # the freshly written baseline suppresses the same violation
+        assert main(["--baseline", str(bl), str(bad)]) == 0
+        assert "1 baselined" in capsys.readouterr().out
+        # --no-baseline reports it again
+        assert main(["--no-baseline", "--baseline", str(bl),
+                     str(bad)]) == 1
+
+    def test_json_schema(self, tmp_path, capsys):
+        from tools.graft_lint.cli import main
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD_SRC)
+        assert main(["--format=json", str(bad)]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert set(report) == {"violations", "baselined"}
+        v = report["violations"][0]
+        assert set(v) == {"rule", "path", "line", "col", "symbol", "message"}
+        assert isinstance(report["baselined"], int)
+
+    def test_only_filters_rules(self, tmp_path, capsys):
+        from tools.graft_lint.cli import main
+        mixed = tmp_path / "mixed.py"
+        mixed.write_text("import os\nv = os.environ.get('DS_X')\n")
+        assert main(["--only=jit-purity", str(mixed)]) == 0
+        capsys.readouterr()
+        assert main(["--only=env-registry", str(mixed)]) == 1
+        capsys.readouterr()
+        assert main(["--only=nope", str(mixed)]) == 2
+        assert "unknown rule" in capsys.readouterr().err

@@ -1,23 +1,58 @@
 """Tier-1 regression gate: ds_lint must stay clean on deepspeed_tpu/.
 
 A new violation fails this test; fix it, pragma it with a reason, or
-(for pre-existing debt only) add a baseline entry.
+(for pre-existing debt only) add a baseline entry. Every rule family —
+including lock-order and knob-docs — runs repo-wide here with ZERO
+baseline entries, and per-rule wall times are reported so a rule that
+regresses the gate's latency is visible in the failure output.
 """
 
 import os
+import time
 
-from tools.graft_lint.cli import DEFAULT_BASELINE, REPO_ROOT
-from tools.graft_lint.linter import lint_paths, load_baseline
+from tools.graft_lint.cli import (DEFAULT_BASELINE, REPO_ROOT,
+                                  check_knob_docs)
+from tools.graft_lint.linter import (KNOB_DOCS, RULES, lint_paths,
+                                     load_baseline)
+
+PKG = os.path.join(REPO_ROOT, "deepspeed_tpu")
+
+
+def _fmt(violations):
+    return "\n" + "\n".join(
+        f"{v.path}:{v.line}: [{v.rule}] {v.symbol}: {v.message}"
+        for v in violations)
 
 
 def test_ds_lint_clean_on_package():
     baseline = (load_baseline(DEFAULT_BASELINE)
                 if os.path.exists(DEFAULT_BASELINE) else set())
-    violations, _ = lint_paths([os.path.join(REPO_ROOT, "deepspeed_tpu")],
-                               baseline=baseline, root=REPO_ROOT)
-    assert violations == [], "\n" + "\n".join(
-        f"{v.path}:{v.line}: [{v.rule}] {v.symbol}: {v.message}"
-        for v in violations)
+    violations, _ = lint_paths([PKG], baseline=baseline, root=REPO_ROOT)
+    assert violations == [], _fmt(violations)
+
+
+def test_each_rule_clean_standalone_with_timings():
+    """Run every rule in isolation (the CLI's --only path) with an
+    EMPTY baseline: proves no rule depends on another's suppressions
+    and gives a per-rule timing line on failure."""
+    timings = []
+    for rule in RULES:
+        start = time.perf_counter()
+        if rule == KNOB_DOCS:
+            violations = check_knob_docs()
+        else:
+            violations, _ = lint_paths([PKG], baseline=set(),
+                                       root=REPO_ROOT, only={rule})
+        timings.append(f"{rule}: {time.perf_counter() - start:.3f}s")
+        assert violations == [], (
+            f"[{rule}] not clean ({'; '.join(timings)})" + _fmt(violations))
+
+
+def test_knob_docs_in_sync():
+    """env_registry.py and the MIGRATING.md knob table must agree in
+    both directions (regenerate with `bin/ds_lint --list-knobs`)."""
+    violations = check_knob_docs()
+    assert violations == [], _fmt(violations)
 
 
 def test_baseline_is_empty_of_new_debt():

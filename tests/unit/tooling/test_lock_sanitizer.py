@@ -1,0 +1,174 @@
+"""Runtime lock-order sanitizer: the DS_SANITIZE=1 twin of the static
+``lock-order`` graft-lint rule. Covers the forged inversion raising
+:class:`LockOrderViolationError` with BOTH acquisition stacks, RLock
+reentrancy staying silent, the Condition-over-tracked-lock pattern
+(nebula writer), and the identity-asserted off state."""
+
+import pathlib
+import threading
+
+import pytest
+
+from deepspeed_tpu.utils import sanitize as S
+from deepspeed_tpu.utils.sanitize import (LockOrderViolationError,
+                                          SanitizerError, lock_graph_snapshot,
+                                          reset_lock_graph, tracked_lock)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_lock_graph():
+    reset_lock_graph()
+    yield
+    reset_lock_graph()
+    S._HELD.stack = []
+
+
+def _establish(first, second, thread_name):
+    """Record first -> second in the global graph from a worker thread."""
+    def run():
+        with first:
+            with second:
+                pass
+    th = threading.Thread(target=run, name=thread_name)
+    th.start()
+    th.join()
+
+
+class TestInversionDetection:
+
+    def test_forged_inversion_raises_with_both_stacks(self):
+        # the runtime half of the ISSUE acceptance pair: the same
+        # tier->mgr inversion the static rule flags on a fixture
+        # (TestLockOrder.test_inverted_tier_then_mgr_flagged)
+        mgr = tracked_lock(threading.Lock(), "PrefixCacheManager._lock",
+                           enabled=True)
+        tier = tracked_lock(threading.Lock(), "TierManager._lock",
+                            enabled=True)
+        _establish(mgr, tier, thread_name="mgr-then-tier")
+        with pytest.raises(LockOrderViolationError) as err, tier:
+            with mgr:
+                pass
+        msg = str(err.value)
+        # names both locks, both threads, and both stacks
+        assert "PrefixCacheManager._lock" in msg
+        assert "TierManager._lock" in msg
+        assert "mgr-then-tier" in msg
+        assert threading.current_thread().name in msg
+        assert "current acquisition stack" in msg
+        assert "conflicting acquisition stack" in msg
+        # raised BEFORE acquiring: nothing leaks onto the held stack
+        # (the outer `with tier` has exited by now)
+        assert S._held_stack() == []
+
+    def test_transitive_cycle_detected(self):
+        a = tracked_lock(threading.Lock(), "A._lock", enabled=True)
+        b = tracked_lock(threading.Lock(), "B._lock", enabled=True)
+        c = tracked_lock(threading.Lock(), "C._lock", enabled=True)
+        _establish(a, b, "a-then-b")
+        _establish(b, c, "b-then-c")
+        with pytest.raises(LockOrderViolationError), c:
+            with a:  # closes c -> a against recorded a -> b -> c
+                pass
+
+    def test_consistent_order_never_raises(self):
+        a = tracked_lock(threading.Lock(), "A._lock", enabled=True)
+        b = tracked_lock(threading.Lock(), "B._lock", enabled=True)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        snap = lock_graph_snapshot()
+        assert "B._lock" in snap["A._lock"]
+        assert "A._lock" not in snap.get("B._lock", {})
+
+    def test_error_type_is_sanitizer_error(self):
+        assert issubclass(LockOrderViolationError, SanitizerError)
+
+
+class TestReentrancy:
+
+    def test_rlock_reacquire_not_flagged(self):
+        r = tracked_lock(threading.RLock(), "ReplicaHealth._lock",
+                         enabled=True)
+        with r:
+            with r:
+                assert len(S._held_stack()) == 2
+        assert S._held_stack() == []
+        assert lock_graph_snapshot() == {}  # no self-edge recorded
+
+    def test_plain_lock_blocking_reacquire_raises_instead_of_hanging(self):
+        lk = tracked_lock(threading.Lock(), "FleetRouter._lock",
+                          enabled=True)
+        with lk:
+            with pytest.raises(LockOrderViolationError,
+                               match="self-deadlock"):
+                lk.acquire()
+
+    def test_nonblocking_probe_of_own_lock_ok(self):
+        # Condition._is_owned probes acquire(False) on a held lock
+        lk = tracked_lock(threading.Lock(), "X._lock", enabled=True)
+        with lk:
+            assert lk.acquire(False) is False
+        assert S._held_stack() == []
+
+
+class TestConditionInterop:
+
+    def test_condition_over_tracked_plain_lock(self):
+        # the nebula writer pattern: _wake = Condition(self._lock) where
+        # _lock is a tracked proxy; wait() must release/reacquire
+        # THROUGH the proxy so held-stack accounting survives
+        lk = tracked_lock(threading.Lock(),
+                          "NebulaCheckpointService._lock", enabled=True)
+        cv = threading.Condition(lk)
+        done = []
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(timeout=1.0)
+
+        th = threading.Thread(target=waiter, name="nebula-writer")
+        th.start()
+        with cv:
+            done.append(1)
+            cv.notify()
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        assert S._held_stack() == []
+
+
+class TestOffState:
+
+    def test_disabled_returns_lock_verbatim(self):
+        plain = threading.Lock()
+        assert tracked_lock(plain, "X._lock", enabled=False) is plain
+
+    def test_env_off_leaves_registered_class_unwrapped(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "0")
+        from deepspeed_tpu.serving.fleet.health import ReplicaHealth
+        lk = ReplicaHealth()._lock
+        assert not isinstance(lk, S._TrackedLock)
+        assert type(lk) is type(threading.RLock())
+
+    def test_env_on_wraps_registered_class(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        from deepspeed_tpu.serving.fleet.health import ReplicaHealth
+        lk = ReplicaHealth()._lock
+        assert isinstance(lk, S._TrackedLock)
+        assert lk._name == "ReplicaHealth._lock"
+
+
+class TestWiringCoverage:
+
+    def test_every_ranked_lock_is_wired_with_its_key(self):
+        """Each LOCK_ORDER key must appear as a tracked_lock() name
+        string somewhere under deepspeed_tpu/ — the static table and
+        the runtime graph must speak the same names."""
+        from tools.graft_lint.linter import LOCK_ORDER
+        pkg = pathlib.Path(S.__file__).resolve().parents[1]
+        sources = [p.read_text() for p in pkg.rglob("*.py")]
+        for key in LOCK_ORDER:
+            assert any(f'"{key}"' in src for src in sources), (
+                f"LOCK_ORDER key {key} has no tracked_lock(..., \"{key}\") "
+                f"wiring in deepspeed_tpu/")

@@ -1,9 +1,14 @@
 """ds_lint command line: lint deepspeed_tpu/ for TPU hazards.
 
-Exit codes: 0 clean, 1 violations, 2 usage/internal error. ``--format
-json`` emits a machine-readable report for CI; ``--list-knobs`` prints
-the DS_* env-knob table from utils/env_registry.py (markdown) instead
-of linting.
+Exit codes: 0 clean, 1 violations, 2 usage/internal error (unknown
+``--only`` rule, malformed baseline). ``--format json`` emits a
+machine-readable report for CI; ``--list-knobs`` prints the DS_*
+env-knob table from utils/env_registry.py (markdown) instead of
+linting; ``--check-docs`` diffs that table against docs/MIGRATING.md
+(the knob-docs rule, standalone); ``--only=rule1,rule2`` restricts the
+run so the tier-1 gate can time rules individually;
+``--update-baseline`` re-lints from scratch and rewrites the baseline
+file with every current violation.
 """
 
 import argparse
@@ -12,12 +17,14 @@ import json
 import os
 import sys
 
-from tools.graft_lint.linter import RULES, lint_paths, load_baseline
+from tools.graft_lint.linter import (KNOB_DOCS, RULES, BaselineError,
+                                     Violation, lint_paths, load_baseline)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
+DEFAULT_KNOB_DOCS = os.path.join(REPO_ROOT, "docs", "MIGRATING.md")
 
 
 def _load_env_registry():
@@ -42,6 +49,57 @@ def format_knobs_markdown():
     return "\n".join(lines)
 
 
+def check_knob_docs(docs_path=None):
+    """knob-docs rule: every knob in env_registry must have a row in
+    the MIGRATING.md generated knob table and vice versa. → list of
+    Violations (symbol = knob name) so drift keys into the baseline
+    machinery like any other rule."""
+    import re
+    docs_path = docs_path or DEFAULT_KNOB_DOCS
+    rel = os.path.relpath(docs_path, REPO_ROOT).replace(os.sep, "/")
+    registered = {k.name for k in _load_env_registry().all_knobs()}
+    try:
+        with open(docs_path) as fd:
+            text = fd.read()
+    except OSError as err:
+        return [Violation(rule=KNOB_DOCS, path=rel, line=1, col=0,
+                          symbol="<file>",
+                          message=f"knob table unreadable: {err}")]
+    documented = {}  # name -> first table-row line number
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = re.match(r"^\| `(DS_[A-Z0-9_]+)` \|", line)
+        if m:
+            documented.setdefault(m.group(1), i)
+    out = []
+    for name in sorted(registered - set(documented)):
+        out.append(Violation(
+            rule=KNOB_DOCS, path=rel, line=1, col=0, symbol=name,
+            message=f"knob {name} is registered in env_registry.py but "
+                    f"missing from the {rel} knob table — regenerate it "
+                    f"with `bin/ds_lint --list-knobs`"))
+    for name in sorted(set(documented) - registered):
+        out.append(Violation(
+            rule=KNOB_DOCS, path=rel, line=documented[name], col=0,
+            symbol=name,
+            message=f"knob {name} is documented in {rel} but no longer "
+                    f"registered in env_registry.py — stale row, "
+                    f"regenerate with `bin/ds_lint --list-knobs`"))
+    return out
+
+
+def write_baseline(path, violations):
+    """Rewrite ``path`` with a suppression entry per current violation
+    (sorted, symbol-keyed — line numbers intentionally absent so the
+    baseline survives unrelated edits)."""
+    entries = sorted({(v.rule, v.path, v.symbol) for v in violations})
+    payload = {"version": 1,
+               "suppressions": [{"rule": r, "path": p, "symbol": s}
+                                for r, p, s in entries]}
+    with open(path, "w") as fd:
+        json.dump(payload, fd, indent=2)
+        fd.write("\n")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ds_lint",
@@ -55,20 +113,64 @@ def main(argv=None):
                              "baseline.json)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="report baselined violations too")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="re-lint ignoring the existing baseline and "
+                             "rewrite it with every current violation")
+    parser.add_argument("--only", default=None, metavar="RULE[,RULE...]",
+                        help="run only these rules (per-rule CI timings)")
     parser.add_argument("--list-knobs", action="store_true",
                         help="print the DS_* env knob table and exit")
+    parser.add_argument("--check-docs", action="store_true",
+                        help="run only the knob-docs rule: diff the env "
+                             "knob registry against the MIGRATING.md table")
     args = parser.parse_args(argv)
 
     if args.list_knobs:
         print(format_knobs_markdown())
         return 0
 
+    only = None
+    if args.only is not None:
+        only = {r.strip() for r in args.only.split(",") if r.strip()}
+        unknown = only - set(RULES)
+        if unknown:
+            print(f"ds_lint: unknown rule(s) {sorted(unknown)} — valid: "
+                  f"{', '.join(RULES)}", file=sys.stderr)
+            return 2
+
+    if args.check_docs:
+        violations = check_knob_docs()
+        for v in violations:
+            print(f"{v.path}:{v.line}: [{v.rule}] {v.symbol}: {v.message}")
+        print(f"ds_lint: {len(violations)} knob-docs violation(s)")
+        return 1 if violations else 0
+
     paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu")]
     baseline = set()
-    if not args.no_baseline and os.path.exists(args.baseline):
-        baseline = load_baseline(args.baseline)
+    if not args.update_baseline and not args.no_baseline \
+            and os.path.exists(args.baseline):
+        try:
+            baseline = load_baseline(args.baseline)
+        except BaselineError as err:
+            print(f"ds_lint: malformed baseline {args.baseline}: {err}",
+                  file=sys.stderr)
+            return 2
     violations, baselined = lint_paths(paths, baseline=baseline,
-                                       root=REPO_ROOT)
+                                       root=REPO_ROOT, only=only)
+    # knob-docs is cross-artifact (registry vs docs), so it runs in the
+    # default whole-repo invocation and under --only, not per-file
+    if not args.paths and (only is None or KNOB_DOCS in only):
+        for v in check_knob_docs():
+            if (v.rule, v.path, v.symbol) in baseline:
+                baselined += 1
+            else:
+                violations.append(v)
+
+    if args.update_baseline:
+        write_baseline(args.baseline, violations)
+        print(f"ds_lint: baseline rewritten with {len(violations)} "
+              f"suppression(s) -> {args.baseline}")
+        return 0
 
     if args.format == "json":
         print(json.dumps({

@@ -17,8 +17,10 @@ HOST_SYNC = "host-sync"
 THREAD_SHARED = "thread-shared-state"
 SPEC_CONSISTENCY = "spec-consistency"
 ENV_REGISTRY = "env-registry"
+LOCK_ORDER_RULE = "lock-order"
+KNOB_DOCS = "knob-docs"  # cross-artifact rule, driven by cli.check_knob_docs
 RULES = (JIT_PURITY, HOST_SYNC, THREAD_SHARED, SPEC_CONSISTENCY,
-         ENV_REGISTRY)
+         ENV_REGISTRY, LOCK_ORDER_RULE, KNOB_DOCS)
 
 # Must mirror deepspeed_tpu/parallel/topology.py MESH_AXES — the linter
 # cannot import the package (no jax at lint time); a unit test asserts
@@ -123,6 +125,108 @@ _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
              "update", "add", "discard", "setdefault", "popitem",
              "difference_update", "appendleft"}
 
+# lock-order: the canonical acquisition order, as CODE. A lock may only
+# be taken while holding locks of strictly LOWER rank; an edge from a
+# higher rank to a lower one is a deadlock-shaped inversion. The two
+# documented orders this encodes: router -> gateway -> engine-side
+# caches, and the kv-tier stack ``manager._lock -> tier._lock ->
+# store._lock`` (tier_manager.py module docstring). Locks not listed
+# here are "unranked": edges touching them are still collected and
+# checked for cycles, just not against a rank.
+LOCK_ORDER = {
+    "FleetRouter._lock": 10,
+    "HandoffManager._lock": 14,
+    "PoolScheduler._lock": 16,
+    "ServingGateway._handoff_lock": 20,
+    "ServingGateway._cancel_lock": 22,
+    "ServingGateway._state_lock": 24,
+    "PrefixCacheManager._lock": 30,
+    "TierManager._lock": 40,
+    "HostKVStore._lock": 50,
+}
+
+# lock-order: which self-attributes point at OTHER registered classes,
+# so ``with self.manager._lock:`` / ``mgr = self.manager; with
+# mgr._lock:`` and ``self.tier.demote(...)`` resolve to the peer
+# class's locks one call level deep.
+CROSS_REFS = {
+    "PrefixCacheManager": {"tier": "TierManager"},
+    "TierManager": {"manager": "PrefixCacheManager", "store": "HostKVStore"},
+    "FleetRouter": {"handoffs": "HandoffManager", "pools": "PoolScheduler"},
+}
+
+# lock-order: per registered class, the methods a PEER may call and the
+# lock keys each acquires (its own and, one level deep, the locks of
+# the objects it calls into). A cross-object call into one of these
+# while holding a lock contributes acquisition edges. The table is kept
+# honest by an in-file drift check (run only on the class's home file,
+# LOCKING_METHODS_HOME): a declared method that no longer exists, a
+# direct self-lock acquisition it fails to declare, or a new public
+# locking method missing from the table are all lock-order violations.
+LOCKING_METHODS = {
+    "TierManager": {
+        "demote": ("TierManager._lock", "HostKVStore._lock"),
+        "probe_chain": ("TierManager._lock", "HostKVStore._lock"),
+        "claim": ("TierManager._lock", "HostKVStore._lock"),
+        "unclaim": ("HostKVStore._lock",),
+        "note_promoted": ("TierManager._lock",),
+        "export_chain": ("PrefixCacheManager._lock", "TierManager._lock"),
+        "import_chain": ("TierManager._lock", "HostKVStore._lock"),
+        "prefetch": ("TierManager._lock", "TierManager._queue_ready"),
+        "wait_prefetch": ("TierManager._lock",),
+        "shutdown": ("TierManager._queue_ready", "TierManager._lock",
+                     "HostKVStore._lock"),
+        "stats": ("TierManager._lock", "HostKVStore._lock"),
+    },
+    "HostKVStore": {
+        "put": ("HostKVStore._lock",),
+        "pop": ("HostKVStore._lock",),
+        "peek": ("HostKVStore._lock",),
+        "contains": ("HostKVStore._lock",),
+        "clear": ("HostKVStore._lock",),
+        "stats": ("HostKVStore._lock",),
+    },
+    "PrefixCacheManager": {
+        "attach_tier": ("PrefixCacheManager._lock",),
+        "ensure_free": ("PrefixCacheManager._lock",),
+        "reserve": ("PrefixCacheManager._lock",),
+        "acquire": ("PrefixCacheManager._lock", "TierManager._lock",
+                    "HostKVStore._lock"),
+        "match_len": ("PrefixCacheManager._lock", "TierManager._lock",
+                      "HostKVStore._lock"),
+        "release_lease": ("PrefixCacheManager._lock",),
+        "release": ("PrefixCacheManager._lock", "TierManager._lock",
+                    "HostKVStore._lock"),
+    },
+}
+
+# Drift-check scope: the file that actually defines each class above.
+# Fixture/test files re-declaring the class name are not held to the
+# table (they exercise the analysis, not the real inventory).
+LOCKING_METHODS_HOME = {
+    "TierManager": "inference/v2/kv_tier/tier_manager.py",
+    "HostKVStore": "inference/v2/kv_tier/host_store.py",
+    "PrefixCacheManager": "inference/v2/prefix_cache/manager.py",
+}
+
+# lock-order: registered-class methods that can BLOCK (fence waits,
+# worker joins) — calling one through a cross-ref while holding any
+# lock is a blocking-under-lock violation even though the blocking call
+# itself is one level down.
+BLOCKING_METHODS = {
+    "TierManager": {"wait_prefetch", "shutdown"},
+    "ServingGateway": {"drain", "close"},
+    "FleetRouter": {"drain", "shutdown"},
+}
+
+# Blocking-call heuristics for the in-method walk.
+_BLOCKING_DOTTED = {"jax.device_get", "jax.block_until_ready",
+                    "subprocess.run", "subprocess.call",
+                    "subprocess.check_call", "subprocess.check_output",
+                    "os.waitpid"}
+_JOIN_RECEIVER_HINTS = ("thread", "worker", "relay", "pump", "agent")
+_SLEEP_UNDER_LOCK_THRESHOLD_S = 0.01
+
 # spec-consistency dtype-leak scope (fp32 Python constants materialized
 # as arrays in bf16 arithmetic): kernel and model code only (plus the
 # grouped-GEMM dispatch, which sits one level up from ops/pallas but
@@ -193,15 +297,34 @@ def _parse_pragmas(source):
     return pragmas
 
 
+class BaselineError(ValueError):
+    """Malformed or unsupported baseline.json (typed so the CLI can
+    turn it into a clean exit-2 instead of a traceback)."""
+
+
 def load_baseline(path):
     """tools/graft_lint/baseline.json → set of (rule, path, symbol)
     triples. Line numbers are deliberately not part of the key."""
     with open(path) as fd:
-        data = json.load(fd)
+        try:
+            data = json.load(fd)
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"baseline {path} is not valid JSON: {e}")
+    if not isinstance(data, dict):
+        raise BaselineError(f"baseline {path} must be a JSON object, "
+                            f"got {type(data).__name__}")
     if data.get("version") != 1:
-        raise ValueError(f"unsupported baseline version in {path}")
-    return {(e["rule"], e["path"], e.get("symbol", "")) for e in
-            data.get("suppressions", ())}
+        raise BaselineError(f"unsupported baseline version in {path}")
+    entries = data.get("suppressions", ())
+    if not isinstance(entries, list):
+        raise BaselineError(f"baseline {path} 'suppressions' must be a list")
+    out = set()
+    for e in entries:
+        if not isinstance(e, dict) or "rule" not in e or "path" not in e:
+            raise BaselineError(f"baseline {path} entry {e!r} needs "
+                                f"'rule' and 'path' keys")
+        out.add((e["rule"], e["path"], e.get("symbol", "")))
+    return out
 
 
 # --------------------------------------------------------------- the pass
@@ -214,6 +337,9 @@ class FileLinter:
         self.source = source
         self.tree = ast.parse(source, filename=path)
         self.violations = []
+        # surviving lock-acquisition edges (rank-clean, unpragma'd) for
+        # the cross-file cycle pass run by lint_paths/lint_file
+        self.lock_edges = []
         # parent / scope bookkeeping filled by _annotate
         self._parents = {}
         self._qualnames = {}
@@ -540,13 +666,411 @@ class FileLinter:
                            f"deepspeed_tpu/utils/env_registry.py — use "
                            f"env_bool/env_int/env_str/env_raw")
 
+    # -- rule 6: lock-order ------------------------------------------------
+    def check_lock_order(self):
+        """Per registered class, walk each method with a held-lock stack
+        and (a) emit acquisition edges checked against LOCK_ORDER (rank
+        inversions flagged here; surviving edges collected on
+        ``self.lock_edges`` for cross-file cycle detection), (b) flag
+        blocking calls reached while any lock is held, (c) flag
+        re-acquisition of a non-reentrant lock, (d) keep the declared
+        LOCKING_METHODS table honest on each class's home file."""
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if cls.name not in THREAD_SHARED_REGISTRY:
+                continue
+            locks, cond_target = self._discover_locks(cls)
+            methods = [m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            summaries = {m.name: self._method_lock_summary(cls.name, m, locks,
+                                                           cond_target)
+                         for m in methods}
+            self._check_locking_methods_drift(cls, methods, summaries)
+            for method in methods:
+                if method.name == "__init__":
+                    continue  # not yet published; lock wiring lives here
+                ctx = {"cls": cls.name, "locks": locks,
+                       "cond_target": cond_target, "aliases": {},
+                       "held": [], "summaries": summaries}
+                if method.name.endswith("_locked") and "_lock" in locks:
+                    # caller-holds-the-lock convention: analyze the body
+                    # as if the class's primary lock is already held
+                    ctx["held"].append({"key": f"{cls.name}._lock",
+                                        "kind": locks["_lock"],
+                                        "seed": True})
+                self._walk_lock_stmts(method.body, ctx)
+
+    # lock discovery -------------------------------------------------------
+    def _discover_locks(self, cls):
+        """``__init__`` assignments → {attr: 'lock'|'rlock'|'condition'}
+        plus {condition attr: underlying lock attr} (a ``Condition(self.X)``
+        aliases X; a bare ``Condition()`` owns its lock — reentrant).
+        ``tracked_lock(...)`` wrappers (the DS_SANITIZE runtime twin) are
+        unwrapped to the real constructor."""
+        locks, cond_target = {}, {}
+        init = next((m for m in cls.body if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            return locks, cond_target
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            attr = _self_attr(node.targets[0])
+            if attr is None:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and \
+                    _last(_dotted(value.func)) == "tracked_lock" and value.args:
+                value = value.args[0]
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = _last(_dotted(value.func))
+            if ctor == "Lock":
+                locks[attr] = "lock"
+            elif ctor == "RLock":
+                locks[attr] = "rlock"
+            elif ctor == "Condition":
+                locks[attr] = "condition"
+                tgt = _self_attr(value.args[0]) if value.args else None
+                cond_target[attr] = tgt if tgt else attr
+        return locks, cond_target
+
+    def _resolve_lock(self, expr, ctx):
+        """→ (lock key 'Class.attr', kind, local attr) or None. Handles
+        ``self.X`` (declared locks and *lock*-named fallbacks),
+        ``self.ref._lock`` through CROSS_REFS, local object/lock
+        aliases, and ``self.X.acquire*()`` call forms."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr.startswith("acquire"):
+                expr = f.value
+            else:
+                return None
+        d = _dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        locks, cond_target = ctx["locks"], ctx["cond_target"]
+        if parts[0] == "self" and len(parts) == 2:
+            attr = parts[1]
+            if attr in locks:
+                target = cond_target.get(attr, attr)
+                kind = locks.get(target, locks[attr])
+                return (f"{ctx['cls']}.{target}", kind, attr)
+            if "lock" in attr.lower():
+                return (f"{ctx['cls']}.{attr}", "unknown", attr)
+            return None
+        if parts[0] == "self" and len(parts) == 3:
+            peer = CROSS_REFS.get(ctx["cls"], {}).get(parts[1])
+            if peer and "lock" in parts[2].lower():
+                return (f"{peer}.{parts[2]}", "unknown", parts[2])
+            return None
+        if len(parts) == 2 and parts[0] in ctx["aliases"]:
+            akind, val = ctx["aliases"][parts[0]]
+            if akind == "obj" and "lock" in parts[1].lower():
+                return (f"{val}.{parts[1]}", "unknown", parts[1])
+            return None
+        if len(parts) == 1 and parts[0] in ctx["aliases"]:
+            akind, val = ctx["aliases"][parts[0]]
+            if akind == "lock":
+                return val
+        return None
+
+    def _resolve_peer(self, recv, ctx):
+        """Receiver expression → peer registered class name, via
+        CROSS_REFS (``self.tier``) or a tracked local alias."""
+        d = _dotted(recv)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            return CROSS_REFS.get(ctx["cls"], {}).get(parts[1])
+        if len(parts) == 1 and parts[0] in ctx["aliases"]:
+            akind, val = ctx["aliases"][parts[0]]
+            if akind == "obj":
+                return val
+        return None
+
+    def _method_lock_summary(self, cls_name, method, locks, cond_target):
+        """Locks this method DIRECTLY acquires (``with``/``.acquire()``
+        on self locks) — the one-level summary intra-class calls and the
+        LOCKING_METHODS drift check consume."""
+        ctx = {"cls": cls_name, "locks": locks, "cond_target": cond_target,
+               "aliases": {}}
+        out = set()
+        for node in ast.walk(method):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    res = self._resolve_lock(item.context_expr, ctx)
+                    if res:
+                        out.add(res[:2])
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire":
+                res = self._resolve_lock(node, ctx)
+                if res:
+                    out.add(res[:2])
+        return out
+
+    def _check_locking_methods_drift(self, cls, methods, summaries):
+        declared = LOCKING_METHODS.get(cls.name)
+        home = LOCKING_METHODS_HOME.get(cls.name)
+        if not declared or not home or not self.relpath.endswith(home):
+            return
+        by_name = {m.name: m for m in methods}
+        prefix = cls.name + "."
+        for mname, keys in sorted(declared.items()):
+            if mname not in by_name:
+                self._emit(LOCK_ORDER_RULE, cls,
+                           f"LOCKING_METHODS declares {cls.name}.{mname} "
+                           f"which no longer exists — update the table in "
+                           f"tools/graft_lint/linter.py")
+                continue
+            direct_self = {key for key, _kind in summaries.get(mname, ())
+                           if key.startswith(prefix)}
+            missing = direct_self - set(keys)
+            if missing:
+                self._emit(LOCK_ORDER_RULE, by_name[mname],
+                           f"{cls.name}.{mname} acquires "
+                           f"{sorted(missing)} not declared in "
+                           f"LOCKING_METHODS — update the table")
+        for mname, m in sorted(by_name.items()):
+            if mname.startswith("_") or mname in declared:
+                continue
+            self_locks = {key for key, _kind in summaries.get(mname, ())
+                          if key.startswith(prefix)}
+            if self_locks:
+                self._emit(LOCK_ORDER_RULE, m,
+                           f"public locking method {cls.name}.{mname} "
+                           f"(acquires {sorted(self_locks)}) is missing "
+                           f"from LOCKING_METHODS — peers calling it "
+                           f"under a lock would be invisible to the "
+                           f"deadlock analysis")
+
+    # held-stack statement walk -------------------------------------------
+    def _walk_lock_stmts(self, stmts, ctx):
+        for stmt in stmts:
+            self._walk_lock_stmt(stmt, ctx)
+
+    def _walk_lock_stmt(self, stmt, ctx):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs run later, not under these locks
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                res = self._resolve_lock(item.context_expr, ctx)
+                if res is not None:
+                    self._note_acquisition(res, item.context_expr, ctx)
+                    pushed += 1
+                else:
+                    self._scan_exprs(item.context_expr, ctx)
+            self._walk_lock_stmts(stmt.body, ctx)
+            for _ in range(pushed):
+                ctx["held"].pop()
+            return
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            self._track_alias(stmt, ctx)
+        # scan this statement's own expressions (not nested blocks)
+        for field, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                self._scan_exprs(value, ctx)
+            elif isinstance(value, list):
+                for el in value:
+                    if isinstance(el, ast.expr):
+                        self._scan_exprs(el, ctx)
+        # then recurse into nested statement blocks
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(stmt, field, None)
+            if block:
+                self._walk_lock_stmts(block, ctx)
+        for handler in getattr(stmt, "handlers", ()):
+            self._walk_lock_stmts(handler.body, ctx)
+
+    def _track_alias(self, stmt, ctx):
+        name = stmt.targets[0].id
+        ctx["aliases"].pop(name, None)
+        attr = _self_attr(stmt.value)
+        if attr is None:
+            return
+        peer = CROSS_REFS.get(ctx["cls"], {}).get(attr)
+        if peer is not None:
+            ctx["aliases"][name] = ("obj", peer)
+        elif attr in ctx["locks"] or "lock" in attr.lower():
+            res = self._resolve_lock(stmt.value, ctx)
+            if res is not None:
+                ctx["aliases"][name] = ("lock", res)
+
+    def _note_acquisition(self, res, node, ctx, via_call=False):
+        key, kind, _attr = res
+        held = ctx["held"]
+        if any(e["key"] == key for e in held):
+            if kind == "lock":
+                self._emit(LOCK_ORDER_RULE, node,
+                           f"re-acquisition of non-reentrant {key} while "
+                           f"already held — this deadlocks (use an RLock "
+                           f"or restructure)")
+            held.append({"key": key, "kind": kind, "via_call": via_call})
+            return
+        for e in held:
+            self._note_edge(e["key"], key, node, ctx)
+        held.append({"key": key, "kind": kind, "via_call": via_call})
+
+    def _note_edge(self, src, dst, node, ctx):
+        if src == dst:
+            return
+        rs, rd = LOCK_ORDER.get(src), LOCK_ORDER.get(dst)
+        if rs is not None and rd is not None and rs > rd:
+            self._emit(LOCK_ORDER_RULE, node,
+                       f"acquires {dst} while holding {src} — inverts the "
+                       f"canonical lock order ({dst} rank {rd} is taken "
+                       f"BEFORE {src} rank {rs}; see LOCK_ORDER in "
+                       f"tools/graft_lint/linter.py)")
+            return  # already reported; keep it out of the cycle graph
+        self.lock_edges.append({
+            "src": src, "dst": dst, "path": self.relpath,
+            "line": node.lineno, "col": getattr(node, "col_offset", 0),
+            "symbol": self._enclosing_symbol(node)})
+
+    def _scan_exprs(self, expr, ctx):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._scan_lock_call(node, ctx)
+
+    def _scan_lock_call(self, call, ctx):
+        held = ctx["held"]
+        dotted = _dotted(call.func)
+        if not isinstance(call.func, ast.Attribute):
+            return
+        meth = call.func.attr
+        recv = call.func.value
+        # explicit acquire()/release() pairs
+        if meth == "acquire":
+            res = self._resolve_lock(call, ctx)
+            if res is not None:
+                self._note_acquisition(res, call, ctx, via_call=True)
+                return
+        elif meth == "release":
+            res = self._resolve_lock(
+                ast.Call(func=ast.Attribute(value=recv, attr="acquire",
+                                            ctx=ast.Load()),
+                         args=[], keywords=[]), ctx)
+            if res is not None:
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i]["key"] == res[0] and held[i].get("via_call"):
+                        del held[i]
+                        break
+                return
+        if not held:
+            return
+        held_keys = [e["key"] for e in held]
+        held_desc = ", ".join(dict.fromkeys(held_keys))
+        # blocking-call heuristics ------------------------------------
+        recv_d = (_dotted(recv) or "").lower()
+        if meth == "join" and any(h in recv_d for h in _JOIN_RECEIVER_HINTS):
+            self._emit(LOCK_ORDER_RULE, call,
+                       f"Thread.join on {_dotted(recv)} while holding "
+                       f"{held_desc} — joining a thread that may need the "
+                       f"lock is a deadlock; join outside the lock")
+            return
+        if meth == "get" and not call.args and not call.keywords and \
+                recv_d != "self":
+            self._emit(LOCK_ORDER_RULE, call,
+                       f"blocking .get() (no timeout) on {_dotted(recv)} "
+                       f"while holding {held_desc}")
+            return
+        if meth == "wait" and not self._wait_is_timed(call):
+            if not self._wait_is_condition_of_held(recv, ctx):
+                self._emit(LOCK_ORDER_RULE, call,
+                           f"untimed .wait() on {_dotted(recv)} while "
+                           f"holding {held_desc} — only a Condition of "
+                           f"the (sole) held lock may wait under it")
+            return
+        if meth == "communicate" and \
+                not any(kw.arg == "timeout" for kw in call.keywords):
+            self._emit(LOCK_ORDER_RULE, call,
+                       f"subprocess communicate() while holding "
+                       f"{held_desc}")
+            return
+        if meth == "block_until_ready" or dotted in _BLOCKING_DOTTED:
+            self._emit(LOCK_ORDER_RULE, call,
+                       f"device sync / process wait ({dotted or meth}) "
+                       f"while holding {held_desc}")
+            return
+        if dotted == "time.sleep":
+            arg = call.args[0] if call.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, (int, float))
+                    and arg.value <= _SLEEP_UNDER_LOCK_THRESHOLD_S):
+                self._emit(LOCK_ORDER_RULE, call,
+                           f"time.sleep under {held_desc} stalls every "
+                           f"thread contending for the lock")
+            return
+        # call resolution, one level deep -----------------------------
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            summary = ctx["summaries"].get(meth)
+            if summary:
+                for key, kind in sorted(summary):
+                    if key in held_keys:
+                        if kind == "lock":
+                            self._emit(LOCK_ORDER_RULE, call,
+                                       f"call to self.{meth}() re-acquires "
+                                       f"non-reentrant {key} already held "
+                                       f"by this method")
+                        continue
+                    self._note_edge(held_keys[-1], key, call, ctx)
+            return
+        peer = self._resolve_peer(recv, ctx)
+        if peer is None:
+            return
+        if meth in BLOCKING_METHODS.get(peer, ()):
+            self._emit(LOCK_ORDER_RULE, call,
+                       f"call to blocking {peer}.{meth}() while holding "
+                       f"{held_desc}")
+            return
+        for key in LOCKING_METHODS.get(peer, {}).get(meth, ()):
+            if key in held_keys:
+                continue
+            self._note_edge(held_keys[-1], key, call, ctx)
+
+    @staticmethod
+    def _wait_is_timed(call):
+        if call.args:
+            a = call.args[0]
+            return not (isinstance(a, ast.Constant) and a.value is None)
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return not (isinstance(kw.value, ast.Constant)
+                            and kw.value.value is None)
+        return False
+
+    def _wait_is_condition_of_held(self, recv, ctx):
+        """Untimed Condition.wait is legal exactly when the condition's
+        underlying lock is the ONLY lock held: the wait releases it, so
+        nothing stays pinned while sleeping."""
+        attr = _self_attr(recv)
+        if attr is None or ctx["locks"].get(attr) != "condition":
+            return False
+        target = ctx["cond_target"].get(attr, attr)
+        target_key = f"{ctx['cls']}.{target}"
+        return {e["key"] for e in ctx["held"]} == {target_key}
+
     # -- driver ------------------------------------------------------------
-    def run(self):
-        self.check_jit_purity()
-        self.check_host_sync()
-        self.check_thread_shared()
-        self.check_spec_consistency()
-        self.check_env_registry()
+    def run(self, only=None):
+        checks = {
+            JIT_PURITY: self.check_jit_purity,
+            HOST_SYNC: self.check_host_sync,
+            THREAD_SHARED: self.check_thread_shared,
+            SPEC_CONSISTENCY: self.check_spec_consistency,
+            ENV_REGISTRY: self.check_env_registry,
+            LOCK_ORDER_RULE: self.check_lock_order,
+        }
+        for rule, check in checks.items():
+            if only is None or rule in only:
+                check()
         pragmas = _parse_pragmas(self.source)
         kept = []
         for v in self.violations:
@@ -555,15 +1079,80 @@ class FileLinter:
                 continue
             kept.append(v)
         kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        # pragma'd edges leave the cycle graph too — a suppressed
+        # acquisition site must not resurrect as a cycle report
+        self.lock_edges = [
+            e for e in self.lock_edges
+            if LOCK_ORDER_RULE not in pragmas.get(e["line"], ())
+            and "all" not in pragmas.get(e["line"], ())]
         return kept
 
 
-def lint_file(path, source=None, relpath=None):
-    """All unsuppressed-by-pragma violations for one file."""
+def lock_cycle_violations(edges):
+    """Cycle detection over merged acquisition edges. ``edges`` is a list
+    of {src, dst, path, line, col, symbol} dicts; a DFS back-edge means
+    two lock keys can be taken in both orders somewhere in the repo —
+    each distinct cycle (deduped by its node set) is reported once,
+    anchored at the back-edge acquisition site."""
+    graph = {}
+    sites = {}
+    for e in edges:
+        graph.setdefault(e["src"], set()).add(e["dst"])
+        graph.setdefault(e["dst"], set())
+        sites.setdefault((e["src"], e["dst"]), e)
+    violations = []
+    seen_cycles = set()
+    color = {}  # node -> 1 (on stack) | 2 (done)
+    stack = []
+
+    def dfs(node):
+        color[node] = 1
+        stack.append(node)
+        for nxt in sorted(graph[node]):
+            if color.get(nxt) == 1:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    site = sites[(node, nxt)]
+                    violations.append(Violation(
+                        rule=LOCK_ORDER_RULE, path=site["path"],
+                        line=site["line"], col=site["col"],
+                        symbol=site["symbol"],
+                        message=("lock-acquisition cycle "
+                                 + " -> ".join(cycle)
+                                 + " — two code paths take these locks "
+                                   "in opposite orders; assign ranks in "
+                                   "LOCK_ORDER and fix the inversion")))
+            elif color.get(nxt) != 2:
+                dfs(nxt)
+        stack.pop()
+        color[node] = 2
+
+    for node in sorted(graph):
+        if node not in color:
+            dfs(node)
+    return violations
+
+
+def _lint_one(path, source, relpath, only=None):
+    """→ (violations, lock_edges) for one file, pragma-filtered."""
+    linter = FileLinter(path, source, relpath=relpath)
+    return linter.run(only=only), linter.lock_edges
+
+
+def lint_file(path, source=None, relpath=None, only=None):
+    """All unsuppressed-by-pragma violations for one file, including a
+    per-file lock-cycle pass (lint_paths instead runs one merged pass
+    over every file so cross-file cycles surface)."""
     if source is None:
         with open(path) as fd:
             source = fd.read()
-    return FileLinter(path, source, relpath=relpath).run()
+    violations, edges = _lint_one(path, source, relpath, only=only)
+    if only is None or LOCK_ORDER_RULE in only:
+        violations = violations + lock_cycle_violations(edges)
+        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
 
 
 def _iter_py_files(paths):
@@ -579,16 +1168,29 @@ def _iter_py_files(paths):
                         yield os.path.join(dirpath, fn)
 
 
-def lint_paths(paths, baseline=None, root=None):
+def lint_paths(paths, baseline=None, root=None, only=None):
     """Lint every .py file under ``paths``. → (violations, baselined)
     where ``baselined`` counts suppressions consumed from the baseline
-    set of (rule, relpath, symbol) triples."""
+    set of (rule, relpath, symbol) triples. Lock-acquisition edges are
+    merged across ALL files before the single cycle pass — an inversion
+    in kv_tier/ against an order established in serving/ is a cycle."""
     baseline = baseline or set()
     root = root or os.getcwd()
     violations, baselined = [], 0
+    all_edges = []
     for path in _iter_py_files(paths):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
-        for v in lint_file(path, relpath=rel):
+        with open(path) as fd:
+            source = fd.read()
+        file_violations, edges = _lint_one(path, source, rel, only=only)
+        all_edges.extend(edges)
+        for v in file_violations:
+            if (v.rule, v.path, v.symbol) in baseline:
+                baselined += 1
+                continue
+            violations.append(v)
+    if only is None or LOCK_ORDER_RULE in only:
+        for v in lock_cycle_violations(all_edges):
             if (v.rule, v.path, v.symbol) in baseline:
                 baselined += 1
                 continue
