@@ -99,33 +99,16 @@ class InferenceEngineV2:
         # (structure-preserving) layout so each quantized carrier takes the
         # leaf's own PartitionSpec.
         qmode = getattr(self._config.quantization, "quantization_mode", "none")
+        self._qmode = qmode
         self._quantized = bool(qmode and qmode != "none")
-        if self._quantized:
-            # One jitted program with the source donated so XLA frees each
-            # full-precision leaf as its carrier forms — no full-tree +
-            # carriers memory spike. Donation is safe when the engine owns
-            # the tree: it built the params itself, or every caller leaf is
-            # a host array whose jnp.asarray device copy is exclusively
-            # ours (an existing jax.Array would be returned as-is and must
-            # not be deleted out from under the caller).
-            from deepspeed_tpu.inference.quantization.quantization import \
-                quantize_params_tree
-            owns = engine_owns_params or all(
-                not isinstance(leaf, jax.Array) for leaf in jax.tree.leaves(params))
-            params = jax.tree.map(jnp.asarray, params)
-            params = jax.jit(
-                lambda p: quantize_params_tree(p, qmode, dequant_dtype=dtype),
-                donate_argnums=(0,) if owns else ())(params)
-
-        if self.mesh is not None:
-            from deepspeed_tpu.inference.v2.sharding import shard_params, tp_rule_for
-            self.params = shard_params(params, self.mesh, tp_rule_for(cfg), dtype=dtype)
-        else:
-            from deepspeed_tpu.inference.quantization import QuantizedWeight
-            self.params = jax.tree.map(
-                lambda x: x if isinstance(x, QuantizedWeight)
-                else x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+        owns = engine_owns_params or all(
+            not isinstance(leaf, jax.Array) for leaf in jax.tree.leaves(params))
+        self.params = self._place_params(params, owns)
+        # monotone weight-version tag: bumped by swap_params (live weight
+        # refresh); stamped into the prefix trie's root key so every
+        # cached KV identity — and every exported handoff record — is
+        # version-tagged (version 0 == the trie's historical root key)
+        self.weight_version = 0
 
         self.max_tokens = int(sm.max_ragged_batch_size)
         self.max_seqs = int(sm.max_ragged_sequence_count)
@@ -295,6 +278,68 @@ class InferenceEngineV2:
                     f"max_seqs={self.max_seqs} kv_blocks={num_blocks} "
                     f"block_size={self.block_size} tp={tp} ep={ep} "
                     f"kv_bytes={self.kv_cache.bytes()/1e6:.1f}MB")
+
+    # ------------------------------------------------------------------
+    def _place_params(self, params, owns):
+        """Quantize/shard/cast a raw param tree into serving placement —
+        the constructor's path, reused verbatim by :meth:`swap_params` so
+        refreshed weights land bit-identical to a cold start."""
+        if self._quantized:
+            # One jitted program with the source donated so XLA frees each
+            # full-precision leaf as its carrier forms — no full-tree +
+            # carriers memory spike. Donation is safe when the engine owns
+            # the tree: it built the params itself, or every caller leaf is
+            # a host array whose jnp.asarray device copy is exclusively
+            # ours (an existing jax.Array would be returned as-is and must
+            # not be deleted out from under the caller).
+            from deepspeed_tpu.inference.quantization.quantization import \
+                quantize_params_tree
+            params = jax.tree.map(jnp.asarray, params)
+            params = jax.jit(
+                lambda p: quantize_params_tree(p, self._qmode,
+                                               dequant_dtype=self.dtype),
+                donate_argnums=(0,) if owns else ())(params)
+        if self.mesh is not None:
+            from deepspeed_tpu.inference.v2.sharding import shard_params, tp_rule_for
+            return shard_params(params, self.mesh, tp_rule_for(self.model_config),
+                                dtype=self.dtype)
+        from deepspeed_tpu.inference.quantization import QuantizedWeight
+        return jax.tree.map(
+            lambda x: x if isinstance(x, QuantizedWeight)
+            else x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+
+    def swap_params(self, new_params, version):
+        """Live weight refresh: adopt ``new_params`` in place, bumping
+        :attr:`weight_version` and invalidating every piece of KV derived
+        from the old weights (prefix trie, tier-2 store, staged copies,
+        suspended host KV). Donated-buffer-safe by construction: no
+        jitted step donates the params argument (``donate_argnums``
+        covers only the KV pool), so rebinding ``self.params`` can never
+        race a compiled program over freed buffers — and the compiled
+        programs themselves are shape-stable, so NOTHING recompiles.
+
+        PUMP-THREAD ONLY and requires an idle engine (no tracked or
+        suspended sequences): the serving gateway quiesces in-flight
+        work before calling this. Returns the adopted version."""
+        if self.state_manager is None:
+            raise RuntimeError("swap_params on a destroyed engine")
+        if self.state_manager.n_tracked_sequences:
+            raise RuntimeError(
+                f"swap_params with {self.state_manager.n_tracked_sequences} "
+                f"live sequence(s) — quiesce the engine first")
+        if self._suspended:
+            raise RuntimeError(
+                f"swap_params with {len(self._suspended)} suspended "
+                f"sequence(s) — their host KV predates the new weights")
+        version = int(version)
+        owns = all(not isinstance(leaf, jax.Array)
+                   for leaf in jax.tree.leaves(new_params))
+        self.params = self._place_params(new_params, owns)
+        self.weight_version = version
+        if self.prefix_cache is not None:
+            self.prefix_cache.invalidate_for_version(version)
+        return version
 
     # ------------------------------------------------------------------
     def put(self, batch_uids, batch_tokens, do_checks=True, sample=None):

@@ -337,6 +337,18 @@ class TierManager:
                     self._staged.popitem(last=False)
                 self.prefetched_blocks += 1
 
+    def invalidate(self):
+        """Drop every tier-2 record, staged device copy, and prefetch
+        fence (weight refresh: KV gathered under the previous weights
+        must never extend a prompt under the new ones). Unlike
+        :meth:`shutdown` the worker stays alive — only content goes."""
+        with self._lock:
+            for ev in self._inflight.values():
+                ev.set()  # never strand an acquire on dropped staging
+            self._inflight.clear()
+            self._staged.clear()
+        self.store.clear()
+
     def shutdown(self):
         """Stop the worker and drop staged/stored state (engine
         destroy)."""

@@ -207,6 +207,28 @@ class PrefixCacheManager:
         tier.note_promoted(len(claimed))
         self._check()
 
+    def invalidate_for_version(self, version):
+        """Weight-refresh invalidation: drop EVERY cached block (both the
+        trie and, via the attached tier, the host store) and re-key the
+        trie root with the new weight version. All chained keys derive
+        from the root key, so post-refresh cached identities — and the
+        ``root_key`` stamped into exported handoff records — are version-
+        tagged: a record exported under version N fails the importing
+        replica's root-key check under version N+1 (typed reject, nothing
+        adopted). Requires an idle cache (no outstanding leases): the
+        gateway quiesces in-flight sequences before swapping weights."""
+        with self._lock:
+            if self._leases:
+                raise RuntimeError(
+                    f"prefix-cache invalidation with {len(self._leases)} "
+                    f"lease(s) outstanding — quiesce in-flight sequences first")
+            freed = self.index.clear(new_root_key=int(version))
+            if freed:
+                self.kv_cache.free(freed)
+            if self.tier is not None:
+                self.tier.invalidate()
+            self._check()
+
     def match_len(self, prompt_tokens):
         """Read-only probe: how many leading tokens of ``prompt_tokens``
         this cache already holds. Takes no lease, bumps no refcount and

@@ -132,6 +132,32 @@ class RadixPrefixIndex:
         self._ref0 -= 1
         self.evictions += 1
 
+    def clear(self, new_root_key=None):
+        """Drop EVERY cached node (weight-refresh invalidation: KV built
+        under the old weights must never be matched again) and optionally
+        re-key the root. The chained keys of all future insertions derive
+        from the root key, so re-keying it to the weight version makes
+        every cached identity — and every handoff record exported from
+        here — version-tagged. Requires an idle trie (no referenced
+        nodes); returns the freed physical block ids."""
+        blocks = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for bucket in node.children.values():
+                for child in bucket:
+                    assert child.ref == 0, \
+                        "prefix-cache clear with a live lease outstanding"
+                    blocks.append(child.block_id)
+                    stack.append(child)
+        self.root.children = {}
+        self.evictions += self.num_nodes
+        self.num_nodes = 0
+        self._ref0 = 0
+        if new_root_key is not None:
+            self.root.key = new_root_key
+        return blocks
+
     def evict(self, n_blocks, protect=frozenset()):
         """Free up to ``n_blocks`` cached blocks: repeatedly drop the
         least-recently-used ref-0 LEAF (cascading — a parent becomes a

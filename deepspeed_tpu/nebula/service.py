@@ -19,8 +19,9 @@ split (snapshot-then-persist):
 Commit protocol — crash-safe at every point:
 
 1. all files are written under ``<save_dir>/.nebula_tmp/<tag>/``;
-2. a manifest (``nebula_manifest.json``) naming every file and its byte
-   size is written into the temp dir (tmp + ``os.replace``);
+2. a manifest (``nebula_manifest.json``) naming every file with its byte
+   size and sha256 content hash is written into the temp dir (tmp +
+   ``os.replace``);
 3. the temp dir is promoted to ``<save_dir>/<tag>`` (``os.rename``);
 4. the ``latest`` pointer is rotated (tmp + ``os.replace``);
 5. retention GC removes committed versions beyond
@@ -43,6 +44,7 @@ across the writer threads); manifest/promote/latest/GC run on the
 control-plane rank 0 only.
 """
 
+import hashlib
 import json
 import os
 import shutil
@@ -134,17 +136,31 @@ def read_latest(save_dir):
         return fd.read().strip() or None
 
 
+def file_sha256(path, chunk_bytes=1 << 20):
+    """Streaming sha256 of a file (never loads the shard into memory)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as fd:
+        while True:
+            chunk = fd.read(chunk_bytes)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def write_manifest(tag_dir, tag, extra=None):
-    """Record every file under ``tag_dir`` with its byte size. Written
-    LAST (after all payload files): a manifest's presence means the write
-    finished; its sizes detect truncation after the fact."""
+    """Record every file under ``tag_dir`` with its byte size and sha256
+    content hash. Written LAST (after all payload files): a manifest's
+    presence means the write finished; its sizes detect truncation and
+    its hashes detect bit-level corruption after the fact."""
     files = {}
     for root, _dirs, names in os.walk(tag_dir):
         for name in names:
             if name == MANIFEST_NAME or name.endswith(".tmp"):
                 continue
             full = os.path.join(root, name)
-            files[os.path.relpath(full, tag_dir)] = {"bytes": os.path.getsize(full)}
+            files[os.path.relpath(full, tag_dir)] = {
+                "bytes": os.path.getsize(full), "sha256": file_sha256(full)}
     manifest = {"version": 1, "tag": str(tag), "files": files}
     manifest.update(extra or {})
     tmp = os.path.join(tag_dir, MANIFEST_NAME + ".tmp")
@@ -180,6 +196,14 @@ def validate_tag(save_dir, tag):
             raise CheckpointCorruptionError(
                 full, f"size mismatch for '{rel}': manifest says {info['bytes']} bytes, "
                 f"disk holds {actual} — truncated or overwritten")
+        expected = info.get("sha256")  # legacy manifests recorded sizes only
+        if expected is not None:
+            digest = file_sha256(full)
+            if digest != expected:
+                raise CheckpointCorruptionError(
+                    full, f"content hash mismatch for '{rel}': manifest says "
+                    f"sha256:{expected[:12]}…, disk holds sha256:{digest[:12]}… — "
+                    f"bit-level corruption")
     return manifest
 
 

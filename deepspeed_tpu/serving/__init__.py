@@ -20,6 +20,10 @@ from deepspeed_tpu.serving.fleet import (FaultyReplica, FleetConfig,
                                          ReplicaHealth, get_fleet_config)
 from deepspeed_tpu.serving.gateway import RequestHandle, ServingGateway
 from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.refresh import (CanaryDivergenceError,
+                                           FleetRefreshController,
+                                           WeightPublisher,
+                                           WeightRefreshError)
 
 __all__ = [
     "ServingGateway", "RequestHandle", "ServingConfig", "get_serving_config",
@@ -30,4 +34,6 @@ __all__ = [
     "FleetRouter", "FleetConfig", "get_fleet_config", "Replica",
     "GatewayReplica", "FaultyReplica", "ReplicaHealth",
     "PoolScheduler", "HandoffManager", "HandoffFailedError",
+    "WeightPublisher", "FleetRefreshController",
+    "WeightRefreshError", "CanaryDivergenceError",
 ]

@@ -82,6 +82,24 @@ class FleetConfig(DeepSpeedConfigModel):
     disagg_recover_after: int = Field(2, ge=1)
     disagg_probe_every: int = Field(4, ge=1)
 
+    # -- live weight refresh ------------------------------------------
+    # canary gate: verify the first refreshed replica's greedy output
+    # bit-identically against a cold-started engine on the new weights
+    # before the rollout proceeds (DS_REFRESH_CANARY overrides, tri-
+    # state, wins both ways)
+    refresh_canary: bool = True
+    # per-replica budget for a staged weight swap to land; a replica
+    # that blows it is retried and eventually demoted, never rolled
+    # back fleet-wide (DS_REFRESH_TIMEOUT_S overrides when > 0)
+    refresh_timeout_s: float = Field(30.0, gt=0)
+    # consecutive refresh attempts a replica may fail to converge to
+    # the target version before it is demoted through the health state
+    # machine (fatal failure -> DOWN, half-open probing takes over)
+    refresh_demote_after: int = Field(2, ge=1)
+    # greedy tokens per canary prompt; small keeps the gate cheap,
+    # but it must be >= 1 so divergence is observable at all
+    refresh_canary_max_new: int = Field(8, ge=1)
+
     # -- request defaults (resolved at the ROUTER so every failover
     #    attempt replays with identical parameters even across replicas
     #    with different ServingConfig defaults) -----------------------

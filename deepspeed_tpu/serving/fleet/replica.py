@@ -105,6 +105,19 @@ class Replica:
         caller (typed retryable errors), drain active work, rebuild."""
         raise NotImplementedError
 
+    def refresh(self, params, version, timeout=None):
+        """Stage a live weight swap: adopt ``params`` as weight version
+        ``version`` WITHOUT draining — in-flight streams finish on the
+        old weights, queued requests wait out the swap, nothing is
+        shed. Returns the adopted version; raises on failure with
+        nothing adopted."""
+        raise NotImplementedError
+
+    def weight_version(self):
+        """The weight version this replica currently serves (0 =
+        as-built weights, never refreshed)."""
+        return 0
+
     def stats(self):
         return {}
 
@@ -210,6 +223,12 @@ class GatewayReplica(Replica):
             self.restarts += 1
         self._build()
 
+    def refresh(self, params, version, timeout=None):
+        return self.gateway.refresh_weights(params, version, timeout=timeout)
+
+    def weight_version(self):
+        return int(self.gateway.weight_version)
+
     def stats(self):
         out = dict(self.gateway.inflight())
         out["restarts"] = self.restarts
@@ -250,17 +269,34 @@ class FaultyReplica(Replica):
     - ``crash_after_publish=True``: the record IS returned, then the
       replica dies — the crash-after-publish-before-ack window.
 
+    Live-weight-refresh faults (hybrid-engine rollout), composable
+    with all of the above:
+
+    - ``refresh_torn=True``: ``refresh`` raises
+      :class:`WeightPublicationError` without adopting anything — the
+      torn/forged publication reaching a replica.
+    - ``crash_mid_swap=True``: ``refresh`` kills the replica mid-swap
+      (old weights gone from the replica's point of view) — the
+      controller must roll the fleet back.
+    - ``lie_version=True``: ``refresh`` adopts NOTHING but
+      ``weight_version()`` reports the requested version — the
+      version-report lie only the canary gate can catch.
+    - ``slow_adopt_s=s``: ``refresh`` sleeps ``s`` before delegating —
+      set it past the refresh timeout to exercise demotion.
+
     - ``hook``: a ``FaultInjector``-shaped callable ``hook(point,
       detail)`` invoked at ``("submit", i)``, ``("token", j)``,
-      ``("handoff", uid)`` and ``("probe", None)``; anything it raises
-      kills the replica. This is how the shared checkpoint fault
-      harness drives serving faults.
+      ``("handoff", uid)``, ``("refresh", version)`` and
+      ``("probe", None)``; anything it raises kills the replica. This
+      is how the shared checkpoint fault harness drives serving faults.
     """
 
     def __init__(self, inner, crash_at_token=None, hang_at_token=None,
                  slow_token_s=0.0, reject_next=0, crash_on_submit=None,
                  drop_handoff=False, handoff_delay_s=0.0,
                  corrupt_handoff=False, crash_after_publish=False,
+                 refresh_torn=False, crash_mid_swap=False,
+                 lie_version=False, slow_adopt_s=0.0,
                  hook=None):
         self.inner = inner
         self.name = inner.name
@@ -273,6 +309,11 @@ class FaultyReplica(Replica):
         self.handoff_delay_s = float(handoff_delay_s)
         self.corrupt_handoff = bool(corrupt_handoff)
         self.crash_after_publish = bool(crash_after_publish)
+        self.refresh_torn = bool(refresh_torn)
+        self.crash_mid_swap = bool(crash_mid_swap)
+        self.lie_version = bool(lie_version)
+        self.slow_adopt_s = float(slow_adopt_s)
+        self._claimed_version = None  # lie_version's fabricated report
         self.hook = hook
         self._lock = tracked_lock(threading.Lock(), "FaultyReplica._lock")
         self._killed = False
@@ -364,6 +405,44 @@ class FaultyReplica(Replica):
                 raise ReplicaDiedError(f"replica {self.name} is dead")
         return self.inner.import_handoff(record)
 
+    def refresh(self, params, version, timeout=None):
+        with self._lock:
+            if self._killed:
+                raise ReplicaDiedError(f"replica {self.name} is dead")
+        if self.hook is not None:
+            try:
+                self.hook("refresh", version)
+            except Exception as e:
+                self._die(f"hook tripped at refresh to v{version}: {e}")
+        if self.refresh_torn:
+            from deepspeed_tpu.utils.sanitize import WeightPublicationError
+            raise WeightPublicationError(
+                f"replica {self.name}: injected torn publication at "
+                f"v{version} — nothing adopted")
+        if self.crash_mid_swap:
+            self._die(f"scripted crash mid-swap to v{version}")
+        if self.slow_adopt_s:
+            budget = self.slow_adopt_s if timeout is None else min(
+                self.slow_adopt_s, timeout)
+            time.sleep(budget)
+            if timeout is not None and self.slow_adopt_s > timeout:
+                raise TimeoutError(
+                    f"replica {self.name}: adoption of v{version} still in "
+                    f"flight after {timeout}s — nothing adopted")
+        if self.lie_version:
+            # adopt NOTHING, report everything: the replica still serves
+            # the old weights but claims the target version
+            with self._lock:
+                self._claimed_version = int(version)
+            return int(version)
+        return self.inner.refresh(params, version, timeout=timeout)
+
+    def weight_version(self):
+        with self._lock:
+            if self._claimed_version is not None:
+                return self._claimed_version
+        return self.inner.weight_version()
+
     def prefix_match_len(self, prompt_tokens):
         return 0 if self._killed else self.inner.prefix_match_len(prompt_tokens)
 
@@ -409,6 +488,12 @@ class FaultyReplica(Replica):
         self.handoff_delay_s = 0.0
         self.corrupt_handoff = False
         self.crash_after_publish = False
+        self.refresh_torn = False
+        self.crash_mid_swap = False
+        self.lie_version = False
+        self.slow_adopt_s = 0.0
+        with self._lock:
+            self._claimed_version = None
 
     def stats(self):
         out = dict(self.inner.stats())

@@ -56,7 +56,8 @@ _COUNTERS = ("submitted", "completed", "failed", "cancelled",
              "deadline_expired", "retries", "failovers", "restarts",
              "recoveries", "prefix_routed", "tokens_relayed",
              "disagg_requests", "disagg_completed", "unified_fallbacks",
-             "handoff_failures")
+             "handoff_failures", "refreshes", "refresh_rollbacks",
+             "refresh_demotions", "canary_divergences")
 
 
 # ---------------------------------------------------------------------- errors

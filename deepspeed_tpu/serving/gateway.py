@@ -166,6 +166,11 @@ class ServingGateway:
         self._state = "running"  # running|draining|stopped|failed
         self._state_lock = tracked_lock(threading.Lock(),
                                         "ServingGateway._state_lock")
+        # live weight refresh: a staged swap the pump applies once the
+        # engine is quiet (admission held, in-flight streams finish)
+        self._pending_refresh = None
+        self._refresh_lock = tracked_lock(threading.Lock(),
+                                          "ServingGateway._refresh_lock")
         self._wake = threading.Event()
         self._pump_stop = False
         self._pump_thread = None
@@ -343,6 +348,89 @@ class ServingGateway:
                 n += 1
         return n
 
+    # -------------------------------------------------------- weight refresh
+    @property
+    def weight_version(self):
+        """The engine's adopted weight version (0 = as-built)."""
+        engine = self.engine
+        return int(getattr(engine, "weight_version", 0)) if engine is not None else 0
+
+    def refresh_weights(self, params, version, timeout=None):
+        """Live, no-drain weight refresh: stage ``params`` for the pump
+        to swap in once the engine is quiet. Admission is HELD (queued
+        requests wait, nothing is shed) while in-flight streams finish on
+        the old weights; the pump then swaps the param tree in place —
+        no engine rebuild, no recompilation — invalidates every trace of
+        old-version KV (prefix trie, tier-2 store, handoff outbox), and
+        re-opens admission on the new version. Blocks until applied.
+
+        Raises the swap's error if it failed (the pump marks the gateway
+        failed — a mid-swap crash must look like a crash, not a silently
+        half-refreshed replica) and :class:`TimeoutError` when in-flight
+        work does not quiesce in time (the staged swap is withdrawn and
+        admission resumes on the old version — nothing was adopted)."""
+        timeout = self.config.drain_timeout_s if timeout is None else timeout
+        if self._state != "running":
+            raise GatewayClosedError(
+                f"weight refresh on a {self._state} gateway")
+        pending = {"params": params, "version": int(version),
+                   "done": threading.Event(), "error": None}
+        with self._refresh_lock:
+            if self._pending_refresh is not None:
+                raise RuntimeError("a weight refresh is already in progress")
+            self._pending_refresh = pending
+        self._wake.set()
+        if self._pump_thread is None:
+            # manual-pump mode (auto_start=False): drive the pump inline
+            deadline = time.monotonic() + timeout
+            while not pending["done"].is_set() and time.monotonic() <= deadline:
+                try:
+                    self._pump_once()
+                except BaseException as e:
+                    with self._state_lock:
+                        self._state = "failed"
+                    self._fail_outstanding(GatewayFailedError(
+                        f"pump died mid-refresh: {type(e).__name__}: {e}"))
+                    break
+        if not pending["done"].wait(timeout):
+            with self._refresh_lock:
+                if self._pending_refresh is pending:
+                    self._pending_refresh = None  # withdraw; admission resumes
+            raise TimeoutError(
+                f"weight refresh to version {version}: in-flight requests "
+                f"still running after {timeout}s — nothing adopted")
+        if pending["error"] is not None:
+            raise pending["error"]
+        return int(version)
+
+    def _maybe_refresh(self):
+        """Pump-side half of :meth:`refresh_weights`: while a swap is
+        staged, admission stays held; once the last in-flight request
+        retires, swap in place and invalidate old-version KV."""
+        with self._refresh_lock:
+            pending = self._pending_refresh
+        if pending is None:
+            return False
+        if self._active:
+            return False  # in-flight streams finish on the old weights
+        try:
+            self.engine.swap_params(pending["params"], pending["version"])
+        except BaseException as e:
+            pending["error"] = e
+            with self._refresh_lock:
+                self._pending_refresh = None
+            pending["done"].set()
+            raise  # pump crash path: a mid-swap failure fails the replica
+        with self._handoff_lock:
+            self._handoffs.clear()  # exported records predate the new weights
+        with self._refresh_lock:
+            self._pending_refresh = None
+        self.metrics.count("weight_refreshes")
+        logger.info(f"serving: weights refreshed to version "
+                    f"{pending['version']} in place")
+        pending["done"].set()
+        return True
+
     def prefix_match_len(self, prompt_tokens):
         """Read-only placement signal: leading tokens of
         ``prompt_tokens`` whose KV this gateway's engine already caches
@@ -373,6 +461,13 @@ class ServingGateway:
         self._pump_thread = None
 
     def _fail_outstanding(self, error):
+        with self._refresh_lock:
+            pending, self._pending_refresh = self._pending_refresh, None
+        if pending is not None:
+            # never strand a refresh caller on a dead pump
+            if pending.get("error") is None:
+                pending["error"] = error
+            pending["done"].set()
         for entry in self.queue.candidates():
             self.queue.remove(entry)
             if entry._finish("failed", error):
@@ -422,7 +517,10 @@ class ServingGateway:
         did = False
         did |= self._process_cancels()
         did |= self._process_deadlines()
-        did |= self._admit()
+        did |= self._maybe_refresh()
+        refreshing = self._pending_refresh is not None
+        if not refreshing:  # admission held while a weight swap is staged
+            did |= self._admit()
         did |= self._resume_paused()
         did |= self._step()
         self.metrics.gauge(

@@ -66,7 +66,8 @@ class ServingMetrics:
                 "rejected_queue_full", "rejected_too_large", "shed",
                 "deadline_expired", "preemptions", "resumes",
                 "tokens_generated", "engine_steps", "failed",
-                "handoffs_exported", "handoffs_imported")
+                "handoffs_exported", "handoffs_imported",
+                "weight_refreshes")
 
     def __init__(self, window=1024):
         self._lock = threading.Lock()

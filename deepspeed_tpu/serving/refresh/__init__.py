@@ -1,0 +1,23 @@
+"""Fault-tolerant live weight refresh (the DeepSpeed hybrid-engine
+train→serve weight sync, made a first-class serving subsystem).
+
+- :class:`WeightPublisher` — versioned, integrity-checked weight
+  publications with chained content hashes (nebula-style atomic commit;
+  torn/forged publications rejected typed with nothing adopted).
+- :class:`FleetRefreshController` — rolling no-drain rollout across a
+  serving fleet: per-replica in-place param swap with version-tagged KV
+  invalidation, a bit-identical canary gate against a cold-started
+  reference, automatic fleet-wide rollback, and health demotion for
+  replicas that will not converge.
+
+See ``docs/MIGRATING.md`` ("Hybrid engine / live weight refresh")."""
+
+from deepspeed_tpu.serving.refresh.controller import (CanaryDivergenceError,
+                                                      FleetRefreshController,
+                                                      WeightRefreshError)
+from deepspeed_tpu.serving.refresh.publisher import WeightPublisher
+
+__all__ = [
+    "WeightPublisher", "FleetRefreshController",
+    "WeightRefreshError", "CanaryDivergenceError",
+]

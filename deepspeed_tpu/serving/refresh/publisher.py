@@ -1,0 +1,327 @@
+"""Weight publisher: versioned, integrity-checked weight publications.
+
+The training side (hybrid engine / nebula host snapshots) turns a live
+param tree into a *publication* — an on-disk directory a serving fleet
+can adopt without ever trusting it blindly. The commit protocol mirrors
+the nebula checkpoint service step for step:
+
+1. payloads are written into a per-publication temp dir under
+   ``.refresh_tmp/`` (a crash leaves no half-publication where a reader
+   could find it);
+2. the manifest — carrying per-file sizes + sha256 AND a chain hash
+   over the publication's entire version lineage — is written LAST
+   (tmp + ``os.replace``), so its presence certifies every payload
+   byte landed;
+3. the temp dir is promoted into place with one atomic ``os.rename``;
+4. ``LATEST`` is rotated (tmp + replace) only after the promote;
+5. retention GC keeps the newest ``DS_REFRESH_KEEP`` publications —
+   never fewer than two, so rollback always has a target.
+
+The chain hash (:func:`publication_chain_hash`) makes the manifest the
+same kind of trust boundary as a prefill->decode handoff record: a
+torn, truncated, or forged publication — or one grafted onto the wrong
+lineage — is rejected **typed** (:class:`WeightPublicationError`) with
+nothing adopted, exactly like the KV importer rejects a mangled chain
+key. Load-side validation is unconditional, not DS_SANITIZE-gated:
+publications cross a process/filesystem boundary and are untrusted
+input.
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.sharded_checkpoint_engine import flatten_named
+from deepspeed_tpu.utils.env_registry import env_int
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.sanitize import (WeightPublicationError,
+                                          check_weight_publication,
+                                          publication_chain_hash,
+                                          tracked_lock)
+
+MANIFEST_NAME = "weight_manifest.json"
+PAYLOAD_NAME = "payload.bin"
+TMP_ROOT = ".refresh_tmp"
+LATEST = "LATEST"
+
+
+def _tag(version):
+    return f"v{int(version):08d}"
+
+
+def _np_dtype(name):
+    """dtype-by-name that also resolves the ml_dtypes extension types
+    (bfloat16 etc.) numpy cannot look up by string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _unflatten(pairs):
+    """Inverse of :func:`flatten_named`: ``[(path, leaf)]`` back into
+    nested dicts / lists (``#i`` path segments are list positions)."""
+    root = {}
+    for path, leaf in pairs:
+        parts = path.split("/")
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+
+    def rebuild(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k.startswith("#") for k in node):
+            idx = sorted(node, key=lambda k: int(k[1:]))
+            if [int(k[1:]) for k in idx] != list(range(len(idx))):
+                raise WeightPublicationError(
+                    f"publication tree has a gap in sequence positions: "
+                    f"{sorted(node)}")
+            return [rebuild(node[k]) for k in idx]
+        return {k: rebuild(v) for k, v in node.items()}
+
+    return rebuild(root)
+
+
+class WeightPublisher:
+    """Publishes and loads versioned weight publications under one
+    directory. Thread-safe; one publisher per publish root.
+
+    ``tree`` leaves may be ``jax.Array``s, numpy arrays, or nebula
+    :class:`HostShardSnapshot`s (``np.asarray`` assembles the full
+    array from the host chunks) — so a training step's async host
+    snapshot publishes without touching the device again.
+
+    ``test_hook(point, detail)`` is the same fault seam the nebula
+    service exposes: anything it raises aborts the publication at that
+    point, which is how tests manufacture torn publications.
+    """
+
+    def __init__(self, publish_dir, keep=None, test_hook=None):
+        self.dir = str(publish_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        # rollback always needs the previous version on disk: floor 2
+        self.keep = max(2, int(keep if keep is not None
+                               else env_int("DS_REFRESH_KEEP")))
+        self._hook = test_hook or (lambda point, detail=None: None)
+        self._lock = tracked_lock(threading.Lock(), "WeightPublisher._lock")
+        self.publishes = 0   # committed publications this process
+        self.rejects = 0     # typed load-side rejections
+
+    # ----------------------------------------------------------- inventory
+    def _pub_dir(self, version):
+        return os.path.join(self.dir, _tag(version))
+
+    def versions(self):
+        """Committed (manifest-bearing) versions, ascending. A payload
+        dir without a manifest is a torn publication and is invisible
+        here — exactly the 'nothing adopted' contract."""
+        out = []
+        for name in os.listdir(self.dir):
+            if not (name.startswith("v") and name[1:].isdigit()):
+                continue
+            if os.path.isfile(os.path.join(self.dir, name, MANIFEST_NAME)):
+                out.append(int(name[1:]))
+        return sorted(out)
+
+    def latest_version(self):
+        """Newest committed version, or None. ``LATEST`` is a hint;
+        the manifest scan is authoritative (a crash between promote and
+        the LATEST rotation must not hide a committed publication)."""
+        versions = self.versions()
+        return versions[-1] if versions else None
+
+    def manifest(self, version):
+        path = os.path.join(self._pub_dir(version), MANIFEST_NAME)
+        try:
+            with open(path) as fd:
+                return json.load(fd)
+        except FileNotFoundError:
+            raise WeightPublicationError(
+                f"no committed publication for version {version} under "
+                f"{self.dir} — the publish never finished (nothing to "
+                f"adopt)") from None
+        except json.JSONDecodeError as e:
+            raise WeightPublicationError(
+                f"torn manifest for version {version}: {e} — the publish "
+                f"was interrupted mid-write (nothing adopted)") from e
+
+    # ------------------------------------------------------------- publish
+    def publish(self, tree, version=None):
+        """Publish ``tree`` as the next version (or an explicit
+        ``version`` > every committed one). Returns the manifest."""
+        with self._lock:
+            latest = self.latest_version()
+            if version is None:
+                version = (latest or 0) + 1
+            version = int(version)
+            if latest is not None and version <= latest:
+                raise WeightPublicationError(
+                    f"publication version {version} does not advance the "
+                    f"lineage (latest committed is {latest})")
+            parent_chain = None
+            parent_version = latest or 0
+            if latest is not None:
+                parent_chain = self.manifest(latest)["chain"]
+
+            tmp = os.path.join(self.dir, TMP_ROOT, _tag(version))
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            self._hook("before_write", version)
+
+            tree_spec = {}
+            offset = 0
+            payload = os.path.join(tmp, PAYLOAD_NAME)
+            with open(payload, "wb") as fd:
+                for path, leaf in flatten_named(tree):
+                    arr = np.asarray(leaf)
+                    if arr.dtype.hasobject:
+                        raise TypeError(
+                            f"publication leaf '{path}' has object dtype "
+                            f"{arr.dtype} — only numeric arrays publish")
+                    # ascontiguousarray promotes 0-d to (1,): record the
+                    # ORIGINAL shape so scalar leaves round-trip exactly
+                    fd.write(np.ascontiguousarray(arr).tobytes())
+                    tree_spec[path] = {"offset": offset,
+                                       "nbytes": int(arr.nbytes),
+                                       "shape": list(arr.shape),
+                                       "dtype": arr.dtype.name}
+                    offset += arr.nbytes
+            self._hook("after_payload", version)
+
+            from deepspeed_tpu.nebula.service import file_sha256
+            files = {PAYLOAD_NAME: {"bytes": os.path.getsize(payload),
+                                    "sha256": file_sha256(payload)}}
+            manifest = {
+                "version": 1,
+                "weight_version": version,
+                "tag": _tag(version),
+                "parent_version": parent_version,
+                "parent_chain": parent_chain,
+                "chain": publication_chain_hash(parent_chain, files),
+                "files": files,
+                "tree": tree_spec,
+            }
+            self._hook("before_manifest", version)
+            mpath = os.path.join(tmp, MANIFEST_NAME)
+            with open(mpath + ".tmp", "w") as fd:
+                json.dump(manifest, fd, indent=1)
+            os.replace(mpath + ".tmp", mpath)
+
+            self._hook("before_promote", version)
+            final = self._pub_dir(version)
+            if os.path.isdir(final):
+                shutil.rmtree(final)  # a torn (manifest-less) leftover
+            os.rename(tmp, final)
+
+            self._hook("before_latest", version)
+            lpath = os.path.join(self.dir, LATEST)
+            with open(lpath + ".tmp", "w") as fd:
+                fd.write(_tag(version) + "\n")
+            os.replace(lpath + ".tmp", lpath)
+            self._hook("after_commit", version)
+
+            self.publishes += 1
+            self._gc_locked()
+            logger.info(f"refresh: published weight version {version} "
+                        f"({len(tree_spec)} leaves, "
+                        f"{files[PAYLOAD_NAME]['bytes']} bytes)")
+            return manifest
+
+    # ---------------------------------------------------------------- load
+    def load(self, version=None, expect_parent_chain=False):
+        """Validate and materialize a publication → ``(tree, manifest)``.
+
+        Everything is checked before anything is returned: manifest
+        shape, chain re-derivation, payload size + sha256, and that the
+        tree spec tiles the payload exactly. Any failure raises
+        :class:`WeightPublicationError` with nothing adopted. Pass
+        ``expect_parent_chain=<chain|None>`` to also pin the lineage
+        (a publication grafted onto a different history is forged)."""
+        if version is None:
+            version = self.latest_version()
+            if version is None:
+                raise WeightPublicationError(
+                    f"no committed publications under {self.dir}")
+        version = int(version)
+        try:
+            manifest = self.manifest(version)
+            kwargs = {}
+            if expect_parent_chain is not False:
+                kwargs["parent_chain"] = expect_parent_chain
+            check_weight_publication(manifest, pub_dir=self._pub_dir(version),
+                                     expect_version=version, **kwargs)
+            tree = self._materialize(manifest, version)
+        except WeightPublicationError:
+            with self._lock:
+                self.rejects += 1
+            raise
+        return tree, manifest
+
+    def _materialize(self, manifest, version):
+        spec = manifest.get("tree")
+        if not isinstance(spec, dict) or not spec:
+            raise WeightPublicationError(
+                f"publication v{version} manifest has no tree spec")
+        payload = os.path.join(self._pub_dir(version), PAYLOAD_NAME)
+        with open(payload, "rb") as fd:
+            buf = fd.read()
+        covered = 0
+        pairs = []
+        for path, info in sorted(spec.items()):
+            off, nbytes = int(info["offset"]), int(info["nbytes"])
+            dtype = _np_dtype(info["dtype"])
+            shape = tuple(int(d) for d in info["shape"])
+            want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            if want != nbytes or off < 0 or off + nbytes > len(buf):
+                raise WeightPublicationError(
+                    f"publication v{version}: tree spec for '{path}' does "
+                    f"not fit the payload (offset {off}, {nbytes} bytes, "
+                    f"shape {shape} {dtype.name}) — forged or torn spec")
+            arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(
+                shape, dtype=np.int64)), offset=off).reshape(shape)
+            pairs.append((path, arr))
+            covered += nbytes
+        if covered != len(buf):
+            raise WeightPublicationError(
+                f"publication v{version}: tree spec covers {covered} of "
+                f"{len(buf)} payload bytes — torn or forged publication")
+        return _unflatten(pairs)
+
+    def verify_chain(self):
+        """Walk every committed publication oldest→newest, re-deriving
+        each chain hash and checking each ``parent_chain`` links to its
+        predecessor. Returns the verified versions; raises typed on the
+        first break."""
+        prev_chain = None
+        versions = self.versions()
+        for v in versions:
+            m = self.manifest(v)
+            check_weight_publication(m, expect_version=v,
+                                     parent_chain=prev_chain)
+            prev_chain = m["chain"]
+        return versions
+
+    # ------------------------------------------------------------------ gc
+    def gc(self):
+        with self._lock:
+            return self._gc_locked()
+
+    def _gc_locked(self):
+        versions = self.versions()
+        doomed = versions[:-self.keep] if len(versions) > self.keep else []
+        for v in doomed:
+            shutil.rmtree(self._pub_dir(v), ignore_errors=True)
+        tmp_root = os.path.join(self.dir, TMP_ROOT)
+        if os.path.isdir(tmp_root) and not os.listdir(tmp_root):
+            shutil.rmtree(tmp_root, ignore_errors=True)
+        if doomed:
+            logger.info(f"refresh: gc removed publications "
+                        f"{[_tag(v) for v in doomed]} (keep={self.keep})")
+        return doomed

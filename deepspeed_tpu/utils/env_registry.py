@@ -200,6 +200,22 @@ register("DS_DISAGG_FALLBACK", "bool", True,
          "the disagg path fails; off, a failed handoff fails the "
          "request with a typed error instead of falling back.",
          "deepspeed_tpu/serving/fleet/router.py")
+register("DS_REFRESH_CANARY", "optional_bool", None,
+         "Kill switch for the live-weight-refresh canary gate (first "
+         "refreshed replica verified bit-identically against a cold-"
+         "started engine on the new weights); set it wins in both "
+         "directions, unset defers to fleet.refresh_canary.",
+         "deepspeed_tpu/serving/refresh/controller.py")
+register("DS_REFRESH_TIMEOUT_S", "int", 0,
+         "Per-replica budget (seconds) for a staged live weight swap "
+         "to land before the attempt is abandoned and retried; 0 "
+         "defers to fleet.refresh_timeout_s.",
+         "deepspeed_tpu/serving/refresh/controller.py")
+register("DS_REFRESH_KEEP", "int", 2,
+         "Weight publications the publisher's retention GC keeps on "
+         "disk (never fewer than the live and previous versions, so "
+         "rollback always has a target).",
+         "deepspeed_tpu/serving/refresh/publisher.py")
 register("DS_SANITIZE", "bool", False,
          "Enable runtime sanitizers: checkify NaN/OOB checks around "
          "the v2 model forward plus allocator/prefix-cache/KV-tier "

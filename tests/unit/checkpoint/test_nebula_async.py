@@ -332,6 +332,28 @@ def test_truncated_chunk_fails_manifest_validation(tmpdir):
     assert_trees_equal(v1_params, host_tree(e.params))
 
 
+def test_bitflip_payload_fails_content_hash(tmpdir):
+    """A single flipped byte that leaves the file SIZE intact sails past
+    the legacy size check but dies on the manifest's per-shard sha256 —
+    and resume falls back to the previous intact tag."""
+    e, v1_params = _two_committed_tags(tmpdir)
+    tag_dir = os.path.join(str(tmpdir), "v2")
+    data = shard_data_files(tag_dir)[0]
+    size = os.path.getsize(data)
+    with open(data, "r+b") as fd:
+        fd.seek(size // 2)
+        b = fd.read(1)
+        fd.seek(size // 2)
+        fd.write(bytes([b[0] ^ 0xFF]))
+    assert os.path.getsize(data) == size  # same size: only the hash can see it
+    with pytest.raises(CheckpointCorruptionError, match="content hash"):
+        validate_tag(str(tmpdir), "v2")
+    assert resolve_load_tag(str(tmpdir)) == "v1"
+    load_dir, _ = e.load_checkpoint()
+    assert load_dir is not None
+    assert_trees_equal(v1_params, host_tree(e.params))
+
+
 def test_validate_tag_typed_errors(tmpdir):
     with pytest.raises(CheckpointCorruptionError, match="does not exist"):
         validate_tag(str(tmpdir), "nope")

@@ -72,7 +72,8 @@ _SYNC_BUILTINS = {"float", "bool"}
 # than one thread. Writes outside ``with self.<*lock*>:`` are flagged
 # (``__init__`` is exempt — the object is not yet published).
 THREAD_SHARED_REGISTRY = {
-    "ServingGateway": {"_cancels", "_state", "_pump_stop", "_handoffs"},
+    "ServingGateway": {"_cancels", "_state", "_pump_stop", "_handoffs",
+                       "_pending_refresh"},
     "NebulaCheckpointService": {"_pending_job", "_failure", "_last_persist",
                                 "_stats", "_thread"},
     "MonitorMaster": {"backends"},
@@ -102,7 +103,14 @@ THREAD_SHARED_REGISTRY = {
     "ReplicaHealth": {"_state", "_consecutive_failures", "_half_open_ok",
                       "_next_probe_at", "_probe_backoff", "transitions"},
     "GatewayReplica": {"gateway", "restarts"},
-    "FaultyReplica": {"_killed", "_reject_left", "_submits"},
+    "FaultyReplica": {"_killed", "_reject_left", "_submits",
+                      "_claimed_version"},
+    # live weight refresh: rollouts run on an operator/train thread
+    # while relay threads read versions and the publisher may be shared
+    # with a bench/train loop publishing concurrently
+    "WeightPublisher": {"publishes", "rejects"},
+    "FleetRefreshController": {"current_version", "current_chain",
+                               "_adopted_params", "rollouts"},
     # disagg serving: relay threads publish/claim handoffs and note
     # pool outcomes concurrently; the router snapshot reads both
     "HandoffManager": {"_inflight", "published", "delivered", "acked",
@@ -134,12 +142,20 @@ _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
 # here are "unranked": edges touching them are still collected and
 # checked for cycles, just not against a rank.
 LOCK_ORDER = {
+    # the refresh controller orchestrates ABOVE the router (it calls
+    # router counters/health and replica refresh while holding its
+    # lock), and calls into its publisher, so both rank below rank 10
+    "FleetRefreshController._lock": 4,
+    "WeightPublisher._lock": 6,
     "FleetRouter._lock": 10,
     "HandoffManager._lock": 14,
     "PoolScheduler._lock": 16,
     "ServingGateway._handoff_lock": 20,
     "ServingGateway._cancel_lock": 22,
     "ServingGateway._state_lock": 24,
+    # staged-refresh handshake: always held alone on the caller side;
+    # the pump takes it strictly before/after (never around) the swap
+    "ServingGateway._refresh_lock": 26,
     "PrefixCacheManager._lock": 30,
     "TierManager._lock": 40,
     "HostKVStore._lock": 50,
@@ -153,6 +169,8 @@ CROSS_REFS = {
     "PrefixCacheManager": {"tier": "TierManager"},
     "TierManager": {"manager": "PrefixCacheManager", "store": "HostKVStore"},
     "FleetRouter": {"handoffs": "HandoffManager", "pools": "PoolScheduler"},
+    "FleetRefreshController": {"router": "FleetRouter",
+                               "publisher": "WeightPublisher"},
 }
 
 # lock-order: per registered class, the methods a PEER may call and the
@@ -172,6 +190,7 @@ LOCKING_METHODS = {
         "note_promoted": ("TierManager._lock",),
         "export_chain": ("PrefixCacheManager._lock", "TierManager._lock"),
         "import_chain": ("TierManager._lock", "HostKVStore._lock"),
+        "invalidate": ("TierManager._lock", "HostKVStore._lock"),
         "prefetch": ("TierManager._lock", "TierManager._queue_ready"),
         "wait_prefetch": ("TierManager._lock",),
         "shutdown": ("TierManager._queue_ready", "TierManager._lock",
@@ -197,6 +216,9 @@ LOCKING_METHODS = {
         "release_lease": ("PrefixCacheManager._lock",),
         "release": ("PrefixCacheManager._lock", "TierManager._lock",
                     "HostKVStore._lock"),
+        "invalidate_for_version": ("PrefixCacheManager._lock",
+                                   "TierManager._lock",
+                                   "HostKVStore._lock"),
     },
 }
 
