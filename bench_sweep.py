@@ -1,6 +1,15 @@
-"""Perf experiment harness for the north-star config (not the driver bench)."""
+"""Perf experiment harness for the north-star config (not the driver bench).
+
+Every invocation appends its experiments to ``bench_sweep_results.json``
+(one JSON dict per run: argv, per-experiment metrics) so sweep numbers
+survive the scrollback.  ``--trace PATH.trace.jsonl`` replays a recorded
+serving trace (see deepspeed_tpu.autotuning) through a gateway built
+from the ambient DS_* / DS_AUTOTUNE_CONFIG environment instead of
+running a training sweep — the serving-side twin of the MFU lanes.
+"""
 
 import json
+import os
 import sys
 import time
 
@@ -10,6 +19,20 @@ import jax
 import jax.numpy as jnp
 
 PEAK = 197e12  # v5e bf16
+
+RESULTS_PATH = os.environ.get("BENCH_SWEEP_RESULTS_PATH",
+                              "bench_sweep_results.json")
+RESULTS = []  # every run()/run_trace() appends one record
+
+
+def _flush_results():
+    """Write this invocation's records alongside the printed lines."""
+    if not RESULTS:
+        return
+    payload = {"argv": sys.argv[1:], "results": RESULTS}
+    with open(RESULTS_PATH, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(f"results -> {RESULTS_PATH}")
 
 
 def run(name, *, hidden=1536, inter=4096, layers=16, heads=16, B=4, S=2048,
@@ -51,6 +74,8 @@ def run(name, *, hidden=1536, inter=4096, layers=16, heads=16, B=4, S=2048,
         dt = min(times)  # min filters chip contention spikes
     except Exception as e:
         print(f"{name}: FAILED {type(e).__name__}: {str(e)[:160]}")
+        RESULTS.append({"name": name,
+                        "error": f"{type(e).__name__}: {str(e)[:160]}"})
         return None
     n_params = int(sum(np.prod(x.shape) for x in jax.tree.leaves(engine.params)))
     tokens = B * gas * S
@@ -59,10 +84,78 @@ def run(name, *, hidden=1536, inter=4096, layers=16, heads=16, B=4, S=2048,
     mfu = (dense + attn) / dt / PEAK
     print(f"{name}: params={n_params/1e6:.0f}M step={dt*1e3:.1f}ms "
           f"tok/s={tokens/dt:,.0f} MFU={mfu:.3f} (dense-only {dense/dt/PEAK:.3f})")
+    RESULTS.append({"name": name, "params": n_params,
+                    "step_ms": round(dt * 1e3, 2),
+                    "tok_s": round(tokens / dt, 1), "mfu": round(mfu, 4)})
     return mfu
 
 
+def run_trace(path):
+    """Replay a recorded ``.trace.jsonl`` through a gateway built from
+    the ambient environment (DS_* knobs + optional DS_AUTOTUNE_CONFIG),
+    so a sweep can score env/tuned-config variants against the same
+    real traffic the offline tuner searched."""
+    from deepspeed_tpu.autotuning import ServingTrace, replay_lockstep
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig,
+                                            InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.serving import ServingConfig, ServingGateway
+
+    trace = ServingTrace.load(path)
+    s = trace.summary()
+    groups.destroy_mesh()
+    on_tpu = jax.default_backend() == "tpu"
+    need_ctx = int(s["mean_prompt_len"] + s["mean_max_new"]) * 4
+    if on_tpu:
+        model = build_llama("7b", hidden_size=3072, intermediate_size=8192,
+                            num_hidden_layers=22, num_attention_heads=24,
+                            num_key_value_heads=8,
+                            max_position_embeddings=2048,
+                            vocab_size=32000, remat=False)
+        block, n_seqs, batch = 32, 16, 512
+    else:
+        model = build_llama("debug")
+        block, n_seqs, batch = 8, 8, 96
+    max_ctx = max(block * 4, -(-need_ctx // block) * block)
+    engine = InferenceEngineV2(
+        model=model,
+        config=RaggedInferenceEngineConfig(
+            kv_block_size=block,
+            state_manager=DSStateManagerConfig(
+                max_ragged_batch_size=batch,
+                max_ragged_sequence_count=n_seqs,
+                max_tracked_sequences=n_seqs,
+                max_context=max_ctx)))
+    # ServingGateway applies DS_AUTOTUNE_CONFIG (if set) on top of the
+    # defaults, so `DS_AUTOTUNE_CONFIG=tuned.json bench_sweep --trace t`
+    # scores exactly what the offline tuner shipped
+    gw = ServingGateway(engine, config=ServingConfig(), auto_start=False)
+    report = replay_lockstep(gw, trace)
+    rec = {"name": f"trace:{os.path.basename(path)}", "trace": s,
+           "serving_config": {
+               k: getattr(gw.config, k)
+               for k in ("token_budget", "max_burst", "max_queue_depth")},
+           "gen_tok_s": round(report.gen_tok_s, 1),
+           "p50_ttft_ms": report.p50_ttft_ms,
+           "p99_ttft_ms": report.p99_ttft_ms,
+           "completed": report.completed}
+    print(f"{rec['name']}: {len(trace)} reqs gen_tok_s={rec['gen_tok_s']} "
+          f"p99_ttft_ms={rec['p99_ttft_ms']} cfg={rec['serving_config']}")
+    RESULTS.append(rec)
+    gw.drain()
+    return rec
+
+
 if __name__ == "__main__":
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 >= len(sys.argv):
+            sys.exit("--trace requires a .trace.jsonl path")
+        run_trace(sys.argv[i + 1])
+        _flush_results()
+        sys.exit(0)
     which = sys.argv[1] if len(sys.argv) > 1 else "sweep"
     if which == "sweep":
         run("A: r01 config (zero1,remat-full)", stage=1, steps=8)
@@ -100,3 +193,4 @@ if __name__ == "__main__":
             B=4, S=2048, gas=64, steps=3, warmup=1)
         run("H5: B4 S2048 gas128 dots z3", stage=3, remat_policy="dots",
             B=4, S=2048, gas=128, steps=2, warmup=1)
+    _flush_results()

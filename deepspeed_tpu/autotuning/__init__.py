@@ -1,8 +1,39 @@
-"""Autotuning (parity: deepspeed/autotuning/)."""
+"""Autotuning (parity: deepspeed/autotuning/).
+
+Two tuners live here:
+
+- the **training** autotuner (``autotuner.py`` / ``scheduler.py`` /
+  ``exp_runner.py``): grid search over ZeRO stage × micro-batch,
+  experiment scheduling over a hostfile — the reference's
+  ``exps``/``tuner``/``space`` machinery;
+- the **serving** autotuner (``trace.py`` / ``serving_space.py`` /
+  ``serving_tuner.py`` / ``online.py``): trace-replay successive
+  halving over the DS_* knob schema plus the gateway's online SLO
+  controller.
+"""
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune
+from deepspeed_tpu.autotuning.online import (OnlineSLOController,
+                                             autotune_enabled)
 from deepspeed_tpu.autotuning.scheduler import (Node, Reservation, ResourceManager,
                                                 parse_hostfile)
+from deepspeed_tpu.autotuning.serving_space import (ModelProfile,
+                                                    ServingKnobSpace,
+                                                    env_overrides,
+                                                    serving_overrides,
+                                                    static_violations)
+from deepspeed_tpu.autotuning.serving_tuner import (ServingTuner, TuningResult,
+                                                    load_tuned_config)
+from deepspeed_tpu.autotuning.trace import (ReplayReport, ServingTrace,
+                                            TraceRecorder, TraceRequest,
+                                            replay_lockstep, replay_realtime,
+                                            synthesize_trace)
 
 __all__ = ["Autotuner", "autotune", "ResourceManager", "Node", "Reservation",
-           "parse_hostfile"]
+           "parse_hostfile",
+           "ServingTrace", "TraceRequest", "TraceRecorder", "ReplayReport",
+           "synthesize_trace", "replay_lockstep", "replay_realtime",
+           "ServingKnobSpace", "ModelProfile", "static_violations",
+           "env_overrides", "serving_overrides",
+           "ServingTuner", "TuningResult", "load_tuned_config",
+           "OnlineSLOController", "autotune_enabled"]

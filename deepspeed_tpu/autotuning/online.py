@@ -1,0 +1,333 @@
+"""Online SLO controller: live adjustment of cheap serving knobs.
+
+The offline tuner (serving_tuner.py) picks the launch config; this
+controller keeps a *running* gateway inside its SLO when the live mix
+drifts from the tuned trace. It only touches the three knobs that are
+cheap to change on a hot engine — no rebuild, no recompilation:
+
+- **token budget** (``scheduler.budget`` — re-read every step),
+- **admission depth** (``queue.max_depth`` — read per ``push``),
+- **spec draft length** (``SpecDecodeState.set_draft_len``).
+
+Control law (deliberately boring — a serving controller must be
+predictable before it is clever):
+
+- a tick samples ``Serve/*`` metrics (p99 TTFT vs the SLO target);
+- **hysteresis**: only ``breach_ticks`` consecutive breached ticks
+  trigger a step DOWN, only ``clear_ticks`` consecutive healthy ticks
+  a step UP, and every adjustment starts a ``cooldown_ticks`` hold —
+  a step change in load converges to a new level instead of
+  oscillating around it;
+- one knob moves per decision, cheapest first on breach (draft len →
+  token budget → admission depth), reverse on recovery, and never
+  past the attach-time defaults;
+- **rollback guard**: ``rollback_ticks`` consecutive breaches mean the
+  controller is not helping — every knob snaps back to its default
+  and the controller FREEZES (observes, publishes, acts no more)
+  until :meth:`reset`. A broken controller must degrade to exactly
+  the hand-picked config, never fight the operator.
+
+Enablement is the usual tri-state: ``DS_AUTOTUNE`` set wins in both
+directions, unset defers to ``serving.autotune.enabled``. Off means
+the gateway never constructs a controller — the DS_AUTOTUNE=0 pipeline
+is byte-identical to a build without this module.
+"""
+
+import threading
+
+from deepspeed_tpu.utils.env_registry import env_int, env_opt_bool
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.sanitize import tracked_lock
+
+# knob identifiers, cheapest-to-restore last (breach walks this list
+# front to back; recovery walks it back to front)
+_KNOBS = ("draft_len", "token_budget", "queue_depth")
+
+_DEFAULTS = {
+    "interval_s": 0.25,
+    "p99_ttft_slo_ms": 500.0,
+    "breach_ticks": 2,
+    "clear_ticks": 4,
+    "cooldown_ticks": 2,
+    "rollback_ticks": 8,
+    "min_token_budget": 0,   # 0 -> one KV block
+    "min_queue_depth": 1,
+    "min_draft_len": 1,
+}
+
+
+def autotune_enabled(config) -> bool:
+    """``DS_AUTOTUNE`` set wins in BOTH directions; unset defers to
+    ``serving.autotune.enabled``."""
+    forced = env_opt_bool("DS_AUTOTUNE")
+    if forced is not None:
+        return forced
+    at = getattr(config, "autotune", None)
+    return bool(getattr(at, "enabled", False)) if at is not None else False
+
+
+def _cfg(config, name):
+    v = getattr(config, name, None) if config is not None else None
+    return _DEFAULTS[name] if v is None else v
+
+
+class OnlineSLOController:
+    """One controller per gateway. ``tick()`` is the whole control law
+    (the background thread just calls it on a timer), so tests drive
+    it tick-by-tick with a fake gateway and no clock.
+
+    Thread-shared: the controller thread mutates decision state while
+    operator threads call ``stats()`` / ``reset()`` / ``stop()``.
+    """
+
+    def __init__(self, gateway, config=None, auto_start=False):
+        self.gateway = gateway
+        config = config if config is not None \
+            else getattr(gateway.config, "autotune", None)
+        env_interval = env_int("DS_AUTOTUNE_INTERVAL_S")
+        self.interval_s = float(env_interval or _cfg(config, "interval_s"))
+        self.slo_p99_ttft_ms = float(_cfg(config, "p99_ttft_slo_ms"))
+        self.breach_ticks = int(_cfg(config, "breach_ticks"))
+        self.clear_ticks = int(_cfg(config, "clear_ticks"))
+        self.cooldown_ticks = int(_cfg(config, "cooldown_ticks"))
+        self.rollback_ticks = int(_cfg(config, "rollback_ticks"))
+        self.min_queue_depth = int(_cfg(config, "min_queue_depth"))
+        self.min_draft_len = int(_cfg(config, "min_draft_len"))
+        min_budget = int(_cfg(config, "min_token_budget"))
+        self.min_token_budget = min_budget or int(gateway.gate.block_size)
+        if self.rollback_ticks < self.breach_ticks:
+            raise ValueError(
+                f"rollback_ticks ({self.rollback_ticks}) must be >= "
+                f"breach_ticks ({self.breach_ticks}) — rollback is the "
+                f"guard BEHIND stepping, not in front of it")
+        # attach-time defaults: the hard ceiling for recovery and the
+        # rollback restore target
+        spec = getattr(gateway.engine, "spec", None)
+        self.defaults = {
+            "token_budget": int(gateway.scheduler.budget),
+            "queue_depth": int(gateway.queue.max_depth),
+            "draft_len": int(spec.draft_len_cfg) if spec is not None else 0,
+        }
+        self._lock = tracked_lock(threading.Lock(),
+                                  "OnlineSLOController._lock")
+        self._breach = 0       # consecutive breached ticks
+        self._clear = 0        # consecutive healthy ticks
+        self._cooldown = 0     # ticks left in the post-adjustment hold
+        self._frozen = False   # rollback tripped; observe only
+        self._last_action = "init"
+        # oscillation damping: a step UP that is punished by a breach-
+        # driven step DOWN doubles the healthy streak required before
+        # the next up — direction flips get geometrically rarer, so a
+        # step change in load converges to a held level
+        self._clear_required = self.clear_ticks
+        self._last_up_tick = None
+        self.ticks = 0
+        self.adjustments = 0
+        self.rollbacks = 0
+        self._stop_event = threading.Event()
+        self._thread = None
+        if auto_start:
+            self.start()
+
+    # -------------------------------------------------------- lifecycle
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="ds-autotune", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop_event.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=10)
+        self._thread = None
+
+    def reset(self):
+        """Operator escape hatch: restore defaults and unfreeze."""
+        self._restore_defaults()
+        with self._lock:
+            self._frozen = False
+            self._breach = 0
+            self._clear = 0
+            self._cooldown = 0
+            self._clear_required = self.clear_ticks
+            self._last_up_tick = None
+            self._last_action = "reset"
+
+    def _run(self):
+        while not self._stop_event.wait(timeout=self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("autotune controller tick failed")
+
+    # ------------------------------------------------------ control law
+    def tick(self):
+        """One decision: sample, update hysteresis counters, move at
+        most one knob. Returns the action taken (``hold`` / ``cooldown``
+        / ``frozen`` / ``rollback`` / ``down:<knob>`` / ``up:<knob>``).
+        Drive it from ONE thread — the controller thread, or a test."""
+        snap = self.gateway.snapshot()
+        ttft = snap.get("ttft", {})
+        p99 = ttft.get("p99_ms")
+        samples = ttft.get("count", 0)
+        decision = "hold"
+        with self._lock:
+            self.ticks += 1
+            if self._frozen:
+                decision = "frozen"
+            elif samples and p99 is not None:
+                breached = p99 > self.slo_p99_ttft_ms
+                if breached:
+                    self._breach += 1
+                    self._clear = 0
+                else:
+                    self._clear += 1
+                    self._breach = 0
+                if breached and self._breach >= self.rollback_ticks:
+                    # hard guard: we are not helping — restore and stop
+                    self._frozen = True
+                    self.rollbacks += 1
+                    decision = "rollback"
+                elif self._cooldown > 0:
+                    self._cooldown -= 1
+                    decision = "cooldown"
+                elif breached and self._breach >= self.breach_ticks:
+                    decision = "step_down"
+                elif not breached and self._clear >= self._clear_required:
+                    decision = "step_up"
+        # cross-object knob writes happen OUTSIDE our lock: the decision
+        # is ours, the actuators belong to the gateway
+        action = decision
+        if decision == "rollback":
+            self._restore_defaults()
+        elif decision == "step_down":
+            action = self._step_down() or "hold"
+        elif decision == "step_up":
+            action = self._step_up() or "hold"
+        if action.startswith(("down:", "up:")):
+            with self._lock:
+                self.adjustments += 1
+                self._cooldown = self.cooldown_ticks
+                if action.startswith("up:"):
+                    self._last_up_tick = self.ticks
+                    self._clear = 0
+                elif self._last_up_tick is not None and \
+                        self.ticks - self._last_up_tick <= \
+                        self.cooldown_ticks + self.breach_ticks + 1:
+                    # the last up-step got punished straight away: back
+                    # off geometrically before trying up again
+                    self._clear_required = min(self._clear_required * 2, 256)
+                    self._last_up_tick = None
+        with self._lock:
+            self._last_action = action
+        self.gateway.metrics.set_external("Serve/Autotune", self.stats())
+        return action
+
+    # -------------------------------------------------------- actuators
+    def _current(self):
+        spec = getattr(self.gateway.engine, "spec", None)
+        return {
+            "token_budget": int(self.gateway.scheduler.budget),
+            "queue_depth": int(self.gateway.queue.max_depth),
+            "draft_len": int(spec.draft_len_cfg) if spec is not None else 0,
+        }
+
+    def _apply(self, knob, value):
+        if knob == "token_budget":
+            self.gateway.scheduler.budget = int(value)
+        elif knob == "queue_depth":
+            self.gateway.queue.max_depth = int(value)
+        elif knob == "draft_len":
+            spec = getattr(self.gateway.engine, "spec", None)
+            if spec is not None:
+                spec.set_draft_len(int(value))
+
+    def _floor(self, knob):
+        return {"token_budget": self.min_token_budget,
+                "queue_depth": self.min_queue_depth,
+                "draft_len": self.min_draft_len}[knob]
+
+    def _step_down(self):
+        """Shed latency: walk the knobs cheapest-first and shrink the
+        first one still above its floor. → action string or None."""
+        current = self._current()
+        for knob in _KNOBS:
+            if self.defaults[knob] == 0:  # feature off (e.g. no spec)
+                continue
+            floor = self._floor(knob)
+            value = current[knob]
+            if value <= floor:
+                continue
+            if knob == "draft_len":
+                new = max(floor, value // 2)
+            else:
+                new = max(floor, (3 * value) // 4)
+            if new < value:
+                self._apply(knob, new)
+                logger.info(f"autotune: {knob} {value} -> {new} "
+                            f"(p99 TTFT over {self.slo_p99_ttft_ms}ms SLO)")
+                return f"down:{knob}"
+        return None
+
+    def _step_up(self):
+        """Recover throughput: walk the knobs most-impactful-first and
+        grow the first one still below its default (never past it)."""
+        current = self._current()
+        for knob in reversed(_KNOBS):
+            default = self.defaults[knob]
+            value = current[knob]
+            if default == 0 or value >= default:
+                continue
+            if knob == "draft_len":
+                new = min(default, max(value + 1, value * 2))
+            else:
+                new = min(default, max(value + 1, (4 * value) // 3))
+            if new > value:
+                self._apply(knob, new)
+                logger.info(f"autotune: {knob} {value} -> {new} "
+                            f"(SLO healthy, recovering toward defaults)")
+                return f"up:{knob}"
+        return None
+
+    def _restore_defaults(self):
+        for knob in _KNOBS:
+            if self.defaults[knob]:
+                self._apply(knob, self.defaults[knob])
+        logger.warning(
+            f"autotune: sustained p99 TTFT breach "
+            f"(>{self.rollback_ticks} ticks over {self.slo_p99_ttft_ms}ms) "
+            f"— rolled every knob back to defaults and froze the "
+            f"controller (reset() to re-arm)")
+        return "defaults"
+
+    # ---------------------------------------------------------- observe
+    def converged(self) -> bool:
+        """True when the controller is holding a level: no pending
+        cooldown and the last decision was not an adjustment."""
+        with self._lock:
+            return self._cooldown == 0 and not self._last_action.startswith(
+                ("down:", "up:")) and self._last_action != "rollback"
+
+    def stats(self) -> dict:
+        current = self._current()
+        with self._lock:
+            return {
+                "slo_p99_ttft_ms": self.slo_p99_ttft_ms,
+                "token_budget": current["token_budget"],
+                "queue_depth": current["queue_depth"],
+                "draft_len": current["draft_len"],
+                "default_token_budget": self.defaults["token_budget"],
+                "ticks": self.ticks,
+                "adjustments": self.adjustments,
+                "rollbacks": self.rollbacks,
+                "breach_ticks": self._breach,
+                "clear_ticks": self._clear,
+                "clear_required": self._clear_required,
+                "cooldown": self._cooldown,
+                "frozen": int(self._frozen),
+                "last_action": self._last_action,
+            }

@@ -1,0 +1,229 @@
+"""Serving knob space: enumeration + cheap static pruning.
+
+The search space is **derived from the env registry's typed schema**
+(`env_registry.tunable_knobs()`), the same artifact behind
+``ds_lint --list-knobs --format=json`` — a knob the registry doesn't
+mark tunable cannot be searched, and a candidate value outside a
+knob's declared range/choices is rejected before anything is built.
+
+On top of the env-var dimensions the space carries the three
+*serving-scope* dimensions the gateway config owns (they have no env
+var because they are per-deployment, not per-process):
+``serving.token_budget``, ``serving.max_burst``,
+``serving.max_queue_depth``.
+
+Static pruning kills candidates that arithmetic alone rules out —
+HBM (params + KV pool) over budget, block-size divisibility, budgets
+that cannot fit one KV block — so replay time is spent only on
+configurations that could actually boot. Stdlib-only.
+"""
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+from deepspeed_tpu.utils.env_registry import get_knob, tunable_knobs
+
+# serving-scope dimensions (gateway config fields, not env vars)
+SERVING_DIMS = ("serving.token_budget", "serving.max_burst",
+                "serving.max_queue_depth")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelProfile:
+    """The arithmetic the static pruner needs — nothing model-specific
+    beyond sizes, so it works from a config without building anything."""
+    param_bytes: int
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    kv_dtype_bytes: int = 2          # bf16 KV
+    hbm_bytes: int = 16 << 30        # one v4/v5e-class chip
+    kv_block_size: int = 16
+    num_kv_blocks: int = 512
+    max_ctx_tokens: int = 2048
+    max_tokens: int = 256            # engine per-step token ceiling
+
+    def kv_bytes_per_token(self) -> int:
+        # K and V, every layer
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim \
+            * self.kv_dtype_bytes
+
+    def kv_pool_bytes(self, num_blocks: Optional[int] = None,
+                      block_size: Optional[int] = None) -> int:
+        blocks = self.num_kv_blocks if num_blocks is None else num_blocks
+        size = self.kv_block_size if block_size is None else block_size
+        return blocks * size * self.kv_bytes_per_token()
+
+
+def default_levels(knob) -> List:
+    """Grid levels for one registry knob: booleans enumerate both ways,
+    ranged ints get a small geometric ladder inside [min, max], choices
+    enumerate. Callers override per-dimension when they know better."""
+    if knob.kind in ("bool", "optional_bool"):
+        return [False, True]
+    if knob.choices is not None:
+        return list(knob.choices)
+    if knob.kind == "int":
+        lo = knob.min_value if knob.min_value is not None else 0
+        hi = knob.max_value if knob.max_value is not None else max(lo, 8) * 4
+        levels, v = [], max(lo, 1)
+        if lo == 0:
+            levels.append(0)
+        while v <= hi and len(levels) < 6:
+            levels.append(v)
+            v *= 2
+        return levels or [lo]
+    raise ValueError(f"no default levels for knob {knob.name} "
+                     f"({knob.kind}) — pass explicit levels")
+
+
+class ServingKnobSpace:
+    """A named set of dimensions, each a finite level list."""
+
+    def __init__(self, dims: Dict[str, Sequence]):
+        if not dims:
+            raise ValueError("empty knob space")
+        self.dims = {}
+        for name, levels in dims.items():
+            levels = list(levels)
+            if not levels:
+                raise ValueError(f"dimension {name} has no levels")
+            if name.startswith("DS_"):
+                knob = get_knob(name)  # must be registered
+                if knob.tuning is None:
+                    raise ValueError(
+                        f"{name} carries no tuning tag in env_registry — "
+                        f"mark it tuning='offline'/'online' to search it")
+                for v in levels:
+                    err = _knob_value_error(knob, v)
+                    if err:
+                        raise ValueError(f"{name} level {v!r}: {err}")
+            elif name not in SERVING_DIMS:
+                raise ValueError(
+                    f"unknown dimension {name!r} (DS_* registry knob or "
+                    f"one of {SERVING_DIMS})")
+            self.dims[name] = levels
+
+    @classmethod
+    def from_registry(cls, *, tag: Optional[str] = None,
+                      include: Optional[Sequence[str]] = None,
+                      serving_dims: Optional[Dict[str, Sequence]] = None,
+                      overrides: Optional[Dict[str, Sequence]] = None
+                      ) -> "ServingKnobSpace":
+        """Build the space from every registry knob tagged tunable
+        (optionally one ``tag``, optionally restricted to ``include``),
+        plus explicit serving-scope dimensions."""
+        dims = {}
+        for knob in tunable_knobs(tag):
+            if include is not None and knob.name not in include:
+                continue
+            dims[knob.name] = (overrides or {}).get(
+                knob.name, default_levels(knob))
+        for name, levels in (serving_dims or {}).items():
+            dims[name] = levels
+        return cls(dims)
+
+    def size(self) -> int:
+        n = 1
+        for levels in self.dims.values():
+            n *= len(levels)
+        return n
+
+    def enumerate(self) -> List[Dict]:
+        names = sorted(self.dims)
+        out = []
+        for combo in itertools.product(*(self.dims[n] for n in names)):
+            out.append(dict(zip(names, combo)))
+        return out
+
+
+def _knob_value_error(knob, value) -> Optional[str]:
+    if knob.kind in ("bool", "optional_bool"):
+        if not isinstance(value, (bool, int)):
+            return f"expected a bool, got {type(value).__name__}"
+        return None
+    if knob.kind == "int":
+        if not isinstance(value, int) or isinstance(value, bool):
+            return f"expected an int, got {type(value).__name__}"
+        if knob.min_value is not None and value < knob.min_value:
+            return f"below registered min {knob.min_value}"
+        if knob.max_value is not None and value > knob.max_value:
+            return f"above registered max {knob.max_value}"
+        return None
+    if knob.choices is not None and value not in knob.choices:
+        return f"not in registered choices {knob.choices}"
+    return None
+
+
+# ------------------------------------------------------- static pruning
+def static_violations(candidate: Dict, profile: ModelProfile) -> List[str]:
+    """Reasons arithmetic alone rules this candidate out (empty =
+    survives to replay). Checks are deliberately cheap — integer math
+    on the profile, no model construction."""
+    reasons = []
+    for name, value in candidate.items():
+        if name.startswith("DS_"):
+            err = _knob_value_error(get_knob(name), value)
+            if err:
+                reasons.append(f"{name}={value!r}: {err}")
+
+    budget = candidate.get("serving.token_budget", 0) or profile.max_tokens
+    burst = candidate.get("serving.max_burst", 16)
+    depth = candidate.get("serving.max_queue_depth", 256)
+    draft = candidate.get("DS_SPEC_DRAFT_LEN", 0)
+
+    # HBM: params + the KV pool must fit the chip
+    kv_bytes = profile.kv_pool_bytes()
+    total = profile.param_bytes + kv_bytes
+    if total > profile.hbm_bytes:
+        reasons.append(
+            f"hbm: params ({profile.param_bytes >> 20} MiB) + KV pool "
+            f"({kv_bytes >> 20} MiB) = {total >> 20} MiB exceeds "
+            f"{profile.hbm_bytes >> 20} MiB")
+    # block-size divisibility: the pool and context must be whole blocks
+    if profile.kv_block_size < 1 or \
+            profile.max_ctx_tokens % profile.kv_block_size:
+        reasons.append(
+            f"blocks: max_ctx_tokens {profile.max_ctx_tokens} is not a "
+            f"multiple of kv_block_size {profile.kv_block_size}")
+    # token budget: must clear the engine step ceiling and hold at least
+    # one full KV block of prefill, or admission can live-lock
+    if budget > profile.max_tokens:
+        reasons.append(f"budget: serving.token_budget {budget} exceeds "
+                       f"engine max_tokens {profile.max_tokens}")
+    if budget < profile.kv_block_size:
+        reasons.append(f"budget: serving.token_budget {budget} below one "
+                       f"KV block ({profile.kv_block_size} tokens)")
+    if burst < 1:
+        reasons.append(f"burst: serving.max_burst {burst} must be >= 1")
+    if depth < 1:
+        reasons.append(f"depth: serving.max_queue_depth {depth} must be >= 1")
+    # speculation: a draft burst (draft + verify token per sequence)
+    # must fit the step budget or spec can never fire
+    if draft and budget // (draft + 1) < 1:
+        reasons.append(f"spec: DS_SPEC_DRAFT_LEN {draft} + 1 verify token "
+                       f"exceeds token budget {budget}")
+    return reasons
+
+
+def env_overrides(candidate: Dict) -> Dict[str, str]:
+    """The DS_* environment assignments a candidate implies (the caller
+    applies them around engine construction; the library never writes
+    ``os.environ`` itself). Booleans serialize as "1"/"0"."""
+    out = {}
+    for name, value in candidate.items():
+        if not name.startswith("DS_"):
+            continue
+        if isinstance(value, bool):
+            out[name] = "1" if value else "0"
+        else:
+            out[name] = str(value)
+    return out
+
+
+def serving_overrides(candidate: Dict) -> Dict[str, object]:
+    """The ServingConfig field overrides a candidate implies."""
+    return {name.split(".", 1)[1]: value
+            for name, value in candidate.items()
+            if name.startswith("serving.")}

@@ -1,0 +1,227 @@
+"""Offline serving tuner: trace-replay search with successive halving.
+
+``ServingTuner`` takes a :class:`ServingKnobSpace`, a
+:class:`ServingTrace`, and a ``build_fn(candidate) -> gateway``
+(the caller owns engine construction — it applies
+:func:`serving_space.env_overrides` around the build and tears the
+gateway down after measurement; at debug scale a fake gateway works
+too, which is how the unit tests run the whole search on CPU).
+
+Search = classic successive halving over one trace: rung 0 replays a
+short prefix of the trace on every surviving candidate, ranks them,
+keeps the top ``1/eta``, and doubles the prefix — so the full trace is
+only ever replayed by finalists. A candidate that blows the p99-TTFT
+SLO at any rung is early-stopped (it cannot advance no matter its
+throughput); the measurement that killed it is kept for the report.
+
+The result serializes to a deployable config JSON: the winning knob
+assignment, its per-rung predicted latency/throughput curve, and the
+full leaderboard — :func:`load_tuned_config` reads it back and the
+gateway applies the serving-scope knobs when ``DS_AUTOTUNE_CONFIG``
+points at it. Stdlib-only.
+"""
+
+import dataclasses
+import json
+import math
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.autotuning.serving_space import (ModelProfile,
+                                                    ServingKnobSpace,
+                                                    static_violations)
+from deepspeed_tpu.autotuning.trace import ReplayReport, ServingTrace
+
+TUNED_CONFIG_VERSION = 1
+
+
+@dataclasses.dataclass
+class CandidateScore:
+    candidate: Dict
+    gen_tok_s: float
+    p99_ttft_ms: Optional[float]
+    slo_violated: bool
+    rung: int
+    requests: int
+
+    def to_json(self) -> Dict:
+        return {"candidate": self.candidate,
+                "gen_tok_s": round(self.gen_tok_s, 2),
+                "p99_ttft_ms": self.p99_ttft_ms,
+                "slo_violated": self.slo_violated,
+                "rung": self.rung, "requests": self.requests}
+
+
+@dataclasses.dataclass
+class TuningResult:
+    best: Optional[Dict]
+    predicted: Dict                  # winner's per-rung curve + finals
+    leaderboard: List[CandidateScore]
+    pruned: List[Dict]               # {candidate, reasons}
+    searched: int                    # candidates that reached replay
+    replays: int                     # replay measurements performed
+    trace_summary: Dict
+    slo_p99_ttft_ms: Optional[float]
+
+    def to_json(self) -> Dict:
+        return {
+            "version": TUNED_CONFIG_VERSION,
+            "knobs": self.best,
+            "predicted": self.predicted,
+            "slo_p99_ttft_ms": self.slo_p99_ttft_ms,
+            "trace": self.trace_summary,
+            "searched": self.searched,
+            "replays": self.replays,
+            "pruned": len(self.pruned),
+            "pruned_examples": self.pruned[:8],
+            "leaderboard": [s.to_json() for s in self.leaderboard[:16]],
+        }
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as fd:
+            json.dump(self.to_json(), fd, indent=2, sort_keys=True)
+            fd.write("\n")
+        return path
+
+
+def load_tuned_config(path: str) -> Dict:
+    """Read a tuned-config JSON back; raises ``ValueError`` on a
+    missing/garbled file or a future version (a bad deploy artifact
+    must fail loudly, not half-apply)."""
+    try:
+        with open(path) as fd:
+            doc = json.load(fd)
+    except (OSError, json.JSONDecodeError) as err:
+        raise ValueError(f"tuned config {path} unreadable: {err}") from None
+    if not isinstance(doc, dict) or "knobs" not in doc:
+        raise ValueError(f"tuned config {path} has no 'knobs' object")
+    if int(doc.get("version", 0)) > TUNED_CONFIG_VERSION:
+        raise ValueError(f"tuned config {path} is version "
+                         f"{doc.get('version')}; this build reads "
+                         f"<= {TUNED_CONFIG_VERSION}")
+    return doc
+
+
+class ServingTuner:
+    """Successive-halving search over a knob space against one trace.
+
+    ``replay_fn(gateway, trace)`` defaults to lockstep replay (fully
+    deterministic); pass a realtime replayer for wall-clock-faithful
+    measurement on a live engine. ``build_fn`` must return a FRESH
+    gateway per call; the tuner drains it after measuring (pass
+    ``teardown=False`` if build_fn manages lifetime itself).
+    """
+
+    def __init__(self, space: ServingKnobSpace, trace: ServingTrace,
+                 build_fn: Callable[[Dict], object], *,
+                 profile: Optional[ModelProfile] = None,
+                 slo_p99_ttft_ms: Optional[float] = None,
+                 eta: int = 3, min_rung_requests: int = 8,
+                 replay_fn: Optional[Callable] = None,
+                 teardown: bool = True):
+        if eta < 2:
+            raise ValueError(f"eta must be >= 2, got {eta}")
+        if len(trace) < 1:
+            raise ValueError("cannot tune against an empty trace")
+        self.space = space
+        self.trace = trace
+        self.build_fn = build_fn
+        self.profile = profile
+        self.slo_p99_ttft_ms = slo_p99_ttft_ms
+        self.eta = int(eta)
+        self.min_rung_requests = max(1, int(min_rung_requests))
+        self.replay_fn = replay_fn or self._lockstep
+        self.teardown = teardown
+        self.replays = 0
+
+    @staticmethod
+    def _lockstep(gateway, trace):
+        from deepspeed_tpu.autotuning.trace import replay_lockstep
+        return replay_lockstep(gateway, trace)
+
+    # ---------------------------------------------------------- search
+    def search(self) -> TuningResult:
+        candidates = self.space.enumerate()
+        survivors, pruned = [], []
+        for cand in candidates:
+            reasons = (static_violations(cand, self.profile)
+                       if self.profile is not None else [])
+            if reasons:
+                pruned.append({"candidate": cand, "reasons": reasons})
+            else:
+                survivors.append(cand)
+        leaderboard: List[CandidateScore] = []
+        curves: Dict[int, List[Dict]] = {id(c): [] for c in survivors}
+        rung, n_requests = 0, min(self.min_rung_requests, len(self.trace))
+        scored = [(c, None) for c in survivors]
+        while scored:
+            rung_scores = []
+            for cand, _ in scored:
+                score = self._measure(cand, rung, n_requests)
+                curves[id(cand)].append({
+                    "requests": score.requests,
+                    "gen_tok_s": round(score.gen_tok_s, 2),
+                    "p99_ttft_ms": score.p99_ttft_ms})
+                rung_scores.append(score)
+            # SLO early-stop: violators cannot advance, whatever their
+            # throughput; among violators, smaller p99 ranks higher so
+            # the report stays informative when nothing satisfies
+            rung_scores.sort(key=self._rank)
+            leaderboard = rung_scores + [s for s in leaderboard
+                                         if s.candidate not in
+                                         [r.candidate for r in rung_scores]]
+            alive = [s for s in rung_scores if not s.slo_violated]
+            if not alive:
+                break
+            if n_requests >= len(self.trace) or len(alive) == 1:
+                break
+            keep = max(1, math.ceil(len(alive) / self.eta))
+            scored = [(s.candidate, s) for s in alive[:keep]]
+            rung += 1
+            n_requests = min(len(self.trace), n_requests * 2)
+        best_score = next((s for s in leaderboard if not s.slo_violated),
+                          None)
+        predicted = {}
+        if best_score is not None:
+            predicted = {
+                "gen_tok_s": round(best_score.gen_tok_s, 2),
+                "p99_ttft_ms": best_score.p99_ttft_ms,
+                "curve": curves[id(best_score.candidate)],
+            }
+        return TuningResult(
+            best=best_score.candidate if best_score else None,
+            predicted=predicted, leaderboard=leaderboard, pruned=pruned,
+            searched=len(survivors), replays=self.replays,
+            trace_summary=self.trace.summary(),
+            slo_p99_ttft_ms=self.slo_p99_ttft_ms)
+
+    def _rank(self, score: CandidateScore):
+        if score.slo_violated:
+            return (1, score.p99_ttft_ms or float("inf"))
+        return (0, -score.gen_tok_s)
+
+    def _measure(self, candidate: Dict, rung: int,
+                 n_requests: int) -> CandidateScore:
+        gateway = self.build_fn(candidate)
+        try:
+            report = self.replay_fn(gateway, self.trace.prefix(n_requests))
+        finally:
+            if self.teardown:
+                try:
+                    gateway.drain()
+                except Exception:
+                    try:
+                        gateway.shutdown()
+                    except Exception:
+                        pass
+        self.replays += 1
+        if not isinstance(report, ReplayReport):
+            raise TypeError(f"replay_fn returned {type(report).__name__}, "
+                            f"expected ReplayReport")
+        violated = (self.slo_p99_ttft_ms is not None
+                    and report.p99_ttft_ms is not None
+                    and report.p99_ttft_ms > self.slo_p99_ttft_ms)
+        return CandidateScore(candidate=candidate,
+                              gen_tok_s=report.gen_tok_s,
+                              p99_ttft_ms=report.p99_ttft_ms,
+                              slo_violated=violated, rung=rung,
+                              requests=n_requests)

@@ -66,6 +66,16 @@ class SpecDecodeState:
                 return 0
             return self.draft_len_cfg
 
+    def set_draft_len(self, n: int) -> None:
+        """Live adjustment hook (the online SLO controller's cheapest
+        knob): change the per-step draft budget on a hot engine. Takes
+        effect on the next verify burst; per-sequence disables stand."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"draft_len must be >= 1, got {n}")
+        with self._lock:
+            self.draft_len_cfg = n
+
     def note(self, uid, accepted: int, drafted: int) -> None:
         """Record one verify result for ``uid``: update the global
         counters and the per-sequence EMA, disabling drafting once a

@@ -12,7 +12,8 @@ from deepspeed_tpu.serving.admission import (AdmissionQueue, CapacityGate,
                                              GatewayFailedError, QueueFullError,
                                              RequestCancelledError, RequestShedError,
                                              RequestTooLargeError, ServingError)
-from deepspeed_tpu.serving.config import ServingConfig, get_serving_config
+from deepspeed_tpu.serving.config import (ServingAutotuneConfig,
+                                          ServingConfig, get_serving_config)
 from deepspeed_tpu.serving.fleet import (FaultyReplica, FleetConfig,
                                          FleetRouter, GatewayReplica,
                                          HandoffFailedError, HandoffManager,
@@ -26,7 +27,8 @@ from deepspeed_tpu.serving.refresh import (CanaryDivergenceError,
                                            WeightRefreshError)
 
 __all__ = [
-    "ServingGateway", "RequestHandle", "ServingConfig", "get_serving_config",
+    "ServingGateway", "RequestHandle", "ServingConfig",
+    "ServingAutotuneConfig", "get_serving_config",
     "ServingMetrics", "AdmissionQueue", "CapacityGate", "ServingError",
     "GatewayClosedError", "GatewayFailedError", "QueueFullError",
     "RequestTooLargeError", "RequestShedError", "RequestCancelledError",

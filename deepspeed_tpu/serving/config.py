@@ -20,6 +20,40 @@ def get_serving_config(param_dict):
     return ServingConfig(**param_dict.get("serving", {}))
 
 
+class ServingAutotuneConfig(DeepSpeedConfigModel):
+    """The online SLO controller's targets and hysteresis (the
+    ``serving.autotune`` sub-block). ``enabled`` defers to the
+    ``DS_AUTOTUNE`` tri-state knob — env set wins in both directions.
+
+    Tick counts, not seconds, parameterize the hysteresis so the same
+    config behaves identically under any ``interval_s``: a knob steps
+    DOWN only after ``breach_ticks`` consecutive breached samples, UP
+    only after ``clear_ticks`` consecutive healthy ones, every move is
+    followed by a ``cooldown_ticks`` hold, and ``rollback_ticks``
+    consecutive breaches trip the hard guard (defaults restored, the
+    controller freezes)."""
+
+    enabled: bool = False
+    interval_s: float = Field(0.25, gt=0)
+    p99_ttft_slo_ms: float = Field(500.0, gt=0)
+    breach_ticks: int = Field(2, ge=1)
+    clear_ticks: int = Field(4, ge=1)
+    cooldown_ticks: int = Field(2, ge=0)
+    rollback_ticks: int = Field(8, ge=1)
+    min_token_budget: int = Field(0, ge=0)  # 0 = one KV block
+    min_queue_depth: int = Field(1, ge=1)
+    min_draft_len: int = Field(1, ge=1)
+
+    @model_validator(mode="after")
+    def _check_autotune(self):
+        if self.rollback_ticks < self.breach_ticks:
+            raise ValueError(
+                f"serving.autotune.rollback_ticks ({self.rollback_ticks}) "
+                f"must be >= breach_ticks ({self.breach_ticks}) — rollback "
+                f"is the guard behind stepping, not in front of it")
+        return self
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Request-level front-end knobs for :class:`ServingGateway`.
 
@@ -58,6 +92,13 @@ class ServingConfig(DeepSpeedConfigModel):
     # -- lifecycle / pump --------------------------------------------
     drain_timeout_s: float = Field(120.0, gt=0)
     idle_poll_s: float = Field(0.001, gt=0)  # pump wait when no work
+
+    # -- autotuning --------------------------------------------------
+    # online SLO controller (token budget / admission depth / spec
+    # draft length adjusted live against p99 TTFT); the DS_AUTOTUNE
+    # env knob overrides `enabled` in both directions
+    autotune: ServingAutotuneConfig = Field(
+        default_factory=ServingAutotuneConfig)
 
     # -- metrics -----------------------------------------------------
     metrics_window: int = Field(1024, ge=16)  # percentile reservoir size

@@ -47,6 +47,39 @@ from deepspeed_tpu.utils.sanitize import tracked_lock
 _DONE = object()  # stream sentinel
 _HANDOFF_OUTBOX = 64  # exported records kept (LRU) awaiting router pickup
 
+# ServingConfig fields a tuned-config JSON (offline serving tuner) may
+# override through DS_AUTOTUNE_CONFIG — the cheap serving-scope knobs;
+# engine-scope knobs in the file need a rebuild and are applied by the
+# deploy tooling via their DS_* env vars instead
+_TUNABLE_SERVING_FIELDS = ("token_budget", "max_burst", "max_queue_depth")
+
+
+def _apply_tuned_config(cfg):
+    """When ``DS_AUTOTUNE_CONFIG`` points at a tuned-config JSON, fold
+    its serving-scope knobs over ``cfg`` (validated copy). Unset — the
+    overwhelmingly common case — returns ``cfg`` untouched."""
+    from deepspeed_tpu.utils.env_registry import env_raw
+    path = env_raw("DS_AUTOTUNE_CONFIG")
+    if path is None or not str(path).strip():
+        return cfg
+    from deepspeed_tpu.autotuning.serving_tuner import load_tuned_config
+    doc = load_tuned_config(path)
+    overrides = {}
+    for name, value in (doc.get("knobs") or {}).items():
+        if not name.startswith("serving."):
+            continue
+        field = name.split(".", 1)[1]
+        if field not in _TUNABLE_SERVING_FIELDS:
+            raise ValueError(
+                f"tuned config {path}: {name} is not a gateway-applicable "
+                f"serving knob (expected one of "
+                f"{['serving.' + f for f in _TUNABLE_SERVING_FIELDS]})")
+        overrides[field] = value
+    if not overrides:
+        return cfg
+    logger.info(f"serving: applying tuned config {path}: {overrides}")
+    return type(cfg)(**{**cfg.model_dump(), **overrides})
+
 
 class RequestHandle:
     """Client-side view of one in-flight request.
@@ -134,7 +167,7 @@ class ServingGateway:
         serving metrics are published through it every
         ``metrics_interval_steps`` engine steps."""
         self.engine = engine
-        self.config = config or ServingConfig()
+        self.config = _apply_tuned_config(config or ServingConfig())
         self.monitor = monitor
         cfg = self.config
         self.scheduler = DynamicSplitFuseScheduler(
@@ -174,6 +207,16 @@ class ServingGateway:
         self._wake = threading.Event()
         self._pump_stop = False
         self._pump_thread = None
+        # serving autotuner hooks: an optional traffic recorder (attach
+        # via attach_recorder()) and the online SLO controller. Both off
+        # is the default and costs one attribute check per submit — the
+        # DS_AUTOTUNE=0 pipeline is otherwise byte-identical
+        self._recorder = None
+        self.controller = None
+        from deepspeed_tpu.autotuning.online import (OnlineSLOController,
+                                                     autotune_enabled)
+        if autotune_enabled(cfg):
+            self.controller = OnlineSLOController(self, cfg.autotune)
         if auto_start:
             self.start()
 
@@ -206,6 +249,11 @@ class ServingGateway:
         except Exception:
             self.metrics.count("rejected_too_large")
             raise
+        recorder = self._recorder
+        if recorder is not None:
+            # record OFFERED traffic (pre-admission): a replay must let
+            # the candidate config make its own admission decisions
+            recorder.record(prompt, max_new, prio)
         handle = RequestHandle(next(self._uids), prompt, max_new, prio,
                                deadline_ms / 1e3 if deadline_ms is not None else None,
                                spec=spec)
@@ -254,6 +302,19 @@ class ServingGateway:
             self._cancels.append(handle)
         self._wake.set()
 
+    # ------------------------------------------------------ trace recording
+    def attach_recorder(self, recorder):
+        """Record every feasible ``submit()`` into ``recorder`` (a
+        :class:`deepspeed_tpu.autotuning.trace.TraceRecorder`) until
+        :meth:`detach_recorder`. Returns the recorder for chaining."""
+        self._recorder = recorder
+        return recorder
+
+    def detach_recorder(self):
+        """Stop recording; returns the detached recorder (or None)."""
+        recorder, self._recorder = self._recorder, None
+        return recorder
+
     # ------------------------------------------------------------- lifecycle
     def start(self):
         if self._pump_thread is not None:
@@ -263,6 +324,8 @@ class ServingGateway:
         self._pump_thread = threading.Thread(target=self._run, name="ds-serve-pump",
                                              daemon=True)
         self._pump_thread.start()
+        if self.controller is not None:
+            self.controller.start()
 
     def drain(self, timeout=None):
         """Stop admitting, finish everything in flight (queued requests
@@ -274,6 +337,8 @@ class ServingGateway:
             if self._state in ("stopped", "failed"):
                 return
             self._state = "draining"
+        if self.controller is not None:
+            self.controller.stop()
         self.queue.close()
         self._wake.set()
         thread = self._pump_thread
@@ -452,6 +517,8 @@ class ServingGateway:
                 "paused": len(self._paused)}
 
     def _stop_pump(self):
+        if self.controller is not None:
+            self.controller.stop()
         thread = self._pump_thread
         with self._state_lock:
             self._pump_stop = True

@@ -10,7 +10,10 @@ All reads now route through here so:
 - every knob carries a name, default, and description, which powers
   the ``ds_lint --list-knobs`` docs generator (docs/MIGRATING.md);
 - the ``env-registry`` lint rule can flag any ``DS_*`` read that
-  bypasses the registry.
+  bypasses the registry;
+- knobs optionally carry a *typed schema* (legal range / choices and a
+  tuning-relevance tag) so the serving autotuner and
+  ``ds_lint --list-knobs --format=json`` consume one source of truth.
 
 This module must stay dependency-free (stdlib only): it is imported by
 ``deepspeed_tpu.utils.logging`` (which reads ``DS_TPU_LOG_LEVEL``) and
@@ -20,7 +23,7 @@ pulls in jax or the rest of the package.
 
 import dataclasses
 import os
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 # the ONE truthiness rule; everything else is truthy (including "yes",
 # "on", "2", and arbitrary junk — kill switches err toward "set means on")
@@ -33,14 +36,31 @@ def parse_bool(raw: str) -> bool:
     return raw.strip().lower() not in _FALSY
 
 
+# tuning-relevance tags: None = not a tuning knob; "offline" = changing
+# it means rebuilding the engine (the offline tuner's search space);
+# "online" = cheap to flip on a live gateway (the SLO controller's
+# actuation surface)
+_TUNING_TAGS = (None, "offline", "online")
+
+
 @dataclasses.dataclass(frozen=True)
 class EnvKnob:
-    """One registered ``DS_*`` environment variable."""
+    """One registered ``DS_*`` environment variable.
+
+    ``min_value``/``max_value`` (int knobs) and ``choices`` (bool /
+    str-family knobs) describe the *legal* value space; ``tuning`` marks
+    whether — and how — the serving autotuner may search it. All three
+    are optional so plain kill switches stay one-line registrations.
+    """
     name: str
     kind: str  # bool | int | str | optional_bool | optional_str
     default: Union[bool, int, str, None]
     description: str
     consumer: str  # module that reads it — docs/debugging breadcrumb
+    min_value: Optional[int] = None
+    max_value: Optional[int] = None
+    choices: Optional[Tuple] = None
+    tuning: Optional[str] = None  # None | "offline" | "online"
 
     def describe_default(self) -> str:
         if self.kind in ("optional_bool", "optional_str"):
@@ -49,19 +69,70 @@ class EnvKnob:
             return "1" if self.default else "0"
         return str(self.default)
 
+    def doc_row(self) -> str:
+        """The knob's MIGRATING.md table row — the ONE format both
+        ``ds_lint --list-knobs`` and the knob-docs drift rule key on."""
+        return (f"| `{self.name}` | {self.kind} | `{self.describe_default()}` "
+                f"| {self.description} (read by `{self.consumer}`) |")
+
+    def schema(self) -> Dict:
+        """JSON-serializable typed schema entry (``--format=json`` and
+        the offline tuner's knob-space enumeration read this)."""
+        rng = (None if self.min_value is None and self.max_value is None
+               else [self.min_value, self.max_value])
+        return {
+            "name": self.name,
+            "type": self.kind,
+            "default": self.default,
+            "range": rng,
+            "choices": list(self.choices) if self.choices else None,
+            "tuning": self.tuning,
+            "description": self.description,
+            "consumer": self.consumer,
+            "doc_row": self.doc_row(),
+        }
+
 
 _REGISTRY: Dict[str, EnvKnob] = {}
 
 
 def register(name: str, kind: str, default, description: str,
-             consumer: str) -> EnvKnob:
+             consumer: str, *, min_value: Optional[int] = None,
+             max_value: Optional[int] = None, choices=None,
+             tuning: Optional[str] = None) -> EnvKnob:
     if not name.startswith("DS_"):
         raise ValueError(f"env knob {name!r} must start with DS_")
     if kind not in ("bool", "int", "str", "optional_bool", "optional_str"):
         raise ValueError(f"unknown knob kind {kind!r} for {name}")
     if name in _REGISTRY:
         raise ValueError(f"env knob {name} registered twice")
-    knob = EnvKnob(name, kind, default, description, consumer)
+    if tuning not in _TUNING_TAGS:
+        raise ValueError(f"unknown tuning tag {tuning!r} for {name} "
+                         f"(expected one of {_TUNING_TAGS})")
+    if (min_value is not None or max_value is not None) and kind != "int":
+        raise ValueError(f"min/max only apply to int knobs ({name} is "
+                         f"{kind})")
+    if min_value is not None and max_value is not None \
+            and min_value > max_value:
+        raise ValueError(f"{name}: min_value {min_value} > max_value "
+                         f"{max_value}")
+    if choices is not None:
+        if kind == "int":
+            raise ValueError(f"{name}: int knobs use min/max, not choices")
+        choices = tuple(choices)
+        if not choices:
+            raise ValueError(f"{name}: choices must be non-empty")
+    if kind == "int" and min_value is not None \
+            and int(default) < min_value:
+        raise ValueError(f"{name}: default {default} below min_value "
+                         f"{min_value}")
+    if kind == "int" and max_value is not None \
+            and int(default) > max_value:
+        raise ValueError(f"{name}: default {default} above max_value "
+                         f"{max_value}")
+    knob = EnvKnob(name, kind, default, description, consumer,
+                   min_value=min_value, max_value=max_value,
+                   choices=choices, tuning=tuning)
     _REGISTRY[name] = knob
     return knob
 
@@ -77,6 +148,22 @@ def get_knob(name: str) -> EnvKnob:
 
 def all_knobs() -> List[EnvKnob]:
     return sorted(_REGISTRY.values(), key=lambda k: k.name)
+
+
+def tunable_knobs(tag: Optional[str] = None) -> List[EnvKnob]:
+    """Knobs carrying a tuning tag (optionally restricted to one tag) —
+    the autotuner's search-space enumeration source."""
+    if tag is not None and tag not in _TUNING_TAGS:
+        raise ValueError(f"unknown tuning tag {tag!r}")
+    return [k for k in all_knobs()
+            if k.tuning is not None and (tag is None or k.tuning == tag)]
+
+
+def knob_schema() -> List[Dict]:
+    """The full typed knob schema as JSON-serializable dicts — the one
+    artifact ``ds_lint --list-knobs --format=json``, the MIGRATING.md
+    knob table, and the offline tuner all derive from."""
+    return [k.schema() for k in all_knobs()]
 
 
 # ------------------------------------------------------------------ readers
@@ -144,40 +231,48 @@ register("DS_PALLAS", "optional_bool", None,
 register("DS_FUSED_QMM", "bool", True,
          "Kill switch for the fused dequant-matmul Pallas kernels in "
          "quantized serving.",
-         "deepspeed_tpu/inference/quantization/quantization.py")
+         "deepspeed_tpu/inference/quantization/quantization.py",
+         tuning="offline")
 register("DS_FUSED_GMM", "optional_bool", None,
          "Kill switch for the fused quantized grouped (MoE expert) "
          "GEMM: 0 restores dequantize-at-entry for the whole MoE "
          "subtree, 1 forces the boxed fused dispatch; set it wins in "
          "both directions, unset defaults to on.",
-         "deepspeed_tpu/ops/grouped_gemm.py")
+         "deepspeed_tpu/ops/grouped_gemm.py",
+         tuning="offline")
 register("DS_PREFIX_CACHE", "optional_bool", None,
          "Kill switch for the radix prefix cache; set it wins in both "
          "directions, unset defers to the engine config.",
-         "deepspeed_tpu/inference/v2/prefix_cache/manager.py")
+         "deepspeed_tpu/inference/v2/prefix_cache/manager.py",
+         tuning="offline")
 register("DS_KV_TIER", "optional_bool", None,
          "Kill switch for the host-RAM KV spill tier (tier-2 of the "
          "prefix cache); set it wins in both directions, unset defers "
          "to the engine config.",
-         "deepspeed_tpu/inference/v2/kv_tier/__init__.py")
+         "deepspeed_tpu/inference/v2/kv_tier/__init__.py",
+         tuning="offline")
 register("DS_KV_TIER_BYTES", "int", 0,
          "Host byte budget for tier-2 KV blocks; 0 defers to the "
          "engine config's kv_tier.host_bytes.",
-         "deepspeed_tpu/inference/v2/kv_tier/__init__.py")
+         "deepspeed_tpu/inference/v2/kv_tier/__init__.py",
+         min_value=0, tuning="offline")
 register("DS_KV_TIER_QUANT", "optional_bool", None,
          "Store tier-2 KV blocks as per-(layer, block)-grouped int8 "
          "(~2x blocks per byte, lossy, never silently on); set it wins "
          "in both directions, unset defers to the engine config.",
-         "deepspeed_tpu/inference/v2/kv_tier/__init__.py")
+         "deepspeed_tpu/inference/v2/kv_tier/__init__.py",
+         tuning="offline")
 register("DS_SPEC_DECODE", "optional_bool", None,
          "Kill switch for self-speculative decoding (n-gram drafting + "
          "batched verify); set it wins in both directions, unset defers "
          "to the engine config.",
-         "deepspeed_tpu/inference/v2/spec/state.py")
+         "deepspeed_tpu/inference/v2/spec/state.py",
+         tuning="offline")
 register("DS_SPEC_DRAFT_LEN", "int", 0,
          "Override the max draft tokens proposed per verify step; 0 "
          "defers to the engine config's spec_decode.draft_len.",
-         "deepspeed_tpu/inference/v2/spec/state.py")
+         "deepspeed_tpu/inference/v2/spec/state.py",
+         min_value=0, max_value=32, tuning="online")
 register("DS_FLEET_FAILOVER", "bool", True,
          "Kill switch for cross-replica failover retries in the fleet "
          "router; off, a failed attempt fails the request immediately.",
@@ -189,7 +284,8 @@ register("DS_FLEET_PREFIX_ROUTING", "bool", True,
 register("DS_DISAGG", "optional_bool", None,
          "Kill switch for disaggregated prefill/decode serving; set it "
          "wins in both directions, unset defers to fleet.disagg.",
-         "deepspeed_tpu/serving/fleet/router.py")
+         "deepspeed_tpu/serving/fleet/router.py",
+         tuning="offline")
 register("DS_DISAGG_HANDOFF_DEADLINE_S", "int", 0,
          "Deadline (seconds) a published prefill->decode KV handoff may "
          "wait before it expires and the request is re-planned; 0 "
@@ -264,6 +360,22 @@ register("DS_ELASTIC_DOWN_SINCE", "optional_str", None,
          "deepspeed_tpu/runtime/engine.py")
 
 # Autotuning / build
+register("DS_AUTOTUNE", "optional_bool", None,
+         "Kill switch for the online SLO controller in the serving "
+         "gateway (live adjustment of token budget, admission depth, "
+         "and spec draft length); set it wins in both directions, "
+         "unset defers to serving.autotune.enabled.",
+         "deepspeed_tpu/autotuning/online.py")
+register("DS_AUTOTUNE_INTERVAL_S", "int", 0,
+         "Seconds between online SLO controller decision ticks; 0 "
+         "defers to serving.autotune.interval_s.",
+         "deepspeed_tpu/autotuning/online.py",
+         min_value=0, max_value=3600)
+register("DS_AUTOTUNE_CONFIG", "optional_str", None,
+         "Path to a tuned-config JSON emitted by the offline serving "
+         "tuner; the gateway applies its serving-scope knobs at "
+         "construction, unset leaves the hand-picked config untouched.",
+         "deepspeed_tpu/serving/gateway.py")
 register("DS_FORCE_PLATFORM", "optional_str", None,
          "Pin the JAX platform (cpu|tpu) in autotuner experiment "
          "runners; unset uses the default backend.",

@@ -1,0 +1,725 @@
+"""Serving autotuner: traces, knob space, offline search, online SLO
+controller.
+
+Four layers under test:
+
+- **traces** (stdlib): seeded synthesis is deterministic, jsonl
+  round-trips exactly, prefix-heavy mixes carry their share structure;
+- **knob schema / space**: the search space is validated against the
+  env registry's typed schema (the same artifact behind
+  ``ds_lint --list-knobs --format=json``) and static pruning kills
+  arithmetically-impossible candidates before anything is built;
+- **offline tuner**: successive halving picks the best SLO-satisfying
+  candidate, early-stops violators, and its config JSON round-trips
+  through ``load_tuned_config`` / ``DS_AUTOTUNE_CONFIG``;
+- **record -> replay determinism** on the REAL v2 engine: a trace
+  recorded off a live gateway and replayed twice produces bit-identical
+  greedy streams and identical admission decisions;
+- **online controller**: hysteresis (no single-tick reactions, no
+  oscillation on a step change in load), cheapest-knob-first stepping
+  bounded by floors and attach-time defaults, and the hard rollback
+  guard (sustained breach -> defaults restored, controller frozen).
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.autotuning import (ModelProfile, OnlineSLOController,
+                                      ReplayReport, ServingKnobSpace,
+                                      ServingTrace, ServingTuner, TraceRecorder,
+                                      autotune_enabled, env_overrides,
+                                      load_tuned_config, replay_lockstep,
+                                      serving_overrides, static_violations,
+                                      synthesize_trace)
+from deepspeed_tpu.autotuning.trace import TraceRequest
+from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.models import build_llama
+from deepspeed_tpu.serving import (ServingAutotuneConfig, ServingConfig,
+                                   ServingGateway)
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.utils import env_registry
+
+
+# ===================================================================== traces
+class TestTraces:
+
+    def test_synthesis_deterministic_per_seed(self):
+        for kind in ("steady", "bursty", "prefix_heavy"):
+            a = synthesize_trace(kind, 24, seed=7)
+            b = synthesize_trace(kind, 24, seed=7)
+            assert [r.to_json() for r in a] == [r.to_json() for r in b]
+            c = synthesize_trace(kind, 24, seed=8)
+            assert [r.to_json() for r in a] != [r.to_json() for r in c]
+
+    def test_arrivals_sorted_and_tokens_in_vocab(self):
+        tr = synthesize_trace("bursty", 64, seed=1, vocab_size=100)
+        arrivals = [r.arrival_s for r in tr]
+        assert arrivals == sorted(arrivals)
+        for r in tr:
+            assert r.max_new_tokens >= 1 and len(r.prompt) >= 1
+            assert all(3 <= t < 100 for t in r.prompt)
+
+    def test_prefix_heavy_share_structure(self):
+        tr = synthesize_trace("prefix_heavy", 32, seed=3, prefix_groups=3,
+                              prefix_share_len=8)
+        assert tr.summary()["prefix_share"] == 1.0
+        by_group = {}
+        for r in tr:
+            by_group.setdefault(r.prefix_group, set()).add(tuple(r.prompt[:8]))
+        assert set(by_group) <= {0, 1, 2}
+        for prefixes in by_group.values():
+            assert len(prefixes) == 1  # one shared prefix per family
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        tr = synthesize_trace("steady", 16, seed=5)
+        path = str(tmp_path / "t.trace.jsonl")
+        tr.save(path)
+        back = ServingTrace.load(path)
+        assert [r.to_json() for r in back] == [r.to_json() for r in tr]
+        assert back.meta == tr.meta
+        # header line first, one JSON object per line
+        lines = open(path).read().splitlines()
+        assert "trace_meta" in json.loads(lines[0])
+        assert len(lines) == 17
+
+    def test_future_version_rejected(self, tmp_path):
+        path = str(tmp_path / "future.trace.jsonl")
+        with open(path, "w") as fd:
+            fd.write(json.dumps({"trace_meta": {"version": 99}}) + "\n")
+        with pytest.raises(ValueError, match="version 99"):
+            ServingTrace.load(path)
+
+    def test_prefix_slices_in_order(self):
+        tr = synthesize_trace("steady", 12, seed=0)
+        head = tr.prefix(5)
+        assert len(head) == 5
+        assert [r.uid for r in head] == [r.uid for r in tr][:5]
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown trace kind"):
+            synthesize_trace("spiky", 4)
+
+    def test_recorder_offsets_and_groups(self):
+        rec = TraceRecorder(prefix_group_len=4)
+        rec.record([1, 2, 3, 4, 9], 8, 0)
+        rec.record([1, 2, 3, 4, 7], 4, 1)
+        rec.record([5, 6], 2, 0)  # too short for a group
+        tr = rec.trace()
+        assert tr.requests[0].arrival_s == 0.0  # clock starts at first
+        assert tr.requests[0].prefix_group == tr.requests[1].prefix_group == 0
+        assert tr.requests[2].prefix_group is None
+        assert [r.max_new_tokens for r in tr] == [8, 4, 2]
+
+
+# ============================================================== knob schema
+class TestKnobSchema:
+
+    def test_schema_entries_typed(self):
+        schema = {k["name"]: k for k in env_registry.knob_schema()}
+        assert "DS_AUTOTUNE" in schema and "DS_SPEC_DRAFT_LEN" in schema
+        for entry in schema.values():
+            assert entry["type"] in ("bool", "int", "str", "optional_bool",
+                                     "optional_str")
+            assert entry["tuning"] in (None, "offline", "online")
+            assert entry["doc_row"].startswith("| `DS_")
+        draft = schema["DS_SPEC_DRAFT_LEN"]
+        assert draft["tuning"] == "online"
+        assert draft["range"] == [0, 32]
+
+    def test_tunable_knobs_filters_by_tag(self):
+        names = {k.name for k in env_registry.tunable_knobs()}
+        online = {k.name for k in env_registry.tunable_knobs("online")}
+        assert "DS_SPEC_DRAFT_LEN" in online
+        assert online <= names
+        assert "DS_AUTOTUNE" not in names  # the enable switch is not a dim
+
+    def test_register_validation(self):
+        with pytest.raises(ValueError, match="unknown tuning tag"):
+            env_registry.register("DS_TEST_BAD_TAG", "int", 0, "x", "y",
+                                  tuning="sometimes")
+        with pytest.raises(ValueError, match="min_value 8 > max_value"):
+            env_registry.register("DS_TEST_BAD_RANGE", "int", 0, "x", "y",
+                                  min_value=8, max_value=4)
+        with pytest.raises(ValueError, match="below min_value"):
+            env_registry.register("DS_TEST_BAD_DEFAULT", "int", 0, "x", "y",
+                                  min_value=2)
+        with pytest.raises(ValueError, match="min/max only apply"):
+            env_registry.register("DS_TEST_BAD_KIND", "bool", True, "x", "y",
+                                  min_value=0)
+        # nothing half-registered by the failed attempts
+        for name in ("DS_TEST_BAD_TAG", "DS_TEST_BAD_RANGE",
+                     "DS_TEST_BAD_DEFAULT", "DS_TEST_BAD_KIND"):
+            with pytest.raises(KeyError):
+                env_registry.get_knob(name)
+
+    def test_cli_json_matches_registry(self):
+        from tools.graft_lint.cli import (format_knobs_json,
+                                          format_knobs_markdown)
+        doc = json.loads(format_knobs_json())
+        assert doc["version"] == 1
+        by_name = {k["name"]: k for k in doc["knobs"]}
+        assert "DS_AUTOTUNE" in by_name and "DS_AUTOTUNE_CONFIG" in by_name
+        # one source of truth: every markdown table row IS a doc_row
+        table_rows = [l for l in format_knobs_markdown().splitlines()
+                      if l.startswith("| `DS_")]
+        assert sorted(table_rows) == sorted(k["doc_row"]
+                                            for k in doc["knobs"])
+
+
+# ================================================================ knob space
+class TestKnobSpace:
+
+    def test_enumerate_and_size(self):
+        space = ServingKnobSpace({"serving.token_budget": [32, 64],
+                                  "DS_SPEC_DRAFT_LEN": [0, 4, 8]})
+        assert space.size() == 6
+        combos = space.enumerate()
+        assert len(combos) == 6
+        assert {"DS_SPEC_DRAFT_LEN": 0, "serving.token_budget": 32} in combos
+
+    def test_untagged_knob_rejected(self):
+        with pytest.raises(ValueError, match="no tuning tag"):
+            ServingKnobSpace({"DS_FLEET_FAILOVER": [True, False]})
+
+    def test_out_of_range_level_rejected(self):
+        with pytest.raises(ValueError, match="above registered max"):
+            ServingKnobSpace({"DS_SPEC_DRAFT_LEN": [0, 64]})
+
+    def test_unknown_dimension_rejected(self):
+        with pytest.raises(ValueError, match="unknown dimension"):
+            ServingKnobSpace({"serving.nope": [1]})
+
+    def test_from_registry_include(self):
+        space = ServingKnobSpace.from_registry(
+            include=["DS_SPEC_DRAFT_LEN"],
+            serving_dims={"serving.token_budget": [64, 128]})
+        assert set(space.dims) == {"DS_SPEC_DRAFT_LEN",
+                                   "serving.token_budget"}
+        assert all(0 <= v <= 32 for v in space.dims["DS_SPEC_DRAFT_LEN"])
+
+    def test_static_pruning_arithmetic(self):
+        profile = ModelProfile(param_bytes=4 << 30, num_layers=16,
+                               num_kv_heads=8, head_dim=128,
+                               hbm_bytes=16 << 30, kv_block_size=16,
+                               num_kv_blocks=512, max_ctx_tokens=2048,
+                               max_tokens=256)
+        assert static_violations({"serving.token_budget": 128}, profile) == []
+        # budget over the engine step ceiling
+        v = static_violations({"serving.token_budget": 512}, profile)
+        assert any("exceeds engine max_tokens" in r for r in v)
+        # budget under one KV block can live-lock admission
+        v = static_violations({"serving.token_budget": 8}, profile)
+        assert any("below one" in r for r in v)
+        # draft burst must fit the budget
+        v = static_violations({"serving.token_budget": 16,
+                               "DS_SPEC_DRAFT_LEN": 31}, profile)
+        assert any("spec" in r for r in v)
+        # HBM: params + KV pool over the chip
+        fat = ModelProfile(param_bytes=15 << 30, num_layers=16,
+                           num_kv_heads=8, head_dim=128,
+                           hbm_bytes=16 << 30, num_kv_blocks=4096)
+        v = static_violations({"serving.token_budget": 128}, fat)
+        assert any(r.startswith("hbm:") for r in v)
+        # block divisibility
+        odd = ModelProfile(param_bytes=1 << 30, num_layers=2,
+                           num_kv_heads=2, head_dim=64,
+                           kv_block_size=16, max_ctx_tokens=100)
+        v = static_violations({"serving.token_budget": 64}, odd)
+        assert any("not a multiple" in r for r in v)
+
+    def test_override_serialization(self):
+        cand = {"DS_SPEC_DRAFT_LEN": 4, "DS_PREFIX_CACHE": True,
+                "serving.token_budget": 96, "serving.max_burst": 8}
+        assert env_overrides(cand) == {"DS_SPEC_DRAFT_LEN": "4",
+                                       "DS_PREFIX_CACHE": "1"}
+        assert serving_overrides(cand) == {"token_budget": 96,
+                                           "max_burst": 8}
+
+
+# ============================================================= offline tuner
+class _FakeReplayGateway:
+    def __init__(self):
+        self.drained = False
+
+    def drain(self):
+        self.drained = True
+
+
+def _fake_replay_factory(latency_of):
+    """Replay stub: throughput rises with budget, p99 from the model."""
+
+    def fake_replay(gateway, trace):
+        budget = gateway.budget
+        n = len(trace)
+        return ReplayReport(requests=[], admitted_order=[], completed=n,
+                            rejected=0, failed=0, gen_tokens=n * budget,
+                            wall_s=float(n), gen_tok_s=float(budget),
+                            p50_ttft_ms=latency_of(budget) / 2,
+                            p99_ttft_ms=latency_of(budget), snapshot={})
+    return fake_replay
+
+
+class TestServingTuner:
+
+    def _build_fn(self, built):
+        def build(candidate):
+            gw = _FakeReplayGateway()
+            gw.budget = candidate["serving.token_budget"]
+            built.append(gw)
+            return gw
+        return build
+
+    def test_halving_picks_best_under_slo(self, tmp_path):
+        space = ServingKnobSpace(
+            {"serving.token_budget": [16, 32, 64, 128]})
+        trace = synthesize_trace("steady", 32, seed=0)
+        built = []
+        # p99 = 100 + budget: 128 blows a 200ms SLO, 64 is the best legal
+        tuner = ServingTuner(space, trace, self._build_fn(built),
+                             slo_p99_ttft_ms=200.0, eta=2,
+                             min_rung_requests=4,
+                             replay_fn=_fake_replay_factory(
+                                 lambda b: 100.0 + b))
+        res = tuner.search()
+        assert res.best == {"serving.token_budget": 64}
+        assert res.predicted["gen_tok_s"] == 64.0
+        assert res.predicted["p99_ttft_ms"] == 164.0
+        assert len(res.predicted["curve"]) >= 1
+        assert res.searched == 4 and res.replays == tuner.replays
+        # the violator is ranked below every satisfier
+        assert res.leaderboard[0].candidate == res.best
+        violators = [s for s in res.leaderboard if s.slo_violated]
+        assert [s.candidate["serving.token_budget"] for s in violators] \
+            == [128]
+        # halving early-stops: far fewer replays than grid x full trace
+        assert res.replays < 4 * 4
+        assert all(g.drained for g in built)  # teardown ran
+        # deployable artifact round-trips
+        path = str(tmp_path / "tuned.json")
+        res.save(path)
+        doc = load_tuned_config(path)
+        assert doc["knobs"] == res.best
+        assert doc["slo_p99_ttft_ms"] == 200.0
+
+    def test_nothing_satisfies_slo(self):
+        space = ServingKnobSpace({"serving.token_budget": [32, 64]})
+        trace = synthesize_trace("steady", 8, seed=0)
+        tuner = ServingTuner(space, trace, self._build_fn([]),
+                             slo_p99_ttft_ms=1.0, eta=2,
+                             min_rung_requests=4,
+                             replay_fn=_fake_replay_factory(
+                                 lambda b: 100.0 + b))
+        res = tuner.search()
+        assert res.best is None and res.predicted == {}
+        # least-bad violator first so the report stays informative
+        assert res.leaderboard[0].p99_ttft_ms == 132.0
+        assert res.replays == 2  # one rung, then everyone early-stopped
+
+    def test_static_pruning_feeds_report(self):
+        space = ServingKnobSpace({"serving.token_budget": [8, 64, 512]})
+        profile = ModelProfile(param_bytes=1 << 30, num_layers=2,
+                               num_kv_heads=2, head_dim=64,
+                               kv_block_size=16, max_tokens=256)
+        trace = synthesize_trace("steady", 8, seed=0)
+        tuner = ServingTuner(space, trace, self._build_fn([]),
+                             profile=profile, eta=2, min_rung_requests=8,
+                             replay_fn=_fake_replay_factory(lambda b: 50.0))
+        res = tuner.search()
+        assert res.searched == 1  # 8 (< block) and 512 (> max_tokens) pruned
+        assert len(res.pruned) == 2
+        assert res.best == {"serving.token_budget": 64}
+
+    def test_load_tuned_config_errors(self, tmp_path):
+        with pytest.raises(ValueError, match="unreadable"):
+            load_tuned_config(str(tmp_path / "missing.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        with pytest.raises(ValueError, match="unreadable"):
+            load_tuned_config(str(bad))
+        noknobs = tmp_path / "noknobs.json"
+        noknobs.write_text(json.dumps({"version": 1}))
+        with pytest.raises(ValueError, match="no 'knobs'"):
+            load_tuned_config(str(noknobs))
+        future = tmp_path / "future.json"
+        future.write_text(json.dumps({"version": 99, "knobs": {}}))
+        with pytest.raises(ValueError, match="version 99"):
+            load_tuned_config(str(future))
+
+
+# ============================================= record -> replay determinism
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = build_llama("debug")
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def make_engine(model_and_params, max_context=64, n_seqs=8):
+    model, params = model_and_params
+    cfg = RaggedInferenceEngineConfig(
+        kv_block_size=8,
+        num_kv_blocks=0,
+        state_manager=DSStateManagerConfig(max_ragged_batch_size=96,
+                                           max_ragged_sequence_count=n_seqs,
+                                           max_tracked_sequences=n_seqs,
+                                           max_context=max_context))
+    return InferenceEngineV2(model=model, config=cfg, params=params,
+                             dtype=jnp.float32)
+
+
+def _replay_gateway(model_and_params):
+    return ServingGateway(
+        make_engine(model_and_params),
+        config=ServingConfig(token_budget=32, max_burst=4,
+                             max_queue_depth=16),
+        auto_start=False)
+
+
+class TestRecordReplayDeterminism:
+
+    def test_recorded_trace_replays_bit_identical(self, model_and_params):
+        # 1) record: drive a synthetic workload through a live gateway
+        # with a recorder attached — the trace captures OFFERED traffic
+        workload = synthesize_trace("steady", 10, seed=11, vocab_size=250,
+                                    mean_prompt_len=6, mean_new_tokens=4)
+        gw_rec = _replay_gateway(model_and_params)
+        rec = gw_rec.attach_recorder(TraceRecorder(prefix_group_len=4))
+        replay_lockstep(gw_rec, workload)
+        assert gw_rec.detach_recorder() is rec
+        recorded = rec.trace()
+        gw_rec.drain(timeout=30)
+        assert len(recorded) == 10
+        assert [list(r.prompt) for r in recorded] == \
+            [list(r.prompt) for r in workload]
+
+        # 2) replay the RECORDED trace twice on fresh gateways
+        reports = []
+        for _ in range(2):
+            gw = _replay_gateway(model_and_params)
+            reports.append(replay_lockstep(gw, recorded))
+            gw.drain(timeout=30)
+        a, b = reports
+
+        # bit-identical greedy streams
+        assert a.streams() == b.streams()
+        assert a.completed == b.completed == 10
+        assert a.gen_tokens == b.gen_tokens > 0
+        assert sum(len(t) for t in a.streams().values()) == a.gen_tokens
+        # identical admission decisions and admission ORDER
+        assert a.admission_decisions() == b.admission_decisions()
+        assert a.admitted_order == b.admitted_order
+        assert sorted(a.admitted_order) == list(range(10))
+
+    def test_lockstep_requires_manual_pump(self, model_and_params):
+        gw = ServingGateway(make_engine(model_and_params),
+                            config=ServingConfig(max_burst=4))
+        try:
+            with pytest.raises(ValueError, match="manual-pump"):
+                replay_lockstep(gw, synthesize_trace("steady", 2, seed=0))
+        finally:
+            gw.drain(timeout=30)
+
+
+# ==================================================== gateway integration
+class FakeEngine:
+    """Deterministic InferenceEngineV2 stand-in (the surface the
+    gateway + scheduler touch; same token arithmetic as the admission
+    tests so streams compare exactly)."""
+
+    def __init__(self, max_tokens=64, max_seqs=8, block_size=8,
+                 max_ctx_tokens=64, free_blocks=16, max_tracked=8):
+        self.max_tokens = max_tokens
+        self.max_seqs = max_seqs
+        self.block_size = block_size
+        self.max_ctx_tokens = max_ctx_tokens
+        self.free_blocks = free_blocks
+        self.state_manager = types.SimpleNamespace(
+            max_tracked_sequences=max_tracked)
+        self._seen = {}
+        self.destroyed = False
+
+    def put(self, uids, chunks, sample=None):
+        out = []
+        for uid, toks in zip(uids, chunks):
+            self._seen[uid] = self._seen.get(uid, 0) + len(toks)
+            out.append((uid * 7 + self._seen[uid]) % 97)
+        return np.asarray(out, np.int32)
+
+    def query(self, uid):
+        if uid not in self._seen:
+            return None
+        return self._seen[uid], self.block_size
+
+    def flush(self, uid):
+        del self._seen[uid]
+
+    def can_burst(self, uids, k):
+        return False
+
+    def destroy(self):
+        self.destroyed = True
+
+
+def _run_fake_workload(gw):
+    handles = [gw.submit([3 + i, 4, 5], max_new_tokens=3) for i in range(4)]
+    for _ in range(64):
+        if all(h.done for h in handles):
+            break
+        gw._pump_once()
+    return [h.result(timeout=1) for h in handles]
+
+
+class TestGatewayIntegration:
+
+    def test_tri_state_enable(self, monkeypatch):
+        on = ServingConfig(autotune=ServingAutotuneConfig(enabled=True))
+        off = ServingConfig()
+        monkeypatch.delenv("DS_AUTOTUNE", raising=False)
+        assert autotune_enabled(on) and not autotune_enabled(off)
+        monkeypatch.setenv("DS_AUTOTUNE", "0")
+        assert not autotune_enabled(on)  # env wins in both directions
+        monkeypatch.setenv("DS_AUTOTUNE", "1")
+        assert autotune_enabled(off)
+
+    def test_off_path_identical_and_no_controller(self, monkeypatch):
+        monkeypatch.setenv("DS_AUTOTUNE", "0")
+        gw_off = ServingGateway(
+            FakeEngine(),
+            config=ServingConfig(
+                max_burst=1,
+                autotune=ServingAutotuneConfig(enabled=True)),
+            auto_start=False)
+        assert gw_off.controller is None  # kill switch beats config
+        monkeypatch.delenv("DS_AUTOTUNE", raising=False)
+        gw_plain = ServingGateway(FakeEngine(),
+                                  config=ServingConfig(max_burst=1),
+                                  auto_start=False)
+        assert gw_plain.controller is None
+        # byte-identical pipeline: same streams either way
+        assert _run_fake_workload(gw_off) == _run_fake_workload(gw_plain)
+
+    def test_controller_constructed_and_stopped(self, monkeypatch):
+        monkeypatch.setenv("DS_AUTOTUNE", "1")
+        gw = ServingGateway(FakeEngine(),
+                            config=ServingConfig(max_burst=1),
+                            auto_start=False)
+        assert gw.controller is not None
+        assert gw.controller.defaults["token_budget"] == \
+            gw.scheduler.budget
+        gw.drain(timeout=5)
+        assert gw.controller._thread is None
+
+    def test_tuned_config_applied(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "tuned.json")
+        with open(path, "w") as fd:
+            json.dump({"version": 1,
+                       "knobs": {"serving.token_budget": 24,
+                                 "serving.max_queue_depth": 7,
+                                 "DS_SPEC_DRAFT_LEN": 4}}, fd)
+        monkeypatch.setenv("DS_AUTOTUNE_CONFIG", path)
+        gw = ServingGateway(FakeEngine(), auto_start=False)
+        assert gw.config.token_budget == 24
+        assert gw.scheduler.budget == 24
+        assert gw.queue.max_depth == 7  # DS_* knob left to the env
+
+    def test_tuned_config_rejects_unknown_serving_knob(self, tmp_path,
+                                                       monkeypatch):
+        path = str(tmp_path / "tuned.json")
+        with open(path, "w") as fd:
+            json.dump({"version": 1, "knobs": {"serving.role": "decode"}},
+                      fd)
+        monkeypatch.setenv("DS_AUTOTUNE_CONFIG", path)
+        with pytest.raises(ValueError, match="not a gateway-applicable"):
+            ServingGateway(FakeEngine(), auto_start=False)
+
+    def test_tuned_config_unreadable_fails_loudly(self, monkeypatch,
+                                                  tmp_path):
+        monkeypatch.setenv("DS_AUTOTUNE_CONFIG",
+                           str(tmp_path / "missing.json"))
+        with pytest.raises(ValueError, match="unreadable"):
+            ServingGateway(FakeEngine(), auto_start=False)
+
+
+# ============================================================== controller
+class StubSpec:
+    def __init__(self, draft_len):
+        self.draft_len_cfg = draft_len
+
+    def set_draft_len(self, n):
+        assert n >= 1
+        self.draft_len_cfg = int(n)
+
+
+class StubGateway:
+    """The exact surface OnlineSLOController touches, with a settable
+    p99 so tests drive the control loop tick-by-tick with no clock."""
+
+    def __init__(self, budget=128, depth=32, draft=4, block_size=16):
+        self.scheduler = types.SimpleNamespace(budget=budget)
+        self.queue = types.SimpleNamespace(max_depth=depth)
+        self.gate = types.SimpleNamespace(block_size=block_size)
+        self.engine = types.SimpleNamespace(spec=StubSpec(draft))
+        self.metrics = ServingMetrics()
+        self.p99_ms = 100.0
+        self.samples = 16
+
+    def snapshot(self):
+        return {"ttft": {"p99_ms": self.p99_ms, "count": self.samples}}
+
+    def knobs(self):
+        return (self.scheduler.budget, self.queue.max_depth,
+                self.engine.spec.draft_len_cfg)
+
+
+def make_controller(gw, **over):
+    cfg = dict(p99_ttft_slo_ms=500.0, breach_ticks=2, clear_ticks=2,
+               cooldown_ticks=1, rollback_ticks=50, interval_s=0.01)
+    cfg.update(over)
+    return OnlineSLOController(gw, ServingAutotuneConfig(**cfg))
+
+
+class TestOnlineController:
+
+    def test_single_breached_tick_does_nothing(self):
+        gw = StubGateway()
+        ctl = make_controller(gw)
+        before = gw.knobs()
+        gw.p99_ms = 900.0
+        assert ctl.tick() == "hold"  # 1 breach < breach_ticks
+        gw.p99_ms = 100.0
+        ctl.tick()
+        assert gw.knobs() == before and ctl.adjustments == 0
+
+    def test_no_samples_holds(self):
+        gw = StubGateway()
+        gw.samples = 0
+        ctl = make_controller(gw)
+        gw.p99_ms = 9000.0
+        assert ctl.tick() == "hold"
+        assert ctl.adjustments == 0
+
+    def test_step_down_cheapest_first_with_cooldown(self):
+        gw = StubGateway(budget=128, depth=32, draft=4)
+        ctl = make_controller(gw)
+        gw.p99_ms = 900.0
+        actions = [ctl.tick() for _ in range(6)]
+        # hold, down:draft, cooldown, down:draft, cooldown, down:budget
+        downs = [a for a in actions if a.startswith("down:")]
+        assert downs == ["down:draft_len", "down:draft_len",
+                         "down:token_budget"]
+        assert "cooldown" in actions  # every adjustment starts a hold
+        assert gw.engine.spec.draft_len_cfg == 1  # 4 -> 2 -> 1, floored
+        assert gw.scheduler.budget == 96  # 128 * 3/4
+
+    def test_floors_respected(self):
+        gw = StubGateway(budget=32, depth=2, draft=1, block_size=16)
+        ctl = make_controller(gw, cooldown_ticks=0, min_queue_depth=2)
+        gw.p99_ms = 900.0
+        for _ in range(30):
+            ctl.tick()
+        # budget floored at one KV block, depth at min, draft at 1
+        assert gw.scheduler.budget >= 16
+        assert gw.queue.max_depth == 2
+        assert gw.engine.spec.draft_len_cfg == 1
+
+    def test_step_up_never_past_defaults(self):
+        gw = StubGateway(budget=128, depth=32, draft=4)
+        ctl = make_controller(gw, cooldown_ticks=0)
+        gw.p99_ms = 900.0
+        for _ in range(6):
+            ctl.tick()
+        assert gw.knobs() != (128, 32, 4)
+        gw.p99_ms = 50.0
+        for _ in range(200):
+            ctl.tick()
+        assert gw.knobs() == (128, 32, 4)  # fully recovered, not beyond
+        assert ctl.converged()
+
+    def test_no_oscillation_on_step_load_change(self):
+        # closed loop: the SLO is breached exactly while budget > 96 —
+        # a step change in capacity the controller must settle under
+        gw = StubGateway(budget=128, depth=32, draft=4)
+        ctl = make_controller(gw)
+
+        def world():
+            gw.p99_ms = 900.0 if gw.scheduler.budget > 96 else 200.0
+
+        actions = []
+        for _ in range(700):
+            world()
+            actions.append(ctl.tick())
+        # converged: the tail holds one level with zero adjustments —
+        # the geometric backoff spaces recovery probes further and
+        # further apart, so the loop settles instead of oscillating
+        tail = actions[-80:]
+        assert all(not a.startswith(("down:", "up:")) for a in tail), \
+            [a for a in tail if a.startswith(("down:", "up:"))]
+        assert gw.scheduler.budget <= 96  # held at the satisfying level
+        assert ctl.converged()
+        assert ctl.rollbacks == 0
+        # direction flips are geometrically rare, not merely legal: a
+        # plain-hysteresis loop would flip every ~clear_ticks ticks
+        # (~100 times in 700); the backoff caps it at a handful
+        ups = sum(1 for a in actions if a.startswith("up:token_budget"))
+        assert 1 <= ups <= 10
+        stats = ctl.stats()
+        assert stats["clear_required"] > ctl.clear_ticks
+
+    def test_rollback_on_sustained_breach(self):
+        gw = StubGateway(budget=128, depth=32, draft=4)
+        ctl = make_controller(gw, rollback_ticks=8)
+        gw.p99_ms = 2000.0  # nothing the controller does helps
+        actions = [ctl.tick() for _ in range(12)]
+        assert "rollback" in actions
+        assert gw.knobs() == (128, 32, 4)  # every knob back to default
+        assert actions[-1] == "frozen" and ctl.rollbacks == 1
+        adjustments = ctl.adjustments
+        for _ in range(5):
+            assert ctl.tick() == "frozen"
+        assert ctl.adjustments == adjustments  # observes, acts no more
+        # published for operators
+        snap = gw.metrics.snapshot()
+        assert snap["external"]["Serve/Autotune"]["frozen"] == 1
+        # reset() re-arms
+        ctl.reset()
+        gw.p99_ms = 100.0
+        assert ctl.tick() == "hold"
+        assert not ctl.stats()["frozen"]
+
+    def test_rollback_must_back_breach(self):
+        with pytest.raises(ValueError, match="rollback_ticks"):
+            make_controller(StubGateway(), breach_ticks=4, rollback_ticks=2)
+        with pytest.raises(Exception):  # pydantic-level validation too
+            ServingAutotuneConfig(breach_ticks=4, rollback_ticks=2)
+
+    def test_no_spec_engine_skips_draft_knob(self):
+        gw = StubGateway(budget=128, depth=32, draft=4)
+        gw.engine = types.SimpleNamespace()  # no spec state at all
+        ctl = make_controller(gw, cooldown_ticks=0)
+        assert ctl.defaults["draft_len"] == 0
+        gw.p99_ms = 900.0
+        actions = [ctl.tick() for _ in range(4)]
+        assert "down:token_budget" in actions  # skipped straight past draft
+        assert not any("draft" in a for a in actions)
+
+    def test_background_thread_ticks(self):
+        import time
+        gw = StubGateway()
+        ctl = make_controller(gw, interval_s=0.01)
+        ctl.start()
+        try:
+            deadline = time.monotonic() + 5
+            while ctl.ticks == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            ctl.stop()
+        assert ctl.ticks > 0
+        assert ctl._thread is None

@@ -272,13 +272,17 @@ class TestThreadSharedState:
         from deepspeed_tpu.serving.gateway import ServingGateway  # noqa: F401
         from deepspeed_tpu.serving.metrics import ServingMetrics  # noqa: F401
         from deepspeed_tpu.ops.grouped_gemm import GroupedGemmStats  # noqa: F401
+        from deepspeed_tpu.autotuning.online import \
+            OnlineSLOController  # noqa: F401
+        from deepspeed_tpu.autotuning.trace import TraceRecorder  # noqa: F401
         from tools.graft_lint.linter import THREAD_SHARED_REGISTRY
         for cls in (ServingGateway, NebulaCheckpointService, MonitorMaster,
                     ServingMetrics, BlockedAllocator, PrefixCacheManager,
                     FleetRouter, ReplicaHealth, GatewayReplica, FaultyReplica,
                     PreemptionGuard, HeartbeatWriter, SpecDecodeState,
                     TierManager, HostKVStore, GroupedGemmStats,
-                    HandoffManager, PoolScheduler):
+                    HandoffManager, PoolScheduler, OnlineSLOController,
+                    TraceRecorder):
             assert cls.__name__ in THREAD_SHARED_REGISTRY
 
 

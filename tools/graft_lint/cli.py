@@ -3,8 +3,8 @@
 Exit codes: 0 clean, 1 violations, 2 usage/internal error (unknown
 ``--only`` rule, malformed baseline). ``--format json`` emits a
 machine-readable report for CI; ``--list-knobs`` prints the DS_*
-env-knob table from utils/env_registry.py (markdown) instead of
-linting; ``--check-docs`` diffs that table against docs/MIGRATING.md
+env-knob table from utils/env_registry.py (markdown, or the typed
+knob schema with ``--format json``) instead of linting; ``--check-docs`` diffs that table against docs/MIGRATING.md
 (the knob-docs rule, standalone); ``--only=rule1,rule2`` restricts the
 run so the tier-1 gate can time rules individually;
 ``--update-baseline`` re-lints from scratch and rewrites the baseline
@@ -44,9 +44,17 @@ def format_knobs_markdown():
     lines = ["| Variable | Type | Default | Description |",
              "|---|---|---|---|"]
     for k in reg.all_knobs():
-        lines.append(f"| `{k.name}` | {k.kind} | `{k.describe_default()}` "
-                     f"| {k.description} (read by `{k.consumer}`) |")
+        lines.append(k.doc_row())
     return "\n".join(lines)
+
+
+def format_knobs_json():
+    """The typed knob schema (name, type, default, range/choices,
+    tuning tag, doc row) — the same artifact the serving autotuner
+    enumerates its search space from."""
+    return json.dumps({"version": 1,
+                       "knobs": _load_env_registry().knob_schema()},
+                      indent=2)
 
 
 def check_knob_docs(docs_path=None):
@@ -126,7 +134,10 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     if args.list_knobs:
-        print(format_knobs_markdown())
+        if args.format == "json":
+            print(format_knobs_json())
+        else:
+            print(format_knobs_markdown())
         return 0
 
     only = None

@@ -94,9 +94,18 @@ THREAD_SHARED_REGISTRY = {
     "HostKVStore": {"_records", "bytes_resident", "demotions", "promotions",
                     "evictions", "lookups", "hits"},
     # spec decode: the gateway pump drafts/notes while client threads
-    # reach forget() through engine.flush (cancel / deadline / drain)
+    # reach forget() through engine.flush (cancel / deadline / drain),
+    # and the online SLO controller adjusts draft_len_cfg live
     "SpecDecodeState": {"_ema", "_disabled", "steps", "accepted", "drafted",
-                        "emitted", "disables"},
+                        "emitted", "disables", "draft_len_cfg"},
+    # serving autotuner: the controller thread mutates decision state
+    # while operator threads read stats()/reset(); the trace recorder
+    # is written from every client thread that submits
+    "OnlineSLOController": {"_breach", "_clear", "_cooldown", "_frozen",
+                            "_last_action", "_clear_required",
+                            "_last_up_tick", "ticks", "adjustments",
+                            "rollbacks"},
+    "TraceRecorder": {"_t0", "_requests", "_groups", "recorded"},
     # fleet: relay threads + heartbeat thread + client threads all touch
     # router/health/replica state
     "FleetRouter": {"_counters", "_relays", "_closed"},
@@ -150,6 +159,12 @@ LOCK_ORDER = {
     "FleetRouter._lock": 10,
     "HandoffManager._lock": 14,
     "PoolScheduler._lock": 16,
+    # the online SLO controller decides under its own lock and actuates
+    # gateway knobs outside it, so it ranks between the router and the
+    # gateway's own locks; the trace recorder is a leaf (submit-path
+    # append, never holds anything else)
+    "OnlineSLOController._lock": 18,
+    "TraceRecorder._lock": 19,
     "ServingGateway._handoff_lock": 20,
     "ServingGateway._cancel_lock": 22,
     "ServingGateway._state_lock": 24,
@@ -171,6 +186,7 @@ CROSS_REFS = {
     "FleetRouter": {"handoffs": "HandoffManager", "pools": "PoolScheduler"},
     "FleetRefreshController": {"router": "FleetRouter",
                                "publisher": "WeightPublisher"},
+    "OnlineSLOController": {"gateway": "ServingGateway"},
 }
 
 # lock-order: per registered class, the methods a PEER may call and the
