@@ -1274,6 +1274,125 @@ def bench_serving_2b_autotune(debug=False):
                     "ones, DS_AUTOTUNE=0 streams asserted bit-identical"}
 
 
+def bench_serving_2b_lora(n_adapters=8, n_req=16, prompt_len=128,
+                          new_tokens=64, rank=8, debug=False):
+    """Multi-tenant LoRA serving: ``n_adapters`` tenants co-served on
+    one base model through the segmented adapter matmul, vs a
+    single-adapter baseline on the SAME engine (same warm programs).
+    The headline is the multi-tenant decode tok/s as a fraction of the
+    single-adapter number (acceptance: >= 0.70) plus the AdapterStore
+    hot-set hit rate over the mixed run; per-tenant streams are
+    asserted bit-identical to solo runs of the same adapter — the
+    cross-tenant-isolation contract. ``debug`` runs the same protocol
+    at debug scale (the CPU/CI path); TPU runs the ~2.5B GQA serving
+    model."""
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig,
+                                            DynamicSplitFuseScheduler,
+                                            InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import LoRAServingConfig
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+
+    groups.destroy_mesh()
+    if debug:
+        model = build_llama("debug")
+        n_req, prompt_len, new_tokens, budget, block = 8, 12, 8, 64, 8
+    else:
+        model = build_llama("7b", hidden_size=3072, intermediate_size=8192,
+                            num_hidden_layers=22, num_attention_heads=24,
+                            num_key_value_heads=8,
+                            max_position_embeddings=2048,
+                            vocab_size=32000, remat=False)
+        budget, block = 512, 32
+    cfg = RaggedInferenceEngineConfig(
+        kv_block_size=block,
+        state_manager=DSStateManagerConfig(
+            max_ragged_batch_size=budget,
+            max_ragged_sequence_count=n_req,
+            max_tracked_sequences=n_req,
+            max_context=prompt_len + new_tokens),
+        lora=LoRAServingConfig(enabled=True, hot_set=n_adapters,
+                               max_rank=rank, prefetch=False))
+    engine = InferenceEngineV2(model=model, config=cfg)
+    store = engine.lora_store
+    vocab = int(model.config.vocab_size)
+
+    rs = np.random.RandomState(0)
+    for aid in range(1, n_adapters + 1):
+        layers = {site: (rs.randn(store.num_layers, din, rank)
+                         .astype(np.float32) * 0.02,
+                         rs.randn(store.num_layers, rank, dout)
+                         .astype(np.float32) * 0.02)
+                  for site, (din, dout) in store.dims.items()}
+        engine.register_adapter(aid, layers, alpha=float(2 * rank))
+    prompts = [rs.randint(3, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(n_req)]
+
+    uid_gen = iter(range(1_000_000))
+
+    def run(assignments):
+        """[(prompt, adapter_id)] → ({local index: tokens}, seconds)."""
+        sched = DynamicSplitFuseScheduler(engine, token_budget=budget,
+                                          max_burst=16)
+        uids = []
+        for prompt, aid in assignments:
+            uid = next(uid_gen)
+            uids.append(uid)
+            sched.add_request(uid, prompt, max_new_tokens=new_tokens,
+                              adapter_id=aid)
+        t0 = time.perf_counter()
+        out = sched.run_to_completion()
+        dt = time.perf_counter() - t0
+        return {i: out[uid] for i, uid in enumerate(uids)}, dt
+
+    # warm every program shape both runs use (prefill pads + bursts)
+    run([(prompts[0][:max(8, prompt_len // 2)], 1), (prompts[1], 2)])
+
+    # single-adapter baseline: the whole trace through one tenant
+    single, dt_single = run([(p, 1) for p in prompts])
+    # mixed trace: requests round-robin across every tenant (uid i ->
+    # adapter 1 + i % n_adapters), so each burst mixes adapters
+    mix = [(p, 1 + i % n_adapters) for i, p in enumerate(prompts)]
+    hits0, misses0 = store.hot_hits, store.hot_misses
+    multi, dt_multi = run(mix)
+    binds = (store.hot_hits - hits0) + (store.hot_misses - misses0)
+    hit_rate = (store.hot_hits - hits0) / binds if binds else 0.0
+
+    # cross-tenant isolation: a tenant's stream is bit-identical solo
+    checked = 0
+    for i in range(min(3, n_req)):
+        solo, _ = run([mix[i]])
+        assert solo[0] == multi[i], (
+            f"request {i} (adapter {mix[i][1]}) diverged between the "
+            f"mixed run and its solo run")
+        checked += 1
+
+    gen = n_req * new_tokens
+    n_params = _param_count(engine.params)
+    stats = store.stats()
+    engine.destroy()
+    single_tok_s = gen / dt_single
+    multi_tok_s = gen / dt_multi
+    return {"params": n_params, "requests": n_req, "adapters": n_adapters,
+            "rank": rank, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "single_adapter_tok_s": round(single_tok_s, 1),
+            "multi_adapter_tok_s": round(multi_tok_s, 1),
+            "multi_vs_single": round(multi_tok_s / single_tok_s, 3),
+            "hot_hit_rate": round(hit_rate, 4),
+            "promotions": stats["promotions"],
+            "evictions": stats["evictions"],
+            "solo_streams_bit_identical": checked,
+            "note": f"{n_adapters} tenants round-robined over a mixed "
+                    "trace through the segmented LoRA matmul on one "
+                    "engine; baseline = same trace, one adapter. "
+                    "Streams of the first 3 mixed requests asserted "
+                    "bit-identical to solo runs (cross-tenant "
+                    "isolation); hit rate counts hot-slot binds over "
+                    "the mixed run"}
+
+
 def bench_train_long_seq():
     """Long-context training on one chip: the same ~551M model as the
     headline bench at seq 16384 (8x its 2048), micro-batch 1. The Pallas
@@ -1731,6 +1850,7 @@ def main():
         ("serving_2b_disagg", bench_serving_2b_disagg, {}),
         ("serving_2b_refresh", bench_serving_2b_refresh, {}),
         ("serving_2b_autotune", bench_serving_2b_autotune, {}),
+        ("serving_2b_lora", bench_serving_2b_lora, {}),
         ("offload", bench_offload_probe, {}),
         ("checkpoint", bench_checkpoint, {}),
         ("train_elastic", bench_train_elastic, {}),
@@ -1752,10 +1872,14 @@ def main():
         # lane runs at debug scale on CPU — the record/tune/compare
         # protocol and the kill-switch bit-identity contract are
         # scale-independent, only the absolute tok/s numbers are not.
+        # Ditto the LoRA lane: the isolation and hit-rate contracts
+        # hold at debug scale.
         for key, fn, kwargs in (
                 ("checkpoint", bench_checkpoint, {}),
                 ("train_elastic", bench_train_elastic, {}),
                 ("serving_2b_autotune", bench_serving_2b_autotune,
+                 {"debug": True}),
+                ("serving_2b_lora", bench_serving_2b_lora,
                  {"debug": True})):
             try:
                 extras[key] = fn(**kwargs)
@@ -1812,6 +1936,12 @@ def main():
               f"{_pick('serving_2b_autotune', 'p99_equal_or_better')}, "
               f"kill-switch bit-identical="
               f"{_pick('serving_2b_autotune', 'autotune_off_bit_identical')}")
+    lora_ratio = _pick("serving_2b_lora", "multi_vs_single")
+    if lora_ratio is not None:
+        print(f"bench: lora {_pick('serving_2b_lora', 'adapters')} tenants at "
+              f"{lora_ratio}x single-adapter decode tok/s, hot-set hit rate "
+              f"{_pick('serving_2b_lora', 'hot_hit_rate')}, solo-stream "
+              f"bit-identity checks={_pick('serving_2b_lora', 'solo_streams_bit_identical')}")
     errs = [k for k, v in extras.items()
             if isinstance(v, dict) and "error" in v]
     skipped = [k for k, v in extras.items() if v is None]
@@ -1866,6 +1996,11 @@ def main():
             "autotune_replays": _pick("serving_2b_autotune", "replays"),
             "autotune_ctl_ok": (at_ctl.get("holds_when_healthy")
                                 if isinstance(at_ctl, dict) else at_ctl),
+            "lora_multi_vs_single": _pick("serving_2b_lora",
+                                          "multi_vs_single"),
+            "lora_hot_hit_rate": _pick("serving_2b_lora", "hot_hit_rate"),
+            "lora_solo_bit_identical": _pick("serving_2b_lora",
+                                             "solo_streams_bit_identical"),
             "full_results": out_path,
         },
     }, separators=(",", ":")))

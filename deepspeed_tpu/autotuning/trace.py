@@ -39,7 +39,7 @@ import random
 import time
 from typing import Callable, Dict, List, Optional
 
-TRACE_VERSION = 1
+TRACE_VERSION = 2  # v2 adds the optional per-request adapter_id field
 TRACE_KINDS = ("recorded", "steady", "bursty", "prefix_heavy")
 # leading tokens that define a prefix-share group when recording (one
 # KV block at the default block size — shorter shares aren't reusable)
@@ -57,21 +57,31 @@ class TraceRequest:
     max_new_tokens: int
     priority: int = 0
     prefix_group: Optional[int] = None
+    # multi-tenant LoRA: which adapter served the request (None = base).
+    # Trace v2; v1 traces load with None — replay then routes to base.
+    adapter_id: Optional[int] = None
 
     def to_json(self) -> Dict:
-        return {"uid": self.uid, "arrival_s": round(self.arrival_s, 6),
-                "prompt": list(self.prompt),
-                "max_new_tokens": self.max_new_tokens,
-                "priority": self.priority,
-                "prefix_group": self.prefix_group}
+        out = {"uid": self.uid, "arrival_s": round(self.arrival_s, 6),
+               "prompt": list(self.prompt),
+               "max_new_tokens": self.max_new_tokens,
+               "priority": self.priority,
+               "prefix_group": self.prefix_group}
+        if self.adapter_id is not None:
+            # only written when set, so base-only v2 traces stay line-
+            # identical to v1 payloads (clean diffs across versions)
+            out["adapter_id"] = int(self.adapter_id)
+        return out
 
     @classmethod
     def from_json(cls, d: Dict) -> "TraceRequest":
+        aid = d.get("adapter_id")
         return cls(uid=int(d["uid"]), arrival_s=float(d["arrival_s"]),
                    prompt=[int(t) for t in d["prompt"]],
                    max_new_tokens=int(d["max_new_tokens"]),
                    priority=int(d.get("priority", 0)),
-                   prefix_group=d.get("prefix_group"))
+                   prefix_group=d.get("prefix_group"),
+                   adapter_id=int(aid) if aid is not None else None)
 
 
 class ServingTrace:
@@ -161,7 +171,7 @@ class TraceRecorder:
         self._groups = {}  # leading-token tuple -> group id
         self.recorded = 0
 
-    def record(self, prompt, max_new_tokens, priority) -> None:
+    def record(self, prompt, max_new_tokens, priority, adapter_id=None) -> None:
         now = time.monotonic()
         key = (tuple(prompt[:self.prefix_group_len])
                if len(prompt) >= self.prefix_group_len else None)
@@ -174,7 +184,8 @@ class TraceRecorder:
             self._requests.append(TraceRequest(
                 uid=len(self._requests), arrival_s=now - self._t0,
                 prompt=list(prompt), max_new_tokens=int(max_new_tokens),
-                priority=int(priority), prefix_group=group))
+                priority=int(priority), prefix_group=group,
+                adapter_id=int(adapter_id) if adapter_id else None))
             self.recorded += 1
 
     def trace(self, meta: Optional[Dict] = None) -> ServingTrace:
@@ -322,8 +333,14 @@ def _finalize(gateway, per_request, admitted_order, handles, wall_s):
 
 
 def _submit(gateway, req):
+    kw = {}
+    aid = getattr(req, "adapter_id", None)
+    if aid is not None:
+        # only forwarded when recorded: base-only traces keep replaying
+        # against gateways/routers that predate adapter routing
+        kw["adapter_id"] = aid
     return gateway.submit(req.prompt, max_new_tokens=req.max_new_tokens,
-                          priority=req.priority)
+                          priority=req.priority, **kw)
 
 
 def replay_lockstep(gateway, trace: ServingTrace,

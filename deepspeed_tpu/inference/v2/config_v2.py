@@ -72,6 +72,28 @@ class SpecDecodeConfig(DeepSpeedConfigModel):
     warmup_steps: int = 3    # verify steps before the EMA may disable
 
 
+class LoRAServingConfig(DeepSpeedConfigModel):
+    """Multi-tenant LoRA serving (segmented adapter matmul + paged
+    AdapterStore). ``enabled`` is the config gate; the ``DS_LORA`` env
+    var overrides it in both directions (kill switch), and the off
+    state builds the exact pre-LoRA pipeline — no slot arrays packed,
+    program keys unchanged. ``hot_set`` counts HBM-resident adapter
+    slots (``DS_LORA_HOT_SET`` overrides when > 0); ``max_rank`` is
+    the rank bucket every hot slab pads to (``DS_LORA_MAX_RANK``
+    overrides when > 0; adapters above it are rejected at
+    registration). ``host_bytes`` budgets the cold host tier;
+    ``prefetch`` stages host→device adapter copies on a background
+    worker at admission. ``publish_root`` roots sha256-validated
+    adapter publications (rollout/rollback like base weights); None
+    disables the disk tier."""
+    enabled: bool = False
+    hot_set: int = 8
+    max_rank: int = 16
+    host_bytes: int = 1 << 30
+    prefetch: bool = True
+    publish_root: str = ""
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel_degree: int = 1
     expert_parallel_degree: int = 1  # MoE expert sharding for serving
@@ -85,6 +107,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
     kv_tier: KVTierConfig = KVTierConfig()
     spec_decode: SpecDecodeConfig = SpecDecodeConfig()
+    lora: LoRAServingConfig = LoRAServingConfig()
     # compiled decode/verify programs kept per engine: each distinct
     # (burst length k, sampling key) and (verify, draft length) compiles
     # its own program; beyond the cap the least-recently-used is dropped

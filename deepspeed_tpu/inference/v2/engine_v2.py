@@ -28,28 +28,37 @@ from deepspeed_tpu.inference.sampling import sample_spec_key as _sample_key
 from deepspeed_tpu.inference.sampling import sample_tokens as _sample_tokens
 
 
-def _burst_layout(ms, mb):
+def _burst_layout(ms, mb, lora=False):
     """Single source for the decode-burst metadata wire format: field →
     (start, end) offsets into the flat int32 vector. Both the host pack
     (``decode_burst``) and the traced unpack (``_make_burst_fn``) read
-    this, so the layout cannot silently diverge."""
+    this, so the layout cannot silently diverge. ``lora`` appends the
+    per-sequence adapter-slot row — strictly opt-in, so the DS_LORA=0
+    wire format is byte-identical to the pre-LoRA one."""
+    fields = [("tokens0", ms), ("token_seq", ms), ("pos0", ms),
+              ("tables", (ms + 1) * mb)]
+    if lora:
+        fields.append(("seq_adapters", ms + 1))
     o, lay = 0, {}
-    for name, size in (("tokens0", ms), ("token_seq", ms), ("pos0", ms),
-                       ("tables", (ms + 1) * mb)):
+    for name, size in fields:
         lay[name] = (o, o + size)
         o += size
     return lay
 
 
-def _verify_layout(ms, mb, d):
+def _verify_layout(ms, mb, d, lora=False):
     """Wire format of the verify-burst metadata vector, ``_burst_layout``'s
     twin for the speculative path: per sequence, the entry token plus
     ``d`` (padded) draft tokens, the real draft count, and the usual
-    slot/position/block-table fields."""
+    slot/position/block-table fields (plus the adapter-slot row when
+    LoRA serving is on)."""
+    fields = [("tokens", ms * (d + 1)), ("dlen", ms),
+              ("token_seq", ms), ("pos0", ms),
+              ("tables", (ms + 1) * mb)]
+    if lora:
+        fields.append(("seq_adapters", ms + 1))
     o, lay = 0, {}
-    for name, size in (("tokens", ms * (d + 1)), ("dlen", ms),
-                       ("token_seq", ms), ("pos0", ms),
-                       ("tables", (ms + 1) * mb)):
+    for name, size in fields:
         lay[name] = (o, o + size)
         o += size
     return lay
@@ -194,6 +203,37 @@ class InferenceEngineV2:
         self.spec = None
         if spec_decode_enabled(self._config.spec_decode):
             self.spec = SpecDecodeState(self._config.spec_decode)
+        # Multi-tenant LoRA serving: config-gated with the DS_LORA env
+        # kill switch. When live, per-request adapter ids bind to hot
+        # AdapterStore slots and every forward adds the segmented
+        # adapter delta; OFF, nothing below changes — the batch wire
+        # format, step signatures, and burst program keys are exactly
+        # the pre-LoRA ones.
+        from deepspeed_tpu.serving.lora import (AdapterStore, lora_hot_set,
+                                                lora_max_rank,
+                                                lora_serving_enabled)
+        self.lora_store = None
+        if lora_serving_enabled(self._config.lora):
+            if hasattr(cfg, "position_embedding"):
+                logger.warning(
+                    "lora serving enabled but the model is GPT-family — "
+                    "the segmented adapter path targets the Llama layer "
+                    "stack; serving base-only")
+            else:
+                lcfg = self._config.lora
+                H, Hkv, Dh = (cfg.num_attention_heads,
+                              cfg.num_key_value_heads, cfg.head_dim)
+                dims = {"q_proj": (cfg.hidden_size, H * Dh),
+                        "k_proj": (cfg.hidden_size, Hkv * Dh),
+                        "v_proj": (cfg.hidden_size, Hkv * Dh),
+                        "o_proj": (H * Dh, cfg.hidden_size)}
+                self.lora_store = AdapterStore(
+                    dims, cfg.num_hidden_layers,
+                    n_hot=lora_hot_set(lcfg),
+                    max_rank=lora_max_rank(lcfg),
+                    host_bytes=int(lcfg.host_bytes),
+                    publish_root=(lcfg.publish_root or None),
+                    prefetch=bool(lcfg.prefetch), dtype=dtype)
         # the per-sequence KV-content token log feeds BOTH the prefix
         # cache (retire-time content addressing) and the n-gram drafter
         self._log_tokens = self.prefix_cache is not None or self.spec is not None
@@ -201,7 +241,8 @@ class InferenceEngineV2:
         self.max_ctx_tokens = min(self.max_blocks_per_seq * self.block_size,
                                   int(cfg.max_position_embeddings))
         self._batch = RaggedBatchWrapper(self.max_tokens, self.max_seqs,
-                                         self.max_blocks_per_seq)
+                                         self.max_blocks_per_seq,
+                                         lora=self.lora_store is not None)
         mesh = self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
         quantized = self._quantized
@@ -212,14 +253,15 @@ class InferenceEngineV2:
         sanitize = self._sanitize
 
         ms, mb = self.max_seqs, self.max_blocks_per_seq
+        lora_on = self.lora_store is not None
 
-        def step(p, kc, vc, packed):
+        def step(p, kc, vc, packed, lora_slabs=None):
             # one flat int32 metadata vector per step (single host→device
             # transfer); static slices rebuild the batch dict on device.
             # The vector's length IS the token bucket, so decode-sized
             # and budget-sized batches compile separate specializations.
             from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import unpack_batch
-            b = unpack_batch(packed, ms, mb)
+            b = unpack_batch(packed, ms, mb, lora=lora_on)
             if quantized:
                 # embed/head/norm leaves dequantize here; the scanned
                 # 'layers' stack stays quantized — each scan step
@@ -228,14 +270,18 @@ class InferenceEngineV2:
                 from deepspeed_tpu.inference.quantization import \
                     dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)
+            lora_arg = None
+            if lora_slabs is not None:
+                la, lb, scales = lora_slabs
+                lora_arg = (la, lb, scales, b["seq_adapters"], None)
             return ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
-                                  attn_impl=attn_impl)
+                                  attn_impl=attn_impl, lora=lora_arg)
 
         self._step = maybe_checkify_jit(step, donate_argnums=(1, 2),
                                         enabled=sanitize)
 
-        def step_greedy(p, kc, vc, b):
-            logits, kc, vc = step(p, kc, vc, b)
+        def step_greedy(p, kc, vc, b, lora_slabs=None):
+            logits, kc, vc = step(p, kc, vc, b, lora_slabs)
             # On-device greedy sampling: ship [n_seqs] int32 tokens to the
             # host instead of [n_seqs, vocab] fp32 logits — vocab-factor
             # less PCIe traffic per decode step (servers sample on-device
@@ -247,8 +293,8 @@ class InferenceEngineV2:
                                                enabled=sanitize)
 
         def step_sample(t, k_, p_):
-            def fn(p, kc, vc, b, rng):
-                logits, kc, vc = step(p, kc, vc, b)
+            def fn(p, kc, vc, b, rng, lora_slabs=None):
+                logits, kc, vc = step(p, kc, vc, b, lora_slabs)
                 return _sample_tokens(logits, rng, t, k_, p_), kc, vc
             return maybe_checkify_jit(fn, donate_argnums=(1, 2),
                                       enabled=sanitize)
@@ -339,6 +385,10 @@ class InferenceEngineV2:
         self.weight_version = version
         if self.prefix_cache is not None:
             self.prefix_cache.invalidate_for_version(version)
+        if self.lora_store is not None:
+            # hot adapter deltas were tuned against the OLD base weights;
+            # drop them so every tenant re-adopts against the new base
+            self.lora_store.invalidate()
         return version
 
     # ------------------------------------------------------------------
@@ -398,6 +448,10 @@ class InferenceEngineV2:
         for i, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
             desc = self.state_manager.get_or_create_sequence(uid)
             desc.slot = i  # slots are per-batch rows in the device tables
+            if self.lora_store is not None:
+                # re-resolve per batch: a hot-swap/eviction between steps
+                # may have moved the adapter to a different slot
+                desc.adapter_slot = self.lora_store.slot_of(uid)
             self.state_manager.allocate_for(desc, len(tokens))
             self._batch.insert_sequence(desc, tokens)
             desc.advance(len(tokens))
@@ -415,6 +469,9 @@ class InferenceEngineV2:
             # batch metadata is replicated over the serving mesh (the flat
             # token batch carries no sharding — only weights/KV do)
             arrays = jax.device_put(arrays, self._replicated)
+        # hot adapter slabs ride as jit ARGUMENTS (not captured constants)
+        # so promotions/hot-swaps rebind buffers without any retrace
+        extra = (self.lora_store.slabs(),) if self.lora_store is not None else ()
         if isinstance(sample, dict):
             key = _sample_key(sample)
             fn = self._step_sample_fns.get(key)
@@ -422,11 +479,11 @@ class InferenceEngineV2:
                 fn = self._step_sample_fns[key] = self._make_step_sample(*key)
             self._rng, sub = jax.random.split(self._rng)
             out, self.kv_cache.k, self.kv_cache.v = fn(
-                self.params, self.kv_cache.k, self.kv_cache.v, arrays, sub)
+                self.params, self.kv_cache.k, self.kv_cache.v, arrays, sub, *extra)
         else:
             fn = self._step_greedy if sample == "greedy" else self._step
             out, self.kv_cache.k, self.kv_cache.v = fn(
-                self.params, self.kv_cache.k, self.kv_cache.v, arrays)
+                self.params, self.kv_cache.k, self.kv_cache.v, arrays, *extra)
         return np.asarray(out)[np.asarray(slots)]  # ds-lint: disable=host-sync -- THE one intended sync per step: callers consume host tokens/logits
 
     def _validate_burst(self, batch_uids, k):
@@ -515,31 +572,45 @@ class InferenceEngineV2:
         if err is not None:
             raise err
 
+        lora_on = self.lora_store is not None
         tokens0 = np.zeros(ms, np.int32)
         token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
         pos0 = np.zeros(ms, np.int32)
         tables = np.full((ms + 1, self.max_blocks_per_seq), NULL_BLOCK, np.int32)
+        adapters = np.zeros(ms + 1, np.int32)   # pad row stays slot 0 = base
         for i, (desc, tok) in enumerate(zip(descs, batch_tokens)):
             desc.slot = i
+            if lora_on:
+                desc.adapter_slot = self.lora_store.slot_of(desc.uid)
+                adapters[i] = desc.adapter_slot
             self.state_manager.allocate_for(desc, k)
             tokens0[i] = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous burst's host copy
             token_seq[i] = i
             pos0[i] = desc.seen_tokens
             tables[i, :len(desc.blocks)] = desc.blocks
             desc.advance(k)
-        meta = np.concatenate([tokens0, token_seq, pos0, tables.ravel()])
-        assert meta.shape[0] == sum(e - s for s, e in _burst_layout(ms, self.max_blocks_per_seq).values())
+        parts = [tokens0, token_seq, pos0, tables.ravel()]
+        if lora_on:
+            parts.append(adapters)
+        meta = np.concatenate(parts)
+        assert meta.shape[0] == sum(e - s for s, e in _burst_layout(
+            ms, self.max_blocks_per_seq, lora=lora_on).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
-        fn = self._get_burst_fn(("burst", k, skey),
-                                lambda: self._make_burst_fn(k, skey))
+        # off-state keys are EXACTLY the pre-LoRA keys (DS_LORA=0
+        # contract); on, the rank-bucket signature joins the key so a
+        # reconfigured store can't replay a stale program
+        key = ("burst", k, skey) if not lora_on else \
+            ("burst", k, skey, self.lora_store.signature())
+        fn = self._get_burst_fn(key, lambda: self._make_burst_fn(k, skey))
+        extra = (self.lora_store.slabs(),) if lora_on else ()
         if skey is None:
             out, self.kv_cache.k, self.kv_cache.v = fn(
-                self.params, self.kv_cache.k, self.kv_cache.v, meta)
+                self.params, self.kv_cache.k, self.kv_cache.v, meta, *extra)
         else:
             self._rng, sub = jax.random.split(self._rng)
             out, self.kv_cache.k, self.kv_cache.v = fn(
-                self.params, self.kv_cache.k, self.kv_cache.v, meta, sub)
+                self.params, self.kv_cache.k, self.kv_cache.v, meta, sub, *extra)
         toks = np.asarray(out)[:, :len(batch_uids)]  # ds-lint: disable=host-sync -- THE one intended sync per k-step burst
         if self._log_tokens:
             # log what the burst actually WROTE to the KV cache: step i
@@ -560,17 +631,23 @@ class InferenceEngineV2:
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
         quantized = self._quantized
         ms, mb = self.max_seqs, self.max_blocks_per_seq
+        lora_on = self.lora_store is not None
 
-        def burst(p, kc, vc, meta, rng=None):
+        def burst(p, kc, vc, meta, rng=None, lora_slabs=None):
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)  # once per burst, not per step
-            lay = _burst_layout(ms, mb)
+            lay = _burst_layout(ms, mb, lora=lora_on)
             tokens0 = meta[slice(*lay["tokens0"])]
             token_seq = meta[slice(*lay["token_seq"])]
             pos0 = meta[slice(*lay["pos0"])]
             tables = meta[slice(*lay["tables"])].reshape(ms + 1, mb)
             last = jnp.arange(ms, dtype=jnp.int32)
+            lora_arg = None
+            if lora_slabs is not None:
+                la, lb, scales = lora_slabs
+                seq_adapters = meta[slice(*lay["seq_adapters"])]
+                lora_arg = (la, lb, scales, seq_adapters, None)
 
             def one(carry, i):
                 kc, vc, toks = carry
@@ -578,7 +655,7 @@ class InferenceEngineV2:
                      "token_pos": pos0 + i, "block_tables": tables,
                      "last_index": last}
                 sel, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
-                                             attn_impl=attn_impl)
+                                             attn_impl=attn_impl, lora=lora_arg)
                 if skey is None:
                     nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
                 else:
@@ -589,11 +666,15 @@ class InferenceEngineV2:
                                             jnp.arange(k, dtype=jnp.int32))
             return out, kc, vc
 
-        if skey is None:
-            return maybe_checkify_jit(lambda p, kc, vc, meta: burst(p, kc, vc, meta),
-                                      donate_argnums=(1, 2),
-                                      enabled=self._sanitize)
-        return maybe_checkify_jit(burst, donate_argnums=(1, 2),
+        # explicit arity wrappers: callers pass everything positionally,
+        # so the lora slab pytree must never land in the rng parameter
+        if skey is None and lora_on:
+            fn = lambda p, kc, vc, meta, slabs: burst(p, kc, vc, meta, None, slabs)
+        elif skey is None:
+            fn = lambda p, kc, vc, meta: burst(p, kc, vc, meta)
+        else:
+            fn = burst
+        return maybe_checkify_jit(fn, donate_argnums=(1, 2),
                                   enabled=self._sanitize)
 
     # -------------------------------------------- speculative decoding
@@ -653,15 +734,20 @@ class InferenceEngineV2:
         if err is not None:
             raise err
         ms, mb = self.max_seqs, self.max_blocks_per_seq
+        lora_on = self.lora_store is not None
         toks = np.zeros((ms, d + 1), np.int32)
         dlen = np.zeros(ms, np.int32)
         token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
         pos0 = np.zeros(ms, np.int32)
         tables = np.full((ms + 1, mb), NULL_BLOCK, np.int32)
+        adapters = np.zeros(ms + 1, np.int32)   # pad row stays slot 0 = base
         entries = []
         for i, (desc, tok, drafts) in enumerate(
                 zip(descs, batch_tokens, batch_drafts)):
             desc.slot = i
+            if lora_on:
+                desc.adapter_slot = self.lora_store.slot_of(desc.uid)
+                adapters[i] = desc.adapter_slot
             self.state_manager.allocate_for(desc, d + 1)
             entry = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous step's host copy
             entries.append(entry)
@@ -672,15 +758,22 @@ class InferenceEngineV2:
             token_seq[i] = i
             pos0[i] = desc.seen_tokens
             tables[i, :len(desc.blocks)] = desc.blocks
-        meta = np.concatenate([toks.ravel(), dlen, token_seq, pos0,
-                               tables.ravel()])
+        parts = [toks.ravel(), dlen, token_seq, pos0, tables.ravel()]
+        if lora_on:
+            parts.append(adapters)
+        meta = np.concatenate(parts)
         assert meta.shape[0] == sum(e - s for s, e
-                                    in _verify_layout(ms, mb, d).values())
+                                    in _verify_layout(ms, mb, d, lora=lora_on).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
-        fn = self._get_burst_fn(("verify", d), lambda: self._make_verify_fn(d))
+        # greedy verify must see the SAME adapter deltas decode does, or
+        # acceptance silently diverges from stepwise decoding
+        key = ("verify", d) if not lora_on else \
+            ("verify", d, self.lora_store.signature())
+        fn = self._get_burst_fn(key, lambda: self._make_verify_fn(d))
+        extra = (self.lora_store.slabs(),) if lora_on else ()
         out, acc, self.kv_cache.k, self.kv_cache.v = fn(
-            self.params, self.kv_cache.k, self.kv_cache.v, meta)
+            self.params, self.kv_cache.k, self.kv_cache.v, meta, *extra)
         out = np.asarray(out)  # ds-lint: disable=host-sync -- THE one intended sync per verify burst
         acc = np.asarray(acc)  # host copy of the device result above, already synced
         n = len(batch_uids)
@@ -711,17 +804,23 @@ class InferenceEngineV2:
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
         quantized = self._quantized
         ms, mb = self.max_seqs, self.max_blocks_per_seq
+        lora_on = self.lora_store is not None
 
-        def verify(p, kc, vc, meta):
+        def verify(p, kc, vc, meta, lora_slabs=None):
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)
-            lay = _verify_layout(ms, mb, d)
+            lay = _verify_layout(ms, mb, d, lora=lora_on)
             toks = meta[slice(*lay["tokens"])].reshape(ms, d + 1)
             dlen = meta[slice(*lay["dlen"])]
             token_seq = meta[slice(*lay["token_seq"])]
             pos0 = meta[slice(*lay["pos0"])]
             tables = meta[slice(*lay["tables"])].reshape(ms + 1, mb)
+            lora_arg = None
+            if lora_slabs is not None:
+                la, lb, scales = lora_slabs
+                seq_adapters = meta[slice(*lay["seq_adapters"])]
+                lora_arg = (la, lb, scales, seq_adapters, None)
             T = ms * (d + 1)
             steps = jnp.arange(d + 1, dtype=jnp.int32)
             # each sequence enters as one (d+1)-token chunk at positions
@@ -735,7 +834,7 @@ class InferenceEngineV2:
                  "block_tables": tables,
                  "last_index": jnp.arange(T, dtype=jnp.int32)}
             logits, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
-                                            attn_impl=attn_impl)
+                                            attn_impl=attn_impl, lora=lora_arg)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32).reshape(ms, d + 1)
             # greedy acceptance: draft j survives iff every earlier
             # draft did AND it equals the model's own next token there —
@@ -823,6 +922,60 @@ class InferenceEngineV2:
             return 0
         return self.kv_tier.import_chain(record)
 
+    # ------------------------------------------------- multi-tenant LoRA
+    def bind_adapter(self, uid, adapter_id):
+        """Pin ``uid``'s tokens to ``adapter_id``'s hot slot for the
+        sequence's lifetime (promoting the adapter from the host tier or
+        its publication dir if cold — may evict an unleased LRU hot
+        adapter). ``adapter_id`` falsy → base model, slot 0. The lease
+        holds the slot until :meth:`flush`; → the bound slot index."""
+        if not adapter_id:
+            return 0
+        if self.lora_store is None:
+            raise RuntimeError(
+                "adapter routing requires LoRA serving "
+                "(config.lora.enabled / DS_LORA)")
+        slot = self.lora_store.bind(uid, int(adapter_id))
+        desc = self.state_manager.query(uid)
+        if desc is not None:
+            desc.adapter_slot = slot
+        return slot
+
+    def has_adapter(self, adapter_id):
+        """True when ``adapter_id`` is HOT (HBM-resident) — placement
+        probes use this for adapter-affine routing."""
+        return (self.lora_store is not None
+                and self.lora_store.has_adapter(int(adapter_id)))
+
+    def knows_adapter(self, adapter_id):
+        """True when any tier (hot, host, publication dir) can serve
+        ``adapter_id`` — gateway admission rejects unknown ids up front."""
+        return (self.lora_store is not None
+                and self.lora_store.known(int(adapter_id)))
+
+    def prefetch_adapter(self, adapter_id):
+        """Fire-and-forget: stage ``adapter_id``'s padded slabs on the
+        store's prefetch worker so a later bind's device copy overlaps
+        queueing (no-op without a store). Safe from any thread."""
+        if self.lora_store is not None:
+            self.lora_store.prefetch(int(adapter_id))
+
+    def register_adapter(self, adapter_id, layers, alpha, version=0):
+        """Install adapter weights into the host tier directly (tests /
+        colocated trainers); the first bind promotes them to HBM."""
+        if self.lora_store is None:
+            raise RuntimeError("LoRA serving is disabled")
+        self.lora_store.register(int(adapter_id), layers, alpha,
+                                 version=version)
+
+    def adopt_adapter(self, adapter_id, version=None):
+        """Adopt a published adapter version (sha256-validated commit
+        protocol; raises WeightPublicationError with nothing adopted on
+        a forged/torn publication). Hot copies hot-swap in place."""
+        if self.lora_store is None:
+            raise RuntimeError("LoRA serving is disabled")
+        return self.lora_store.adopt(int(adapter_id), version=version)
+
     def prefix_match_len(self, prompt_tokens):
         """Read-only twin of :meth:`prefix_match` for placement probes:
         → leading tokens of ``prompt_tokens`` whose KV is cached, WITHOUT
@@ -853,6 +1006,8 @@ class InferenceEngineV2:
             raise KeyError(f"unknown sequence {uid}")
         if self.spec is not None:
             self.spec.forget(uid)
+        if self.lora_store is not None:
+            self.lora_store.release(uid)  # drop the adapter-slot lease
 
     def suspend(self, uid):
         """Swap a live sequence's KV blocks to host memory and release
@@ -935,6 +1090,9 @@ class InferenceEngineV2:
         if self.kv_tier is not None:
             self.kv_tier.shutdown()  # stop the prefetch worker + drop host KV
         self.kv_tier = None
+        if self.lora_store is not None:
+            self.lora_store.shutdown()  # stop the adapter prefetch worker
+        self.lora_store = None
         self.spec = None
         self._step = self._step_greedy = None
         self._burst_fns = OrderedDict()

@@ -112,8 +112,26 @@ def _paged_attend(q, k, v, kc, vc, batch, Dh, alibi=None, mesh=None, impl=None):
     return _c(out, (None, "tensor", None), mesh), kc, vc
 
 
-def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
-    lp, kc, vc = xs
+def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, lora_ctx, h, xs):
+    if lora_ctx is None:
+        lp, kc, vc = xs
+
+        def lproj(x, p, site):
+            return _proj(x, p)
+    else:
+        # Multi-tenant LoRA: the scan sliced this layer's stacked hot
+        # slabs alongside the params; each targeted projection adds the
+        # segmented per-token adapter delta (slot 0 = base = exact 0.0).
+        lp, kc, vc, la, lb = xs
+        slots, scales, lora_impl = lora_ctx
+        from deepspeed_tpu.ops.pallas.lora_matmul import apply_lora_delta
+
+        def lproj(x, p, site):
+            y = _proj(x, p)
+            if site in la:
+                y = y + apply_lora_delta(x, slots, la[site], lb[site],
+                                         scales, impl=lora_impl)
+            return y
     # Weight-only quantized serving: the scan sliced this layer's
     # quantized carriers; they stay quantized here and every projection
     # consumes them through the fused dequant-matmul in _proj (norm
@@ -125,15 +143,15 @@ def _layer_step(cfg, cos, sin, batch, mesh, attn_impl, h, xs):
     attn = lp["self_attn"]
 
     hn = _rms(h, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
-    q = _c(_proj(hn, attn["q_proj"]).reshape(T, H, Dh), (None, "tensor", None), mesh)
-    k = _c(_proj(hn, attn["k_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
-    v = _c(_proj(hn, attn["v_proj"]).reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
+    q = _c(lproj(hn, attn["q_proj"], "q_proj").reshape(T, H, Dh), (None, "tensor", None), mesh)
+    k = _c(lproj(hn, attn["k_proj"], "k_proj").reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
+    v = _c(lproj(hn, attn["v_proj"], "v_proj").reshape(T, Hkv, Dh), (None, "tensor", None), mesh)
     q = _rope_flat(q, cos, sin, batch["token_pos"])
     k = _rope_flat(k, cos, sin, batch["token_pos"])
 
     out, kc, vc = _paged_attend(q, k, v, kc, vc, batch, Dh, mesh=mesh,
                                 impl=attn_impl)
-    h = _c(h + _proj(out.reshape(T, H * Dh), attn["o_proj"]), (None, None), mesh)
+    h = _c(h + lproj(out.reshape(T, H * Dh), attn["o_proj"], "o_proj"), (None, None), mesh)
 
     hn2 = _rms(h, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
     if "moe_mlp" in lp:
@@ -254,7 +272,7 @@ def _gpt_layer_step(cfg, cos, sin, alibi, batch, mesh, attn_impl, h, xs):
 
 
 def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=None,
-                   attn_impl=None):
+                   attn_impl=None, lora=None):
     """→ (last-token logits [max_seqs, vocab] fp32, new kcache, new vcache).
 
     ``kcache``/``vcache``: [L, NB, bs, Hkv, Dh]; ``batch``: the arrays
@@ -263,7 +281,14 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=
     serving mesh — params/KV arrive sharded per
     ``inference/v2/sharding.py`` and the step pins the Megatron layout
     (replicated tokens, head/feature-sharded projections) so GSPMD
-    inserts the TP all-reduces."""
+    inserts the TP all-reduces.
+
+    ``lora``: None (the exact pre-LoRA program) or
+    ``(a, b, scales, seq_adapters, impl)`` — per-site stacked hot slabs
+    ``a[site] [L, S, in, r]`` / ``b[site] [L, S, r, out]``, per-slot
+    ``scales [S]``, the batch's per-sequence adapter slots
+    ``seq_adapters [max_seqs + 1]`` (pad row = slot 0 = base), and the
+    static kernel impl selector. Llama-family layers only."""
     is_gpt = hasattr(cfg, "position_embedding")
     embed = params["model"]["embed_tokens"]
     h = _c(embed[batch["token_ids"]].astype(dtype), (None, None), mesh)  # [T, D]
@@ -271,6 +296,10 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=
     if mult != 1.0:  # Gemma: sqrt(hidden_size)
         h = h * jnp.asarray(mult, h.dtype)
 
+    if lora is not None and is_gpt:
+        raise NotImplementedError(
+            "multi-tenant LoRA serving targets the Llama-family layer "
+            "stack; GPT-family models serve base-only")
     if is_gpt:
         cos = sin = None
         if cfg.position_embedding == "rope" and cfg.rotary_dim > 0:
@@ -288,13 +317,24 @@ def ragged_forward(params, kcache, vcache, batch, cfg, dtype=jnp.bfloat16, mesh=
             h = _layernorm(h, params["model"]["embed_layernorm"], cfg.layer_norm_eps)
         step = functools.partial(_gpt_layer_step, cfg, cos, sin, alibi, batch, mesh,
                                  attn_impl)
+        xs = (params["model"]["layers"], kcache, vcache)
     else:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
                                     scaling=rope_scaling_of(cfg))
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
-        step = functools.partial(_layer_step, cfg, cos, sin, batch, mesh, attn_impl)
+        lora_ctx = None
+        xs = (params["model"]["layers"], kcache, vcache)
+        if lora is not None:
+            la, lb, scales, seq_adapters, lora_impl = lora
+            # per-token adapter slot: pad tokens hit the pad row, which
+            # carries slot 0 (base) by construction
+            slots = seq_adapters[batch["token_seq"]]
+            lora_ctx = (slots, scales, lora_impl)
+            xs = (params["model"]["layers"], kcache, vcache, la, lb)
+        step = functools.partial(_layer_step, cfg, cos, sin, batch, mesh, attn_impl,
+                                 lora_ctx)
 
-    h, (kc, vc) = jax.lax.scan(step, h, (params["model"]["layers"], kcache, vcache))
+    h, (kc, vc) = jax.lax.scan(step, h, xs)
 
     if is_gpt:
         if cfg.norm_type == "layernorm":

@@ -18,6 +18,10 @@ class DSSequenceDescriptor:
         self.slot = slot
         self.block_size = block_size
         self.seen_tokens = 0  # tokens already written to the KV cache
+        # multi-tenant LoRA: the AdapterStore hot slot this sequence's
+        # tokens select in the segmented adapter matmul (0 = base model;
+        # stays 0 whenever LoRA serving is off)
+        self.adapter_slot = 0
         self.blocks = []  # owned KV block ids, in order
         self.in_flight_tokens = 0
         # ---- prefix-cache bookkeeping (zero/empty when caching is off) ----

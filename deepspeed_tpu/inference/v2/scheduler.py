@@ -15,11 +15,15 @@ import numpy as np
 
 class Request:
 
-    def __init__(self, uid, prompt_tokens, max_new_tokens, priority=0, spec=True):
+    def __init__(self, uid, prompt_tokens, max_new_tokens, priority=0, spec=True,
+                 adapter_id=None):
         self.uid = uid
         self.prompt = list(np.atleast_1d(np.asarray(prompt_tokens)).tolist())
         self.max_new_tokens = max_new_tokens
         self.priority = int(priority)  # larger = scheduled first
+        # multi-tenant LoRA: which adapter serves this request (None =
+        # base model); bound to a hot slot at admission
+        self.adapter_id = adapter_id
         # per-request speculative-decoding opt-out: False rides along in
         # verify bursts without drafts of its own (engine-level spec
         # support still decides whether drafting happens at all)
@@ -83,13 +87,22 @@ class DynamicSplitFuseScheduler:
         self.requests = OrderedDict()  # uid -> Request
 
     def add_request(self, uid, prompt_tokens, max_new_tokens=16, priority=0,
-                    spec=True):
+                    spec=True, adapter_id=None):
         if uid in self.requests:
             raise ValueError(f"uid {uid} already queued")
         req = Request(uid, prompt_tokens, max_new_tokens, priority=priority,
-                      spec=spec)
+                      spec=spec, adapter_id=adapter_id)
         if not req.prompt:
             raise ValueError(f"uid {uid}: empty prompt can never be scheduled")
+        if adapter_id:
+            # bind BEFORE queueing: a cold adapter promotes (or raises
+            # typed capacity/unknown errors) here, not mid-step — and the
+            # lease guarantees the slot survives until the engine flush
+            bind = getattr(self.engine, "bind_adapter", None)
+            if bind is None:
+                raise ValueError(f"uid {uid}: adapter_id={adapter_id} but the "
+                                 f"engine has no adapter support")
+            bind(uid, adapter_id)
         self.requests[uid] = req
         # KV-tier prefetch kick: stage any demoted prefix extension for
         # this prompt off-thread NOW, so the host→device copy overlaps

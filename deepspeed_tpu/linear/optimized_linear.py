@@ -106,10 +106,13 @@ def _is_lora_site(d):
 
 
 def _site_scaling(a, lora_alpha, lora_r=None):
-    """alpha / r with r taken from the adapter's own shape (lora_a is
-    [in, r]) unless explicitly overridden — the rank is never guessed."""
-    r = int(lora_r) if lora_r is not None else int(a.shape[-1])
-    return float(lora_alpha) / float(r)
+    """alpha / r with r always taken from THIS site's own shape
+    (``lora_a`` is [in, r]). ``lora_r`` is a legacy config-global hint
+    kept for API compatibility: trees may mix ranks per site (rank-
+    heterogeneous adapters), so a global rank must never be assumed —
+    scaling one site by another site's rank silently mis-scales the
+    fused delta, and fuse→unfuse stops round-tripping."""
+    return float(lora_alpha) / float(int(a.shape[-1]))
 
 
 def fuse_lora_tree(params, lora_alpha, lora_r=None):
@@ -117,8 +120,9 @@ def fuse_lora_tree(params, lora_alpha, lora_r=None):
     ``hybrid_engine.py:138`` ``fuse_lora_weight``): per site,
     ``base_kernel += (lora_a @ lora_b) * (alpha / r)`` and ``lora_b`` is
     zeroed so the unchanged module forward computes exactly the fused
-    product once. The rank ``r`` is read from each site's ``lora_a``
-    shape (pass ``lora_r`` only to override). → ``(fused_tree, stash)``
+    product once. The rank ``r`` is read from each site's own ``lora_a``
+    shape — ``lora_r`` is accepted for API compatibility but never
+    overrides it (sites may mix ranks). → ``(fused_tree, stash)``
     where ``stash`` maps site path → original ``lora_b`` for
     :func:`unfuse_lora_tree`. The delta is accumulated in fp32 and cast
     back to the base dtype.

@@ -1,0 +1,142 @@
+"""Pallas segmented LoRA matmul — multi-tenant adapter deltas.
+
+Punica's SGMV insight, restated for the TPU grouped layout we already
+own (:mod:`~deepspeed_tpu.ops.pallas.grouped_matmul`): a batch mixing
+many tenants' adapters is just a grouped matmul over per-token adapter
+ids.  Tokens are sorted and segmented by adapter slot at pack time (the
+same ``tile_layout`` math the MoE expert GEMM uses), each group padded
+with zero rows to a multiple of the row tile ``tm``, so every (tm × K)
+row tile belongs to exactly ONE adapter and the kernel needs no in-tile
+masking: a scalar-prefetched ``tile_groups`` array steers the A and B
+slab DMA per row tile.  The kernel chains both low-rank dots in one
+pass — ``(x @ A_g) @ B_g`` — with the fp32 rank-r intermediate living
+in registers/VMEM, so the delta costs two skinny matmuls of HBM traffic
+instead of materializing ``x @ A`` per adapter.
+
+Slot 0 is the base-model slot: ``a[0]``/``b[0]`` are zero slabs, so
+base-only rows ride the same program and contribute exactly nothing
+(0.0 + y = y bitwise).  Rank-bucketing is the caller's job
+(``serving/lora/store.py``): adapters below the bucket rank are
+zero-padded in the rank dim, which is also an exactly-zero
+contribution (zero A columns × zero B rows).
+
+Every output row depends only on its own input row, so a token's delta
+is bit-identical whether it shares the batch with other tenants or runs
+solo — the cross-tenant-isolation property the serving tests assert.
+
+``interpret=True`` runs the Pallas branch on CPU; ``lora_delta_ref`` is
+the identical-math jnp fallback (masked sum over groups — the engine's
+default off-TPU, where interpret-mode Pallas is needlessly slow).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.pallas.grouped_matmul import (_fit_tile,
+                                                     pad_groups_to_tiles)
+
+# Tests set this to route ``apply_lora_delta`` through the Pallas branch
+# in interpret mode on CPU (mirrors ops/grouped_gemm.FORCE_INTERPRET).
+FORCE_INTERPRET = False
+
+
+def _lora_kernel(tg_ref, x_ref, a_ref, b_ref, o_ref):
+    h = jnp.dot(x_ref[:].astype(jnp.float32), a_ref[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+    o_ref[:] = jnp.dot(h, b_ref[0].astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+
+
+def _lora_raw(x, a, b, tile_groups, tm, tn, interpret=False):
+    """x [Mp, K] (rows tile-aligned by adapter slot), a [G, K, r],
+    b [G, r, N], tile_groups [Mp/tm] → unscaled delta [Mp, N] fp32."""
+    Mp, K = x.shape
+    G, _, r = a.shape
+    N = b.shape[-1]
+    tn = _fit_tile(tn, N)
+    grid = (N // tn, Mp // tm)  # row sweep innermost: A/B slabs stay in VMEM
+    return pl.pallas_call(
+        _lora_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, K), lambda j, i, tg: (i, 0)),
+                pl.BlockSpec((1, K, r), lambda j, i, tg: (tg[i], 0, 0)),
+                pl.BlockSpec((1, r, tn), lambda j, i, tg: (tg[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((tm, tn), lambda j, i, tg: (i, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), jnp.float32),
+        interpret=interpret,
+    )(tile_groups, x, a, b)
+
+
+def segment_tokens(slots, num_groups, tm):
+    """Pack-time segmentation for :func:`apply_lora_delta`'s Pallas path.
+
+    ``slots`` [T] int32 adapter slot per token (0 = base) →
+    ``(order, dst, tile_groups, Mp)``: the stable slot-sort permutation,
+    each sorted row's padded destination, the owning slot per row tile,
+    and the static padded row count.  All shapes are static given
+    ``(T, num_groups, tm)`` so the layout traces into the serving step.
+    """
+    sizes = jnp.bincount(slots, length=num_groups)
+    order = jnp.argsort(slots, stable=True).astype(jnp.int32)
+    dst, tile_groups, Mp = pad_groups_to_tiles(sizes, slots.shape[0], tm)
+    return order, dst, tile_groups, Mp
+
+
+def lora_delta_pallas(x, slots, a, b, scales, tm=8, tn=512, interpret=False):
+    """Per-token LoRA delta via the segmented kernel: [T, N] in x.dtype."""
+    T, K = x.shape
+    G = a.shape[0]
+    order, dst, tile_groups, Mp = segment_tokens(slots, G, tm)
+    xp = jnp.zeros((Mp, K), x.dtype).at[dst].set(x[order])
+    delta_p = _lora_raw(xp, a, b, tile_groups, tm, tn, interpret)
+    delta = jnp.zeros((T, delta_p.shape[-1]), jnp.float32).at[order].set(
+        delta_p[dst])
+    return (delta * scales[slots][:, None]).astype(x.dtype)
+
+
+def lora_delta_ref(x, slots, a, b, scales):
+    """Identical-math jnp fallback: masked sum over adapter slots.
+
+    Each token's owning slot contributes ``(x @ A_g) @ B_g * s_g`` in
+    fp32; every other slot contributes exactly 0.0, and ``0.0 + v`` is
+    ``v`` bitwise — so, like the kernel, a token's delta is independent
+    of its batchmates.
+    """
+    xf = x.astype(jnp.float32)
+    h = jnp.einsum("tk,gkr->gtr", xf, a.astype(jnp.float32))
+    d = jnp.einsum("gtr,gro->gto", h, b.astype(jnp.float32))
+    w = jnp.where(slots[None, :] == jnp.arange(a.shape[0])[:, None],
+                  scales[:, None], 0.0).astype(jnp.float32)
+    return jnp.einsum("gto,gt->to", d, w).astype(x.dtype)
+
+
+def apply_lora_delta(x, slots, a, b, scales, *, tm=8, tn=512, impl=None):
+    """Segmented multi-tenant LoRA delta: ``y += apply_lora_delta(...)``.
+
+    ``x`` [T, K] activations, ``slots`` [T] int32 adapter slot per token
+    (slot 0 = base → zero delta), ``a`` [G, K, r] / ``b`` [G, r, N]
+    rank-bucketed hot slabs, ``scales`` [G] fp32 = alpha/true_rank per
+    slot.  Returns [T, N] in ``x.dtype``.
+
+    ``impl``: ``"pallas"`` | ``"jnp"`` | None (auto: Pallas on TPU, jnp
+    fallback elsewhere — interpret-mode Pallas only when FORCE_INTERPRET
+    routes tests through the kernel branch on CPU).
+    """
+    if impl is None:
+        if jax.default_backend() == "tpu":
+            impl = "pallas"
+        elif FORCE_INTERPRET:
+            impl = "interpret"
+        else:
+            impl = "jnp"
+    if impl == "jnp":
+        return lora_delta_ref(x, slots, a, b, scales)
+    return lora_delta_pallas(x, slots, a, b, scales, tm=tm, tn=tn,
+                             interpret=(impl == "interpret"))

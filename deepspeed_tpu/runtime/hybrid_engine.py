@@ -60,7 +60,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if lora_alpha is None:
             lora_alpha = LoRAConfig().lora_alpha
         if lora_r is None:
-            lora_r = self._lora_r_default  # None → per-site from lora_a shape
+            lora_r = self._lora_r_default  # legacy hint; rank is per-site
         self._ensure_params_resident()
         self.params, self._lora_stash = fuse_lora_tree(self.params, lora_alpha, lora_r)
         self._lora_scaling = (float(lora_alpha), lora_r)

@@ -58,10 +58,20 @@ class Replica:
     role = "unified"
 
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, adapter_id=None):
         """→ a :class:`RequestHandle`-shaped streaming handle. Raises a
         :class:`ServingError` subclass when not accepted."""
         raise NotImplementedError
+
+    def has_adapter(self, adapter_id):
+        """True when this replica's hot adapter set holds ``adapter_id``
+        (the adapter-affine placement signal). Must never create state."""
+        return False
+
+    def prefetch_adapter(self, adapter_id):
+        """Fire-and-forget: warm ``adapter_id`` toward this replica's
+        hot set so a follow-up placement finds it resident."""
+        return None
 
     def take_handoff(self, uid):
         """Claim the exported KV handoff record for gateway-local
@@ -158,9 +168,22 @@ class GatewayReplica(Replica):
 
     # ------------------------------------------------------------ routing API
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, adapter_id=None):
         return self.gateway.submit(prompt_tokens, max_new_tokens=max_new_tokens,
-                                   priority=priority, deadline_ms=deadline_ms)
+                                   priority=priority, deadline_ms=deadline_ms,
+                                   adapter_id=adapter_id)
+
+    def has_adapter(self, adapter_id):
+        try:
+            return bool(self.gateway.engine.has_adapter(adapter_id))
+        except Exception:
+            return False  # no LoRA store / broken replica → not a target
+
+    def prefetch_adapter(self, adapter_id):
+        try:
+            self.gateway.engine.prefetch_adapter(adapter_id)
+        except Exception:
+            pass  # warm-up is best-effort; placement still works cold
 
     def take_handoff(self, uid):
         return self.gateway.take_handoff(uid)
@@ -336,7 +359,7 @@ class FaultyReplica(Replica):
 
     # ------------------------------------------------------------ routing API
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, adapter_id=None):
         with self._lock:
             if self._killed:
                 raise ReplicaDiedError(f"replica {self.name} is dead")
@@ -357,8 +380,16 @@ class FaultyReplica(Replica):
         inner_handle = self.inner.submit(prompt_tokens,
                                          max_new_tokens=max_new_tokens,
                                          priority=priority,
-                                         deadline_ms=deadline_ms)
+                                         deadline_ms=deadline_ms,
+                                         adapter_id=adapter_id)
         return _FaultyHandle(inner_handle, self)
+
+    def has_adapter(self, adapter_id):
+        return (not self._killed) and self.inner.has_adapter(adapter_id)
+
+    def prefetch_adapter(self, adapter_id):
+        if not self._killed:
+            self.inner.prefetch_adapter(adapter_id)
 
     def take_handoff(self, uid):
         with self._lock:

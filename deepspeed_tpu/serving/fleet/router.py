@@ -57,7 +57,8 @@ _COUNTERS = ("submitted", "completed", "failed", "cancelled",
              "recoveries", "prefix_routed", "tokens_relayed",
              "disagg_requests", "disagg_completed", "unified_fallbacks",
              "handoff_failures", "refreshes", "refresh_rollbacks",
-             "refresh_demotions", "canary_divergences")
+             "refresh_demotions", "canary_divergences",
+             "adapter_routed", "adapter_misses")
 
 
 # ---------------------------------------------------------------------- errors
@@ -86,8 +87,10 @@ class FleetHandle(RequestHandle):
     instead of a gateway pump. Adds the failover breadcrumbs tests and
     operators want: which replicas served it, how many attempts."""
 
-    def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s):
-        super().__init__(uid, prompt, max_new_tokens, priority, deadline_s)
+    def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s,
+                 adapter_id=None):
+        super().__init__(uid, prompt, max_new_tokens, priority, deadline_s,
+                         adapter_id=adapter_id)
         self.replica_trail = []  # replica names, one per attempt
         self.attempts = 0
         self._cancelled = False
@@ -157,10 +160,13 @@ class FleetRouter:
 
     # ---------------------------------------------------------------- client
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, adapter_id=None):
         """Gateway-compatible submit: → a streaming :class:`FleetHandle`.
         Placement, retries and failover all happen on a per-request
         relay thread; the caller just consumes ``handle.tokens()``.
+        ``adapter_id`` routes the request through that LoRA adapter's
+        weights (None = base) — placement prefers replicas whose hot
+        set already holds the adapter.
 
         Defaults resolve HERE (from :class:`FleetConfig`), not per
         replica — every failover attempt must replay with identical
@@ -180,7 +186,7 @@ class FleetRouter:
                     "fleet router is closed — not accepting requests")
         handle = FleetHandle(next(self._uids), prompt, max_new, prio,
                              deadline_ms / 1e3 if deadline_ms is not None
-                             else None)
+                             else None, adapter_id=adapter_id)
         handle._cancel_cb = self._request_cancel
         self._count("submitted")
         thread = threading.Thread(target=self._serve, args=(handle,),
@@ -230,12 +236,14 @@ class FleetRouter:
                         f"request {handle.uid} deadline expired before "
                         f"attempt {handle.attempts}"))
                     return
-                replica = self._place(handle.prompt, excluded)
+                replica = self._place(handle.prompt, excluded,
+                                      adapter_id=handle.adapter_id)
                 if replica is None and excluded:
                     # every un-failed replica is unroutable; a replica
                     # that failed this request earlier may have recovered
                     excluded.clear()
-                    replica = self._place(handle.prompt, excluded)
+                    replica = self._place(handle.prompt, excluded,
+                                          adapter_id=handle.adapter_id)
                 if replica is None:
                     self._fail(handle, NoReplicaAvailableError(
                         f"no routable replica for request {handle.uid} "
@@ -522,7 +530,8 @@ class FleetRouter:
             inner = replica.submit(handle.prompt,
                                    max_new_tokens=max_new,
                                    priority=handle.priority,
-                                   deadline_ms=deadline_ms)
+                                   deadline_ms=deadline_ms,
+                                   adapter_id=handle.adapter_id)
         except ServingError as e:
             self._note_failure(replica, e)
             return (_RETRY if e.retry_elsewhere else _FATAL), e
@@ -615,12 +624,16 @@ class FleetRouter:
             health.record_failure(why=f"[{reason}] {err}")
 
     # ------------------------------------------------------------- placement
-    def _place(self, prompt, excluded, roles=None):
+    def _place(self, prompt, excluded, roles=None, adapter_id=None):
         """Pick a replica for ``prompt``: routable + alive, HEALTHY
-        preferred over DEGRADED, then longest prefix-cache match (ties
-        to lighter load), then least-loaded. ``roles`` restricts
-        placement to the named disagg pool(s); None means any replica
-        (unified serving and degraded-mode fallback)."""
+        preferred over DEGRADED, then adapter-affine (a replica whose
+        hot set already holds ``adapter_id`` skips the promotion stall),
+        then longest prefix-cache match (ties to lighter load), then
+        least-loaded. A full adapter miss falls back to least-loaded
+        and kicks that replica's adapter prefetch so the NEXT request
+        for this tenant lands warm. ``roles`` restricts placement to
+        the named disagg pool(s); None means any replica (unified
+        serving and degraded-mode fallback)."""
         candidates = []
         for name, rep in self.replicas.items():
             if name in excluded or not self.health[name].routable:
@@ -639,6 +652,25 @@ class FleetRouter:
         healthy = [r for r in candidates
                    if self.health[r.name].state == HEALTHY]
         pool = healthy or candidates
+        if adapter_id:
+            warm = []
+            for rep in pool:
+                try:
+                    if rep.has_adapter(adapter_id):
+                        warm.append(rep)
+                except Exception:
+                    pass
+            if warm:
+                self._count("adapter_routed")
+                pool = warm  # prefix routing breaks remaining ties below
+            else:
+                self._count("adapter_misses")
+                chosen = min(pool, key=self._load)
+                try:
+                    chosen.prefetch_adapter(adapter_id)
+                except Exception:
+                    pass
+                return chosen
         if self._prefix_routing and len(prompt) > 1:
             best, best_key = None, None
             for rep in pool:

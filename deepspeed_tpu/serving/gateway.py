@@ -91,7 +91,7 @@ class RequestHandle:
     """
 
     def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s,
-                 spec=True):
+                 spec=True, adapter_id=None):
         self.uid = uid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -99,6 +99,9 @@ class RequestHandle:
         # per-request speculative-decoding opt-out (engine support and
         # the DS_SPEC_DECODE kill switch still gate it globally)
         self.spec = bool(spec)
+        # multi-tenant LoRA: serve this request through adapter_id's
+        # weights (None = base model)
+        self.adapter_id = adapter_id
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + deadline_s
                          if deadline_s is not None else None)
@@ -222,14 +225,18 @@ class ServingGateway:
 
     # ---------------------------------------------------------------- client
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None, spec=True):
+               deadline_ms=None, spec=True, adapter_id=None):
         """Accept a request from any thread → :class:`RequestHandle`.
         ``spec=False`` opts this request out of speculative decoding
         (it still rides in verify batches, just without drafts).
+        ``adapter_id`` routes the request through that LoRA adapter's
+        weights (None = base model).
 
         Raises :class:`RequestTooLargeError` when the request can never
         fit this engine, :class:`QueueFullError` per the admission
-        policy, :class:`GatewayClosedError` after ``drain()`` began.
+        policy, :class:`GatewayClosedError` after ``drain()`` began, and
+        ``UnknownAdapterError`` when no tier of the engine's adapter
+        store can serve ``adapter_id``.
         """
         prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
         max_new = int(max_new_tokens if max_new_tokens is not None
@@ -244,6 +251,17 @@ class ServingGateway:
             raise GatewayClosedError("gateway is draining — not accepting requests")
         if self._state == "failed":
             raise GatewayFailedError("gateway pump died; rebuild the gateway")
+        if adapter_id:
+            # typed unknown-adapter rejection at the door — NOT a
+            # mid-pump failure after the request already queued
+            knows = getattr(self.engine, "knows_adapter", None)
+            if knows is None or not knows(adapter_id):
+                from deepspeed_tpu.serving.lora.store import UnknownAdapterError
+                self.metrics.count("rejected_unknown_adapter")
+                raise UnknownAdapterError(
+                    f"adapter {adapter_id} is not registered with this "
+                    f"replica (hot, host, or published)",
+                    adapter_id=int(adapter_id))
         try:
             self.gate.check_feasible(len(prompt), max_new)
         except Exception:
@@ -253,10 +271,10 @@ class ServingGateway:
         if recorder is not None:
             # record OFFERED traffic (pre-admission): a replay must let
             # the candidate config make its own admission decisions
-            recorder.record(prompt, max_new, prio)
+            recorder.record(prompt, max_new, prio, adapter_id=adapter_id)
         handle = RequestHandle(next(self._uids), prompt, max_new, prio,
                                deadline_ms / 1e3 if deadline_ms is not None else None,
-                               spec=spec)
+                               spec=spec, adapter_id=adapter_id)
         handle._cancel_cb = self._request_cancel
         try:
             shed = self.queue.push(handle)
@@ -278,6 +296,14 @@ class ServingGateway:
                     active=self.gate.active,
                     est_wait_s=round(qw.total_ms / qw.count / 1e3, 4)
                     if qw.count else None)
+                if adapter_id:
+                    # adapter-miss hint: a router seeing hot=False should
+                    # prefer a replica whose hot set already holds this
+                    # adapter over re-queueing here behind a promotion
+                    has = getattr(self.engine, "has_adapter", None)
+                    e.details.update(
+                        adapter_id=int(adapter_id),
+                        adapter_hot=bool(has(adapter_id)) if has else False)
             raise
         self.metrics.count("submitted")
         self.metrics.gauge_peak("queue_depth_peak",
@@ -294,6 +320,12 @@ class ServingGateway:
         prefetch = getattr(self.engine, "prefetch_prefix", None)
         if prefetch is not None:
             prefetch(prompt)
+        if adapter_id:
+            # same overlap trick for cold adapters: stage the padded
+            # slabs on the store's worker while the request queues
+            pf = getattr(self.engine, "prefetch_adapter", None)
+            if pf is not None:
+                pf(adapter_id)
         self._wake.set()
         return handle
 
@@ -606,6 +638,9 @@ class ServingGateway:
         spec = getattr(self.engine, "spec", None)
         if spec is not None:
             self.metrics.set_external("Serve/Spec", spec.stats())
+        lora_store = getattr(self.engine, "lora_store", None)
+        if lora_store is not None:
+            self.metrics.set_external("Serve/LoRA", lora_store.stats())
         interval = self.config.metrics_interval_steps
         if self.monitor is not None and interval and did:
             steps = self.metrics.snapshot()["counters"]["engine_steps"]
@@ -676,10 +711,26 @@ class ServingGateway:
             if entry.done:  # shed/failed between snapshot and now
                 self.gate.release(plen, max_new)
                 continue
-            self.scheduler.add_request(entry.uid, entry.prompt,
-                                       max_new_tokens=max_new,
-                                       priority=entry.priority,
-                                       spec=getattr(entry, "spec", True))
+            try:
+                self.scheduler.add_request(entry.uid, entry.prompt,
+                                           max_new_tokens=max_new,
+                                           priority=entry.priority,
+                                           spec=getattr(entry, "spec", True),
+                                           adapter_id=getattr(entry, "adapter_id",
+                                                              None))
+            except Exception as e:
+                from deepspeed_tpu.serving.admission import ServingError
+                if not isinstance(e, ServingError):
+                    raise
+                # typed adapter failure at bind time (hot set saturated
+                # with leased slots, publication vanished): fail THIS
+                # request with the retryable error instead of killing
+                # the pump — the fleet router fails it over
+                self.gate.release(plen, max_new)
+                if entry._finish("failed", e):
+                    self.metrics.count("rejected_adapter")
+                did = True
+                continue
             entry.status = "running"
             entry.queue_wait_s = time.monotonic() - entry.submitted_at
             self.metrics.observe_queue_wait(entry.queue_wait_s)

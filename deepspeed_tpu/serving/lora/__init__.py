@@ -1,0 +1,56 @@
+"""Multi-tenant LoRA serving: thousands of adapters on one base model.
+
+The reference ships LoRA as a training-side construct only
+(``OptimizedLinear`` + hybrid-engine fuse/unfuse — one adapter, fused
+into the base before serving). This package is the serving-side
+redesign (Punica SGMV / S-LoRA): per-request ``adapter_id`` flows
+gateway → scheduler → packed batch → model runner, where a segmented
+Pallas matmul (:mod:`~deepspeed_tpu.ops.pallas.lora_matmul`) applies
+every tenant's delta in one grouped pass, and an
+:class:`~deepspeed_tpu.serving.lora.store.AdapterStore` pages adapters
+between HBM slabs, host RAM, and sha256-validated disk publications.
+
+``DS_LORA=0`` (or ``lora.enabled = False`` unset) builds the exact
+pre-LoRA pipeline — no slot arrays packed, no extra burst-key
+component, program keys unchanged.
+"""
+
+from deepspeed_tpu.serving.lora.publisher import AdapterPublisher
+from deepspeed_tpu.serving.lora.store import (LORA_SITES,
+                                              AdapterCapacityError,
+                                              AdapterStore,
+                                              UnknownAdapterError)
+from deepspeed_tpu.utils.env_registry import env_int, env_opt_bool
+
+
+def lora_serving_enabled(config) -> bool:
+    """Config gate plus the ``DS_LORA`` kill switch: when the env var is
+    set it wins in BOTH directions; unset defers to
+    ``config.enabled``."""
+    forced = env_opt_bool("DS_LORA")
+    if forced is not None:
+        return forced
+    return bool(getattr(config, "enabled", False))
+
+
+def lora_hot_set(config) -> int:
+    """Hot adapter slots: ``DS_LORA_HOT_SET`` when set to a positive
+    value, else the config's ``hot_set``."""
+    override = env_int("DS_LORA_HOT_SET")
+    if override > 0:
+        return override
+    return int(getattr(config, "hot_set", 8))
+
+
+def lora_max_rank(config) -> int:
+    """Rank bucket ceiling: ``DS_LORA_MAX_RANK`` when set to a positive
+    value, else the config's ``max_rank``."""
+    override = env_int("DS_LORA_MAX_RANK")
+    if override > 0:
+        return override
+    return int(getattr(config, "max_rank", 16))
+
+
+__all__ = ["AdapterPublisher", "AdapterStore", "AdapterCapacityError",
+           "UnknownAdapterError", "LORA_SITES", "lora_serving_enabled",
+           "lora_hot_set", "lora_max_rank"]

@@ -67,7 +67,8 @@ class ServingMetrics:
                 "deadline_expired", "preemptions", "resumes",
                 "tokens_generated", "engine_steps", "failed",
                 "handoffs_exported", "handoffs_imported",
-                "weight_refreshes")
+                "weight_refreshes", "rejected_unknown_adapter",
+                "rejected_adapter")
 
     def __init__(self, window=1024):
         self._lock = threading.Lock()

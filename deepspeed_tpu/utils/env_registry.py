@@ -262,6 +262,24 @@ register("DS_KV_TIER_QUANT", "optional_bool", None,
          "in both directions, unset defers to the engine config.",
          "deepspeed_tpu/inference/v2/kv_tier/__init__.py",
          tuning="offline")
+register("DS_LORA", "optional_bool", None,
+         "Kill switch for multi-tenant LoRA serving (segmented adapter "
+         "deltas + AdapterStore paging); set it wins in both "
+         "directions, unset defers to the engine config. Off builds "
+         "the exact pre-LoRA pipeline (program keys unchanged).",
+         "deepspeed_tpu/serving/lora/__init__.py",
+         tuning="offline")
+register("DS_LORA_HOT_SET", "int", 0,
+         "Hot adapter slots the AdapterStore keeps resident as HBM "
+         "slabs; 0 defers to the engine config's lora.hot_set.",
+         "deepspeed_tpu/serving/lora/__init__.py",
+         min_value=0, tuning="offline")
+register("DS_LORA_MAX_RANK", "int", 0,
+         "Rank bucket ceiling for hot adapter slabs (smaller ranks "
+         "zero-pad up, larger ranks are rejected at registration); 0 "
+         "defers to the engine config's lora.max_rank.",
+         "deepspeed_tpu/serving/lora/__init__.py",
+         min_value=0, tuning="offline")
 register("DS_SPEC_DECODE", "optional_bool", None,
          "Kill switch for self-speculative decoding (n-gram drafting + "
          "batched verify); set it wins in both directions, unset defers "

@@ -89,6 +89,38 @@ class TestTraces:
         assert "trace_meta" in json.loads(lines[0])
         assert len(lines) == 17
 
+    def test_adapter_id_roundtrip_and_v1_compat(self, tmp_path):
+        """Trace v2: ``adapter_id`` survives the jsonl round trip, is
+        only written when set (base-only v2 payloads stay line-identical
+        to v1), and a v1 trace without the field loads as None."""
+        tr = synthesize_trace("steady", 4, seed=5)
+        tr.requests[1].adapter_id = 7
+        tr.requests[3].adapter_id = 42
+        path = str(tmp_path / "t.trace.jsonl")
+        tr.save(path)
+        back = ServingTrace.load(path)
+        assert [r.adapter_id for r in back] == [None, 7, None, 42]
+        assert [r.to_json() for r in back] == [r.to_json() for r in tr]
+        # base-only requests never emit the key
+        assert "adapter_id" not in tr.requests[0].to_json()
+        # a v1 record (no adapter_id, v1 header) loads with None
+        with open(path) as fd:
+            lines = fd.read().splitlines()
+        v1 = str(tmp_path / "v1.trace.jsonl")
+        with open(v1, "w") as fd:
+            fd.write(json.dumps({"trace_meta": {"version": 1}}) + "\n")
+            fd.write(lines[1] + "\n")
+        old = ServingTrace.load(v1)
+        assert old.requests[0].adapter_id is None
+
+    def test_recorder_captures_adapter_id(self):
+        rec = TraceRecorder()
+        rec.record([3, 4, 5], 8, 0)
+        rec.record([3, 4, 6], 8, 1, adapter_id=9)
+        tr = rec.trace()
+        assert [r.adapter_id for r in tr] == [None, 9]
+        assert [r.priority for r in tr] == [0, 1]
+
     def test_future_version_rejected(self, tmp_path):
         path = str(tmp_path / "future.trace.jsonl")
         with open(path, "w") as fd:

@@ -69,6 +69,44 @@ class TestLoraFuseTree:
             np.testing.assert_allclose(np.asarray(va), np.asarray(vb),
                                        rtol=1e-6, atol=1e-6, err_msg=str(ka))
 
+    def test_heterogeneous_ranks_fuse_per_site(self):
+        """Sites may disagree on rank: scaling must come from each
+        site's own ``lora_a`` shape, and a config-global ``lora_r`` hint
+        must never override it — otherwise one site's delta is fused at
+        the wrong scale and fuse→unfuse stops round-tripping."""
+        x, _ = _data()
+
+        class MixedRankNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = OptimizedLinear(32, lora_config=LoRAConfig(
+                    lora_r=4, lora_alpha=8.0), dtype=jnp.float32,
+                    name="wide")(x)
+                return OptimizedLinear(16, lora_config=LoRAConfig(
+                    lora_r=2, lora_alpha=8.0), dtype=jnp.float32,
+                    name="narrow")(nn.gelu(h))
+
+        model = MixedRankNet()
+        params = model.init(jax.random.PRNGKey(1), jnp.asarray(x))["params"]
+        params = jax.tree_util.tree_map_with_path(
+            lambda kp, v: v + 0.02 if "lora_b" in str(kp) else v, params)
+        assert params["wide"]["lora_a"].shape[-1] == 4
+        assert params["narrow"]["lora_a"].shape[-1] == 2
+        want = model.apply({"params": params}, jnp.asarray(x))
+
+        # lora_r=4 is the (wrong-for-one-site) global hint; the per-site
+        # rank must win for BOTH the fuse and the unfuse
+        fused, stash = fuse_lora_tree(params, 8.0, lora_r=4)
+        got = model.apply({"params": fused}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        restored = unfuse_lora_tree(fused, stash, 8.0, lora_r=4)
+        for (ka, va), (kb, vb) in zip(
+                jax.tree_util.tree_leaves_with_path(params),
+                jax.tree_util.tree_leaves_with_path(restored)):
+            np.testing.assert_allclose(np.asarray(va), np.asarray(vb),
+                                       rtol=1e-6, atol=1e-6, err_msg=str(ka))
+
     def test_quantized_base_fuses_and_unfuses_bit_exact(self):
         """LoRA fuse over an int8 quantized base (reference
         hybrid_engine.py:138-146 with linear/quantization.py):

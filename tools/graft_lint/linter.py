@@ -93,6 +93,16 @@ THREAD_SHARED_REGISTRY = {
                     "import_rejects"},
     "HostKVStore": {"_records", "bytes_resident", "demotions", "promotions",
                     "evictions", "lookups", "hits"},
+    # multi-tenant LoRA: the adapter prefetch worker stages slabs while
+    # the pump thread binds/promotes/evicts and client threads register,
+    # publish, prefetch-kick, and read stats
+    "AdapterStore": {"_hot", "_slot_meta", "_refs", "_uid_slot", "_lru",
+                     "_free", "_host", "_host_bytes", "_staged", "_inflight",
+                     "_a", "_b", "_scales", "_shutdown",
+                     "registrations", "promotions", "evictions",
+                     "host_evictions", "hot_hits", "hot_misses", "swaps",
+                     "prefetched", "stage_hits", "prefetch_errors",
+                     "publish_rejects"},
     # spec decode: the gateway pump drafts/notes while client threads
     # reach forget() through engine.flush (cancel / deadline / drain),
     # and the online SLO controller adjusts draft_len_cfg live
@@ -172,6 +182,10 @@ LOCK_ORDER = {
     # the pump takes it strictly before/after (never around) the swap
     "ServingGateway._refresh_lock": 26,
     "PrefixCacheManager._lock": 30,
+    # the adapter store is called from the pump with no engine-side lock
+    # held above it, and itself calls only its publisher (unranked leaf
+    # I/O) — it slots between the prefix cache and the kv-tier stack
+    "AdapterStore._lock": 34,
     "TierManager._lock": 40,
     "HostKVStore._lock": 50,
 }
