@@ -96,15 +96,22 @@ def run_trace(path):
     so a sweep can score env/tuned-config variants against the same
     real traffic the offline tuner searched."""
     from deepspeed_tpu.autotuning import ServingTrace, replay_lockstep
+    from deepspeed_tpu.inference.structured import byte_vocab
     from deepspeed_tpu.inference.v2 import (DSStateManagerConfig,
                                             InferenceEngineV2,
-                                            RaggedInferenceEngineConfig)
+                                            RaggedInferenceEngineConfig,
+                                            StructuredConfig)
     from deepspeed_tpu.models import build_llama
     from deepspeed_tpu.parallel import groups
     from deepspeed_tpu.serving import ServingConfig, ServingGateway
 
     trace = ServingTrace.load(path)
     s = trace.summary()
+    # v3 traces may carry per-request sampling specs and raw schemas;
+    # schemas need the constrained-decoding slabs plus a tokenizer
+    # surface (byte vocab here — real deployments pass their own
+    # token_strings) recompiled against THIS config's vocab
+    constrained = any(getattr(r, "schema", None) is not None for r in trace)
     groups.destroy_mesh()
     on_tpu = jax.default_backend() == "tpu"
     need_ctx = int(s["mean_prompt_len"] + s["mean_max_new"]) * 4
@@ -114,15 +121,16 @@ def run_trace(path):
                             num_key_value_heads=8,
                             max_position_embeddings=2048,
                             vocab_size=32000, remat=False)
-        block, n_seqs, batch = 32, 16, 512
+        block, n_seqs, batch, vocab = 32, 16, 512, 32000
     else:
         model = build_llama("debug")
-        block, n_seqs, batch = 8, 8, 96
+        block, n_seqs, batch, vocab = 8, 8, 96, 256
     max_ctx = max(block * 4, -(-need_ctx // block) * block)
     engine = InferenceEngineV2(
         model=model,
         config=RaggedInferenceEngineConfig(
             kv_block_size=block,
+            structured=StructuredConfig(enabled=constrained),
             state_manager=DSStateManagerConfig(
                 max_ragged_batch_size=batch,
                 max_ragged_sequence_count=n_seqs,
@@ -131,7 +139,12 @@ def run_trace(path):
     # ServingGateway applies DS_AUTOTUNE_CONFIG (if set) on top of the
     # defaults, so `DS_AUTOTUNE_CONFIG=tuned.json bench_sweep --trace t`
     # scores exactly what the offline tuner shipped
-    gw = ServingGateway(engine, config=ServingConfig(), auto_start=False)
+    scfg = ServingConfig(
+        token_strings=byte_vocab(vocab) if constrained else None,
+        # constrained lanes stop at the schema's accept states; without
+        # an EOS id the DFA would have no legal token there
+        eos_token_id=2 if constrained else None)
+    gw = ServingGateway(engine, config=scfg, auto_start=False)
     report = replay_lockstep(gw, trace)
     rec = {"name": f"trace:{os.path.basename(path)}", "trace": s,
            "serving_config": {

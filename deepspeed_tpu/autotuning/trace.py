@@ -39,7 +39,10 @@ import random
 import time
 from typing import Callable, Dict, List, Optional
 
-TRACE_VERSION = 2  # v2 adds the optional per-request adapter_id field
+# v2 added the optional per-request adapter_id field; v3 adds optional
+# per-request sample (resolved on-device sampling spec) and schema
+# (raw grammar/JSON-schema constraint). v1/v2 traces still load.
+TRACE_VERSION = 3
 TRACE_KINDS = ("recorded", "steady", "bursty", "prefix_heavy")
 # leading tokens that define a prefix-share group when recording (one
 # KV block at the default block size — shorter shares aren't reusable)
@@ -60,6 +63,12 @@ class TraceRequest:
     # multi-tenant LoRA: which adapter served the request (None = base).
     # Trace v2; v1 traces load with None — replay then routes to base.
     adapter_id: Optional[int] = None
+    # trace v3: the RESOLVED sampling spec (the gateway backfills the
+    # seed before recording, so a replay draws the bit-identical
+    # stream) and the RAW schema constraint (dict or regex string —
+    # replay recompiles it over the replaying config's vocab)
+    sample: Optional[Dict] = None
+    schema: Optional[object] = None
 
     def to_json(self) -> Dict:
         out = {"uid": self.uid, "arrival_s": round(self.arrival_s, 6),
@@ -69,8 +78,13 @@ class TraceRequest:
                "prefix_group": self.prefix_group}
         if self.adapter_id is not None:
             # only written when set, so base-only v2 traces stay line-
-            # identical to v1 payloads (clean diffs across versions)
+            # identical to v1 payloads (clean diffs across versions);
+            # same rule for the v3 sample/schema fields below
             out["adapter_id"] = int(self.adapter_id)
+        if self.sample is not None:
+            out["sample"] = dict(self.sample)
+        if self.schema is not None:
+            out["schema"] = self.schema
         return out
 
     @classmethod
@@ -81,7 +95,8 @@ class TraceRequest:
                    max_new_tokens=int(d["max_new_tokens"]),
                    priority=int(d.get("priority", 0)),
                    prefix_group=d.get("prefix_group"),
-                   adapter_id=int(aid) if aid is not None else None)
+                   adapter_id=int(aid) if aid is not None else None,
+                   sample=d.get("sample"), schema=d.get("schema"))
 
 
 class ServingTrace:
@@ -171,7 +186,8 @@ class TraceRecorder:
         self._groups = {}  # leading-token tuple -> group id
         self.recorded = 0
 
-    def record(self, prompt, max_new_tokens, priority, adapter_id=None) -> None:
+    def record(self, prompt, max_new_tokens, priority, adapter_id=None,
+               sample=None, schema=None) -> None:
         now = time.monotonic()
         key = (tuple(prompt[:self.prefix_group_len])
                if len(prompt) >= self.prefix_group_len else None)
@@ -185,7 +201,8 @@ class TraceRecorder:
                 uid=len(self._requests), arrival_s=now - self._t0,
                 prompt=list(prompt), max_new_tokens=int(max_new_tokens),
                 priority=int(priority), prefix_group=group,
-                adapter_id=int(adapter_id) if adapter_id else None))
+                adapter_id=int(adapter_id) if adapter_id else None,
+                sample=dict(sample) if sample else None, schema=schema))
             self.recorded += 1
 
     def trace(self, meta: Optional[Dict] = None) -> ServingTrace:
@@ -339,6 +356,13 @@ def _submit(gateway, req):
         # only forwarded when recorded: base-only traces keep replaying
         # against gateways/routers that predate adapter routing
         kw["adapter_id"] = aid
+    # v3 fields, same set-only rule — greedy traces replay unchanged
+    # against pre-sampling gateways. The recorded sample already holds
+    # its resolved seed, so the replayed stream is bit-identical.
+    if getattr(req, "sample", None) is not None:
+        kw["sample"] = req.sample
+    if getattr(req, "schema", None) is not None:
+        kw["schema"] = req.schema
     return gateway.submit(req.prompt, max_new_tokens=req.max_new_tokens,
                           priority=req.priority, **kw)
 

@@ -11,13 +11,17 @@ import numbers
 import jax
 import jax.numpy as jnp
 
-_KEYS = ("temperature", "top_k", "top_p")
+_KEYS = ("temperature", "top_k", "top_p", "seed")
 
 
 def validate_sample_spec(sample):
     """Reject typo'd keys / invalid values in a sampling spec dict —
     unknown keys would otherwise be silently dropped (running unfiltered
-    T=1.0 sampling), the opposite of what the caller asked for."""
+    T=1.0 sampling), the opposite of what the caller asked for.
+
+    ``temperature > 0`` is load-bearing beyond plausibility: the v2
+    packed sampled step uses temperature bits 0.0 as its greedy-row
+    sentinel, so a user temperature of exactly 0 must never reach it."""
     unknown = set(sample) - set(_KEYS)
     if unknown:
         raise ValueError(f"unknown sampling keys {sorted(unknown)}; "
@@ -25,6 +29,7 @@ def validate_sample_spec(sample):
     t = sample.get("temperature", 1.0)
     k = sample.get("top_k", 0)
     p = sample.get("top_p", 1.0)
+    s = sample.get("seed", 0)
     # numbers.Real/Integral so numpy scalars from config pipelines pass
     if not (isinstance(t, numbers.Real) and t > 0):
         raise ValueError(f"temperature must be > 0, got {t!r}")
@@ -32,10 +37,14 @@ def validate_sample_spec(sample):
         raise ValueError(f"top_k must be an int >= 0, got {k!r}")
     if not (isinstance(p, numbers.Real) and 0 < p <= 1):
         raise ValueError(f"top_p must be in (0, 1], got {p!r}")
+    if not (isinstance(s, numbers.Integral) and 0 <= s < 2 ** 31):
+        raise ValueError(f"seed must be an int in [0, 2**31), got {s!r}")
 
 
 def sample_spec_key(sample):
-    """Normalized hashable static key for jit caching."""
+    """Normalized hashable static key for jit caching (v1 engine's
+    per-spec specializations; ``seed`` is per-request DATA, never part
+    of a program key, so it is deliberately excluded)."""
     validate_sample_spec(sample)
     return (float(sample.get("temperature", 1.0)),
             int(sample.get("top_k", 0)),

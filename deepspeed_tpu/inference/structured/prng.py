@@ -1,0 +1,60 @@
+"""Counter-based sampling PRNG.
+
+The old sampled path drew from a sequential engine stream
+(``engine._rng`` split per call, seeded from ``os.urandom`` when the
+caller passed none): correct in isolation, but the emitted tokens
+depended on *host call order*, so a replica replaying a half-finished
+stream after a failover could never reproduce it. Here every token's
+randomness is a counter lookup instead:
+
+    key(token) = fold_in(fold_in(PRNGKey(DS_SEED), request_seed),
+                         absolute_position)
+
+``request_seed`` is resolved once per request at submit time (the fleet
+router derives it from the stable fleet uid, so every failover attempt
+replays with the identical seed) and ``absolute_position`` is the
+token's index in the sequence — both are properties of the *stream*,
+not of which replica, burst size, or scheduling order produced it.
+Stepwise decode, k-step bursts, and rejection-sampled speculative
+verification therefore all draw bit-identical tokens at every position.
+"""
+
+import jax
+
+
+# domain-separation constant: keeps the sampling counter stream disjoint
+# from the param-init / dropout streams that also hang off DS_SEED
+_SAMPLING_DOMAIN = 0x5A3
+
+
+def base_sampling_key(seed):
+    """The engine-wide root key all per-token keys fold into. Derived
+    from ``DS_SEED`` (tuning tag ``fixed``) so every replica in a fleet
+    shares it — the per-request ``seed`` field is what decorrelates
+    requests, not the replica."""
+    return jax.random.fold_in(jax.random.PRNGKey(int(seed)), _SAMPLING_DOMAIN)
+
+
+def token_keys(base, seeds, positions):
+    """Traced: per-row keys for a batch of draws. ``seeds``/``positions``
+    are int32 ``[N]``; → ``[N]`` stacked PRNG keys, row i =
+    ``fold_in(fold_in(base, seeds[i]), positions[i])``."""
+    return jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.fold_in(base, s), p)
+    )(seeds, positions)
+
+
+def derive_seed(base: int, uid: int) -> int:
+    """Deterministic per-request sampling seed from a stable request
+    identity (splitmix-style integer hash — NOT Python ``hash``, which
+    is salted for some types). Gateways and the fleet router call this
+    at submit time for requests whose sampling spec carries no explicit
+    ``seed``; deriving from the *router* uid makes every failover
+    attempt replay with the identical seed."""
+    x = (int(base) * 0x9E3779B1 + int(uid) * 0x85EBCA77) & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & 0xFFFFFFFF
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & 0xFFFFFFFF
+    x ^= x >> 16
+    return int(x & 0x7FFFFFFF)

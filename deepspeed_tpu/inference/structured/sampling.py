@@ -1,0 +1,112 @@
+"""Packed per-sequence sampling: spec parameters as data, one program.
+
+The v2 engine's original sampled path specialized a jitted step per
+distinct ``(temperature, top_k, top_p)`` tuple — a jit-cache explosion
+under multi-tenant traffic where every request carries its own spec.
+Here the spec rides the batch as DATA: six int32 rows per sequence
+(float bits for temperature/top_p via bitcast, plus top_k, the
+counter-PRNG seed, and the constrained-decoding DFA slot/state), packed
+into the same flat metadata vector the burst scan already ships, so ONE
+compiled program serves every mix of greedy, sampled, and
+schema-constrained rows.
+
+Row convention: ``temperature == 0.0`` (all-zero bits — the natural
+value of an untouched meta row) marks a GREEDY row, decoded by argmax;
+validation forbids 0 in user specs, so the sentinel can never collide
+with a real temperature. Pad rows therefore argmax garbage logits
+harmlessly.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# meta layout: 6 int32 rows of max_seqs entries each
+SAMPLE_META_ROWS = 6
+_TEMP_BITS, _TOP_K, _TOP_P_BITS, _SEED, _DFA_SLOT, _DFA_STATE = range(6)
+
+
+def _f32_bits(x):
+    """Host-side float32 → raw int32 bits (inverse of the traced
+    ``lax.bitcast_convert_type`` in :func:`unpack_sample_meta`)."""
+    return int(np.array(x, np.float32).view(np.int32))
+
+
+def pack_sample_meta(specs, max_seqs, dfa=None):
+    """Host pack: per-row sampling specs (+ optional DFA bindings) →
+    one flat int32 vector of ``SAMPLE_META_ROWS * max_seqs`` entries.
+
+    ``specs[i]`` is the resolved sampling dict for batch row i (seed
+    already present) or None for a greedy row; rows past ``len(specs)``
+    are padding. ``dfa[i]`` is ``(schema_slot, dfa_state)`` when
+    constrained decoding is live (slot 0 = the trivial all-allow DFA)."""
+    meta = np.zeros((SAMPLE_META_ROWS, max_seqs), np.int32)
+    for i, spec in enumerate(specs):
+        if spec is None:
+            continue  # greedy row: temperature bits stay 0.0 == argmax
+        meta[_TEMP_BITS, i] = _f32_bits(float(spec.get("temperature", 1.0)))
+        meta[_TOP_K, i] = int(spec.get("top_k", 0))
+        meta[_TOP_P_BITS, i] = _f32_bits(float(spec.get("top_p", 1.0)))
+        meta[_SEED, i] = np.int32(int(spec.get("seed", 0)) & 0x7FFFFFFF)
+    if dfa is not None:
+        for i, (slot, state) in enumerate(dfa):
+            meta[_DFA_SLOT, i] = int(slot)
+            meta[_DFA_STATE, i] = int(state)
+    return meta.ravel()
+
+
+def unpack_sample_meta(flat, max_seqs):
+    """Traced inverse of :func:`pack_sample_meta` →
+    ``(temperature f32[N], top_k i32[N], top_p f32[N], seed i32[N],
+    dfa_slot i32[N], dfa_state i32[N])``."""
+    m = flat.reshape(SAMPLE_META_ROWS, max_seqs)
+    temp = jax.lax.bitcast_convert_type(m[_TEMP_BITS], jnp.float32)
+    top_p = jax.lax.bitcast_convert_type(m[_TOP_P_BITS], jnp.float32)
+    return temp, m[_TOP_K], top_p, m[_SEED], m[_DFA_SLOT], m[_DFA_STATE]
+
+
+def apply_dfa_mask(logits, masks, slots, states):
+    """Compose the constrained-decoding logits mask on device:
+    ``masks[slots[i], states[i]]`` is row i's allowed-token row (bool
+    ``[V]``); disallowed tokens drop to -inf. Slot 0 is the trivial
+    all-allow DFA, so unconstrained rows pass through unchanged."""
+    return jnp.where(masks[slots, states], logits, -jnp.inf)
+
+
+def sample_rows(logits, keys, temperature, top_k, top_p):
+    """Traced per-row sampling with TRACED parameters: ``[N, V]`` logits
+    → ``[N]`` int32 tokens. Row i draws with its own
+    ``(temperature[i], top_k[i], top_p[i])`` and PRNG key ``keys[i]``
+    (from :func:`prng.token_keys`); ``temperature[i] == 0`` rows take
+    the plain argmax instead (mixed greedy/sampled batches).
+
+    Same filtering semantics as the static
+    :func:`deepspeed_tpu.inference.sampling.sample_tokens`: temperature
+    scale, then top-k, then nucleus over the top-k-filtered
+    distribution — one descending sort serves both filters. ``top_k ==
+    0`` disables the k filter; ``top_p == 1`` disables the nucleus;
+    ``top_k == 1`` degenerates to exact argmax (the pinned greedy-
+    equivalence contract)."""
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    # top_k >= vocab filters nothing; clamp so any spec fits any model
+    k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    kth = jnp.take_along_axis(sorted_l, (k_eff - 1)[:, None], axis=-1)
+    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # nucleus applies to the top-k-filtered distribution
+    sorted_f = jnp.where(jnp.arange(V)[None, :] < k_eff[:, None],
+                         sorted_l, -jnp.inf)
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # smallest set with cumulative prob >= top_p
+    cutoff_idx = jnp.minimum(jnp.sum((cum < top_p[:, None]), axis=-1), V - 1)
+    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
+    apply_p = (top_p < 1.0)[:, None]
+    filtered = jnp.where(apply_p & (scaled < cutoff), -jnp.inf, filtered)
+    drawn = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     drawn).astype(jnp.int32)

@@ -9,10 +9,11 @@ from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig, KVTierCo
                                                   PrefixCacheConfig,
                                                   QuantizationConfig,
                                                   RaggedInferenceEngineConfig,
-                                                  SpecDecodeConfig)
+                                                  SpecDecodeConfig,
+                                                  StructuredConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 
 __all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig", "DSStateManagerConfig",
            "QuantizationConfig", "PrefixCacheConfig", "KVTierConfig",
-           "SpecDecodeConfig", "DynamicSplitFuseScheduler"]
+           "SpecDecodeConfig", "StructuredConfig", "DynamicSplitFuseScheduler"]

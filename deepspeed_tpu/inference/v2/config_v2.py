@@ -59,10 +59,15 @@ class SpecDecodeConfig(DeepSpeedConfigModel):
     """Self-speculative decoding (n-gram prompt-lookup drafting + a
     batched greedy verify forward). ``enabled`` is the config gate; the
     ``DS_SPEC_DECODE`` env var overrides it in both directions (kill
-    switch) and ``DS_SPEC_DRAFT_LEN`` overrides ``draft_len``.
-    Greedy-only: schedulers fall back to plain bursts for stochastic
-    sampling (acceptance is exact token match, which only preserves the
-    output distribution under argmax decoding)."""
+    switch) and ``DS_SPEC_DRAFT_LEN`` overrides ``draft_len``. Works
+    under both greedy decoding (acceptance = exact match against the
+    argmax) and per-sequence stochastic sampling (rejection-sampled
+    verification: acceptance = exact match against a counter-keyed draw
+    from the filtered target, which for point-mass n-gram drafts is the
+    standard rejection scheme — the emitted stream is bit-identical to
+    the spec-off stream per seed). Schema-constrained sequences still
+    fall back to plain bursts (drafts are proposed without the DFA
+    mask)."""
     enabled: bool = False
     draft_len: int = 4       # max draft tokens proposed per verify step
     max_ngram: int = 3       # longest suffix n-gram the drafter looks up
@@ -94,6 +99,22 @@ class LoRAServingConfig(DeepSpeedConfigModel):
     publish_root: str = ""
 
 
+class StructuredConfig(DeepSpeedConfigModel):
+    """Constrained (grammar/JSON-schema) decoding: bound schemas lower
+    to token-level DFAs whose masks compose into the on-device sampling
+    step. ``enabled`` is the config gate; the ``DS_CONSTRAINED`` env
+    var overrides it in both directions (kill switch), and the off
+    state builds the exact pre-structured pipeline — no DFA metadata
+    packed, program keys unchanged. ``max_schemas`` bounds
+    concurrently-installed schemas (the device slabs are
+    ``[max_schemas + 1, max_states, vocab]``; slot 0 is the reserved
+    all-allow DFA); ``max_states`` bounds any one schema's token DFA —
+    both are program-shape parameters, so changing them retraces."""
+    enabled: bool = False
+    max_schemas: int = 4
+    max_states: int = 64
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel_degree: int = 1
     expert_parallel_degree: int = 1  # MoE expert sharding for serving
@@ -108,6 +129,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     kv_tier: KVTierConfig = KVTierConfig()
     spec_decode: SpecDecodeConfig = SpecDecodeConfig()
     lora: LoRAServingConfig = LoRAServingConfig()
+    structured: StructuredConfig = StructuredConfig()
     # compiled decode/verify programs kept per engine: each distinct
     # (burst length k, sampling key) and (verify, draft length) compiles
     # its own program; beyond the cap the least-recently-used is dropped

@@ -20,25 +20,36 @@ from deepspeed_tpu.inference.v2.model_runner import ragged_forward
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
 from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
+from deepspeed_tpu.utils.env_registry import env_int
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.sanitize import maybe_checkify_jit, sanitize_enabled
 
 
-from deepspeed_tpu.inference.sampling import sample_spec_key as _sample_key
-from deepspeed_tpu.inference.sampling import sample_tokens as _sample_tokens
+from deepspeed_tpu.inference.sampling import \
+    validate_sample_spec as _validate_sample
+from deepspeed_tpu.inference.structured.prng import (base_sampling_key,
+                                                     token_keys)
+from deepspeed_tpu.inference.structured.sampling import (SAMPLE_META_ROWS,
+                                                         apply_dfa_mask,
+                                                         pack_sample_meta,
+                                                         sample_rows,
+                                                         unpack_sample_meta)
 
 
-def _burst_layout(ms, mb, lora=False):
+def _burst_layout(ms, mb, lora=False, sampled=False):
     """Single source for the decode-burst metadata wire format: field →
     (start, end) offsets into the flat int32 vector. Both the host pack
     (``decode_burst``) and the traced unpack (``_make_burst_fn``) read
     this, so the layout cannot silently diverge. ``lora`` appends the
-    per-sequence adapter-slot row — strictly opt-in, so the DS_LORA=0
-    wire format is byte-identical to the pre-LoRA one."""
+    per-sequence adapter-slot row and ``sampled`` the per-sequence
+    sampling-spec rows — each strictly opt-in, so the off-state wire
+    format is byte-identical to the pre-feature one."""
     fields = [("tokens0", ms), ("token_seq", ms), ("pos0", ms),
               ("tables", (ms + 1) * mb)]
     if lora:
         fields.append(("seq_adapters", ms + 1))
+    if sampled:
+        fields.append(("sample_meta", SAMPLE_META_ROWS * ms))
     o, lay = 0, {}
     for name, size in fields:
         lay[name] = (o, o + size)
@@ -46,17 +57,20 @@ def _burst_layout(ms, mb, lora=False):
     return lay
 
 
-def _verify_layout(ms, mb, d, lora=False):
+def _verify_layout(ms, mb, d, lora=False, sampled=False):
     """Wire format of the verify-burst metadata vector, ``_burst_layout``'s
     twin for the speculative path: per sequence, the entry token plus
     ``d`` (padded) draft tokens, the real draft count, and the usual
     slot/position/block-table fields (plus the adapter-slot row when
-    LoRA serving is on)."""
+    LoRA serving is on and the sampling-spec rows for the
+    rejection-sampled verify)."""
     fields = [("tokens", ms * (d + 1)), ("dlen", ms),
               ("token_seq", ms), ("pos0", ms),
               ("tables", (ms + 1) * mb)]
     if lora:
         fields.append(("seq_adapters", ms + 1))
+    if sampled:
+        fields.append(("sample_meta", SAMPLE_META_ROWS * ms))
     o, lay = 0, {}
     for name, size in fields:
         lay[name] = (o, o + size)
@@ -234,6 +248,21 @@ class InferenceEngineV2:
                     host_bytes=int(lcfg.host_bytes),
                     publish_root=(lcfg.publish_root or None),
                     prefetch=bool(lcfg.prefetch), dtype=dtype)
+        # Structured (grammar/JSON-schema constrained) decoding:
+        # config-gated with the DS_CONSTRAINED env kill switch. When
+        # live, bound schemas install token-DFA slabs and the sampled
+        # programs gather a per-sequence logits mask from them; OFF,
+        # nothing below changes — wire formats and program keys are
+        # exactly the pre-structured ones.
+        from deepspeed_tpu.inference.structured import constrained_enabled
+        from deepspeed_tpu.inference.structured.store import StructuredStore
+        self.structured = None
+        if constrained_enabled(self._config.structured):
+            scfg = self._config.structured
+            self.structured = StructuredStore(
+                int(cfg.vocab_size),
+                max_schemas=int(scfg.max_schemas),
+                max_states=int(scfg.max_states))
         # the per-sequence KV-content token log feeds BOTH the prefix
         # cache (retire-time content addressing) and the n-gram drafter
         self._log_tokens = self.prefix_cache is not None or self.spec is not None
@@ -292,15 +321,51 @@ class InferenceEngineV2:
         self._step_greedy = maybe_checkify_jit(step_greedy, donate_argnums=(1, 2),
                                                enabled=sanitize)
 
-        def step_sample(t, k_, p_):
-            def fn(p, kc, vc, b, rng, lora_slabs=None):
-                logits, kc, vc = step(p, kc, vc, b, lora_slabs)
-                return _sample_tokens(logits, rng, t, k_, p_), kc, vc
-            return maybe_checkify_jit(fn, donate_argnums=(1, 2),
-                                      enabled=sanitize)
+        # ONE sampled program for every per-sequence spec: temperature /
+        # top_k / top_p / seed (+ DFA slot/state) ride the packed batch
+        # as int32 DATA, so multi-tenant sampled traffic cannot explode
+        # the jit cache the way per-(t, k, p) specializations did. Rows
+        # whose temperature bits are 0.0 take the argmax branch, so one
+        # program serves any mix of greedy/sampled/constrained rows.
+        structured_on = self.structured is not None
 
-        self._make_step_sample = step_sample
-        self._step_sample_fns = {}   # (temperature, top_k, top_p) -> jitted step
+        def step_sampled(p, kc, vc, packed, base, slabs=None, lora_slabs=None):
+            from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import unpack_batch
+            b = unpack_batch(packed, ms, mb, lora=lora_on, sampled=True)
+            if quantized:
+                from deepspeed_tpu.inference.quantization import \
+                    dequantize_tree_except
+                p = dequantize_tree_except(p, dtype)
+            lora_arg = None
+            if lora_slabs is not None:
+                la, lb, scales = lora_slabs
+                lora_arg = (la, lb, scales, b["seq_adapters"], None)
+            logits, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
+                                            attn_impl=attn_impl, lora=lora_arg)
+            temp, topk, topp, seed, slot, state = unpack_sample_meta(
+                b["sample_meta"], ms)
+            if slabs is not None:
+                logits = apply_dfa_mask(logits, slabs[0], slot, state)
+            # the token this step emits lands one past the row's last
+            # scheduled token — the SAME absolute position (and so the
+            # same counter key) every other path derives for it
+            pos_out = b["token_pos"][b["last_index"]] + 1
+            keys = token_keys(base, seed, pos_out)
+            return sample_rows(logits, keys, temp, topk, topp), kc, vc
+
+        if structured_on and lora_on:
+            sampled_fn = step_sampled
+        elif structured_on:
+            sampled_fn = lambda p, kc, vc, packed, base, slabs: \
+                step_sampled(p, kc, vc, packed, base, slabs)
+        elif lora_on:
+            sampled_fn = lambda p, kc, vc, packed, base, lslabs: \
+                step_sampled(p, kc, vc, packed, base, None, lslabs)
+        else:
+            sampled_fn = lambda p, kc, vc, packed, base: \
+                step_sampled(p, kc, vc, packed, base)
+        self._step_sampled = maybe_checkify_jit(sampled_fn, donate_argnums=(1, 2),
+                                                enabled=sanitize)
         # LRU of compiled multi-step programs: ("burst", k, sample_key)
         # decode bursts and ("verify", d) speculative verifies. Bounded —
         # spec decoding adds a draft-length dimension to the key space,
@@ -309,13 +374,19 @@ class InferenceEngineV2:
         self._burst_fn_cap = max(1, int(self._config.burst_fn_cache_cap))
         self.burst_fn_evictions = 0
         self._suspended = {}  # uid -> {"handle": host KV, "seen_tokens": int}
-        # sampling stream, decorrelated from the param-init key. When the
-        # caller passed params but no rng, seed from OS entropy — parallel
-        # serving replicas must not all draw the identical "stochastic"
-        # token sequence. Pass rng explicitly for reproducible sampling.
+        # Counter-PRNG root for sampling: every sampled token's key folds
+        # (request seed, absolute position) into this DS_SEED-derived
+        # base. Sampling never consumes a sequential stream, so a replica
+        # replaying a half-finished request reproduces it bit-identically
+        # — requests decorrelate through their per-request seed, NOT
+        # through replica-local entropy (the old os.urandom fallback,
+        # which silently broke failover replay the moment anyone sampled).
+        self._base_key = base_sampling_key(env_int("DS_SEED"))
+        # per-request seed fallback stream (draw_seed), decorrelated from
+        # the param-init key; DS_SEED-rooted so it is deterministic by
+        # default. Pass rng explicitly to decorrelate engines in-process.
         if rng is None:
-            import os
-            rng = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
+            rng = jax.random.PRNGKey(env_int("DS_SEED"))
         self._rng = jax.random.fold_in(rng, 7)
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as _P
@@ -400,16 +471,28 @@ class InferenceEngineV2:
         ``sample="greedy"``, int32 argmax token ids ``[len(uids)]``
         sampled on device (vocab-factor less host traffic per step).
 
+        A ``{"temperature", "top_k", "top_p", "seed"}`` dict samples on
+        device with per-sequence counter-PRNG keys; a per-uid LIST of
+        dict/None mixes sampled and greedy rows in one batch (ONE
+        compiled program serves every spec — the parameters ride the
+        packed batch as data). Sequences with a bound schema
+        (:meth:`bind_schema`) additionally gather their DFA logits mask
+        on device and MUST use an on-device mode (``"greedy"`` or a
+        spec): the raw-logits path cannot enforce the constraint.
+
         ``do_checks`` exists for reference API parity but is ignored:
         validation is what keeps sequence state consistent with the KV
         pool, so it always runs."""
-        if isinstance(sample, dict):
-            from deepspeed_tpu.inference.sampling import validate_sample_spec
-            validate_sample_spec(sample)  # BEFORE any sequence-state mutation
-        elif not (sample is None or sample == "greedy"):
-            raise ValueError(f"sample={sample!r}: supported modes are None (logits), "
-                             f"'greedy' (on-device argmax), or a sampling dict "
-                             f"{{'temperature', 'top_k', 'top_p'}}")
+        mode, specs = self._classify_sample(sample, len(batch_uids))
+        if self.structured is not None and \
+                any(self.structured.bound(u) for u in batch_uids):
+            if mode == "logits":
+                raise RuntimeError(
+                    "constrained sequences sample on device — call put "
+                    "with sample='greedy' or a sampling spec, not the "
+                    "raw-logits path")
+            mode = "packed"  # greedy rows still need the DFA mask rows
+            specs = specs if specs is not None else [None] * len(batch_uids)
         # host-side list→array prep on caller-provided tokens, no device sync
         batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]  # ds-lint: disable=host-sync -- input tokens are host lists, never device arrays
         # Validate the WHOLE batch before touching any sequence state: a
@@ -465,6 +548,19 @@ class InferenceEngineV2:
         # one. Two programs total — shapes stay static per bucket.
         bucket = self.max_seqs if total <= self.max_seqs else self.max_tokens
         arrays = self._batch.finalize_packed(bucket=bucket)
+        if mode == "packed":
+            # sampling specs ride the SAME flat metadata vector: resolve
+            # engine-stream seeds for specs submitted without one, then
+            # append the six int32 rows per sequence
+            for s in specs:
+                if s is not None and "seed" not in s:
+                    s["seed"] = self.draw_seed()
+            dfa = None
+            if self.structured is not None:
+                dfa = [(self.structured.slot_of(u), self.structured.state_of(u))
+                       for u in batch_uids]
+            arrays = np.concatenate(
+                [arrays, pack_sample_meta(specs, self.max_seqs, dfa=dfa)])
         if self.mesh is not None:
             # batch metadata is replicated over the serving mesh (the flat
             # token batch carries no sharding — only weights/KV do)
@@ -472,19 +568,104 @@ class InferenceEngineV2:
         # hot adapter slabs ride as jit ARGUMENTS (not captured constants)
         # so promotions/hot-swaps rebind buffers without any retrace
         extra = (self.lora_store.slabs(),) if self.lora_store is not None else ()
-        if isinstance(sample, dict):
-            key = _sample_key(sample)
-            fn = self._step_sample_fns.get(key)
-            if fn is None:
-                fn = self._step_sample_fns[key] = self._make_step_sample(*key)
-            self._rng, sub = jax.random.split(self._rng)
-            out, self.kv_cache.k, self.kv_cache.v = fn(
-                self.params, self.kv_cache.k, self.kv_cache.v, arrays, sub, *extra)
+        if mode == "packed":
+            sargs = (self._base_key,)
+            if self.structured is not None:
+                sargs += (self.structured.slabs(),)  # rebind, never retrace
+            out, self.kv_cache.k, self.kv_cache.v = self._step_sampled(
+                self.params, self.kv_cache.k, self.kv_cache.v, arrays,
+                *sargs, *extra)
         else:
-            fn = self._step_greedy if sample == "greedy" else self._step
+            fn = self._step_greedy if mode == "greedy" else self._step
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, arrays, *extra)
         return np.asarray(out)[np.asarray(slots)]  # ds-lint: disable=host-sync -- THE one intended sync per step: callers consume host tokens/logits
+
+    def _classify_sample(self, sample, n):
+        """Normalize ``put``/burst ``sample`` arguments → ``(mode,
+        specs)``: ``("logits", None)`` for raw logits, ``("greedy",
+        None)`` for on-device argmax, or ``("packed", [dict|None] * n)``
+        with every dict VALIDATED and copied (seeds resolve later, after
+        batch validation — no state mutates for a rejected batch)."""
+        if sample is None:
+            return "logits", None
+        if sample == "greedy":
+            return "greedy", None
+        if isinstance(sample, dict):
+            _validate_sample(sample)
+            return "packed", [dict(sample) for _ in range(n)]
+        if isinstance(sample, (list, tuple)):
+            if len(sample) != n:
+                raise ValueError(f"sample list has {len(sample)} specs for "
+                                 f"{n} sequences")
+            out = []
+            for s in sample:
+                if s is None:
+                    out.append(None)
+                    continue
+                if not isinstance(s, dict):
+                    raise ValueError(f"sample list entries are dict/None, "
+                                     f"got {s!r}")
+                _validate_sample(s)
+                out.append(dict(s))
+            if not any(s is not None for s in out):
+                return "greedy", None  # all-greedy list: plain argmax program
+            return "packed", out
+        raise ValueError(f"sample={sample!r}: supported modes are None (logits), "
+                         f"'greedy' (on-device argmax), a sampling dict "
+                         f"{{'temperature', 'top_k', 'top_p', 'seed'}}, or a "
+                         f"per-sequence list of dict/None")
+
+    def draw_seed(self):
+        """One per-request sampling seed from the engine's deterministic
+        DS_SEED-rooted stream — the compatibility path for specs
+        submitted WITHOUT an explicit ``seed`` straight at the engine /
+        scheduler surface. Serving front-ends (gateway, fleet router)
+        resolve seeds at submit time from the stable request uid instead,
+        so cross-replica replay never depends on engine-local stream
+        order."""
+        self._rng, sub = jax.random.split(self._rng)
+        return int(jax.random.randint(sub, (), 0, 2 ** 31 - 1))  # ds-lint: disable=host-sync -- per-request seed resolution is a host decision
+
+    # ---------------------------------------------- constrained decoding
+    def bind_schema(self, uid, schema, token_strings=None, eos_token_id=None):
+        """Constrain ``uid``'s generated tokens to ``schema``: a
+        :class:`~deepspeed_tpu.inference.structured.grammar.CompiledSchema`,
+        or a raw JSON-schema dict / regex string compiled through the
+        process-wide schema cache (``token_strings`` — the vocab's
+        per-token surface strings — required then). The token-DFA mask
+        composes into the on-device sampling step for every subsequent
+        batch containing ``uid``. → the leased device slot."""
+        if self.structured is None:
+            raise RuntimeError("constrained decoding is disabled "
+                               "(config.structured / DS_CONSTRAINED)")
+        from deepspeed_tpu.inference.structured.grammar import CompiledSchema
+        if not isinstance(schema, CompiledSchema):
+            if token_strings is None:
+                raise ValueError(
+                    "raw schemas need token_strings to compile against — "
+                    "pass a CompiledSchema or the vocab surface strings")
+            from deepspeed_tpu.inference.structured.store import schema_cache
+            schema = schema_cache().get_or_compile(schema, token_strings,
+                                                   eos_token_id=eos_token_id)
+        return self.structured.bind(uid, schema)
+
+    def advance_schema(self, uid, token):
+        """Advance ``uid``'s authoritative host DFA state through one
+        ACCEPTED token (no-op → 0 for unconstrained uids). Schedulers
+        call this from their accept loop only — tokens a burst drew past
+        EOS/max_new and then discarded never advance it, which is what
+        keeps rewinds and truncation consistent with the device state
+        the next batch packs."""
+        if self.structured is None:
+            return 0
+        return self.structured.advance(uid, int(token))
+
+    def schema_accepting(self, uid):
+        """True when ``uid``'s constraint (if any) is at an accepting DFA
+        state — i.e. the emitted stream so far is schema-complete and
+        EOS is currently grammatical."""
+        return self.structured is None or self.structured.accepting(uid)
 
     def _validate_burst(self, batch_uids, k):
         """Shared pre-flight for the burst family (``can_burst``,
@@ -549,18 +730,24 @@ class InferenceEngineV2:
         tokens instead of every token (multi-step scheduling — ~70
         ms/step of transport round-trip in tunneled environments, and
         scheduler CPU on production hosts). ``sample=None`` decodes
-        greedily; a ``{"temperature", "top_k", "top_p"}`` dict draws
-        stochastically (the engine's PRNG stream advances per burst).
-        Returns int32 tokens ``[k, len(uids)]``.
+        greedily; a ``{"temperature", "top_k", "top_p", "seed"}`` dict —
+        or a per-uid list of dict/None — draws with counter-PRNG keys
+        ``(seed, absolute position)``, so burst size and scheduling
+        order never change the emitted stream. Sequences with a bound
+        schema gather their DFA logits mask in-scan. Returns int32
+        tokens ``[k, len(uids)]``.
 
         KV blocks for all ``k`` tokens are reserved up front, so the
         block tables are static across the burst."""
         k = int(k)
         if k < 1:
             raise ValueError("k must be >= 1")
-        skey = _sample_key(sample) if isinstance(sample, dict) else None  # validates
-        if not (sample is None or sample == "greedy" or skey is not None):
-            raise ValueError(f"sample={sample!r}: None/'greedy' or a sampling dict")
+        mode, specs = self._classify_sample(sample, len(batch_uids))
+        if self.structured is not None and \
+                any(self.structured.bound(u) for u in batch_uids):
+            mode = "packed"  # constrained rows need their DFA meta rows
+            specs = specs if specs is not None else [None] * len(batch_uids)
+        sampled = mode == "packed"
         if len(batch_uids) != len(batch_tokens):
             raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} tokens")
         if len(batch_uids) > self.max_seqs:
@@ -592,25 +779,44 @@ class InferenceEngineV2:
         parts = [tokens0, token_seq, pos0, tables.ravel()]
         if lora_on:
             parts.append(adapters)
+        if sampled:
+            for s in specs:
+                if s is not None and "seed" not in s:
+                    s["seed"] = self.draw_seed()
+            dfa = None
+            if self.structured is not None:
+                dfa = [(self.structured.slot_of(u), self.structured.state_of(u))
+                       for u in batch_uids]
+            parts.append(pack_sample_meta(specs, ms, dfa=dfa))
         meta = np.concatenate(parts)
         assert meta.shape[0] == sum(e - s for s, e in _burst_layout(
-            ms, self.max_blocks_per_seq, lora=lora_on).values())
+            ms, self.max_blocks_per_seq, lora=lora_on, sampled=sampled).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
-        # off-state keys are EXACTLY the pre-LoRA keys (DS_LORA=0
-        # contract); on, the rank-bucket signature joins the key so a
-        # reconfigured store can't replay a stale program
-        key = ("burst", k, skey) if not lora_on else \
-            ("burst", k, skey, self.lora_store.signature())
+        # Off-state keys are EXACTLY the pre-feature keys (DS_LORA=0 /
+        # greedy contract); sampled bursts run ONE program regardless of
+        # the specs (they are data), keyed "sampled" plus — when
+        # constrained decoding is live — the DFA slab shape signature,
+        # and the LoRA rank-bucket signature when serving adapters, so a
+        # reconfigured store can't replay a stale program.
+        skey = "sampled" if sampled else None
+        key = ("burst", k, skey)
+        if sampled and self.structured is not None:
+            key = key + (("dfa",) + self.structured.signature(),)
+        if lora_on:
+            key = key + (self.lora_store.signature(),)
         fn = self._get_burst_fn(key, lambda: self._make_burst_fn(k, skey))
         extra = (self.lora_store.slabs(),) if lora_on else ()
         if skey is None:
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, meta, *extra)
         else:
-            self._rng, sub = jax.random.split(self._rng)
+            sargs = (self._base_key,)
+            if self.structured is not None:
+                sargs += (self.structured.slabs(),)
             out, self.kv_cache.k, self.kv_cache.v = fn(
-                self.params, self.kv_cache.k, self.kv_cache.v, meta, sub, *extra)
+                self.params, self.kv_cache.k, self.kv_cache.v, meta,
+                *sargs, *extra)
         toks = np.asarray(out)[:, :len(batch_uids)]  # ds-lint: disable=host-sync -- THE one intended sync per k-step burst
         if self._log_tokens:
             # log what the burst actually WROTE to the KV cache: step i
@@ -632,12 +838,14 @@ class InferenceEngineV2:
         quantized = self._quantized
         ms, mb = self.max_seqs, self.max_blocks_per_seq
         lora_on = self.lora_store is not None
+        sampled = skey == "sampled"
+        structured_on = sampled and self.structured is not None
 
-        def burst(p, kc, vc, meta, rng=None, lora_slabs=None):
+        def burst(p, kc, vc, meta, base=None, slabs=None, lora_slabs=None):
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)  # once per burst, not per step
-            lay = _burst_layout(ms, mb, lora=lora_on)
+            lay = _burst_layout(ms, mb, lora=lora_on, sampled=sampled)
             tokens0 = meta[slice(*lay["tokens0"])]
             token_seq = meta[slice(*lay["token_seq"])]
             pos0 = meta[slice(*lay["pos0"])]
@@ -649,31 +857,62 @@ class InferenceEngineV2:
                 seq_adapters = meta[slice(*lay["seq_adapters"])]
                 lora_arg = (la, lb, scales, seq_adapters, None)
 
+            if not sampled:
+                def one(carry, i):
+                    kc, vc, toks = carry
+                    b = {"token_ids": toks, "token_seq": token_seq,
+                         "token_pos": pos0 + i, "block_tables": tables,
+                         "last_index": last}
+                    sel, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
+                                                 attn_impl=attn_impl, lora=lora_arg)
+                    nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+                    return (kc, vc, nxt), nxt
+
+                (kc, vc, _), out = jax.lax.scan(one, (kc, vc, tokens0),
+                                                jnp.arange(k, dtype=jnp.int32))
+                return out, kc, vc
+
+            temp, topk, topp, seed, slot, state0 = unpack_sample_meta(
+                meta[slice(*lay["sample_meta"])], ms)
+
             def one(carry, i):
-                kc, vc, toks = carry
+                kc, vc, toks, st = carry
                 b = {"token_ids": toks, "token_seq": token_seq,
                      "token_pos": pos0 + i, "block_tables": tables,
                      "last_index": last}
                 sel, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
                                              attn_impl=attn_impl, lora=lora_arg)
-                if skey is None:
-                    nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
-                else:
-                    nxt = _sample_tokens(sel, jax.random.fold_in(rng, i), *skey)
-                return (kc, vc, nxt), nxt
+                if slabs is not None:
+                    sel = apply_dfa_mask(sel, slabs[0], slot, st)
+                # step i's token lands at absolute position pos0 + i + 1,
+                # so its counter key matches the stepwise path exactly
+                keys = token_keys(base, seed, pos0 + i + 1)
+                nxt = sample_rows(sel, keys, temp, topk, topp)
+                if slabs is not None:
+                    st = slabs[1][slot, st, nxt]  # in-scan DFA advance
+                return (kc, vc, nxt, st), nxt
 
-            (kc, vc, _), out = jax.lax.scan(one, (kc, vc, tokens0),
-                                            jnp.arange(k, dtype=jnp.int32))
+            (kc, vc, _, _), out = jax.lax.scan(one, (kc, vc, tokens0, state0),
+                                               jnp.arange(k, dtype=jnp.int32))
             return out, kc, vc
 
         # explicit arity wrappers: callers pass everything positionally,
-        # so the lora slab pytree must never land in the rng parameter
-        if skey is None and lora_on:
-            fn = lambda p, kc, vc, meta, slabs: burst(p, kc, vc, meta, None, slabs)
-        elif skey is None:
+        # so the slab pytrees must never land in the wrong parameter
+        if not sampled and lora_on:
+            fn = lambda p, kc, vc, meta, lslabs: \
+                burst(p, kc, vc, meta, None, None, lslabs)
+        elif not sampled:
             fn = lambda p, kc, vc, meta: burst(p, kc, vc, meta)
-        else:
+        elif structured_on and lora_on:
             fn = burst
+        elif structured_on:
+            fn = lambda p, kc, vc, meta, base, slabs: \
+                burst(p, kc, vc, meta, base, slabs)
+        elif lora_on:
+            fn = lambda p, kc, vc, meta, base, lslabs: \
+                burst(p, kc, vc, meta, base, None, lslabs)
+        else:
+            fn = lambda p, kc, vc, meta, base: burst(p, kc, vc, meta, base)
         return maybe_checkify_jit(fn, donate_argnums=(1, 2),
                                   enabled=self._sanitize)
 
@@ -700,14 +939,26 @@ class InferenceEngineV2:
             out.append(self.spec.drafter.propose(desc.tokens + [entry], cap))
         return out
 
-    def verify_burst(self, batch_uids, batch_tokens, batch_drafts):
+    def verify_burst(self, batch_uids, batch_tokens, batch_drafts, sample=None):
         """Score each sequence's entry token plus its draft tokens in
         ONE ragged forward — the drafts enter as a (d+1)-token ragged
         chunk through the same packed-prefill path ``put`` uses — and
-        accept the longest draft prefix matching the model's own greedy
+        accept the longest draft prefix matching the model's own
         choices, followed by the model's next token at the first
-        mismatch. The emitted stream is therefore bit-identical to
-        stepwise greedy decoding by construction.
+        mismatch.
+
+        Greedy (``sample=None``): the model's choice is the argmax, so
+        the emitted stream is bit-identical to stepwise greedy decoding
+        by construction. Sampled (a spec dict or per-uid list):
+        rejection-sampled speculative verification — position ``j``'s
+        choice is drawn from the (temperature/top-k/top-p-filtered)
+        target distribution with the SAME counter key ``(seed, pos0 +
+        j + 1)`` stepwise decode would use there, and a draft survives
+        iff it equals that draw. Because the n-gram drafter proposes
+        point-mass drafts, accept-iff-equal IS the standard
+        rejection-sampling correction (the residual distribution equals
+        the target draw), and the emitted stream stays bit-identical to
+        the spec-off sampled stream per seed.
 
         → ``(tokens [n, d+1] int32, accepted [n] int64)``: row ``i``
         emits ``tokens[i, :accepted[i] + 1]``. KV blocks are reserved
@@ -720,6 +971,16 @@ class InferenceEngineV2:
         if self.spec is None:
             raise RuntimeError("speculative decoding is disabled "
                                "(config.spec_decode / DS_SPEC_DECODE)")
+        mode, specs = self._classify_sample(sample, len(batch_uids))
+        if mode == "logits":
+            mode = "greedy"  # verify has no raw-logits mode
+        sampled = mode == "packed"
+        if self.structured is not None and \
+                any(self.structured.bound(u) for u in batch_uids):
+            raise RuntimeError(
+                "constrained sequences cannot enter verify bursts — the "
+                "drafter proposed tokens without the DFA mask; schedulers "
+                "route schema-bound sequences through plain bursts")
         if not (len(batch_uids) == len(batch_tokens) == len(batch_drafts)):
             raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} "
                              f"tokens vs {len(batch_drafts)} drafts")
@@ -761,19 +1022,28 @@ class InferenceEngineV2:
         parts = [toks.ravel(), dlen, token_seq, pos0, tables.ravel()]
         if lora_on:
             parts.append(adapters)
+        if sampled:
+            for s in specs:
+                if s is not None and "seed" not in s:
+                    s["seed"] = self.draw_seed()
+            parts.append(pack_sample_meta(specs, ms))
         meta = np.concatenate(parts)
-        assert meta.shape[0] == sum(e - s for s, e
-                                    in _verify_layout(ms, mb, d, lora=lora_on).values())
+        assert meta.shape[0] == sum(
+            e - s for s, e in _verify_layout(ms, mb, d, lora=lora_on,
+                                             sampled=sampled).values())
         if self.mesh is not None:
             meta = jax.device_put(meta, self._replicated)
-        # greedy verify must see the SAME adapter deltas decode does, or
+        # the verify must see the SAME adapter deltas decode does, or
         # acceptance silently diverges from stepwise decoding
-        key = ("verify", d) if not lora_on else \
-            ("verify", d, self.lora_store.signature())
-        fn = self._get_burst_fn(key, lambda: self._make_verify_fn(d))
+        key = ("verify", d) if not sampled else ("verify", d, "sampled")
+        if lora_on:
+            key = key + (self.lora_store.signature(),)
+        fn = self._get_burst_fn(key, lambda: self._make_verify_fn(d, sampled))
         extra = (self.lora_store.slabs(),) if lora_on else ()
+        sargs = (self._base_key,) if sampled else ()
         out, acc, self.kv_cache.k, self.kv_cache.v = fn(
-            self.params, self.kv_cache.k, self.kv_cache.v, meta, *extra)
+            self.params, self.kv_cache.k, self.kv_cache.v, meta,
+            *sargs, *extra)
         out = np.asarray(out)  # ds-lint: disable=host-sync -- THE one intended sync per verify burst
         acc = np.asarray(acc)  # host copy of the device result above, already synced
         n = len(batch_uids)
@@ -793,12 +1063,14 @@ class InferenceEngineV2:
                 self.spec.note(desc.uid, accepted=a, drafted=int(dlen[i]))
         return out[:n], acc[:n]
 
-    def _make_verify_fn(self, d):
-        """One compiled greedy verify program for draft length ``d``: a
-        single ragged forward over ``max_seqs * (d+1)`` packed tokens
+    def _make_verify_fn(self, d, sampled=False):
+        """One compiled verify program for draft length ``d``: a single
+        ragged forward over ``max_seqs * (d+1)`` packed tokens
         (``last_index = arange`` selects EVERY token's logits, so no
-        model-runner change is needed), per-position argmax, and
-        on-device longest-matching-prefix acceptance."""
+        model-runner change is needed), per-position argmax — or, for
+        the ``sampled`` variant, a per-position counter-keyed draw from
+        the spec-filtered target — and on-device
+        longest-matching-prefix acceptance."""
         from deepspeed_tpu.inference.v2.model_runner import ragged_forward
         cfg, dtype, mesh = self.model_config, self.dtype, self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
@@ -806,11 +1078,11 @@ class InferenceEngineV2:
         ms, mb = self.max_seqs, self.max_blocks_per_seq
         lora_on = self.lora_store is not None
 
-        def verify(p, kc, vc, meta, lora_slabs=None):
+        def verify(p, kc, vc, meta, base=None, lora_slabs=None):
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)
-            lay = _verify_layout(ms, mb, d, lora=lora_on)
+            lay = _verify_layout(ms, mb, d, lora=lora_on, sampled=sampled)
             toks = meta[slice(*lay["tokens"])].reshape(ms, d + 1)
             dlen = meta[slice(*lay["dlen"])]
             token_seq = meta[slice(*lay["token_seq"])]
@@ -835,15 +1107,41 @@ class InferenceEngineV2:
                  "last_index": jnp.arange(T, dtype=jnp.int32)}
             logits, kc, vc = ragged_forward(p, kc, vc, b, cfg, dtype, mesh=mesh,
                                             attn_impl=attn_impl, lora=lora_arg)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32).reshape(ms, d + 1)
-            # greedy acceptance: draft j survives iff every earlier
-            # draft did AND it equals the model's own next token there —
-            # sum of the running cumprod counts the matching prefix
+            if not sampled:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                # rejection-sampled verify: position j of row i draws
+                # from its spec-filtered target with counter key
+                # (seed[i], pos0[i] + j + 1) — exactly the key stepwise
+                # decode uses for that position, so the accepted stream
+                # is bit-identical to the spec-off stream per seed
+                temp, topk, topp, seed, _slot, _state = unpack_sample_meta(
+                    meta[slice(*lay["sample_meta"])], ms)
+                rep = lambda x: jnp.repeat(x, d + 1)
+                pos = (pos0[:, None] + steps[None, :] + 1).reshape(-1)
+                keys = token_keys(base, rep(seed), pos)
+                nxt = sample_rows(logits, keys, rep(temp), rep(topk), rep(topp))
+            nxt = nxt.reshape(ms, d + 1)
+            # acceptance: draft j survives iff every earlier draft did
+            # AND it equals the model's own next token there — sum of
+            # the running cumprod counts the matching prefix. For the
+            # sampled verify this accept-iff-equal IS the rejection-
+            # sampling correction: the drafter is a point mass, so the
+            # residual distribution at a mismatch is the target draw.
             match = (toks[:, 1:] == nxt[:, :-1]) & (steps[None, :d] < dlen[:, None])
             acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
             return nxt, acc, kc, vc
 
-        return maybe_checkify_jit(verify, donate_argnums=(1, 2),
+        if not sampled and lora_on:
+            fn = lambda p, kc, vc, meta, lslabs: \
+                verify(p, kc, vc, meta, None, lslabs)
+        elif not sampled:
+            fn = lambda p, kc, vc, meta: verify(p, kc, vc, meta)
+        elif lora_on:
+            fn = verify
+        else:
+            fn = lambda p, kc, vc, meta, base: verify(p, kc, vc, meta, base)
+        return maybe_checkify_jit(fn, donate_argnums=(1, 2),
                                   enabled=self._sanitize)
 
     def rewind(self, uid, n_tokens):
@@ -1008,6 +1306,8 @@ class InferenceEngineV2:
             self.spec.forget(uid)
         if self.lora_store is not None:
             self.lora_store.release(uid)  # drop the adapter-slot lease
+        if self.structured is not None:
+            self.structured.release(uid)  # drop the schema lease + DFA state
 
     def suspend(self, uid):
         """Swap a live sequence's KV blocks to host memory and release
@@ -1094,10 +1394,9 @@ class InferenceEngineV2:
             self.lora_store.shutdown()  # stop the adapter prefetch worker
         self.lora_store = None
         self.spec = None
-        self._step = self._step_greedy = None
+        self.structured = None
+        self._step = self._step_greedy = self._step_sampled = None
         self._burst_fns = OrderedDict()
-        self._step_sample_fns = {}
-        self._make_step_sample = None
         self._suspended = {}
 
     @property

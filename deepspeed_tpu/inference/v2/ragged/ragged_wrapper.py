@@ -112,15 +112,22 @@ class RaggedBatchWrapper:
         return list(self._order)
 
 
-def unpack_batch(packed, max_seqs, max_blocks, lora=False):
+def unpack_batch(packed, max_seqs, max_blocks, lora=False, sampled=False):
     """Inverse of :meth:`RaggedBatchWrapper.finalize_packed` in traced
     code: static slices of the flat vector back into the step's dict.
     The token-bucket length is derived from the vector's static size, so
     each bucket traces (and compiles) its own specialization. ``lora``
     must match the wrapper's flag: on, the trailing per-sequence
-    adapter-slot row is parsed out as ``seq_adapters``."""
+    adapter-slot row is parsed out as ``seq_adapters``. ``sampled``
+    parses the per-sequence sampling-spec rows the engine's packed
+    sampled step appends AFTER the wrapper's own fields (6 int32 rows of
+    ``max_seqs``, see ``inference.structured.sampling``) as
+    ``sample_meta`` — strictly opt-in, so the greedy wire format stays
+    byte-identical to the pre-sampling one."""
     ms, mb = max_seqs, max_blocks
     extra = (ms + 1) if lora else 0
+    if sampled:
+        extra += 6 * ms
     mt = (packed.shape[0] - (ms + 1) * mb - ms - 1 - extra) // 3
     o = 0
     token_ids = packed[o:o + mt]; o += mt
@@ -135,4 +142,6 @@ def unpack_batch(packed, max_seqs, max_blocks, lora=False):
     if lora:
         o += 1
         out["seq_adapters"] = packed[o:o + ms + 1]
+    if sampled:
+        out["sample_meta"] = packed[packed.shape[0] - 6 * ms:]
     return out

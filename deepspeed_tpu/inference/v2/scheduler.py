@@ -16,7 +16,7 @@ import numpy as np
 class Request:
 
     def __init__(self, uid, prompt_tokens, max_new_tokens, priority=0, spec=True,
-                 adapter_id=None):
+                 adapter_id=None, sample=None, schema=None):
         self.uid = uid
         self.prompt = list(np.atleast_1d(np.asarray(prompt_tokens)).tolist())
         self.max_new_tokens = max_new_tokens
@@ -24,6 +24,12 @@ class Request:
         # multi-tenant LoRA: which adapter serves this request (None =
         # base model); bound to a hot slot at admission
         self.adapter_id = adapter_id
+        # per-request sampling spec (None = the scheduler-wide default):
+        # rides the packed batch as data, so mixed specs share programs
+        self.sample = dict(sample) if sample else None
+        # per-request decode constraint (a CompiledSchema bound to the
+        # engine's StructuredStore at admission); None = unconstrained
+        self.schema = schema
         # per-request speculative-decoding opt-out: False rides along in
         # verify bursts without drafts of its own (engine-level spec
         # support still decides whether drafting happens at all)
@@ -87,13 +93,39 @@ class DynamicSplitFuseScheduler:
         self.requests = OrderedDict()  # uid -> Request
 
     def add_request(self, uid, prompt_tokens, max_new_tokens=16, priority=0,
-                    spec=True, adapter_id=None):
+                    spec=True, adapter_id=None, sample=None, schema=None):
         if uid in self.requests:
             raise ValueError(f"uid {uid} already queued")
+        if sample is not None:
+            from deepspeed_tpu.inference.sampling import validate_sample_spec
+            validate_sample_spec(sample)  # typed, pre-admission
+            sample = dict(sample)
+            if "seed" not in sample:
+                # resolve the seed AT ADMISSION from the engine's
+                # deterministic stream: the emitted tokens then depend
+                # only on (seed, position), never on how later
+                # scheduling interleaves this request with others
+                draw = getattr(self.engine, "draw_seed", None)
+                if draw is not None:
+                    sample["seed"] = draw()
+        if schema is not None and sample is None and not self._device_greedy:
+            raise ValueError(f"uid {uid}: schema-constrained requests sample "
+                             f"on device; host sample_fn cannot enforce the "
+                             f"constraint")
         req = Request(uid, prompt_tokens, max_new_tokens, priority=priority,
-                      spec=spec, adapter_id=adapter_id)
+                      spec=spec, adapter_id=adapter_id, sample=sample,
+                      schema=schema)
         if not req.prompt:
             raise ValueError(f"uid {uid}: empty prompt can never be scheduled")
+        if schema is not None:
+            # bind BEFORE queueing, same discipline as adapters: schema
+            # compile/capacity errors surface typed at admission
+            bind = getattr(self.engine, "bind_schema", None)
+            if bind is None or getattr(self.engine, "structured", None) is None:
+                raise ValueError(f"uid {uid}: schema given but constrained "
+                                 f"decoding is disabled (config.structured / "
+                                 f"DS_CONSTRAINED)")
+            bind(uid, schema)
         if adapter_id:
             # bind BEFORE queueing: a cold adapter promotes (or raises
             # typed capacity/unknown errors) here, not mid-step — and the
@@ -244,7 +276,7 @@ class DynamicSplitFuseScheduler:
             # mutation + KV donation and is not recoverable.)
             return None
         toks = self.engine.decode_burst(uids, [r.next_token for r in live], k,
-                                        sample=self._sampling)
+                                        sample=self._sample_arg(live))
         for r in live:
             r.next_token = None
         for step_i in range(k):
@@ -258,20 +290,39 @@ class DynamicSplitFuseScheduler:
                                    unused_tokens=k - step_i - 1)
         return uids
 
+    def _spec_of(self, r):
+        """The sampling spec governing request ``r``: its own, else the
+        scheduler-wide default; None = greedy."""
+        return r.sample if r.sample is not None else self._sampling
+
+    def _sample_arg(self, live):
+        """The engine ``sample=`` argument for a batch over ``live``:
+        per-row specs when any row samples (mixed greedy rows stay
+        ``None`` — the packed program argmaxes them), else None for the
+        plain greedy program."""
+        specs = [self._spec_of(r) for r in live]
+        return specs if any(s is not None for s in specs) else None
+
     def _try_spec_burst(self):
-        """All live requests decoding greedily on an engine with
+        """All live requests decoding on device on an engine with
         speculative decoding armed → draft with the n-gram drafter and
-        score entry + drafts in ONE compiled verify forward; None when
-        the speculative path doesn't apply this round (no drafts found,
-        stochastic sampling, budget too tight…) — the plain k-step burst
-        then gets its chance."""
+        score entry + drafts in ONE compiled verify forward — greedy
+        acceptance under greedy decoding, rejection-sampled acceptance
+        under per-sequence sampling (bit-identical to the spec-off
+        stream either way); None when the speculative path doesn't
+        apply this round (no drafts found, a schema-bound request in
+        the batch, budget too tight…) — the plain k-step burst then
+        gets its chance."""
         engine = self.engine
         spec = getattr(engine, "spec", None)
-        if spec is None or self._sampling is not None or not self._device_greedy:
+        if spec is None or not self._device_greedy:
             return None
         live = self._live()
         if (not live or len(live) > engine.max_seqs
-                or any(r.next_token is None for r in live)):
+                or any(r.next_token is None for r in live)
+                # constrained sequences never verify: their drafts were
+                # proposed without the DFA mask
+                or any(r.schema is not None for r in live)):
             return None
         n = len(live)
         # each sequence enters the verify batch as a (d+1)-token chunk,
@@ -300,7 +351,7 @@ class DynamicSplitFuseScheduler:
         if not engine.can_burst(uids, d + 1):
             return None  # pool too tight: fall back (see _try_burst)
         toks, acc = engine.verify_burst(uids, [[r.next_token] for r in live],
-                                        drafts)
+                                        drafts, sample=self._sample_arg(live))
         for r in live:
             r.next_token = None
         for j, r in enumerate(live):
@@ -322,6 +373,11 @@ class DynamicSplitFuseScheduler:
         retire frees them — and the prefix cache never content-addresses
         post-EOS garbage."""
         r.generated.append(tok)
+        if r.schema is not None:
+            # the authoritative host DFA advances ONLY for accepted
+            # tokens — burst tails discarded after EOS/max_new never
+            # touch it, so the state the next batch packs stays right
+            self.engine.advance_schema(r.uid, tok)
         if (self.eos_token_id is not None and tok == self.eos_token_id) \
                 or len(r.generated) >= r.max_new_tokens:
             r.done = True
@@ -345,7 +401,9 @@ class DynamicSplitFuseScheduler:
         if not uids:
             return []
         if self._device_greedy:
-            out = self.engine.put(uids, chunks, sample=self._sampling or "greedy")
+            rows = [self.requests[u] for u in uids]
+            out = self.engine.put(uids, chunks,
+                                  sample=self._sample_arg(rows) or "greedy")
         else:
             out = self.engine.put(uids, chunks)
         for uid, row in zip(uids, out):

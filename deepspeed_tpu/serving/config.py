@@ -86,6 +86,10 @@ class ServingConfig(DeepSpeedConfigModel):
     max_burst: int = Field(16, ge=1)
     eos_token_id: Optional[int] = None
     sampling: Optional[dict] = None  # on-device stochastic sampling spec
+    # tokenizer surface (token id -> string) for compiling raw
+    # grammar/JSON-schema constraints at submit; None = only
+    # precompiled CompiledSchema objects are accepted per request
+    token_strings: Optional[list] = None
     default_max_new_tokens: int = Field(16, ge=1)
     default_priority: int = 0
 

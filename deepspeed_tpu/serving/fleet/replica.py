@@ -58,9 +58,12 @@ class Replica:
     role = "unified"
 
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None, adapter_id=None):
+               deadline_ms=None, adapter_id=None, sample=None, schema=None):
         """→ a :class:`RequestHandle`-shaped streaming handle. Raises a
-        :class:`ServingError` subclass when not accepted."""
+        :class:`ServingError` subclass when not accepted. ``sample``
+        always arrives with its seed already resolved (the router
+        derives it from the router uid) so every failover attempt
+        draws the identical stream."""
         raise NotImplementedError
 
     def has_adapter(self, adapter_id):
@@ -168,10 +171,11 @@ class GatewayReplica(Replica):
 
     # ------------------------------------------------------------ routing API
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None, adapter_id=None):
+               deadline_ms=None, adapter_id=None, sample=None, schema=None):
         return self.gateway.submit(prompt_tokens, max_new_tokens=max_new_tokens,
                                    priority=priority, deadline_ms=deadline_ms,
-                                   adapter_id=adapter_id)
+                                   adapter_id=adapter_id, sample=sample,
+                                   schema=schema)
 
     def has_adapter(self, adapter_id):
         try:
@@ -359,7 +363,7 @@ class FaultyReplica(Replica):
 
     # ------------------------------------------------------------ routing API
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None, adapter_id=None):
+               deadline_ms=None, adapter_id=None, sample=None, schema=None):
         with self._lock:
             if self._killed:
                 raise ReplicaDiedError(f"replica {self.name} is dead")
@@ -381,7 +385,8 @@ class FaultyReplica(Replica):
                                          max_new_tokens=max_new_tokens,
                                          priority=priority,
                                          deadline_ms=deadline_ms,
-                                         adapter_id=adapter_id)
+                                         adapter_id=adapter_id,
+                                         sample=sample, schema=schema)
         return _FaultyHandle(inner_handle, self)
 
     def has_adapter(self, adapter_id):

@@ -88,9 +88,9 @@ class FleetHandle(RequestHandle):
     operators want: which replicas served it, how many attempts."""
 
     def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s,
-                 adapter_id=None):
+                 adapter_id=None, sample=None, schema=None):
         super().__init__(uid, prompt, max_new_tokens, priority, deadline_s,
-                         adapter_id=adapter_id)
+                         adapter_id=adapter_id, sample=sample, schema=schema)
         self.replica_trail = []  # replica names, one per attempt
         self.attempts = 0
         self._cancelled = False
@@ -160,17 +160,21 @@ class FleetRouter:
 
     # ---------------------------------------------------------------- client
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None, adapter_id=None):
+               deadline_ms=None, adapter_id=None, sample=None, schema=None):
         """Gateway-compatible submit: → a streaming :class:`FleetHandle`.
         Placement, retries and failover all happen on a per-request
         relay thread; the caller just consumes ``handle.tokens()``.
         ``adapter_id`` routes the request through that LoRA adapter's
         weights (None = base) — placement prefers replicas whose hot
-        set already holds the adapter.
+        set already holds the adapter. ``sample``/``schema`` ride along
+        to whichever replica serves each attempt.
 
         Defaults resolve HERE (from :class:`FleetConfig`), not per
         replica — every failover attempt must replay with identical
-        parameters or greedy replay equivalence breaks."""
+        parameters or replay equivalence breaks. That includes the
+        sampling seed: a spec without one gets a seed derived from the
+        ROUTER uid, so a mid-stream replica kill replays the identical
+        counter-keyed stream on the survivor."""
         prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self.config.default_max_new_tokens)
@@ -180,13 +184,22 @@ class FleetRouter:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if sample is not None:
+            from deepspeed_tpu.inference.sampling import validate_sample_spec
+            validate_sample_spec(sample)  # typed, before any placement
+            sample = dict(sample)
         with self._lock:
             if self._closed:
                 raise GatewayClosedError(
                     "fleet router is closed — not accepting requests")
-        handle = FleetHandle(next(self._uids), prompt, max_new, prio,
+        uid = next(self._uids)
+        if sample is not None and "seed" not in sample:
+            from deepspeed_tpu.inference.structured.prng import derive_seed
+            sample["seed"] = derive_seed(env_int("DS_SEED"), uid)
+        handle = FleetHandle(uid, prompt, max_new, prio,
                              deadline_ms / 1e3 if deadline_ms is not None
-                             else None, adapter_id=adapter_id)
+                             else None, adapter_id=adapter_id,
+                             sample=sample, schema=schema)
         handle._cancel_cb = self._request_cancel
         self._count("submitted")
         thread = threading.Thread(target=self._serve, args=(handle,),
@@ -531,7 +544,9 @@ class FleetRouter:
                                    max_new_tokens=max_new,
                                    priority=handle.priority,
                                    deadline_ms=deadline_ms,
-                                   adapter_id=handle.adapter_id)
+                                   adapter_id=handle.adapter_id,
+                                   sample=handle.sample,
+                                   schema=handle.schema)
         except ServingError as e:
             self._note_failure(replica, e)
             return (_RETRY if e.retry_elsewhere else _FATAL), e

@@ -91,7 +91,7 @@ class RequestHandle:
     """
 
     def __init__(self, uid, prompt, max_new_tokens, priority, deadline_s,
-                 spec=True, adapter_id=None):
+                 spec=True, adapter_id=None, sample=None, schema=None):
         self.uid = uid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -102,6 +102,11 @@ class RequestHandle:
         # multi-tenant LoRA: serve this request through adapter_id's
         # weights (None = base model)
         self.adapter_id = adapter_id
+        # on-device sampling spec (seed already resolved — replays and
+        # failovers are uid-stable) and compiled constrained-decoding
+        # schema; None/None = greedy unconstrained
+        self.sample = sample
+        self.schema = schema
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + deadline_s
                          if deadline_s is not None else None)
@@ -225,18 +230,30 @@ class ServingGateway:
 
     # ---------------------------------------------------------------- client
     def submit(self, prompt_tokens, max_new_tokens=None, priority=None,
-               deadline_ms=None, spec=True, adapter_id=None):
+               deadline_ms=None, spec=True, adapter_id=None, sample=None,
+               schema=None):
         """Accept a request from any thread → :class:`RequestHandle`.
         ``spec=False`` opts this request out of speculative decoding
         (it still rides in verify batches, just without drafts).
         ``adapter_id`` routes the request through that LoRA adapter's
-        weights (None = base model).
+        weights (None = base model). ``sample`` is a per-request
+        on-device sampling spec (``{"temperature", "top_k", "top_p",
+        "seed"}``, all optional); when it carries no ``seed`` one is
+        derived deterministically from the request uid, so trace
+        replays and fleet failovers draw the identical stream.
+        ``schema`` constrains generation to a JSON schema (dict), a
+        regex (str), or a precompiled
+        :class:`~deepspeed_tpu.inference.structured.grammar.CompiledSchema`;
+        raw schemas compile through the process-wide schema cache over
+        ``config.token_strings``.
 
         Raises :class:`RequestTooLargeError` when the request can never
         fit this engine, :class:`QueueFullError` per the admission
-        policy, :class:`GatewayClosedError` after ``drain()`` began, and
+        policy, :class:`GatewayClosedError` after ``drain()`` began,
         ``UnknownAdapterError`` when no tier of the engine's adapter
-        store can serve ``adapter_id``.
+        store can serve ``adapter_id``, and ``ValueError`` /
+        ``SchemaCompileError`` for malformed sampling specs or schemas
+        — all typed, all BEFORE the request queues.
         """
         prompt = [int(t) for t in np.atleast_1d(np.asarray(prompt_tokens))]
         max_new = int(max_new_tokens if max_new_tokens is not None
@@ -262,19 +279,68 @@ class ServingGateway:
                     f"adapter {adapter_id} is not registered with this "
                     f"replica (hot, host, or published)",
                     adapter_id=int(adapter_id))
+        raw_schema = None
+        if sample is not None:
+            # typed pre-admission validation: a malformed spec fails at
+            # the door, never mid-pump after the request already queued
+            from deepspeed_tpu.inference.sampling import validate_sample_spec
+            try:
+                validate_sample_spec(sample)
+            except ValueError:
+                self.metrics.count("rejected_bad_sample")
+                raise
+            sample = dict(sample)
+        if schema is not None:
+            from deepspeed_tpu.inference.structured.grammar import CompiledSchema
+            if getattr(self.engine, "structured", None) is None:
+                self.metrics.count("rejected_schema")
+                raise ValueError(
+                    "schema given but constrained decoding is disabled on "
+                    "this replica (config.structured.enabled / DS_CONSTRAINED)")
+            if isinstance(schema, CompiledSchema):
+                raw_schema = schema.schema
+            else:
+                # compile at the door through the process-wide cache:
+                # repeat schemas hit; malformed ones raise typed here
+                raw_schema = schema
+                toks = self.config.token_strings
+                if not toks:
+                    self.metrics.count("rejected_schema")
+                    raise ValueError(
+                        "raw schema given but config.token_strings is unset — "
+                        "pass a precompiled CompiledSchema or configure the "
+                        "tokenizer surface")
+                from deepspeed_tpu.inference.structured.store import schema_cache
+                try:
+                    schema = schema_cache().get_or_compile(
+                        schema, toks, self.config.eos_token_id)
+                except Exception:
+                    self.metrics.count("rejected_schema")
+                    raise
         try:
             self.gate.check_feasible(len(prompt), max_new)
         except Exception:
             self.metrics.count("rejected_too_large")
             raise
+        uid = next(self._uids)
+        if sample is not None and "seed" not in sample:
+            # resolve the seed AT THE GATEWAY, derived from the request
+            # uid: the recorder below sees the RESOLVED spec, so a trace
+            # replay (or a failover resubmit reusing the uid) draws the
+            # bit-identical stream
+            from deepspeed_tpu.inference.structured.prng import derive_seed
+            from deepspeed_tpu.utils.env_registry import env_int
+            sample["seed"] = derive_seed(env_int("DS_SEED"), uid)
         recorder = self._recorder
         if recorder is not None:
             # record OFFERED traffic (pre-admission): a replay must let
             # the candidate config make its own admission decisions
-            recorder.record(prompt, max_new, prio, adapter_id=adapter_id)
-        handle = RequestHandle(next(self._uids), prompt, max_new, prio,
+            recorder.record(prompt, max_new, prio, adapter_id=adapter_id,
+                            sample=sample, schema=raw_schema)
+        handle = RequestHandle(uid, prompt, max_new, prio,
                                deadline_ms / 1e3 if deadline_ms is not None else None,
-                               spec=spec, adapter_id=adapter_id)
+                               spec=spec, adapter_id=adapter_id,
+                               sample=sample, schema=schema)
         handle._cancel_cb = self._request_cancel
         try:
             shed = self.queue.push(handle)
@@ -711,16 +777,23 @@ class ServingGateway:
             if entry.done:  # shed/failed between snapshot and now
                 self.gate.release(plen, max_new)
                 continue
+            schema = getattr(entry, "schema", None)
             try:
                 self.scheduler.add_request(entry.uid, entry.prompt,
                                            max_new_tokens=max_new,
                                            priority=entry.priority,
                                            spec=getattr(entry, "spec", True),
                                            adapter_id=getattr(entry, "adapter_id",
-                                                              None))
+                                                              None),
+                                           sample=getattr(entry, "sample", None),
+                                           schema=schema)
             except Exception as e:
                 from deepspeed_tpu.serving.admission import ServingError
-                if not isinstance(e, ServingError):
+                # schema bind failures (every DFA slot leased by a live
+                # sequence, state overflow) are per-request admission
+                # failures just like typed adapter errors — fail THIS
+                # request retryably, never the pump
+                if not isinstance(e, ServingError) and schema is None:
                     raise
                 # typed adapter failure at bind time (hot set saturated
                 # with leased slots, publication vanished): fail THIS
@@ -728,7 +801,8 @@ class ServingGateway:
                 # the pump — the fleet router fails it over
                 self.gate.release(plen, max_new)
                 if entry._finish("failed", e):
-                    self.metrics.count("rejected_adapter")
+                    self.metrics.count("rejected_schema" if schema is not None
+                                       else "rejected_adapter")
                 did = True
                 continue
             entry.status = "running"

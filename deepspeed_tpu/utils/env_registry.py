@@ -39,8 +39,11 @@ def parse_bool(raw: str) -> bool:
 # tuning-relevance tags: None = not a tuning knob; "offline" = changing
 # it means rebuilding the engine (the offline tuner's search space);
 # "online" = cheap to flip on a live gateway (the SLO controller's
-# actuation surface)
-_TUNING_TAGS = (None, "offline", "online")
+# actuation surface); "fixed" = a determinism anchor the autotuner must
+# NEVER search — changing it changes every replayed stream's bits (the
+# fleet's failover/canary replay contract), so it is excluded from
+# tunable_knobs() entirely
+_TUNING_TAGS = (None, "offline", "online", "fixed")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,7 +63,7 @@ class EnvKnob:
     min_value: Optional[int] = None
     max_value: Optional[int] = None
     choices: Optional[Tuple] = None
-    tuning: Optional[str] = None  # None | "offline" | "online"
+    tuning: Optional[str] = None  # None | "offline" | "online" | "fixed"
 
     def describe_default(self) -> str:
         if self.kind in ("optional_bool", "optional_str"):
@@ -151,12 +154,20 @@ def all_knobs() -> List[EnvKnob]:
 
 
 def tunable_knobs(tag: Optional[str] = None) -> List[EnvKnob]:
-    """Knobs carrying a tuning tag (optionally restricted to one tag) —
-    the autotuner's search-space enumeration source."""
+    """Knobs carrying a searchable tuning tag (optionally restricted to
+    one tag) — the autotuner's search-space enumeration source.
+    ``"fixed"`` knobs are determinism anchors (e.g. ``DS_SEED``): tagged
+    so their replay-contract role is machine-readable, but NEVER
+    enumerated here — an autotuner flipping one would silently break
+    every bit-identical-replay guarantee in the fleet."""
     if tag is not None and tag not in _TUNING_TAGS:
         raise ValueError(f"unknown tuning tag {tag!r}")
+    if tag == "fixed":
+        raise ValueError("'fixed' knobs are excluded from tuning by "
+                         "definition — they anchor replay determinism")
     return [k for k in all_knobs()
-            if k.tuning is not None and (tag is None or k.tuning == tag)]
+            if k.tuning is not None and k.tuning != "fixed"
+            and (tag is None or k.tuning == tag)]
 
 
 def knob_schema() -> List[Dict]:
@@ -213,8 +224,12 @@ def env_str(name: str) -> str:
 # ------------------------------------------------------------------- knobs
 # Runtime / training
 register("DS_SEED", "int", 42,
-         "Base PRNG seed for parameter init and dropout streams.",
-         "deepspeed_tpu/runtime/engine.py")
+         "Base PRNG seed for parameter init, dropout streams, and the "
+         "serving counter-PRNG that keys every sampled token by "
+         "(request seed, position) — all replicas in a fleet must share "
+         "it or failover replay diverges.",
+         "deepspeed_tpu/runtime/engine.py",
+         tuning="fixed")
 register("DS_ACCELERATOR", "optional_str", None,
          "Force the accelerator backend (tpu|cpu); unset auto-detects.",
          "deepspeed_tpu/accelerator/real_accelerator.py")
@@ -280,6 +295,14 @@ register("DS_LORA_MAX_RANK", "int", 0,
          "defers to the engine config's lora.max_rank.",
          "deepspeed_tpu/serving/lora/__init__.py",
          min_value=0, tuning="offline")
+register("DS_CONSTRAINED", "optional_bool", None,
+         "Kill switch for grammar/JSON-schema constrained decoding "
+         "(token-DFA logits masks in the sampled programs); set it wins "
+         "in both directions, unset defers to the engine config. Off "
+         "builds the exact pre-structured pipeline (program keys "
+         "unchanged).",
+         "deepspeed_tpu/inference/structured/__init__.py",
+         tuning="offline")
 register("DS_SPEC_DECODE", "optional_bool", None,
          "Kill switch for self-speculative decoding (n-gram drafting + "
          "batched verify); set it wins in both directions, unset defers "

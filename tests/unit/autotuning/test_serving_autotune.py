@@ -121,6 +121,47 @@ class TestTraces:
         assert [r.adapter_id for r in tr] == [None, 9]
         assert [r.priority for r in tr] == [0, 1]
 
+    def test_sample_schema_roundtrip_and_v2_compat(self, tmp_path):
+        """Trace v3: per-request ``sample`` (with its resolved seed) and
+        ``schema`` survive the jsonl round trip, are only written when
+        set (greedy v3 payloads stay line-identical to v2), and a v2
+        trace without the fields loads as None/None."""
+        schema = {"type": "object",
+                  "properties": {"ok": {"type": "boolean"}}}
+        tr = synthesize_trace("steady", 4, seed=5)
+        tr.requests[1].sample = {"temperature": 0.9, "top_k": 20, "seed": 123}
+        tr.requests[3].sample = {"temperature": 1.1, "seed": 7}
+        tr.requests[3].schema = schema
+        path = str(tmp_path / "t3.trace.jsonl")
+        tr.save(path)
+        back = ServingTrace.load(path)
+        assert [r.sample for r in back] == [None, tr.requests[1].sample,
+                                            None, tr.requests[3].sample]
+        assert [r.schema for r in back] == [None, None, None, schema]
+        assert [r.to_json() for r in back] == [r.to_json() for r in tr]
+        # greedy unconstrained requests never emit the keys
+        assert "sample" not in tr.requests[0].to_json()
+        assert "schema" not in tr.requests[0].to_json()
+        # a v2 record (no sample/schema, v2 header) loads with None
+        with open(path) as fd:
+            lines = fd.read().splitlines()
+        v2 = str(tmp_path / "v2.trace.jsonl")
+        with open(v2, "w") as fd:
+            fd.write(json.dumps({"trace_meta": {"version": 2}}) + "\n")
+            fd.write(lines[1] + "\n")
+        old = ServingTrace.load(v2)
+        assert old.requests[0].sample is None
+        assert old.requests[0].schema is None
+
+    def test_recorder_captures_sample_and_schema(self):
+        rec = TraceRecorder()
+        rec.record([3, 4, 5], 8, 0)
+        rec.record([3, 4, 6], 8, 0, sample={"top_k": 4, "seed": 11},
+                   schema={"enum": ["a", "b"]})
+        tr = rec.trace()
+        assert [r.sample for r in tr] == [None, {"top_k": 4, "seed": 11}]
+        assert [r.schema for r in tr] == [None, {"enum": ["a", "b"]}]
+
     def test_future_version_rejected(self, tmp_path):
         path = str(tmp_path / "future.trace.jsonl")
         with open(path, "w") as fd:
@@ -159,11 +200,14 @@ class TestKnobSchema:
         for entry in schema.values():
             assert entry["type"] in ("bool", "int", "str", "optional_bool",
                                      "optional_str")
-            assert entry["tuning"] in (None, "offline", "online")
+            assert entry["tuning"] in (None, "offline", "online", "fixed")
             assert entry["doc_row"].startswith("| `DS_")
         draft = schema["DS_SPEC_DRAFT_LEN"]
         assert draft["tuning"] == "online"
         assert draft["range"] == [0, 32]
+        # determinism anchors carry the "fixed" tag (machine-readable
+        # replay contract) without ever entering the search space
+        assert schema["DS_SEED"]["tuning"] == "fixed"
 
     def test_tunable_knobs_filters_by_tag(self):
         names = {k.name for k in env_registry.tunable_knobs()}
@@ -171,6 +215,10 @@ class TestKnobSchema:
         assert "DS_SPEC_DRAFT_LEN" in online
         assert online <= names
         assert "DS_AUTOTUNE" not in names  # the enable switch is not a dim
+        # "fixed" knobs anchor bit-identical replay: never tunable
+        assert "DS_SEED" not in names
+        with pytest.raises(ValueError, match="fixed"):
+            env_registry.tunable_knobs("fixed")
 
     def test_register_validation(self):
         with pytest.raises(ValueError, match="unknown tuning tag"):

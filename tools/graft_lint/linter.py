@@ -103,6 +103,10 @@ THREAD_SHARED_REGISTRY = {
                      "host_evictions", "hot_hits", "hot_misses", "swaps",
                      "prefetched", "stage_hits", "prefetch_errors",
                      "publish_rejects"},
+    # structured decoding: every gateway's client submit threads compile
+    # schemas through the ONE process-wide cache at admission, so the
+    # LRU map and its counters are cross-thread state
+    "SchemaCompilerCache": {"_cache", "compiles", "hits"},
     # spec decode: the gateway pump drafts/notes while client threads
     # reach forget() through engine.flush (cancel / deadline / drain),
     # and the online SLO controller adjusts draft_len_cfg live
@@ -186,6 +190,10 @@ LOCK_ORDER = {
     # held above it, and itself calls only its publisher (unranked leaf
     # I/O) — it slots between the prefix cache and the kv-tier stack
     "AdapterStore._lock": 34,
+    # the schema compiler cache is a leaf: get_or_compile runs the
+    # compiler OUTSIDE the lock and the locked sections touch only the
+    # LRU map — it never calls into another registered class
+    "SchemaCompilerCache._lock": 36,
     "TierManager._lock": 40,
     "HostKVStore._lock": 50,
 }
