@@ -118,6 +118,16 @@ def _measure_tunnel_bandwidth(nbytes=32 << 20):
     return round(h2d, 1), round(d2h, 1)
 
 
+def _sync_stats(engine):
+    """Lifetime syncs/token of a v2 engine (warmup included) — every
+    serving lane reports it so the static pragma-count ratchet
+    (tools/graft_lint/host_sync_budget.json) has a live counterpart in
+    published numbers. {} for engines without the counter (v1)."""
+    if getattr(engine, "host_syncs", None) is None:
+        return {}
+    return {"syncs_per_token": engine.syncs_per_generated_token}
+
+
 def bench_serving_2b(dtype="bf16", quant_scheme=None):
     """~2.5B-param serving on-chip: v1 engine jitted generate (prefill +
     scan decode), weights born on device via jitted init. ``dtype='int8'``
@@ -213,6 +223,7 @@ def bench_serving_v2_ragged():
     once per step — on a production host that dispatch is local."""
     from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, DynamicSplitFuseScheduler,
                                             InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import AsyncBurstConfig
     from deepspeed_tpu.models import build_llama
     from deepspeed_tpu.parallel import groups
 
@@ -225,55 +236,83 @@ def bench_serving_v2_ragged():
                         num_key_value_heads=8, max_position_embeddings=2048,
                         vocab_size=32000, remat=False)
     n_req, prompt_len, new_tokens, budget = 16, 128, 64, 512
-    cfg = RaggedInferenceEngineConfig(
-        kv_block_size=32,
-        state_manager=DSStateManagerConfig(
-            max_ragged_batch_size=budget,
-            max_ragged_sequence_count=n_req,
-            max_tracked_sequences=n_req,
-            max_context=prompt_len + new_tokens))
-    engine = InferenceEngineV2(model=model, config=cfg)
-    # DS_SANITIZE off must add zero overhead: the serving step is a bare
-    # jax.jit, not a checkify wrapper (structural proof -- no wrapper, no cost)
-    assert not engine._sanitize and not getattr(engine._step, "_ds_sanitized", False), \
-        "serving bench must run unsanitized (unset DS_SANITIZE)"
-    rng = np.random.RandomState(0)
+    rng_seed = 0
 
-    def run(n, plen, ntok):
-        sched = DynamicSplitFuseScheduler(engine, token_budget=budget, max_burst=16)
-        for uid in range(n):
-            sched.add_request(uid, rng.randint(0, 32000, size=plen).astype(np.int32),
-                              max_new_tokens=ntok)
-        steps = 0
-        while sched.has_work:
-            sched.step()  # finished sequences are flushed by the scheduler
-            steps += 1
-        return steps
+    def lane(async_on):
+        cfg = RaggedInferenceEngineConfig(
+            kv_block_size=32,
+            async_burst=AsyncBurstConfig(enabled=async_on),
+            state_manager=DSStateManagerConfig(
+                max_ragged_batch_size=budget,
+                max_ragged_sequence_count=n_req,
+                max_tracked_sequences=n_req,
+                max_context=prompt_len + new_tokens))
+        engine = InferenceEngineV2(model=model, config=cfg)
+        # DS_SANITIZE off must add zero overhead: the serving step is a bare
+        # jax.jit, not a checkify wrapper (structural proof -- no wrapper, no cost)
+        assert not engine._sanitize and not getattr(engine._step, "_ds_sanitized", False), \
+            "serving bench must run unsanitized (unset DS_SANITIZE)"
+        rng = np.random.RandomState(rng_seed)
 
-    # compile both padded put shapes + the power-of-two burst programs
-    # (16/8/4/2) the timed run will use, and warm the pool
-    run(2, 16, 32)
-    t0 = time.perf_counter()
-    steps = run(n_req, prompt_len, new_tokens)
-    dt = time.perf_counter() - t0
-    gen = n_req * new_tokens
-    total = n_req * (prompt_len + new_tokens)
-    n_params = _param_count(engine.params)
-    if hasattr(engine, "destroy"):
-        engine.destroy()
-    return {"params": n_params, "requests": n_req, "prompt_len": prompt_len,
-            "new_tokens": new_tokens, "token_budget": budget, "steps": steps,
+        def run(n, plen, ntok):
+            sched = DynamicSplitFuseScheduler(engine, token_budget=budget, max_burst=16)
+            for uid in range(n):
+                sched.add_request(uid, rng.randint(0, 32000, size=plen).astype(np.int32),
+                                  max_new_tokens=ntok)
+            steps = 0
+            while sched.has_work:
+                sched.step()  # finished sequences are flushed by the scheduler
+                steps += 1
+            return steps
+
+        # compile both padded put shapes + the power-of-two burst programs
+        # (16/8/4/2) the timed run will use, and warm the pool
+        run(2, 16, 32)
+        syncs0, toks0 = engine.host_syncs, engine.tokens_emitted
+        t0 = time.perf_counter()
+        steps = run(n_req, prompt_len, new_tokens)
+        dt = time.perf_counter() - t0
+        syncs = engine.host_syncs - syncs0
+        toks = engine.tokens_emitted - toks0
+        n_params = _param_count(engine.params)
+        if hasattr(engine, "destroy"):
+            engine.destroy()
+        gen = n_req * new_tokens
+        total = n_req * (prompt_len + new_tokens)
+        return n_params, {
+            "steps": steps,
             "gen_tokens_per_sec": round(gen / dt, 1),
             "total_tokens_per_sec": round(total / dt, 1),
             "time_s": round(dt, 2),
+            "host_syncs": syncs,
+            "syncs_per_token": round(syncs / max(toks, 1), 4)}
+
+    n_params, sync_lane = lane(async_on=False)
+    _, async_lane = lane(async_on=True)
+    sync_drop = sync_lane["syncs_per_token"] / max(async_lane["syncs_per_token"], 1e-9)
+    # the sync-count claim is structural (counted at every pragma'd
+    # site), so it holds at any scale — unlike tok/s it is assertable
+    # on the CPU/CI path too
+    assert sync_drop >= 4.0, \
+        f"pipelined bursts must cut syncs/token >=4x, got {sync_drop:.2f}x"
+    return {"params": n_params, "requests": n_req, "prompt_len": prompt_len,
+            "new_tokens": new_tokens, "token_budget": budget,
+            "steps": async_lane["steps"],
+            "gen_tokens_per_sec": async_lane["gen_tokens_per_sec"],
+            "total_tokens_per_sec": async_lane["total_tokens_per_sec"],
+            "time_s": async_lane["time_s"],
+            "syncs_per_token": async_lane["syncs_per_token"],
+            "sync_mode": sync_lane, "async_mode": async_lane,
+            "syncs_per_token_drop": round(sync_drop, 1),
+            "async_speedup": round(sync_lane["time_s"] / max(async_lane["time_s"], 1e-9), 2),
             "note": "continuous batching via Dynamic SplitFuse; greedy sampled on "
                     "device; 16-step decode bursts (one compiled scan per burst) "
-                    "cut host syncs 16x. Gap vs the v1 static bench ATTRIBUTED "
-                    "(r5): host scheduling ~0%; the ~15 remaining sync calls x "
-                    "~71ms tunnel RTT are ~50% of wall time — device-only "
-                    "throughput (~2x the reported number) exceeds v1 static, so "
-                    "the deficit is the tunnel, not the ragged engine; v1's "
-                    "single-program generate pays 1 sync total"}
+                    "cut host syncs 16x, and pipelined double-buffered bursts "
+                    "(DS_ASYNC_BURST, r22) cut the remaining per-burst syncs to "
+                    "ONE packed fetch consumed a burst late — syncs/token drops "
+                    ">=4x again (asserted) and the r5-attributed tunnel-RTT "
+                    "deficit shrinks with it; streams are bit-identical to the "
+                    "sync path (kill switch rebuilds the exact pre-pipeline loop)"}
 
 
 def bench_serving_2b_prefix(n_req=8, sys_len=512, sfx_len=32, new_tokens=64):
@@ -349,6 +388,7 @@ def bench_serving_2b_prefix(n_req=8, sys_len=512, sfx_len=32, new_tokens=64):
             "warm_vs_cold_speedup": round(cold_dt / warm_dt, 2),
             "cache": {k: stats[k] for k in ("hit_rate", "tokens_saved",
                                             "cached_blocks", "evictions")},
+            **_sync_stats(engine),
             "note": "cross-request KV reuse (radix prefix cache): the warm "
                     "fleet leases the 512-token system prompt's blocks from "
                     "the trie and prefills only its 32-token suffix; "
@@ -442,12 +482,14 @@ def bench_serving_2b_kv_tier(n_req=4, sys_len=512, sfx_len=32, new_tokens=64,
         tier_stats = engine.kv_tier.stats() if engine.kv_tier else None
         pc_stats = engine.prefix_cache.stats()
         n_params = _param_count(engine.params)
+        syncs = _sync_stats(engine)
         engine.destroy()
         gc.collect()
-        return dt, out_a + out_b + out_back, saved, tier_stats, pc_stats, n_params
+        return dt, out_a + out_b + out_back, saved, tier_stats, pc_stats, \
+            n_params, syncs
 
-    off_dt, off_outs, off_saved, _, _, n_params = run(tier_off=True)
-    on_dt, on_outs, on_saved, tier_stats, pc_stats, _ = run(tier_off=False)
+    off_dt, off_outs, off_saved, _, _, n_params, _ = run(tier_off=True)
+    on_dt, on_outs, on_saved, tier_stats, pc_stats, _, syncs = run(tier_off=False)
     assert on_outs == off_outs, \
         "the KV spill tier changed the greedy token streams"
     saved_ratio = round(on_saved / max(off_saved, 1), 2)
@@ -472,6 +514,7 @@ def bench_serving_2b_kv_tier(n_req=4, sys_len=512, sfx_len=32, new_tokens=64,
             "return_gen_tok_s_tier1_only": round(gen / off_dt, 1),
             "return_gen_tok_s_tiered": round(gen / on_dt, 1),
             "bit_identical": True,  # asserted above
+            **syncs,
             "note": "host-RAM KV spill tier: fleet B overflows the HBM pool "
                     "and evicts fleet A's shared system prompt — dropped "
                     "with DS_KV_TIER=0, demoted to host and promoted back "
@@ -554,12 +597,13 @@ def bench_serving_2b_spec(n_req=8, sys_len=256, tmpl_len=64, new_tokens=64,
         dt, outs = fleet(engine, 0, prompts, new_tokens)
         spec1 = engine.spec.stats() if engine.spec is not None else None
         n_params = _param_count(engine.params)
+        syncs = _sync_stats(engine)
         engine.destroy()
         gc.collect()
-        return dt, outs, spec0, spec1, n_params
+        return dt, outs, spec0, spec1, n_params, syncs
 
-    plain_dt, plain_outs, _, _, n_params = run(spec_off=True)
-    spec_dt, spec_outs, spec0, spec1, _ = run(spec_off=False)
+    plain_dt, plain_outs, _, _, n_params, _ = run(spec_off=True)
+    spec_dt, spec_outs, spec0, spec1, _, syncs = run(spec_off=False)
     assert spec_outs == plain_outs, \
         "speculative decoding changed the greedy token streams"
     steps = spec1["verify_steps"] - spec0["verify_steps"]
@@ -579,6 +623,7 @@ def bench_serving_2b_spec(n_req=8, sys_len=256, tmpl_len=64, new_tokens=64,
             "spec_gen_tokens_per_sec": round(gen / spec_dt, 1),
             "spec_vs_plain_speedup": round(plain_dt / spec_dt, 2),
             "bit_identical": True,  # asserted above
+            **syncs,
             "note": "self-speculative decoding (n-gram drafting + batched "
                     "verify): repetitive templated trace decoded with "
                     "DS_SPEC_DECODE=0 (plain bursts) then with drafting on; "
@@ -666,11 +711,13 @@ def bench_serving_2b_sampled(n_req=8, prompt_len=256, new_tokens=64,
         f"{len(sampled_keys)} sampled burst programs for {n_req} distinct " \
         f"specs — per-spec retrace leaked back in"
     n_params = _param_count(engine.params)
+    syncs = _sync_stats(engine)
     gen = n_req * new_tokens
     engine.destroy()
     gc.collect()
     return {"params": n_params, "requests": n_req,
             "prompt_len": prompt_len, "new_tokens": new_tokens,
+            **syncs,
             "distinct_sample_specs": n_req,
             "sampled_burst_programs": len(sampled_keys),
             "greedy_gen_tokens_per_sec": round(gen / greedy_dt, 1),
@@ -794,10 +841,12 @@ def bench_serving_2b_json(n_req=8, prompt_len=64, new_tokens=64,
     assert overhead < (0.10 if not debug else 1.0), \
         f"constrained decode overhead {overhead:.1%} exceeds bound"
     n_params = _param_count(engine.params)
+    syncs = _sync_stats(engine)
     engine.destroy()
     gc.collect()
     return {"params": n_params, "requests": n_req,
             "prompt_len": prompt_len, "max_new_tokens": new_tokens,
+            **syncs,
             "dfa_states": compiled.n_states,
             "schema_valid_frac": valid / n_req,
             "plain_gen_tokens_per_sec": round(plain_tput, 1),
@@ -876,12 +925,13 @@ def bench_serving_2b_moe(n_req=8, prompt_len=256, new_tokens=64,
         n_params = _param_count(engine.params)
         from deepspeed_tpu.inference.quantization import quantized_bytes
         resident_gb = quantized_bytes(engine.params) / 1e9
+        syncs = _sync_stats(engine)
         engine.destroy()
         gc.collect()
-        return dt, outs, n_params, resident_gb
+        return dt, outs, n_params, resident_gb, syncs
 
-    entry_dt, entry_outs, n_params, resident_gb = run(fused_off=True)
-    fused_dt, fused_outs, _, _ = run(fused_off=False)
+    entry_dt, entry_outs, n_params, resident_gb, _ = run(fused_off=True)
+    fused_dt, fused_outs, _, _, syncs = run(fused_off=False)
     assert fused_outs == entry_outs, \
         "fused grouped GEMM changed the greedy token streams"
     gen = n_req * new_tokens
@@ -902,6 +952,7 @@ def bench_serving_2b_moe(n_req=8, prompt_len=256, new_tokens=64,
             "entry_transient_dequant_mb": round(entry_transient / 1e6, 1),
             "fused_transient_dequant_mb": round(fused_transient / 1e6, 3),
             "bit_identical": True,  # asserted above
+            **syncs,
             "note": "quantized MoE expert stacks consumed boxed by the "
                     "grouped GEMM (gmm_quant: per-tile VMEM dequant inside "
                     "the K-loop) vs DS_FUSED_GMM=0 dequantize-at-entry; "
@@ -990,6 +1041,7 @@ def bench_serving_2b_fleet(n_req=8, prompt_len=256, new_tokens=32):
     c_dt, c_ok, c_typed, c_lost = run_phase(trace[2 * n_req:3 * n_req])
     lost = a_lost + b_lost + c_lost
     counters = router.snapshot()["counters"]
+    syncs = _sync_stats(r1.gateway.engine)  # the survivor served every phase
     router.shutdown()
     assert lost == 0, f"{lost} request(s) neither completed nor failed typed"
     assert b_ok + b_typed == n_req, "mid-fault phase dropped a request"
@@ -1007,6 +1059,7 @@ def bench_serving_2b_fleet(n_req=8, prompt_len=256, new_tokens=32):
             "failovers": counters["failovers"],
             "retries": counters["retries"],
             "restarts": counters["restarts"],
+            **syncs,
             "note": "N=2 replica fleet, replica 0 killed mid-trace then "
                     "rolling-restarted; zero-lost is asserted (every request "
                     "completes on a survivor or fails typed), tput_during "
@@ -1130,9 +1183,10 @@ def bench_serving_2b_disagg(n_req=12, long_prompt=384, short_prompt=64,
         assert all(s for s in streams), "lost request"
         counters = router.snapshot()["counters"]
         disagg_stats = router.snapshot().get("disagg")
+        syncs = _sync_stats(reps[-1].gateway.engine)  # the decode side
         router.shutdown()
         arr = np.asarray(gaps)
-        return {"streams": streams,
+        return {"streams": streams, "syncs": syncs,
                 "p99_ttft_ms": float(np.percentile(
                     [t * 1e3 for t in ttft], 99)),
                 "mean_ttft_ms": float(np.mean(ttft)) * 1e3,
@@ -1159,6 +1213,7 @@ def bench_serving_2b_disagg(n_req=12, long_prompt=384, short_prompt=64,
             "handoffs_acked": dis["disagg"]["handoffs"]["acked"],
             "handoff_failures": dis["counters"]["handoff_failures"],
             "streams_bit_identical": True,
+            **dis["syncs"],
             "note": "bursty mixed trace (long-prompt/short-gen + "
                     "short-prompt/long-gen), 2 replicas each side: "
                     "unified fleet vs prefill+decode pools with "
@@ -1308,6 +1363,7 @@ def bench_serving_2b_refresh(n_req=8, prompt_len=256, new_tokens=32):
     drain_restart_s = time.perf_counter() - t0
 
     counters = router.snapshot()["counters"]
+    syncs = _sync_stats(reps[0].gateway.engine)
     router.shutdown()
     refresh_wall_s = rep2["wall_s"]  # warm-path swap (v1 -> v2)
     n_params = _param_count(shared["params"])
@@ -1322,6 +1378,7 @@ def bench_serving_2b_refresh(n_req=8, prompt_len=256, new_tokens=32):
             "p99_gap_during_refresh_ms": round(max(b_p99, c_p99), 2),
             "refreshes": counters["refreshes"],
             "streams_agree_post_refresh": True,
+            **syncs,
             "note": "2-replica fleet, trainer publications alternated "
                     "with live traffic; no-drain rolling swap vs "
                     "drain+cold-restart of ONE replica on the new "
@@ -1468,9 +1525,11 @@ def bench_serving_2b_autotune(debug=False):
         "DS_AUTOTUNE=0 changed the greedy token streams"
 
     n_params = _param_count(engine.params)
+    syncs = _sync_stats(engine)
     engine.destroy()
     gc.collect()
     return {"params": n_params, "requests": len(recorded),
+            **syncs,
             "trace": recorded.summary(),
             "searched": result.searched, "pruned": len(result.pruned),
             "replays": result.replays,
@@ -1597,12 +1656,14 @@ def bench_serving_2b_lora(n_adapters=8, n_req=16, prompt_len=128,
     gen = n_req * new_tokens
     n_params = _param_count(engine.params)
     stats = store.stats()
+    syncs = _sync_stats(engine)
     engine.destroy()
     single_tok_s = gen / dt_single
     multi_tok_s = gen / dt_multi
     return {"params": n_params, "requests": n_req, "adapters": n_adapters,
             "rank": rank, "prompt_len": prompt_len,
             "new_tokens": new_tokens,
+            **syncs,
             "single_adapter_tok_s": round(single_tok_s, 1),
             "multi_adapter_tok_s": round(multi_tok_s, 1),
             "multi_vs_single": round(multi_tok_s / single_tok_s, 3),

@@ -20,7 +20,7 @@ from deepspeed_tpu.inference.v2.model_runner import ragged_forward
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
 from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
-from deepspeed_tpu.utils.env_registry import env_int
+from deepspeed_tpu.utils.env_registry import env_int, env_opt_bool
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.sanitize import maybe_checkify_jit, sanitize_enabled
 
@@ -36,7 +36,19 @@ from deepspeed_tpu.inference.structured.sampling import (SAMPLE_META_ROWS,
                                                          unpack_sample_meta)
 
 
-def _burst_layout(ms, mb, lora=False, sampled=False):
+def async_burst_enabled(config) -> bool:
+    """Config gate plus the ``DS_ASYNC_BURST`` kill switch: when the
+    env var is set it wins in BOTH directions (``0``/``false``/``off``
+    forces the pre-pipeline loop, anything else forces pipelining);
+    unset defers to ``config.enabled``. The off state rebuilds the
+    exact pre-pipeline decode loop — byte-identical program keys."""
+    forced = env_opt_bool("DS_ASYNC_BURST")
+    if forced is not None:
+        return forced
+    return bool(getattr(config, "enabled", False))
+
+
+def _burst_layout(ms, mb, lora=False, sampled=False, async_entry=False):
     """Single source for the decode-burst metadata wire format: field →
     (start, end) offsets into the flat int32 vector. Both the host pack
     (``decode_burst``) and the traced unpack (``_make_burst_fn``) read
@@ -46,6 +58,11 @@ def _burst_layout(ms, mb, lora=False, sampled=False):
     format is byte-identical to the pre-feature one."""
     fields = [("tokens0", ms), ("token_seq", ms), ("pos0", ms),
               ("tables", (ms + 1) * mb)]
+    if async_entry:
+        # pipelined bursts chain entry tokens on DEVICE (the previous
+        # burst's last output row rides in as a separate argument), so
+        # the packed vector drops the host tokens0 field entirely
+        fields = fields[1:]
     if lora:
         fields.append(("seq_adapters", ms + 1))
     if sampled:
@@ -76,6 +93,74 @@ def _verify_layout(ms, mb, d, lora=False, sampled=False):
         lay[name] = (o, o + size)
         o += size
     return lay
+
+
+class AsyncBurstHandle:
+    """One dispatched-but-unfenced pipelined decode burst.
+
+    ``out`` is the device ``[k, max_seqs]`` token array the burst's
+    scan produced (a future under JAX async dispatch — holding it costs
+    nothing); ``out[-1]`` is the next burst's device entry row and
+    ``st`` (sampled bursts only) the chained DFA state row. ``fetch()``
+    performs THE one device→host copy for the burst; until then the
+    host knows nothing about the burst's tokens — EOS, accept counts
+    and the token log are all discovered one burst late, when the
+    scheduler fences.
+
+    Pump-thread only (it is part of the engine step surface)."""
+
+    def __init__(self, engine, uids, descs, k, out, st=None,
+                 entry_np=None, prev=None):
+        self.uids = list(uids)
+        self.k = int(k)
+        self.out = out            # device [k, max_seqs] int32
+        self.st = st              # device [max_seqs] chained DFA state (sampled)
+        self._engine = engine
+        self._descs = descs
+        self._entry_np = entry_np  # host entry tokens, or None when chained
+        self._prev = prev          # previous handle in the device chain
+        self._toks = None
+
+    @property
+    def entry_next(self):
+        """Device entry row for the next chained burst (no sync)."""
+        return self.out[-1]
+
+    def entry_values(self):
+        """Host values of this burst's entry tokens ([n] np.int32). For
+        a chained burst this reads the PREVIOUS handle's fetched output
+        — in-order fencing makes that a no-op re-read, never an early
+        sync of a younger burst."""
+        if self._entry_np is None:
+            self._entry_np = self._prev.fetch()[-1][:len(self.uids)]
+        return self._entry_np
+
+    def fetch(self):
+        """THE one device→host copy for this burst → np.int32 [k, n].
+        Idempotent; also counts the engine's per-burst sync site. After
+        the copy the handle drops its device buffer and its ``_prev``
+        link (resolving the host entry row first — in-order fencing
+        makes that a cached re-read), so a long pipeline never chains
+        unbounded memory."""
+        if self._toks is None:
+            self._engine.count_host_sync()
+            self._toks = np.asarray(self.out)[:, :len(self.uids)]  # ds-lint: disable=host-sync -- THE one intended sync per pipelined burst, paid at fence time
+            self.out = None
+            if self._prev is not None:
+                if self._entry_np is None:
+                    self._entry_np = self._prev.fetch()[-1][:len(self.uids)]
+                self._prev = None
+        return self._toks
+
+    def fence_logs(self):
+        """Materialize the pending token-log segments of every sequence
+        this burst touched. NOTE: a descriptor's log fences in append
+        order ACROSS bursts, so this forces the fetch of any younger
+        in-flight burst over the same rows — call it at drain time (or
+        let flush/suspend/propose_drafts fence lazily), never from the
+        steady-state fence loop."""
+        for desc in self._descs:
+            desc.tokens.fence()
 
 
 class InferenceEngineV2:
@@ -373,6 +458,22 @@ class InferenceEngineV2:
         self._burst_fns = OrderedDict()
         self._burst_fn_cap = max(1, int(self._config.burst_fn_cache_cap))
         self.burst_fn_evictions = 0
+        # Pipelined (double-buffered) decode bursts: schedulers consult
+        # this to run the async dispatch/fence pump instead of the
+        # fetch-every-burst loop. OFF state: every pre-pipeline code
+        # path below is untouched — byte-identical program keys.
+        self.async_burst = async_burst_enabled(self._config.async_burst)
+        self.async_burst_depth = max(1, int(getattr(
+            self._config.async_burst, "depth", 2)))
+        # Host-sync accounting: host_syncs increments at every pragma'd
+        # host-sync site EXECUTION (the graft-lint host-sync rule maps
+        # the sites; the counter measures how often serving actually
+        # pays them); tokens_emitted counts tokens handed to callers as
+        # per-sequence step/burst outputs. Their ratio is the
+        # syncs_per_generated_token the serving lanes report — the
+        # number the pipelined pump exists to drive toward 1/k.
+        self.host_syncs = 0
+        self.tokens_emitted = 0
         self._suspended = {}  # uid -> {"handle": host KV, "seen_tokens": int}
         # Counter-PRNG root for sampling: every sampled token's key folds
         # (request seed, absolute position) into this DS_SEED-derived
@@ -494,6 +595,7 @@ class InferenceEngineV2:
             mode = "packed"  # greedy rows still need the DFA mask rows
             specs = specs if specs is not None else [None] * len(batch_uids)
         # host-side list→array prep on caller-provided tokens, no device sync
+        self.count_host_sync()
         batch_tokens = [np.atleast_1d(np.asarray(t, np.int32)) for t in batch_tokens]  # ds-lint: disable=host-sync -- input tokens are host lists, never device arrays
         # Validate the WHOLE batch before touching any sequence state: a
         # mid-loop failure after allocate/advance would leave earlier
@@ -540,7 +642,11 @@ class InferenceEngineV2:
             desc.advance(len(tokens))
             if self._log_tokens:
                 # content log: retire-time insertion into the prefix
-                # trie, and the n-gram drafter's lookup corpus
+                # trie, and the n-gram drafter's lookup corpus. A host
+                # append must land AFTER any pending device segments
+                # from drained pipelined bursts, so fence first (a
+                # cached re-read once the scheduler has fetched them)
+                desc.tokens.fence()
                 desc.tokens.extend(int(t) for t in tokens)
             slots.append(desc.slot)
         # decode bucket: a batch of ≤ max_seqs tokens (pure decode round)
@@ -579,6 +685,8 @@ class InferenceEngineV2:
             fn = self._step_greedy if mode == "greedy" else self._step
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, arrays, *extra)
+        self.count_host_sync()
+        self.tokens_emitted += len(batch_uids)
         return np.asarray(out)[np.asarray(slots)]  # ds-lint: disable=host-sync -- THE one intended sync per step: callers consume host tokens/logits
 
     def _classify_sample(self, sample, n):
@@ -616,6 +724,22 @@ class InferenceEngineV2:
                          f"{{'temperature', 'top_k', 'top_p', 'seed'}}, or a "
                          f"per-sequence list of dict/None")
 
+    def count_host_sync(self, n=1):
+        """Record ``n`` executions of a pragma'd host-sync site. Every
+        place the graft-lint host-sync rule allows a sync (the inline
+        ``ds-lint: disable=host-sync`` pragmas) increments this when it
+        actually runs, so ``syncs_per_generated_token`` measures the
+        live sync tax — not the static site count."""
+        self.host_syncs += n
+
+    @property
+    def syncs_per_generated_token(self):
+        """Pragma'd host-sync site executions per emitted token — the
+        serving lanes' headline sync-tax metric. The stepwise loop pays
+        ~2/token, a fetched-every-burst loop ~(n+1)/(n*k), and the
+        pipelined pump ~1/(n*k)."""
+        return round(self.host_syncs / max(self.tokens_emitted, 1), 4)
+
     def draw_seed(self):
         """One per-request sampling seed from the engine's deterministic
         DS_SEED-rooted stream — the compatibility path for specs
@@ -625,6 +749,7 @@ class InferenceEngineV2:
         so cross-replica replay never depends on engine-local stream
         order."""
         self._rng, sub = jax.random.split(self._rng)
+        self.count_host_sync()
         return int(jax.random.randint(sub, (), 0, 2 ** 31 - 1))  # ds-lint: disable=host-sync -- per-request seed resolution is a host decision
 
     # ---------------------------------------------- constrained decoding
@@ -771,6 +896,7 @@ class InferenceEngineV2:
                 desc.adapter_slot = self.lora_store.slot_of(desc.uid)
                 adapters[i] = desc.adapter_slot
             self.state_manager.allocate_for(desc, k)
+            self.count_host_sync()
             tokens0[i] = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous burst's host copy
             token_seq[i] = i
             pos0[i] = desc.seen_tokens
@@ -817,6 +943,8 @@ class InferenceEngineV2:
             out, self.kv_cache.k, self.kv_cache.v = fn(
                 self.params, self.kv_cache.k, self.kv_cache.v, meta,
                 *sargs, *extra)
+        self.count_host_sync()
+        self.tokens_emitted += k * len(batch_uids)
         toks = np.asarray(out)[:, :len(batch_uids)]  # ds-lint: disable=host-sync -- THE one intended sync per k-step burst
         if self._log_tokens:
             # log what the burst actually WROTE to the KV cache: step i
@@ -827,11 +955,153 @@ class InferenceEngineV2:
             # cache is content-addressed, so post-EOS tokens just hash to
             # prefixes nobody asks for.
             for i, desc in enumerate(descs):
+                desc.tokens.fence()  # order after drained pipelined segments
                 desc.tokens.append(int(tokens0[i]))
                 desc.tokens.extend(int(t) for t in toks[:-1, i])
         return toks
 
-    def _make_burst_fn(self, k, skey=None):
+    def decode_burst_async(self, batch_uids, batch_tokens, k, sample=None,
+                           prev=None):
+        """Pipelined ``decode_burst``: dispatches the k-step burst and
+        returns an :class:`AsyncBurstHandle` WITHOUT any device→host
+        copy — the caller fences one burst late, so the host packs and
+        dispatches burst k+1 while burst k executes.
+
+        ``prev=None`` is the pipeline cold start: entry tokens come from
+        ``batch_tokens`` (host ints, e.g. ``put()``'s last outputs).
+        With ``prev`` set, entry tokens chain ON DEVICE from the
+        previous handle's last output row (``prev.entry_next``) and
+        ``batch_tokens`` is ignored — the uid order must match ``prev``
+        exactly (the scheduler drains the pipeline whenever the live set
+        changes). Sampled chains also carry the DFA state row from
+        ``prev.st``, so constrained streams stay bit-identical to the
+        sync path. Token-log segments are appended as pending DEVICE
+        segments (:meth:`TokenLog.append_device`); prefix-cache retire,
+        suspend and handoff export fence them lazily."""
+        k = int(k)
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if prev is not None and list(prev.uids) != list(batch_uids):
+            raise ValueError(
+                "chained async burst must keep its predecessor's uid "
+                "order — drain the pipeline when the live set changes")
+        mode, specs = self._classify_sample(sample, len(batch_uids))
+        if self.structured is not None and \
+                any(self.structured.bound(u) for u in batch_uids):
+            mode = "packed"
+            specs = specs if specs is not None else [None] * len(batch_uids)
+        sampled = mode == "packed"
+        if sampled and prev is not None and prev.st is None:
+            raise ValueError(
+                "sampled async burst chained onto a greedy handle — "
+                "drain the pipeline before changing decode mode")
+        if len(batch_uids) > self.max_seqs:
+            raise ValueError(f"{len(batch_uids)} sequences > "
+                             f"max_ragged_sequence_count={self.max_seqs}")
+        from deepspeed_tpu.inference.v2.ragged.kv_cache import NULL_BLOCK
+        ms = self.max_seqs
+        descs, err = self._validate_burst(batch_uids, k)
+        if err is not None:
+            raise err
+
+        lora_on = self.lora_store is not None
+        token_seq = np.full(ms, ms, np.int32)   # pad rows write the null slot
+        pos0 = np.zeros(ms, np.int32)
+        tables = np.full((ms + 1, self.max_blocks_per_seq), NULL_BLOCK, np.int32)
+        adapters = np.zeros(ms + 1, np.int32)
+        for i, desc in enumerate(descs):
+            desc.slot = i
+            if lora_on:
+                desc.adapter_slot = self.lora_store.slot_of(desc.uid)
+                adapters[i] = desc.adapter_slot
+            self.state_manager.allocate_for(desc, k)
+            token_seq[i] = i
+            pos0[i] = desc.seen_tokens
+            tables[i, :len(desc.blocks)] = desc.blocks
+            desc.advance(k)
+        parts = [token_seq, pos0, tables.ravel()]
+        if lora_on:
+            parts.append(adapters)
+        st0 = None
+        if sampled:
+            for s in specs:
+                if s is not None and "seed" not in s:
+                    s["seed"] = self.draw_seed()
+            dfa = None
+            if self.structured is not None:
+                dfa = [(self.structured.slot_of(u), self.structured.state_of(u))
+                       for u in batch_uids]
+            parts.append(pack_sample_meta(specs, ms, dfa=dfa))
+            if prev is not None:
+                st0 = prev.st  # device chain — host DFA mirror lags one burst
+            else:
+                st_np = np.zeros(ms, np.int32)
+                if dfa is not None:
+                    for i, (_, state) in enumerate(dfa):
+                        st_np[i] = int(state)
+                st0 = jax.device_put(st_np, self._replicated) \
+                    if self.mesh is not None else jnp.asarray(st_np)
+        meta = np.concatenate(parts)
+        assert meta.shape[0] == sum(e - s for s, e in _burst_layout(
+            ms, self.max_blocks_per_seq, lora=lora_on, sampled=sampled,
+            async_entry=True).values())
+        if self.mesh is not None:
+            meta = jax.device_put(meta, self._replicated)
+        entry_np = None
+        if prev is not None:
+            entry = prev.entry_next  # device row, no sync
+        else:
+            entry_full = np.zeros(ms, np.int32)
+            for i, tok in enumerate(batch_tokens):
+                entry_full[i] = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- cold-start entries are host ints (put()'s already-fetched outputs), not device data
+            entry_np = entry_full[:len(batch_uids)].copy()
+            entry = jax.device_put(entry_full, self._replicated) \
+                if self.mesh is not None else jnp.asarray(entry_full)
+        # "aburst" keys are disjoint from the sync "burst" keys by
+        # construction, so DS_ASYNC_BURST=0 replays byte-identical keys
+        skey = "sampled" if sampled else None
+        key = ("aburst", k, skey)
+        if sampled and self.structured is not None:
+            key = key + (("dfa",) + self.structured.signature(),)
+        if lora_on:
+            key = key + (self.lora_store.signature(),)
+        fn = self._get_burst_fn(
+            key, lambda: self._make_burst_fn(k, skey, async_entry=True))
+        extra = (self.lora_store.slabs(),) if lora_on else ()
+        st = None
+        if skey is None:
+            out, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, meta,
+                entry, *extra)
+        else:
+            sargs = (self._base_key,)
+            if self.structured is not None:
+                sargs += (self.structured.slabs(),)
+            out, st, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, meta,
+                entry, st0, *sargs, *extra)
+        self.tokens_emitted += k * len(batch_uids)
+        handle = AsyncBurstHandle(self, batch_uids, descs, k, out, st=st,
+                                  entry_np=entry_np, prev=prev)
+        if self._log_tokens:
+            # KV content over [seen, seen+k) = the entry token plus the
+            # first k-1 outputs, exactly like the sync path — but it
+            # stays a pending DEVICE segment until something fences
+            for i, desc in enumerate(descs):
+                desc.tokens.append_device(
+                    lambda i=i, h=handle:
+                        [int(h.entry_values()[i])]
+                        + [int(t) for t in h.fetch()[:-1, i]])
+        return handle
+
+    def _make_burst_fn(self, k, skey=None, async_entry=False):
+        """``async_entry=False``: the classic burst program (host entry
+        tokens ride the meta vector; returns ``out, kc, vc``).
+        ``async_entry=True``: the pipelined variant — entry tokens (and,
+        sampled, the DFA state row) arrive as DEVICE arrays chained from
+        the previous burst's outputs, so the host packs burst k+1
+        without ever reading burst k; sampled async programs also return
+        the final DFA state row for the next link."""
         from deepspeed_tpu.inference.v2.model_runner import ragged_forward
         cfg, dtype, mesh = self.model_config, self.dtype, self.mesh
         attn_impl = (self._config.implementation_overrides or {}).get("attention")
@@ -841,12 +1111,14 @@ class InferenceEngineV2:
         sampled = skey == "sampled"
         structured_on = sampled and self.structured is not None
 
-        def burst(p, kc, vc, meta, base=None, slabs=None, lora_slabs=None):
+        def burst(p, kc, vc, meta, entry=None, st0=None,
+                  base=None, slabs=None, lora_slabs=None):
             if quantized:
                 from deepspeed_tpu.inference.quantization import dequantize_tree_except
                 p = dequantize_tree_except(p, dtype)  # once per burst, not per step
-            lay = _burst_layout(ms, mb, lora=lora_on, sampled=sampled)
-            tokens0 = meta[slice(*lay["tokens0"])]
+            lay = _burst_layout(ms, mb, lora=lora_on, sampled=sampled,
+                                async_entry=async_entry)
+            tokens0 = entry if async_entry else meta[slice(*lay["tokens0"])]
             token_seq = meta[slice(*lay["token_seq"])]
             pos0 = meta[slice(*lay["pos0"])]
             tables = meta[slice(*lay["tables"])].reshape(ms + 1, mb)
@@ -874,6 +1146,11 @@ class InferenceEngineV2:
 
             temp, topk, topp, seed, slot, state0 = unpack_sample_meta(
                 meta[slice(*lay["sample_meta"])], ms)
+            if async_entry:
+                # DFA state chains on device from the previous burst's
+                # final state row; the meta copy is only the cold-start
+                # value the engine materializes for the first link
+                state0 = st0
 
             def one(carry, i):
                 kc, vc, toks, st = carry
@@ -892,27 +1169,49 @@ class InferenceEngineV2:
                     st = slabs[1][slot, st, nxt]  # in-scan DFA advance
                 return (kc, vc, nxt, st), nxt
 
-            (kc, vc, _, _), out = jax.lax.scan(one, (kc, vc, tokens0, state0),
-                                               jnp.arange(k, dtype=jnp.int32))
+            (kc, vc, _, st_f), out = jax.lax.scan(one, (kc, vc, tokens0, state0),
+                                                  jnp.arange(k, dtype=jnp.int32))
+            if async_entry:
+                return out, st_f, kc, vc
             return out, kc, vc
 
         # explicit arity wrappers: callers pass everything positionally,
         # so the slab pytrees must never land in the wrong parameter
-        if not sampled and lora_on:
+        if async_entry:
+            if not sampled and lora_on:
+                fn = lambda p, kc, vc, meta, entry, lslabs: \
+                    burst(p, kc, vc, meta, entry, lora_slabs=lslabs)
+            elif not sampled:
+                fn = lambda p, kc, vc, meta, entry: \
+                    burst(p, kc, vc, meta, entry)
+            elif structured_on and lora_on:
+                fn = burst
+            elif structured_on:
+                fn = lambda p, kc, vc, meta, entry, st0, base, slabs: \
+                    burst(p, kc, vc, meta, entry, st0, base, slabs)
+            elif lora_on:
+                fn = lambda p, kc, vc, meta, entry, st0, base, lslabs: \
+                    burst(p, kc, vc, meta, entry, st0, base, lora_slabs=lslabs)
+            else:
+                fn = lambda p, kc, vc, meta, entry, st0, base: \
+                    burst(p, kc, vc, meta, entry, st0, base)
+        elif not sampled and lora_on:
             fn = lambda p, kc, vc, meta, lslabs: \
-                burst(p, kc, vc, meta, None, None, lslabs)
+                burst(p, kc, vc, meta, lora_slabs=lslabs)
         elif not sampled:
             fn = lambda p, kc, vc, meta: burst(p, kc, vc, meta)
         elif structured_on and lora_on:
-            fn = burst
+            fn = lambda p, kc, vc, meta, base, slabs, lslabs: \
+                burst(p, kc, vc, meta, base=base, slabs=slabs,
+                      lora_slabs=lslabs)
         elif structured_on:
             fn = lambda p, kc, vc, meta, base, slabs: \
-                burst(p, kc, vc, meta, base, slabs)
+                burst(p, kc, vc, meta, base=base, slabs=slabs)
         elif lora_on:
             fn = lambda p, kc, vc, meta, base, lslabs: \
-                burst(p, kc, vc, meta, base, None, lslabs)
+                burst(p, kc, vc, meta, base=base, lora_slabs=lslabs)
         else:
-            fn = lambda p, kc, vc, meta, base: burst(p, kc, vc, meta, base)
+            fn = lambda p, kc, vc, meta, base: burst(p, kc, vc, meta, base=base)
         return maybe_checkify_jit(fn, donate_argnums=(1, 2),
                                   enabled=self._sanitize)
 
@@ -935,7 +1234,11 @@ class InferenceEngineV2:
             if desc is None or cap < 1:
                 out.append([])
                 continue
+            self.count_host_sync()
             entry = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous step's host copy
+            # the drafter reads the WHOLE content log — any pending
+            # device segments must land first (no-op when fenced)
+            desc.tokens.fence()
             out.append(self.spec.drafter.propose(desc.tokens + [entry], cap))
         return out
 
@@ -1010,6 +1313,7 @@ class InferenceEngineV2:
                 desc.adapter_slot = self.lora_store.slot_of(desc.uid)
                 adapters[i] = desc.adapter_slot
             self.state_manager.allocate_for(desc, d + 1)
+            self.count_host_sync()
             entry = int(np.asarray(tok).reshape(-1)[-1])  # ds-lint: disable=host-sync -- entry tokens come from the previous step's host copy
             entries.append(entry)
             row = [entry] + [int(t) for t in drafts]
@@ -1036,19 +1340,38 @@ class InferenceEngineV2:
         # the verify must see the SAME adapter deltas decode does, or
         # acceptance silently diverges from stepwise decoding
         key = ("verify", d) if not sampled else ("verify", d, "sampled")
+        if self.async_burst:
+            # one-fetch-per-burst: the program concatenates tokens and
+            # accept counts into ONE int32 vector, so the host pays a
+            # single device→host copy instead of two. A distinct key —
+            # the off state keeps the exact pre-pipeline keys/programs.
+            key = key + ("packed",)
         if lora_on:
             key = key + (self.lora_store.signature(),)
-        fn = self._get_burst_fn(key, lambda: self._make_verify_fn(d, sampled))
+        packed = self.async_burst
+        fn = self._get_burst_fn(
+            key, lambda: self._make_verify_fn(d, sampled, packed=packed))
         extra = (self.lora_store.slabs(),) if lora_on else ()
         sargs = (self._base_key,) if sampled else ()
-        out, acc, self.kv_cache.k, self.kv_cache.v = fn(
-            self.params, self.kv_cache.k, self.kv_cache.v, meta,
-            *sargs, *extra)
-        out = np.asarray(out)  # ds-lint: disable=host-sync -- THE one intended sync per verify burst
-        acc = np.asarray(acc)  # host copy of the device result above, already synced
+        if packed:
+            wire, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, meta,
+                *sargs, *extra)
+            self.count_host_sync()
+            wire = np.asarray(wire)  # ds-lint: disable=host-sync -- THE one intended sync per verify burst (packed tokens + accept counts)
+            out = wire[:ms * (d + 1)].reshape(ms, d + 1)
+            acc = wire[ms * (d + 1):].astype(np.int64)
+        else:
+            out, acc, self.kv_cache.k, self.kv_cache.v = fn(
+                self.params, self.kv_cache.k, self.kv_cache.v, meta,
+                *sargs, *extra)
+            self.count_host_sync(2)
+            out = np.asarray(out)  # ds-lint: disable=host-sync -- THE one intended sync per verify burst
+            acc = np.asarray(acc)  # ds-lint: disable=host-sync -- host copy of the device result above, already synced
         n = len(batch_uids)
         for i, desc in enumerate(descs):
             a = int(acc[i])
+            self.tokens_emitted += a + 1
             # KV positions [seen, seen+a] hold the entry token and the a
             # accepted drafts; the bonus token out[i, a] is the NEXT
             # step's entry and was never written (same convention as the
@@ -1056,6 +1379,7 @@ class InferenceEngineV2:
             # unused trailing blocks.
             desc.advance(a + 1)
             if self._log_tokens:
+                desc.tokens.fence()  # order after drained pipelined segments
                 desc.tokens.append(entries[i])
                 desc.tokens.extend(int(t) for t in out[i, :a])
             self.state_manager.release_unused_blocks(desc)
@@ -1063,7 +1387,7 @@ class InferenceEngineV2:
                 self.spec.note(desc.uid, accepted=a, drafted=int(dlen[i]))
         return out[:n], acc[:n]
 
-    def _make_verify_fn(self, d, sampled=False):
+    def _make_verify_fn(self, d, sampled=False, packed=False):
         """One compiled verify program for draft length ``d``: a single
         ragged forward over ``max_seqs * (d+1)`` packed tokens
         (``last_index = arange`` selects EVERY token's logits, so no
@@ -1130,6 +1454,11 @@ class InferenceEngineV2:
             # residual distribution at a mismatch is the target draw.
             match = (toks[:, 1:] == nxt[:, :-1]) & (steps[None, :d] < dlen[:, None])
             acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            if packed:
+                # one device→host copy per verify burst: tokens and
+                # accept counts leave as a single int32 wire vector
+                return jnp.concatenate(
+                    [nxt.reshape(-1), acc.astype(jnp.int32)]), kc, vc
             return nxt, acc, kc, vc
 
         if not sampled and lora_on:
@@ -1298,7 +1627,11 @@ class InferenceEngineV2:
         sequence whose client went away could never be retired: resume
         needs pool room, which is exactly what the suspend relieved)."""
         suspended = self._suspended.pop(uid, None) is not None
-        if self.state_manager.query(uid) is not None:
+        desc = self.state_manager.query(uid)
+        if desc is not None:
+            # prefix-cache retire content-addresses blocks by the token
+            # log — materialize any pending device segments first
+            desc.tokens.fence()
             self.state_manager.flush_sequence(uid)
         elif not suspended:
             raise KeyError(f"unknown sequence {uid}")
@@ -1326,6 +1659,9 @@ class InferenceEngineV2:
         # resumed sequence gets private copies — correct, at the price of
         # re-duplicating a prefix that may still be cache-resident.
         shared = desc.blocks[:desc.shared_blocks]
+        # the host copy must carry the WHOLE token log — materialize any
+        # pending device segments before snapshotting it
+        desc.tokens.fence()
         handle = self.kv_cache.offload(desc.blocks, keep=shared)
         if self.prefix_cache is not None:
             self.prefix_cache.release_lease(uid)

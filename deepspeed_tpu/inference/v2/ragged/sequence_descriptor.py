@@ -9,6 +9,97 @@ in the (fixed-size) batch tables."""
 import numpy as np
 
 
+class UnfencedTokenLogError(RuntimeError):
+    """A host read (or host mutation) of a token log that still has
+    device-resident segments pending. Under ``DS_ASYNC_BURST`` the
+    engine appends burst outputs to the log as *device* segments — the
+    host materializes them one burst late, when the pipeline fences.
+    Any consumer of KV content (prefix-cache retire, tier/handoff
+    export, suspend, the n-gram drafter) must go through
+    ``TokenLog.fence()`` first; reading around the fence would
+    content-address KV whose token identity is not on the host yet."""
+
+
+class TokenLog(list):
+    """The per-sequence KV-content token log: a host int list plus an
+    ordered tail of *pending device segments* (zero-arg thunks that
+    materialize to ``list[int]``, appended by the async burst path).
+
+    Fenced (no pending segments) it behaves exactly like the plain list
+    it replaces — every pre-pipeline call site works unchanged. While
+    segments are pending, host reads and host mutations raise
+    :class:`UnfencedTokenLogError`: the log's tail only exists on
+    device, so iterating/slicing/extending it would silently desync the
+    log from the KV content it is supposed to mirror. ``fence()``
+    materializes the pending tail in order (the underlying device
+    arrays are shared with the scheduler's burst fetch, so fencing
+    after the pipeline fence is pure host work).
+
+    Pump-thread owned, like the descriptor itself: appends happen on
+    the engine step path and fences on the same thread (engine.flush /
+    rewind / suspend / propose_drafts all fence before reading)."""
+
+    def __init__(self, items=()):
+        super().__init__(items)
+        self._pending = []
+
+    # ------------------------------------------------- device-segment API
+    @property
+    def pending(self):
+        """True while device segments are waiting to materialize."""
+        return bool(self._pending)
+
+    def append_device(self, thunk):
+        """Queue one device-resident segment: ``thunk()`` → list[int],
+        called at fence time in append order. No device sync here."""
+        self._pending.append(thunk)
+
+    def fence(self):
+        """Materialize every pending device segment into the host list
+        (in order). Idempotent; returns self."""
+        while self._pending:
+            thunk = self._pending.pop(0)
+            super().extend(int(t) for t in thunk())
+        return self
+
+    def _guard(self, op):
+        if self._pending:
+            raise UnfencedTokenLogError(
+                f"token-log {op} with {len(self._pending)} device "
+                f"segment(s) pending — fence() the log (or drain the "
+                f"burst pipeline) before reading KV content")
+
+    # ---------------------------------------------------- guarded reads
+    def __iter__(self):
+        self._guard("iteration")
+        return super().__iter__()
+
+    def __len__(self):
+        self._guard("len()")
+        return super().__len__()
+
+    def __getitem__(self, idx):
+        self._guard("indexing")
+        return super().__getitem__(idx)
+
+    def __add__(self, other):
+        self._guard("concatenation")
+        return [*super().__iter__(), *other]
+
+    # ------------------------------------------------ guarded mutations
+    def append(self, item):
+        self._guard("append")
+        super().append(item)
+
+    def extend(self, items):
+        self._guard("extend")
+        super().extend(items)
+
+    def __delitem__(self, idx):
+        self._guard("truncation")
+        super().__delitem__(idx)
+
+
 class DSSequenceDescriptor:
 
     def __init__(self, uid: int, block_size: int, slot: int = -1):
@@ -30,7 +121,18 @@ class DSSequenceDescriptor:
         # token ids written to the KV cache, in order (== KV content over
         # [0, seen_tokens)); the engine records these only when a prefix
         # cache is attached, so retire can content-address the blocks
-        self.tokens = []
+        self.tokens = TokenLog()
+
+    @property
+    def tokens(self):
+        return self._tokens
+
+    @tokens.setter
+    def tokens(self, value):
+        # every assignment rebuilds a TokenLog, so the async burst path
+        # can always append device segments regardless of which call
+        # site (creation, resume, prefix-cache lease) last replaced it
+        self._tokens = value if isinstance(value, TokenLog) else TokenLog(value)
 
     @property
     def cur_allocated_blocks(self) -> int:
@@ -58,6 +160,7 @@ class DSSequenceDescriptor:
             raise ValueError(f"cannot rewind {n_tokens} of "
                              f"{self.seen_tokens} seen tokens")
         self.seen_tokens -= n_tokens
+        self.tokens.fence()  # a truncation must see the whole log
         if len(self.tokens) > self.seen_tokens:
             del self.tokens[self.seen_tokens:]
 

@@ -8,7 +8,7 @@ remaining budget is filled with chunks of pending prompts — long
 prompts are SPLIT across steps, decodes are FUSED into prefill steps,
 so step latency stays flat and the MXU stays fed."""
 
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -41,6 +41,10 @@ class Request:
         self.prefix_checked = False
         self.generated = []
         self.next_token = None  # decode token awaiting scheduling
+        # pipelined (async) bursts: tokens dispatched to the device but
+        # not yet fenced/accepted — ``len(generated) + _inflight`` is the
+        # request's true generation frontier while bursts are in flight
+        self._inflight = 0
         self.done = False
         # paused requests hold scheduler state but take no step work —
         # their KV may be suspended to host (gateway preemption)
@@ -91,6 +95,15 @@ class DynamicSplitFuseScheduler:
         # the serving gateway's streaming hook. None = no streaming.
         self.on_token = on_token
         self.requests = OrderedDict()  # uid -> Request
+        # pipelined bursts (DS_ASYNC_BURST): the pump dispatches burst
+        # k+1 while burst k executes on device and fences one burst
+        # late. Only meaningful for on-device sampling with bursting on;
+        # the off state never touches the pipeline — step() runs the
+        # exact pre-pipeline loop.
+        self.async_burst = bool(getattr(engine, "async_burst", False)) \
+            and self._device_greedy and self.max_burst >= 2
+        self.async_depth = max(1, int(getattr(engine, "async_burst_depth", 2)))
+        self._pipeline = deque()  # (AsyncBurstHandle, [Request]) oldest first
 
     def add_request(self, uid, prompt_tokens, max_new_tokens=16, priority=0,
                     spec=True, adapter_id=None, sample=None, schema=None):
@@ -161,6 +174,7 @@ class DynamicSplitFuseScheduler:
         r = self.requests.get(uid)
         if r is None:
             raise KeyError(f"unknown request {uid}")
+        self._drain_if_inflight(r)
         if not r.done:
             r.done = True
             r.next_token = None
@@ -191,6 +205,10 @@ class DynamicSplitFuseScheduler:
             raise KeyError(f"unknown request {uid}")
         if r.done or r.paused:
             raise ValueError(f"request {uid} is not pausable (done={r.done})")
+        self._drain_if_inflight(r)
+        if r.done:
+            raise ValueError(f"request {uid} finished while its pipelined "
+                             f"bursts drained — not pausable")
         r.paused = True
         if self.engine.query(uid) is not None:
             self.engine.suspend(uid)
@@ -389,11 +407,147 @@ class DynamicSplitFuseScheduler:
         if self.on_token is not None:
             self.on_token(r.uid, tok, r.done)
 
+    # ---------------------------------------------- pipelined (async) bursts
+    def _drain_if_inflight(self, r):
+        """Settle the whole pipeline when ``r`` has unfenced bursts in
+        it (cancel/pause must observe the request's final state)."""
+        if r._inflight:
+            self._drain_pipeline()
+
+    def _plan_async_k(self, rows):
+        """Burst length for the next pipeline link, or None when the
+        burst path no longer applies. Mirrors :meth:`_try_burst`'s k
+        computation exactly, with ``_inflight`` standing in for the
+        not-yet-fenced generated tokens (the engine's ``seen_tokens``
+        already advanced at dispatch, so the context-room term needs no
+        correction)."""
+        if len(rows) > self.budget or len(rows) > self.engine.max_seqs:
+            return None
+        k = min(self.max_burst,
+                min(r.max_new_tokens - len(r.generated) - r._inflight
+                    for r in rows),
+                min(self.engine.max_ctx_tokens - self.engine.query(r.uid)[0]
+                    for r in rows))
+        if k < 2:
+            return None
+        return 1 << (k.bit_length() - 1)  # power-of-two, see _try_burst
+
+    def _accept_async(self, r, tok):
+        """Fence-time accept: exactly :meth:`_accept_token` minus the
+        completion-side engine work (rewind/flush), which MUST wait for
+        the full pipeline drain — younger bursts are still executing
+        over this sequence's KV reservation."""
+        r._inflight -= 1
+        r.generated.append(tok)
+        if r.schema is not None:
+            self.engine.advance_schema(r.uid, tok)
+        if (self.eos_token_id is not None and tok == self.eos_token_id) \
+                or len(r.generated) >= r.max_new_tokens:
+            r.done = True
+            r.next_token = None
+        else:
+            r.next_token = tok
+        if self.on_token is not None:
+            self.on_token(r.uid, tok, r.done)
+
+    def _fence_one(self):
+        """Fence the OLDEST in-flight burst (the one device→host copy it
+        ever pays) and accept its tokens; post-EOS rows skip the tail —
+        their ``_inflight`` debt is rewound at drain time."""
+        handle, rows = self._pipeline.popleft()
+        toks = handle.fetch()
+        for step_i in range(handle.k):
+            for j, r in enumerate(rows):
+                if r.done:
+                    continue  # finished mid-pipeline; tail is debt
+                self._accept_async(r, int(toks[step_i, j]))
+        return [r.uid for r in rows]
+
+    def _drain_pipeline(self):
+        """Fence every in-flight burst in dispatch order, then settle
+        finished rows: rewind the speculatively-dispatched tail
+        (``_inflight`` debt — KV positions past EOS/max_new) and flush,
+        matching what the sync paths do per-burst at accept time."""
+        uids = []
+        settled = []
+        while self._pipeline:
+            _, rows = self._pipeline[0]
+            uids = self._fence_one()
+            for r in rows:
+                if r not in settled:
+                    settled.append(r)
+        for r in settled:
+            if r.done:
+                if r._inflight:
+                    self.engine.rewind(r.uid, r._inflight)
+                    r._inflight = 0
+                self.engine.flush(r.uid)
+        return uids
+
+    def _pipeline_rows(self):
+        return self._pipeline[-1][1]
+
+    def _continue_pipeline(self):
+        """Pipeline non-empty: dispatch the next chained burst (host
+        packs while the device runs), then fence one burst late. Any
+        condition that breaks the chain — live set changed, tail too
+        short, pool too tight, a fenced row finished — drains."""
+        rows = self._pipeline_rows()
+        live = self._live()
+        chainable = live == rows and not any(r.done for r in rows)
+        k = self._plan_async_k(rows) if chainable else None
+        uids = [r.uid for r in rows]
+        if k is None or not self.engine.can_burst(uids, k):
+            return self._drain_pipeline()
+        handle = self.engine.decode_burst_async(
+            uids, None, k, sample=self._sample_arg(rows),
+            prev=self._pipeline[-1][0])
+        for r in rows:
+            r._inflight += k
+        self._pipeline.append((handle, rows))
+        if len(self._pipeline) > self.async_depth:
+            self._fence_one()
+            if any(r.done for r in rows):
+                self._drain_pipeline()  # EOS discovered one burst late
+        return uids
+
+    def _try_async_start(self):
+        """Pipeline cold start: same applicability test as
+        :meth:`_try_burst`, but the burst is dispatched WITHOUT a fetch
+        — the fence lands ``async_depth`` bursts later."""
+        live = self._live()
+        if (not live or len(live) > self.engine.max_seqs
+                or len(live) > self.budget
+                or any(r.next_token is None for r in live)):
+            return None
+        k = self._plan_async_k(live)
+        if k is None:
+            return None
+        uids = [r.uid for r in live]
+        if not self.engine.can_burst(uids, k):
+            return None  # tight pool: fall back, see _try_burst
+        handle = self.engine.decode_burst_async(
+            uids, [[r.next_token] for r in live], k,
+            sample=self._sample_arg(live))
+        for r in live:
+            r.next_token = None
+            r._inflight += k
+        self._pipeline.append((handle, live))
+        return uids
+
     def step(self):
         """Schedule + run one engine step; returns the uids stepped."""
+        if self.async_burst and self._pipeline:
+            # in-flight bursts continue (or drain) before anything else
+            # — spec/stepwise paths need the fenced host state
+            return self._continue_pipeline()
         stepped = self._try_spec_burst()
         if stepped is not None:
             return stepped
+        if self.async_burst:
+            stepped = self._try_async_start()
+            if stepped is not None:
+                return stepped
         burst = self._try_burst()
         if burst is not None:
             return burst

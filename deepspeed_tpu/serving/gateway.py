@@ -707,6 +707,14 @@ class ServingGateway:
         lora_store = getattr(self.engine, "lora_store", None)
         if lora_store is not None:
             self.metrics.set_external("Serve/LoRA", lora_store.stats())
+        syncs = getattr(self.engine, "host_syncs", None)
+        if syncs is not None:
+            self.metrics.set_external("Serve/Engine", {
+                "host_syncs": int(syncs),
+                "tokens_emitted": int(self.engine.tokens_emitted),
+                "syncs_per_token": self.engine.syncs_per_generated_token,
+                "async_burst": int(getattr(self.engine, "async_burst", 0)),
+            })
         interval = self.config.metrics_interval_steps
         if self.monitor is not None and interval and did:
             steps = self.metrics.snapshot()["counters"]["engine_steps"]
@@ -823,7 +831,13 @@ class ServingGateway:
             return False
         # lowest priority loses; youngest among ties (oldest keeps running)
         uid, handle = min(reversed(victims), key=lambda it: it[1].priority)
-        self.scheduler.pause(uid)
+        try:
+            self.scheduler.pause(uid)
+        except ValueError:
+            # the pipelined-burst drain inside pause() can discover the
+            # victim already finished — nothing left to preempt; the
+            # normal finish path releases its gate tokens
+            return False
         self.gate.release(len(handle.prompt), handle.max_new_tokens)
         self._paused.append(uid)
         self.metrics.count("preemptions")

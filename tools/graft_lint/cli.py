@@ -17,14 +17,18 @@ import json
 import os
 import sys
 
-from tools.graft_lint.linter import (KNOB_DOCS, RULES, BaselineError,
-                                     Violation, lint_paths, load_baseline)
+from tools.graft_lint.linter import (HOST_SYNC, KNOB_DOCS, RULES,
+                                     BaselineError, Violation,
+                                     count_host_sync_pragmas, lint_paths,
+                                     load_baseline)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 DEFAULT_KNOB_DOCS = os.path.join(REPO_ROOT, "docs", "MIGRATING.md")
+DEFAULT_SYNC_BUDGET = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "host_sync_budget.json")
 
 
 def _load_env_registry():
@@ -95,6 +99,51 @@ def check_knob_docs(docs_path=None):
     return out
 
 
+def check_sync_budget(paths, budget_path=None):
+    """host-sync counted-pragma ratchet: the number of ``disable=
+    host-sync`` pragma sites under ``paths`` may never exceed the
+    recorded budget — every pragma is one deliberate host sync, so
+    growth means a new sync slipped into a hot path. → list of
+    Violations (empty when within budget). A count BELOW budget is
+    clean but prints nothing; tighten with ``--update-sync-budget``."""
+    budget_path = budget_path or DEFAULT_SYNC_BUDGET
+    rel = os.path.relpath(budget_path, REPO_ROOT).replace(os.sep, "/")
+    count = count_host_sync_pragmas(paths)
+    try:
+        with open(budget_path) as fd:
+            data = json.load(fd)
+        if not isinstance(data, dict) or data.get("version") != 1 or \
+                not isinstance(data.get("pragma_budget"), int):
+            raise ValueError("needs {version: 1, pragma_budget: <int>}")
+        budget = data["pragma_budget"]
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        return [Violation(
+            rule=HOST_SYNC, path=rel, line=1, col=0,
+            symbol="<pragma-budget>",
+            message=f"host-sync pragma budget unreadable ({err}) — "
+                    f"record the current count with "
+                    f"`bin/ds_lint --update-sync-budget`")]
+    if count > budget:
+        return [Violation(
+            rule=HOST_SYNC, path=rel, line=1, col=0,
+            symbol="<pragma-budget>",
+            message=f"{count} host-sync pragma site(s) exceed the "
+                    f"recorded budget of {budget} — a new deliberate "
+                    f"sync entered a hot path; remove it, or justify it "
+                    f"in review and raise the budget with "
+                    f"`bin/ds_lint --update-sync-budget`")]
+    return []
+
+
+def write_sync_budget(paths, budget_path=None):
+    budget_path = budget_path or DEFAULT_SYNC_BUDGET
+    count = count_host_sync_pragmas(paths)
+    with open(budget_path, "w") as fd:
+        json.dump({"version": 1, "pragma_budget": count}, fd, indent=2)
+        fd.write("\n")
+    return count
+
+
 def write_baseline(path, violations):
     """Rewrite ``path`` with a suppression entry per current violation
     (sorted, symbol-keyed — line numbers intentionally absent so the
@@ -131,6 +180,9 @@ def main(argv=None):
     parser.add_argument("--check-docs", action="store_true",
                         help="run only the knob-docs rule: diff the env "
                              "knob registry against the MIGRATING.md table")
+    parser.add_argument("--update-sync-budget", action="store_true",
+                        help="record the current host-sync pragma count as "
+                             "the ratchet budget and exit")
     args = parser.parse_args(argv)
 
     if args.list_knobs:
@@ -157,6 +209,11 @@ def main(argv=None):
         return 1 if violations else 0
 
     paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu")]
+    if args.update_sync_budget:
+        count = write_sync_budget(paths)
+        print(f"ds_lint: host-sync pragma budget recorded at {count} "
+              f"site(s) -> {DEFAULT_SYNC_BUDGET}")
+        return 0
     baseline = set()
     if not args.update_baseline and not args.no_baseline \
             and os.path.exists(args.baseline):
@@ -176,6 +233,10 @@ def main(argv=None):
                 baselined += 1
             else:
                 violations.append(v)
+    # the host-sync pragma ratchet is likewise whole-repo: a count over
+    # a partial path list would always read as "shrunk"
+    if not args.paths and (only is None or HOST_SYNC in only):
+        violations.extend(check_sync_budget(paths))
 
     if args.update_baseline:
         write_baseline(args.baseline, violations)

@@ -41,7 +41,17 @@ _HOT_PATHS = {
     "inference/v2/scheduler.py": {
         "DynamicSplitFuseScheduler._plan",
         "DynamicSplitFuseScheduler._try_burst",
+        "DynamicSplitFuseScheduler._try_spec_burst",
         "DynamicSplitFuseScheduler.step",
+        # pipelined (DS_ASYNC_BURST) pump: a stray sync here stalls the
+        # double buffer — the ONE intended sync lives in
+        # AsyncBurstHandle.fetch, reached via _fence_one
+        "DynamicSplitFuseScheduler._plan_async_k",
+        "DynamicSplitFuseScheduler._accept_async",
+        "DynamicSplitFuseScheduler._fence_one",
+        "DynamicSplitFuseScheduler._drain_pipeline",
+        "DynamicSplitFuseScheduler._continue_pipeline",
+        "DynamicSplitFuseScheduler._try_async_start",
     },
     "serving/gateway.py": {
         "ServingGateway._pump_once",
@@ -55,6 +65,9 @@ _HOT_PATHS = {
     "inference/v2/engine_v2.py": {
         "InferenceEngineV2.put",
         "InferenceEngineV2.decode_burst",
+        "InferenceEngineV2.decode_burst_async",
+        "InferenceEngineV2.verify_burst",
+        "AsyncBurstHandle.fetch",
     },
 }
 
@@ -1226,6 +1239,32 @@ def _iter_py_files(paths):
                 for fn in sorted(filenames):
                     if fn.endswith(".py"):
                         yield os.path.join(dirpath, fn)
+
+
+def count_host_sync_pragmas(paths):
+    """Number of ``# ds-lint: disable=…host-sync…`` pragma SITES (one
+    per source line carrying the comment) under ``paths`` — the counted
+    budget ``bin/ds_lint --only=host-sync`` ratchets against: every
+    pragma is one deliberate host sync, so the count growing means a
+    new sync site slipped into a hot path. Counted from raw lines, not
+    parsed suppressions, so the standalone-pragma next-line rule in
+    :func:`_parse_pragmas` cannot double-count a site."""
+    count = 0
+    for path in _iter_py_files(paths):
+        with open(path) as fd:
+            for line in fd:
+                idx = line.find("# ds-lint:")
+                if idx < 0:
+                    continue
+                body = line[idx + len("# ds-lint:"):]
+                body = body.split("--", 1)[0].strip()
+                if not body.startswith("disable="):
+                    continue
+                rules = {r.strip()
+                         for r in body[len("disable="):].split(",")}
+                if HOST_SYNC in rules or "all" in rules:
+                    count += 1
+    return count
 
 
 def lint_paths(paths, baseline=None, root=None, only=None):
