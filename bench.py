@@ -1066,6 +1066,265 @@ def bench_serving_2b_fleet(n_req=8, prompt_len=256, new_tokens=32):
                     "shows the failover cost, tput_after the recovery"}
 
 
+# ------------------------------------------------------------ fleet / mp
+# Shared config for serving_2b_fleet_mp: the parent lane, the in-process
+# reference subprocess, and the bin/ds_replica children must build the
+# SAME engine (params come from the fixed PRNGKey(0) init, so same
+# config + same backend => identical weights => greedy streams compare
+# bit-for-bit across process boundaries).
+_FLEET_MP_MODEL = {"hidden_size": 512, "intermediate_size": 1408,
+                   "num_hidden_layers": 4, "num_attention_heads": 8,
+                   "num_key_value_heads": 4,
+                   "max_position_embeddings": 512, "vocab_size": 32000}
+
+
+def _fleet_mp_engine_cfg(n_req, prompt_len, new_tokens):
+    budget = prompt_len + n_req
+    return {"kv_block_size": 32,
+            "state_manager": {"max_ragged_batch_size": budget,
+                              "max_ragged_sequence_count": n_req,
+                              "max_tracked_sequences": n_req,
+                              "max_context": prompt_len + new_tokens}}
+
+
+def _fleet_mp_trace(n_req, prompt_len):
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, 32000, size=prompt_len).tolist()
+            for _ in range(2 + 3 * n_req)]
+
+
+def _fleet_mp_run_phase(router, prompts, new_tokens, kill=None):
+    """Submit one burst and consume every stream on its own thread
+    (TTFT = first-token wall time per request). ``kill`` fires once
+    streams are open. A request is LOST only if it neither completes
+    nor fails with a typed ServingError — the contract this lane
+    gates."""
+    import threading
+
+    from deepspeed_tpu.serving import ServingError
+
+    n = len(prompts)
+    streams, ttft = [None] * n, [None] * n
+    outcome = ["lost"] * n
+
+    def consume(i, h, t_sub):
+        toks = []
+        try:
+            for tok in h.tokens(timeout=600):
+                if ttft[i] is None:
+                    ttft[i] = time.perf_counter() - t_sub
+                toks.append(tok)
+            streams[i] = toks
+            outcome[i] = "ok"
+        except ServingError:
+            outcome[i] = "typed"
+        except Exception:
+            outcome[i] = "lost"
+
+    handles, threads = [], []
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        h = router.submit(p, max_new_tokens=new_tokens)
+        handles.append(h)
+        t = threading.Thread(target=consume,
+                             args=(i, h, time.perf_counter()), daemon=True)
+        t.start()
+        threads.append(t)
+    if kill is not None:
+        while not any(h._collected for h in handles):
+            time.sleep(0.005)
+        kill()
+    for t in threads:
+        t.join(timeout=900)
+    wall = time.perf_counter() - t0
+    done = [t_ for t_ in ttft if t_ is not None]
+    return {"streams": streams,
+            "ok": outcome.count("ok"), "typed": outcome.count("typed"),
+            "lost": outcome.count("lost"), "wall_s": wall,
+            "mean_ttft_ms": float(np.mean(done)) * 1e3 if done else None,
+            "p99_ttft_ms": (float(np.percentile(
+                [t_ * 1e3 for t_ in done], 99)) if done else None),
+            "tok_s": sum(len(s) for s in streams if s) / wall}
+
+
+def _fleet_mp_inproc_reference(n_req, prompt_len, new_tokens):
+    """The in-process half of serving_2b_fleet_mp. Runs in its OWN
+    subprocess pinned to the children's backend (JAX_PLATFORMS=cpu) so
+    its numerics match the replica processes exactly regardless of the
+    parent's accelerator: streams compare bit-for-bit, and the
+    TTFT/tok_s delta against the wire fleet is transport overhead, not
+    backend noise."""
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig,
+                                            InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import build_llama
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                             GatewayReplica)
+
+    groups.destroy_mesh()
+    model = build_llama("debug", remat=False, **_FLEET_MP_MODEL)
+    ecfg = _fleet_mp_engine_cfg(n_req, prompt_len, new_tokens)
+    shared = {}
+
+    def factory():
+        cfg = RaggedInferenceEngineConfig(
+            kv_block_size=ecfg["kv_block_size"],
+            state_manager=DSStateManagerConfig(**ecfg["state_manager"]))
+        eng = InferenceEngineV2(model=model, config=cfg,
+                                params=shared.get("params"))
+        shared.setdefault("params", eng.params)
+        return eng
+
+    scfg = ServingConfig(token_budget=prompt_len + n_req, max_burst=16)
+    router = FleetRouter(
+        [GatewayReplica("r0", factory, serving_config=scfg),
+         GatewayReplica("r1", factory, serving_config=scfg)],
+        config=FleetConfig(heartbeat_interval_s=0.2, retry_backoff_s=0.05,
+                           stream_token_timeout_s=120.0))
+    trace = _fleet_mp_trace(n_req, prompt_len)
+    for p in trace[:2]:
+        router.submit(p, max_new_tokens=2).result(timeout=600)
+    phases = [_fleet_mp_run_phase(
+        router, trace[2 + k * n_req:2 + (k + 1) * n_req], new_tokens)
+        for k in range(3)]
+    router.shutdown()
+    streams = [s for ph in phases for s in ph["streams"]]
+    assert all(s for s in streams), "reference run lost a request"
+    return {"streams": streams, "params": _param_count(shared["params"]),
+            "mean_ttft_ms": phases[0]["mean_ttft_ms"],
+            "p99_ttft_ms": phases[0]["p99_ttft_ms"],
+            "tok_s": phases[0]["tok_s"]}
+
+
+def bench_serving_2b_fleet_mp(n_req=6, prompt_len=64, new_tokens=24):
+    """Cross-process fleet: the serving_2b_fleet contract with the
+    replicas in SEPARATE OS PROCESSES behind the wire transport. A
+    FleetSupervisor spawns two ``bin/ds_replica`` workers on unix
+    sockets; the same FleetRouter drives them through WireReplica
+    clients. Phase A healthy (wire TTFT/tok_s against an in-process
+    reference fleet), phase B ``kill -9`` one replica with streams in
+    flight (ZERO lost requests; every completed stream — failover
+    replays included — bit-identical to the reference), phase C after
+    the supervisor relaunches the victim on the same socket. The whole
+    lane, reference included, runs on CPU at debug scale: replica
+    children cannot share the parent's TPU client, and the contracts
+    measured (zero-lost, bit-identity, relative wire overhead) are
+    backend- and scale-independent — only absolute tok/s is not."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from deepspeed_tpu.serving.fleet import FleetConfig, FleetRouter
+    from deepspeed_tpu.serving.fleet.wire import (FleetSupervisor,
+                                                  ReplicaProcSpec,
+                                                  WireReplica)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    pyp = os.environ.get("PYTHONPATH")
+    child_env = {"JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": here if not pyp else here + os.pathsep + pyp}
+
+    code = ("import json, bench\n"
+            f"out = bench._fleet_mp_inproc_reference({n_req}, {prompt_len}, "
+            f"{new_tokens})\n"
+            "print('FLEETMPREF ' + json.dumps(out))\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=here,
+                          env={**os.environ, **child_env},
+                          capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, (
+        f"in-process reference failed:\n{proc.stderr[-2000:]}")
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("FLEETMPREF ")][-1]
+    ref = json.loads(line[len("FLEETMPREF "):])
+
+    child_cfg = {"preset": "debug", "model": dict(_FLEET_MP_MODEL),
+                 "engine": _fleet_mp_engine_cfg(n_req, prompt_len,
+                                                new_tokens),
+                 "serving": {"token_budget": prompt_len + n_req,
+                             "max_burst": 16}}
+    run_dir = tempfile.mkdtemp(prefix="ds_fleet_mp_")
+    sup = FleetSupervisor(
+        [ReplicaProcSpec(n, config=dict(child_cfg, name=n), env=child_env)
+         for n in ("r0", "r1")],
+        run_dir=run_dir, max_restarts=3, monitor_interval=0.2,
+        watchdog_timeout=0, grace=10.0)
+    sup.start()
+    try:
+        clients = {n: WireReplica(n, sup.address(n, timeout=60.0),
+                                  timeout_s=600.0, probe_timeout_s=5.0,
+                                  connect_timeout_s=10.0, backoff_s=0.2)
+                   for n in ("r0", "r1")}
+        deadline = time.monotonic() + 600
+        for n, cli in clients.items():
+            while not cli.probe():  # the child imports jax + compiles
+                assert time.monotonic() < deadline, f"{n} never came up"
+                time.sleep(0.5)
+        router = FleetRouter(
+            list(clients.values()),
+            config=FleetConfig(heartbeat_interval_s=0.5,
+                               retry_backoff_s=0.1,
+                               stream_token_timeout_s=600.0))
+        trace = _fleet_mp_trace(n_req, prompt_len)
+        for p in trace[:2]:
+            router.submit(p, max_new_tokens=2).result(timeout=900)
+        a = _fleet_mp_run_phase(router, trace[2:2 + n_req], new_tokens)
+        victim = "r0"
+        b = _fleet_mp_run_phase(
+            router, trace[2 + n_req:2 + 2 * n_req], new_tokens,
+            kill=lambda: os.kill(sup.pid(victim), _signal.SIGKILL))
+        deadline = time.monotonic() + 600
+        while not (sup.running(victim) and clients[victim].probe()):
+            assert time.monotonic() < deadline, "victim never relaunched"
+            time.sleep(0.5)
+        c = _fleet_mp_run_phase(router, trace[2 + 2 * n_req:], new_tokens)
+        counters = router.snapshot()["counters"]
+        victim_restarts = sup.stats()[victim]["restarts"]
+        # detaches the wire clients only — the replica processes stay
+        # up until the supervisor stops them below
+        router.shutdown()
+    finally:
+        sup.stop()
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    lost = a["lost"] + b["lost"] + c["lost"]
+    assert lost == 0, f"{lost} request(s) neither completed nor failed typed"
+    assert a["ok"] == n_req and c["ok"] == n_req, "healthy phase dropped"
+    assert b["ok"] + b["typed"] == n_req, "mid-kill phase dropped a request"
+    for k, ph in enumerate((a, b, c)):
+        for i, s in enumerate(ph["streams"]):
+            assert s is None or s == ref["streams"][k * n_req + i], (
+                f"wire stream {k}:{i} diverged from the in-process "
+                f"reference")
+    return {"params": ref["params"], "replicas": 2,
+            "transport": "wire(unix)",
+            "requests_per_phase": n_req, "prompt_len": prompt_len,
+            "new_tokens": new_tokens, "lost_requests": lost,
+            "completed": [a["ok"], b["ok"], c["ok"]],
+            "typed_failures": [a["typed"], b["typed"], c["typed"]],
+            "failovers": counters["failovers"],
+            "retries": counters["retries"],
+            "victim_restarts": victim_restarts,
+            "streams_bit_identical": True,
+            "wire_mean_ttft_ms": round(a["mean_ttft_ms"], 1),
+            "inproc_mean_ttft_ms": round(ref["mean_ttft_ms"], 1),
+            "wire_p99_ttft_ms": round(a["p99_ttft_ms"], 1),
+            "inproc_p99_ttft_ms": round(ref["p99_ttft_ms"], 1),
+            "wire_tok_s": round(a["tok_s"], 1),
+            "inproc_tok_s": round(ref["tok_s"], 1),
+            "wire_ttft_overhead_ms": round(
+                a["mean_ttft_ms"] - ref["mean_ttft_ms"], 2),
+            "wire_vs_inproc_tok_s": round(a["tok_s"] / ref["tok_s"], 3),
+            "note": "N=2 bin/ds_replica processes under a FleetSupervisor, "
+                    "r0 SIGKILLed mid-trace and relaunched on the same "
+                    "socket; zero-lost asserted, every completed stream "
+                    "(failover replays included) bit-identical to an "
+                    "in-process reference fleet on the same backend"}
+
+
 def bench_serving_2b_disagg(n_req=12, long_prompt=384, short_prompt=64,
                             new_tokens=48, prefill_burst=2):
     """Disaggregated prefill/decode serving vs the unified fleet on the
@@ -2136,6 +2395,7 @@ def main():
         ("serving_2b_json", bench_serving_2b_json, {}),
         ("serving_2b_moe", bench_serving_2b_moe, {}),
         ("serving_2b_fleet", bench_serving_2b_fleet, {}),
+        ("serving_2b_fleet_mp", bench_serving_2b_fleet_mp, {}),
         ("serving_2b_disagg", bench_serving_2b_disagg, {}),
         ("serving_2b_refresh", bench_serving_2b_refresh, {}),
         ("serving_2b_autotune", bench_serving_2b_autotune, {}),
@@ -2176,7 +2436,13 @@ def main():
                 ("serving_2b_sampled", bench_serving_2b_sampled,
                  {"debug": True}),
                 ("serving_2b_json", bench_serving_2b_json,
-                 {"debug": True})):
+                 {"debug": True}),
+                # CPU-native by construction: replica child processes
+                # can't share an accelerator client, so the whole lane
+                # (in-process reference included) is pinned to CPU and
+                # its zero-lost / bit-identity / relative-overhead
+                # contracts are measured everywhere
+                ("serving_2b_fleet_mp", bench_serving_2b_fleet_mp, {})):
             try:
                 extras[key] = fn(**kwargs)
             except Exception as e:
@@ -2283,6 +2549,14 @@ def main():
             "fleet_tok_s_before": _pick("serving_2b_fleet", "tput_before_tok_s"),
             "fleet_tok_s_during_fault": _pick("serving_2b_fleet", "tput_during_tok_s"),
             "fleet_tok_s_after_recovery": _pick("serving_2b_fleet", "tput_after_tok_s"),
+            "fleet_mp_lost_requests": _pick("serving_2b_fleet_mp",
+                                            "lost_requests"),
+            "fleet_mp_bit_identical": _pick("serving_2b_fleet_mp",
+                                            "streams_bit_identical"),
+            "fleet_mp_ttft_overhead_ms": _pick("serving_2b_fleet_mp",
+                                               "wire_ttft_overhead_ms"),
+            "fleet_mp_wire_vs_inproc_tok_s": _pick("serving_2b_fleet_mp",
+                                                   "wire_vs_inproc_tok_s"),
             "disagg_p99_ttft_speedup": _pick("serving_2b_disagg", "p99_ttft_speedup"),
             "refresh_wall_s": _pick("serving_2b_refresh", "refresh_wall_s"),
             "refresh_vs_drain": _pick("serving_2b_refresh", "drain_over_refresh"),

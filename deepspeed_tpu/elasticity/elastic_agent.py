@@ -43,6 +43,7 @@ import time
 from typing import Callable, Optional, Sequence
 
 from deepspeed_tpu.elasticity.preemption import PREEMPT_RC, read_heartbeat
+from deepspeed_tpu.utils import proc
 from deepspeed_tpu.utils.env_registry import env_int
 from deepspeed_tpu.utils.logging import logger
 
@@ -122,25 +123,16 @@ class DSElasticAgent:
         return self._child
 
     def _kill_child(self, sig=signal.SIGTERM):
-        if self._child is None or self._child.poll() is not None:
-            return
-        try:
-            os.killpg(os.getpgid(self._child.pid), sig)
-        except ProcessLookupError:
-            pass
+        proc.killpg(self._child, sig)
 
     def _terminate_with_grace(self, child, reason):
         """SIGTERM, wait up to ``preempt_grace`` for the emergency
-        checkpoint, then SIGKILL. Returns the rc."""
-        logger.warning(f"[elastic] {reason}: SIGTERM with "
-                       f"{self.preempt_grace:.0f}s grace")
-        self._kill_child(signal.SIGTERM)
-        try:
-            return child.wait(timeout=max(self.preempt_grace, 0.05))
-        except subprocess.TimeoutExpired:
-            logger.error(f"[elastic] {reason}: grace expired, SIGKILL")
-            self._kill_child(signal.SIGKILL)
-            return child.wait()
+        checkpoint, then SIGKILL. Returns the rc. (Shared escalation:
+        ``deepspeed_tpu/utils/proc.py`` — the fleet supervisor uses the
+        same implementation.)"""
+        return proc.terminate_with_grace(child, self.preempt_grace, reason,
+                                         log_prefix="[elastic]",
+                                         kill=self._kill_child)
 
     def shutdown(self, sig=signal.SIGTERM):
         """Graceful stop: forward the signal and let ``run()`` finish
@@ -151,19 +143,15 @@ class DSElasticAgent:
         self._kill_child(sig)
 
     # ---------------------------------------------------------- watchdog
-    def _heartbeat_stalled(self, last_progress_t, last_payload):
-        """(stalled, progress_t, payload): progress is any change in the
-        heartbeat payload; the clock only starts once the worker has
-        beaten at least once (startup/compile time is not a hang)."""
-        payload = read_heartbeat(self._heartbeat_file)
-        now = time.monotonic()
-        if payload is None:
-            return False, last_progress_t, last_payload  # not armed yet
-        if payload != last_payload:
-            return False, now, payload
-        if last_progress_t is not None and now - last_progress_t > self.watchdog_timeout:
-            return True, last_progress_t, payload
-        return False, last_progress_t if last_progress_t is not None else now, payload
+    def _make_watchdog(self):
+        """Fresh :class:`~deepspeed_tpu.utils.proc.HeartbeatWatchdog`
+        for one worker incarnation. The arming rules (no beat = not
+        armed, payload change = progress) are the shared implementation
+        in ``utils/proc.py`` — the fleet supervisor watches its replica
+        servers with the exact same clock."""
+        return proc.HeartbeatWatchdog(self._heartbeat_file,
+                                      self.watchdog_timeout,
+                                      read=read_heartbeat)
 
     # ------------------------------------------------------------------
     def run(self) -> int:
@@ -194,7 +182,7 @@ class DSElasticAgent:
         while not self._shutdown:
             child = self._spawn()
             hang = False
-            hb_progress_t, hb_payload = None, None
+            watchdog = self._make_watchdog()
             while not self._shutdown:
                 try:
                     child.wait(timeout=self.monitor_interval)
@@ -202,8 +190,7 @@ class DSElasticAgent:
                 except subprocess.TimeoutExpired:
                     pass
                 if self.watchdog_timeout > 0 and self._heartbeat_file:
-                    hang, hb_progress_t, hb_payload = self._heartbeat_stalled(
-                        hb_progress_t, hb_payload)
+                    hang = watchdog.stalled()
                     if hang:
                         self.hang_count += 1
                         self._terminate_with_grace(

@@ -546,12 +546,14 @@ class _FaultyHandle:
     def __init__(self, inner, replica):
         self._inner = inner
         self._replica = replica
+        self._yielded = 0  # per-HANDLE, so fresh iterators (the wire
+        # relay polls with one per round) see the same fault schedule
 
     def tokens(self, timeout=None):
         rep = self._replica
         it = self._inner.tokens(timeout=timeout)
-        idx = 0
         while True:
+            idx = self._yielded
             if rep.hang_at_token is not None and idx >= rep.hang_at_token:
                 # wedged pump: nothing arrives, nothing dies — surface
                 # the same timeout the real stream would
@@ -570,8 +572,8 @@ class _FaultyHandle:
                 rep._die(f"scripted crash at token {idx}")
             if rep.slow_token_s:
                 time.sleep(rep.slow_token_s)
+            self._yielded += 1
             yield tok
-            idx += 1
 
     def cancel(self):
         self._inner.cancel()

@@ -1,0 +1,189 @@
+"""Length-prefixed framed codec for the fleet wire protocol.
+
+One frame = a 4-byte big-endian payload length, a 1-byte format marker
+(``M`` = msgpack, ``J`` = JSON), then the encoded message. Every message
+is a dict envelope ``{"v": WIRE_VERSION, "type": <str>, "id": <int>,
+...}``; the version is checked on decode so a future protocol bump
+surfaces as a typed :class:`WireProtocolError` instead of a KeyError
+three layers down.
+
+Payload encoding is msgpack when the module is importable, JSON
+otherwise — the *decoder* always accepts both (the marker byte travels
+with every frame), so mixed fleets interoperate. No dependency is ever
+installed for this: JSON is the guaranteed floor.
+
+numpy arrays (KV handoff carriers, weight trees) are tagged before
+packing — ``{"__nd__": 1, "dtype": ..., "shape": [...], "data":
+<raw-bytes | base64>}`` — and rebuilt with ``np.frombuffer``, so a
+round-trip is **bit-identical** (asserted by
+tests/unit/inference/serving/test_wire_protocol.py). Plain ``bytes``
+values get the same treatment under a ``__bytes__`` tag. Tuples arrive
+as lists on the far side (both payload formats flatten them); consumers
+that need tuples re-tuple, exactly like the handoff validators already
+do for records that crossed a process boundary.
+"""
+
+import base64
+import json
+import struct
+
+import numpy as np
+
+from deepspeed_tpu.serving.fleet.wire.errors import WireProtocolError
+
+try:  # optional: the container may or may not ship msgpack
+    import msgpack as _msgpack
+except ImportError:  # pragma: no cover - environment-dependent
+    _msgpack = None
+
+WIRE_VERSION = 1
+
+_HEADER = struct.Struct("!IB")  # payload length, format marker
+_FMT_MSGPACK = ord("M")
+_FMT_JSON = ord("J")
+# a frame larger than this is garbage (a torn stream re-synced mid
+# payload, or a length field read off random bytes) — reject typed
+# instead of trying to allocate it
+MAX_FRAME_BYTES = 1 << 31
+
+
+# ------------------------------------------------------------------ tagging
+def _tag(obj):
+    """Recursively replace wire-opaque values (ndarrays, bytes) with
+    tagged dicts; tuples become lists."""
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": 1, "dtype": obj.dtype.str,
+                "shape": list(obj.shape), "data": obj.tobytes()}
+    if isinstance(obj, np.generic):  # numpy scalar -> python scalar
+        return obj.item()
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__bytes__": 1, "data": bytes(obj)}
+    if isinstance(obj, dict):
+        return {k: _tag(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_tag(v) for v in obj]
+    return obj
+
+
+def _untag(obj):
+    if isinstance(obj, dict):
+        if obj.get("__nd__") == 1:
+            data = obj["data"]
+            if isinstance(data, str):  # JSON carried it base64
+                data = base64.b64decode(data)
+            arr = np.frombuffer(data, dtype=np.dtype(obj["dtype"]))
+            return arr.reshape(tuple(obj["shape"])).copy()
+        if obj.get("__bytes__") == 1:
+            data = obj["data"]
+            return base64.b64decode(data) if isinstance(data, str) else data
+        return {k: _untag(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_untag(v) for v in obj]
+    return obj
+
+
+class _JSONBytes(json.JSONEncoder):
+    """Tagged payloads still hold raw bytes under ``data`` when JSON is
+    the frame format — base64 them at the encoder seam."""
+
+    def default(self, o):
+        if isinstance(o, (bytes, bytearray)):
+            return base64.b64encode(bytes(o)).decode("ascii")
+        return super().default(o)
+
+
+# ------------------------------------------------------------------ messages
+def encode_msg(msg, prefer=None):
+    """Envelope dict → one wire frame (header + payload bytes).
+    ``prefer`` forces a format (tests); default is msgpack when
+    available."""
+    payload = _tag(msg)
+    fmt = prefer if prefer is not None else \
+        (_FMT_MSGPACK if _msgpack is not None else _FMT_JSON)
+    if fmt == _FMT_MSGPACK and _msgpack is not None:
+        body = _msgpack.packb(payload, use_bin_type=True)
+    else:
+        fmt = _FMT_JSON
+        body = json.dumps(payload, cls=_JSONBytes,
+                          separators=(",", ":")).encode("utf-8")
+    return _HEADER.pack(len(body), fmt) + body
+
+
+def decode_body(fmt, body):
+    """Frame body bytes → envelope dict (version-checked)."""
+    if fmt == _FMT_MSGPACK:
+        if _msgpack is None:
+            raise WireProtocolError(
+                "peer sent a msgpack frame but msgpack is unavailable "
+                "here — restart the peer with JSON frames")
+        try:
+            payload = _msgpack.unpackb(body, raw=False, strict_map_key=False)
+        except Exception as e:
+            raise WireProtocolError(f"undecodable msgpack frame: {e}")
+    elif fmt == _FMT_JSON:
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise WireProtocolError(f"undecodable JSON frame: {e}")
+    else:
+        raise WireProtocolError(
+            f"unknown frame format marker {fmt!r} — torn stream or "
+            f"incompatible peer")
+    msg = _untag(payload)
+    if not isinstance(msg, dict) or msg.get("v") != WIRE_VERSION:
+        got = msg.get("v") if isinstance(msg, dict) else type(msg).__name__
+        raise WireProtocolError(
+            f"wire message version {got!r} is not {WIRE_VERSION} — "
+            f"incompatible peer", got_version=got,
+            want_version=WIRE_VERSION)
+    return msg
+
+
+# -------------------------------------------------------------------- stream
+def read_exact(rfile, n):
+    """Read exactly ``n`` bytes; '' on clean EOF at the FIRST byte,
+    :class:`WireProtocolError` on EOF mid-read (a torn frame)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = rfile.read(n - len(buf))
+        if not chunk:
+            if not buf:
+                return b""
+            raise WireProtocolError(
+                f"torn frame: stream closed after {len(buf)} of {n} "
+                f"bytes")
+        buf += chunk
+    return buf
+
+
+def read_frame(rfile):
+    """Blocking frame read → envelope dict, or None on clean EOF at a
+    frame boundary. Torn frames, garbage lengths, undecodable payloads
+    and version mismatches all raise :class:`WireProtocolError`."""
+    header = read_exact(rfile, _HEADER.size)
+    if not header:
+        return None
+    length, fmt = _HEADER.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise WireProtocolError(
+            f"frame length {length} exceeds {MAX_FRAME_BYTES} — torn "
+            f"stream or garbage header")
+    body = read_exact(rfile, length)
+    if length and not body:
+        raise WireProtocolError("torn frame: stream closed before payload")
+    return decode_body(fmt, body)
+
+
+def write_frame(wfile, msg, lock=None, prefer=None):
+    """Serialize + write one frame. ``lock`` (when given) makes the
+    write atomic against other threads sharing the connection —
+    responses from per-request relay threads interleave at frame
+    granularity, never mid-frame."""
+    data = encode_msg(msg, prefer=prefer)
+    if lock is not None:
+        with lock:
+            wfile.write(data)
+            wfile.flush()
+    else:
+        wfile.write(data)
+        wfile.flush()

@@ -346,6 +346,26 @@ register("DS_DISAGG_FALLBACK", "bool", True,
          "the disagg path fails; off, a failed handoff fails the "
          "request with a typed error instead of falling back.",
          "deepspeed_tpu/serving/fleet/router.py")
+register("DS_FLEET_TRANSPORT", "optional_str", None,
+         "Fleet replica transport: 'inproc' (default — replicas are "
+         "in-process GatewayReplica objects, byte-identical to the "
+         "pre-wire fleet) or 'wire' (replicas are separate processes "
+         "reached over the framed socket protocol); unset behaves as "
+         "'inproc'.",
+         "deepspeed_tpu/serving/fleet/wire/__init__.py",
+         choices=("inproc", "wire"))
+register("DS_WIRE_TIMEOUT_S", "int", 30,
+         "Default I/O deadline (seconds) for unary wire calls from "
+         "WireReplica to a replica server (submit ack, handoff claim, "
+         "import, drain/restart/refresh get this on top of their own "
+         "budgets); a blown deadline raises WireTimeoutError.",
+         "deepspeed_tpu/serving/fleet/wire/client.py",
+         min_value=1, max_value=3600)
+register("DS_WIRE_BIND", "optional_str", None,
+         "Default bind address for a replica server when the launcher "
+         "passes none: 'host:port' (port 0 = ephemeral) or "
+         "'unix:/path.sock'; unset falls back to 127.0.0.1:0.",
+         "deepspeed_tpu/serving/fleet/wire/server.py")
 register("DS_REFRESH_CANARY", "optional_bool", None,
          "Kill switch for the live-weight-refresh canary gate (first "
          "refreshed replica verified bit-identically against a cold-"

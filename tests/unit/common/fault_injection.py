@@ -150,3 +150,149 @@ def maybe_step_fault(kind, step, at_step, armed=True):
     else:
         raise ValueError(f"unknown step fault kind {kind!r}")
     return True
+
+
+# ----------------------------------------------------------------------
+# process / wire faults (cross-process fleet harness)
+# ----------------------------------------------------------------------
+# The wire-transport tests need the failure modes only a real process
+# boundary has: hard process death (kill -9 of a replica server), a
+# blackholed socket (peer alive but not answering — accepts and reads
+# nothing, so client deadlines must fire), and torn frames (connection
+# cut mid-frame, which the codec must surface as WireProtocolError, not
+# a bare struct/EOF error). ``WireFaultProxy`` sits between a
+# WireReplica and a ReplicaServer so these compose with FaultyReplica's
+# in-gateway faults.
+
+def _sever(sock):
+    """Shutdown-then-close: close() alone neither interrupts a thread
+    blocked in recv on the socket nor sends the FIN until that recv
+    returns — shutdown does both, so the cut is actually observable."""
+    import socket as _socket
+
+    try:
+        sock.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def kill_process(popen_or_pid, sig=None):
+    """``kill -9`` a process (group if it leads one). Accepts a Popen
+    or a pid; ProcessLookupError (already gone) is a success."""
+    import signal
+
+    sig = signal.SIGKILL if sig is None else sig
+    pid = getattr(popen_or_pid, "pid", popen_or_pid)
+    if pid is None:
+        return
+    try:
+        os.killpg(os.getpgid(pid), sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+class WireFaultProxy:
+    """TCP proxy with scripted wire faults between a client and a
+    replica server.
+
+    Modes (set ``.mode`` live; existing and new connections obey it):
+
+    - ``"pass"``     — transparent byte relay (the control case);
+    - ``"blackhole"`` — accept connections, forward nothing in either
+      direction: the server looks alive to connect() but every call
+      must hit its I/O deadline;
+    - ``"torn"``     — forward ``torn_after`` more bytes, then hard-cut
+      the connection mid-frame (client sees a truncated frame / EOF
+      mid-read → WireProtocolError / typed reconnect).
+    """
+
+    def __init__(self, upstream, mode="pass", torn_after=64):
+        import socket
+        import threading
+
+        self.upstream = str(upstream)
+        self.mode = mode
+        self.torn_after = int(torn_after)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(8)
+        host, port = self._listener.getsockname()[:2]
+        self.address = f"{host}:{port}"
+        self._open = True
+        self._socks = set()
+        self._lock = threading.Lock()
+        self.forwarded = 0
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="wire-fault-proxy").start()
+
+    def _accept_loop(self):
+        import threading
+
+        from deepspeed_tpu.serving.fleet.wire import address as _address
+
+        while self._open:
+            try:
+                client, _peer = self._listener.accept()
+            except OSError:
+                return
+            try:
+                server = _address.connect(self.upstream, timeout=2.0)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._socks.update((client, server))
+            threading.Thread(target=self._pump, args=(client, server),
+                             daemon=True).start()
+            threading.Thread(target=self._pump, args=(server, client),
+                             daemon=True).start()
+
+    def _pump(self, src, dst):
+        budget = [self.torn_after]
+        while self._open:
+            if self.mode == "blackhole":
+                import time
+                time.sleep(0.02)  # swallow nothing, forward nothing
+                continue
+            try:
+                data = src.recv(4096)
+            except OSError:
+                break
+            if not data:
+                break
+            if self.mode == "torn":
+                data = data[:max(0, budget[0])]
+                budget[0] -= len(data)
+            try:
+                if data:
+                    dst.sendall(data)
+                    self.forwarded += len(data)
+            except OSError:
+                break
+            if self.mode == "torn" and budget[0] <= 0:
+                break  # cut mid-frame
+        for s in (src, dst):
+            _sever(s)
+
+    def drop_connections(self):
+        """Hard-cut every live proxied connection (keeps listening)."""
+        with self._lock:
+            socks, self._socks = self._socks, set()
+        for s in socks:
+            _sever(s)
+
+    def close(self):
+        self._open = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.drop_connections()

@@ -1,0 +1,214 @@
+"""Shared process-supervision primitives (deepspeed_tpu/utils/proc.py).
+
+These are the pieces BOTH supervisors lean on — the elastic training
+agent and the serving fleet supervisor — hoisted so escalation and
+watchdog-arming semantics cannot drift apart. Covered here:
+
+- ``terminate_with_grace``: SIGTERM-exits-in-grace vs
+  grace-expired-SIGKILL escalation, on real child processes;
+- ``HeartbeatWatchdog``: the arming rules (never armed before the
+  first beat, payload change is progress, unchanged past timeout
+  stalls, 0 disables) on a fake clock;
+- ``HeartbeatFileWriter``: atomic writes, every beat is progress;
+- regression on both callers: ``DSElasticAgent`` delegates its
+  escalation and watchdog to this module, and ``FleetSupervisor`` /
+  ``ReplicaServer`` consume the same watchdog/writer pair.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.utils import proc
+
+
+def _spawn(code):
+    return subprocess.Popen([sys.executable, "-c", code],
+                            start_new_session=True)
+
+
+class TestTerminateWithGrace:
+
+    def test_sigterm_exits_within_grace(self):
+        child = _spawn(
+            "import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))\n"
+            "time.sleep(60)\n")
+        time.sleep(0.3)  # let the handler install
+        t0 = time.monotonic()
+        rc = proc.terminate_with_grace(child, grace_s=10.0)
+        assert rc == 0  # exited on its own terms, no SIGKILL
+        assert time.monotonic() - t0 < 5.0  # did not sit out the grace
+        assert child.poll() == 0
+
+    def test_grace_expiry_escalates_to_sigkill(self):
+        child = _spawn(
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "time.sleep(60)\n")
+        time.sleep(0.3)
+        rc = proc.terminate_with_grace(child, grace_s=0.3)
+        assert rc == -signal.SIGKILL  # the escalation fired
+        assert child.poll() is not None
+
+    def test_custom_kill_hook_is_used(self):
+        child = _spawn("import time; time.sleep(60)")
+        sigs = []
+
+        def kill(sig):
+            sigs.append(sig)
+            child.send_signal(sig)
+
+        rc = proc.terminate_with_grace(child, grace_s=5.0, kill=kill)
+        assert rc == -signal.SIGTERM
+        assert sigs == [signal.SIGTERM]
+
+    def test_killpg_on_exited_child_is_noop(self):
+        child = _spawn("pass")
+        child.wait()
+        proc.killpg(child, signal.SIGKILL)  # must not raise
+        proc.killpg(None, signal.SIGKILL)
+
+
+class TestHeartbeatWatchdog:
+
+    def test_not_armed_before_first_beat(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        dog = proc.HeartbeatWatchdog(path, timeout_s=1.0)
+        # no file at all: far past the timeout, still not a stall
+        assert dog.stalled(now=0.0) is False
+        assert dog.stalled(now=100.0) is False
+        assert not dog.armed
+
+    def test_progress_resets_clock_and_stall_fires(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        writer = proc.HeartbeatFileWriter(path)
+        dog = proc.HeartbeatWatchdog(path, timeout_s=5.0)
+        writer.beat()
+        assert dog.stalled(now=0.0) is False  # first beat arms, no stall
+        assert dog.armed
+        assert dog.stalled(now=4.0) is False  # within timeout
+        writer.beat()  # progress: payload changed
+        assert dog.stalled(now=6.0) is False  # clock reset at 6.0
+        assert dog.stalled(now=10.0) is False  # 4s since progress
+        assert dog.stalled(now=11.5) is True  # >5s with no change
+
+    def test_reset_forgets_previous_incarnation(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        writer = proc.HeartbeatFileWriter(path)
+        dog = proc.HeartbeatWatchdog(path, timeout_s=1.0)
+        writer.beat()
+        assert dog.stalled(now=0.0) is False
+        dog.reset()
+        assert not dog.armed
+        os.remove(path)  # supervisor removes the stale file on respawn
+        assert dog.stalled(now=50.0) is False  # replacement not beaten yet
+
+    def test_zero_timeout_disables(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        proc.HeartbeatFileWriter(path).beat()
+        dog = proc.HeartbeatWatchdog(path, timeout_s=0)
+        assert dog.stalled(now=0.0) is False
+        assert dog.stalled(now=1e9) is False
+        assert proc.HeartbeatWatchdog(None, timeout_s=5.0).stalled() is False
+
+    def test_torn_heartbeat_file_reads_as_absent(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        with open(path, "w") as fd:
+            fd.write('{"beats": 3,')  # torn mid-write
+        assert proc.read_heartbeat_file(path) is None
+        dog = proc.HeartbeatWatchdog(path, timeout_s=1.0)
+        assert dog.stalled(now=100.0) is False  # torn != hung
+
+    def test_writer_payload_grows_monotonically(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        writer = proc.HeartbeatFileWriter(path)
+        writer.beat({"name": "r0"})
+        first = proc.read_heartbeat_file(path)
+        writer.beat({"name": "r0"})
+        second = proc.read_heartbeat_file(path)
+        assert first["beats"] == 1 and second["beats"] == 2
+        assert first["name"] == "r0"
+        assert first != second  # every beat is progress
+        assert not [p for p in os.listdir(os.path.dirname(path))
+                    if ".tmp." in p]  # atomic: no tmp droppings
+
+
+class TestCallersDelegate:
+    """Both supervisors must route through the shared implementation —
+    the hoist is only safe if neither keeps a private copy."""
+
+    def test_elastic_agent_escalation_delegates(self):
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+        agent = DSElasticAgent(["true"], preempt_grace=0.3,
+                               watchdog_timeout=0)
+        child = _spawn(
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "time.sleep(60)\n")
+        time.sleep(0.3)
+        agent._child = child  # _kill_child signals the agent's child
+        rc = agent._terminate_with_grace(child, "test")
+        assert rc == -signal.SIGKILL
+
+    def test_elastic_agent_watchdog_is_shared_class(self, tmp_path):
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+        agent = DSElasticAgent(["true"], watchdog_timeout=7.0,
+                               preempt_grace=1.0)
+        agent._heartbeat_file = str(tmp_path / "hb.json")
+        dog = agent._make_watchdog()
+        assert isinstance(dog, proc.HeartbeatWatchdog)
+        assert dog.path == agent._heartbeat_file
+        assert dog.timeout_s == 7.0
+        # the agent's reader understands the engine's step-counter beats
+        with open(agent._heartbeat_file, "w") as fd:
+            json.dump({"step": 1, "time": 1.0}, fd)
+        assert dog.stalled(now=0.0) is False and dog.armed
+
+    def test_fleet_supervisor_watchdog_is_shared_class(self, tmp_path):
+        from deepspeed_tpu.serving.fleet.wire.supervisor import (
+            FleetSupervisor, ReplicaProcSpec)
+
+        sup = FleetSupervisor(
+            [ReplicaProcSpec("r0", cmd=["true"])],
+            run_dir=str(tmp_path / "run"), watchdog_timeout=3.0,
+            grace=0.5)
+        child = sup._children["r0"]
+        assert child.heartbeat_file.endswith("r0.heartbeat")
+        # never started: no processes to clean up, but the watchdog the
+        # monitor would use is the shared one
+        sup._spawn_locked(child)
+        try:
+            assert isinstance(child.watchdog, proc.HeartbeatWatchdog)
+            assert child.watchdog.timeout_s == 3.0
+        finally:
+            sup.stop()
+
+    def test_replica_server_beats_shared_writer(self, tmp_path):
+        from deepspeed_tpu.serving.fleet.wire.server import ReplicaServer
+
+        path = str(tmp_path / "hb.json")
+        srv = ReplicaServer(replica=None, bind="127.0.0.1:0",
+                            heartbeat_file=path,
+                            heartbeat_interval_s=0.05)
+        assert isinstance(srv._hb, proc.HeartbeatFileWriter)
+        srv.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                payload = proc.read_heartbeat_file(path)
+                if payload is not None:
+                    break
+                time.sleep(0.02)
+            assert payload is not None and payload["beats"] >= 1
+            dog = proc.HeartbeatWatchdog(path, timeout_s=30.0)
+            assert dog.stalled() is False and dog.armed
+        finally:
+            srv.stop()

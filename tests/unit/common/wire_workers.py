@@ -1,0 +1,35 @@
+"""Replica factories for cross-process wire tests.
+
+``bin/ds_replica --factory unit.common.wire_workers:<fn>`` imports
+these in the CHILD process (the supervisor's spec env must put the
+repo root and ``tests/`` on ``PYTHONPATH``). They build the same
+deterministic FakeEngine-backed gateway replicas the in-process fleet
+tests use, so cross-process streams are comparable token-for-token
+with their in-process references.
+"""
+
+import time
+
+from deepspeed_tpu.serving import ServingConfig
+from deepspeed_tpu.serving.fleet import GatewayReplica
+from unit.inference.serving.test_admission import FakeEngine
+
+
+class SlowFakeEngine(FakeEngine):
+    """Paced generation so a kill -9 reliably lands mid-stream."""
+
+    def put(self, uids, chunks, sample=None):
+        time.sleep(0.05)
+        return super().put(uids, chunks, sample=sample)
+
+
+def make_fake_replica(name, role="unified"):
+    return GatewayReplica(name, lambda: FakeEngine(),
+                          serving_config=ServingConfig(max_burst=1),
+                          role=role)
+
+
+def make_slow_replica(name, role="unified"):
+    return GatewayReplica(name, lambda: SlowFakeEngine(),
+                          serving_config=ServingConfig(max_burst=1),
+                          role=role)

@@ -1,0 +1,233 @@
+"""Wire codec + typed error taxonomy (deepspeed_tpu/serving/fleet/wire).
+
+The framing contract: length-prefixed frames with a per-frame format
+marker (msgpack when available, JSON always), version-checked on
+decode; ndarray payloads round-trip BIT-IDENTICAL (KV handoff carriers
+and weight trees depend on it); torn frames, garbage headers and
+unknown formats surface as typed :class:`WireProtocolError`, never a
+bare struct/EOF error.
+
+The taxonomy contract: EVERY ``ServingError`` subclass crosses the
+wire and rebuilds as the same type with the same message and the same
+machine-readable retry hints (``details``) — the fleet router's
+failover and the admission backoff logic key on them. Unknown codes
+decode to :class:`WireProtocolError`, never bare ``Exception``.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.admission import QueueFullError, ServingError
+from deepspeed_tpu.serving.fleet.wire import codec
+from deepspeed_tpu.serving.fleet.wire.codec import (WIRE_VERSION, decode_body,
+                                                    encode_msg, read_frame,
+                                                    write_frame)
+from deepspeed_tpu.serving.fleet.wire.errors import (WireProtocolError,
+                                                     WireTimeoutError,
+                                                     _error_registry,
+                                                     decode_error,
+                                                     encode_error)
+from deepspeed_tpu.utils.sanitize import (KVTierCorruptionError,
+                                          WeightPublicationError)
+
+FORMATS = [codec._FMT_JSON] + (
+    [codec._FMT_MSGPACK] if codec._msgpack is not None else [])
+
+
+def roundtrip(msg, prefer=None):
+    frame = encode_msg(msg, prefer=prefer)
+    return read_frame(io.BytesIO(frame))
+
+
+# ======================================================================
+# framing
+# ======================================================================
+class TestFraming:
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_basic_envelope_roundtrip(self, fmt):
+        msg = {"v": WIRE_VERSION, "id": 7, "type": "req", "op": "probe",
+               "args": {"nested": {"list": [1, 2.5, None, "s", True]}}}
+        assert roundtrip(msg, prefer=fmt) == msg
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    @pytest.mark.parametrize("dtype", ["int32", "int8", "float32",
+                                       "float16", "uint16"])
+    def test_ndarray_roundtrip_bit_identical(self, fmt, dtype):
+        rng = np.random.RandomState(0)
+        arr = (rng.randint(-120, 120, size=(3, 5, 2))
+               .astype(dtype) if np.issubdtype(np.dtype(dtype), np.integer)
+               else rng.randn(3, 5, 2).astype(dtype))
+        out = roundtrip({"v": WIRE_VERSION, "id": 1, "type": "ok",
+                         "result": {"k": arr}}, prefer=fmt)["result"]["k"]
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        assert out.tobytes() == arr.tobytes()  # bit-identical
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_bytes_and_tuple_handling(self, fmt):
+        msg = {"v": WIRE_VERSION, "id": 1, "type": "ok",
+               "result": {"blob": b"\x00\xffraw", "tup": (1, 2, 3)}}
+        out = roundtrip(msg, prefer=fmt)["result"]
+        assert out["blob"] == b"\x00\xffraw"
+        assert out["tup"] == [1, 2, 3]  # tuples flatten: consumers re-tuple
+
+    def test_mixed_formats_interoperate_on_one_stream(self):
+        buf = io.BytesIO()
+        for i, fmt in enumerate(FORMATS * 2):
+            write_frame(buf, {"v": WIRE_VERSION, "id": i, "type": "ok"},
+                        prefer=fmt)
+        buf.seek(0)
+        ids = []
+        while True:
+            msg = read_frame(buf)
+            if msg is None:
+                break
+            ids.append(msg["id"])
+        assert ids == list(range(2 * len(FORMATS)))
+
+    def test_clean_eof_returns_none(self):
+        assert read_frame(io.BytesIO(b"")) is None
+
+    def test_torn_header_raises_typed(self):
+        frame = encode_msg({"v": WIRE_VERSION, "id": 1, "type": "ok"})
+        with pytest.raises(WireProtocolError):
+            read_frame(io.BytesIO(frame[:3]))  # cut inside the header
+
+    def test_torn_payload_raises_typed(self):
+        frame = encode_msg({"v": WIRE_VERSION, "id": 1, "type": "ok",
+                            "result": list(range(64))})
+        with pytest.raises(WireProtocolError):
+            read_frame(io.BytesIO(frame[:-5]))  # cut inside the payload
+
+    def test_garbage_length_rejected_before_allocation(self):
+        header = codec._HEADER.pack(codec.MAX_FRAME_BYTES + 1,
+                                    codec._FMT_JSON)
+        with pytest.raises(WireProtocolError, match="torn stream"):
+            read_frame(io.BytesIO(header + b"x" * 16))
+
+    def test_unknown_format_marker_raises_typed(self):
+        body = b"{}"
+        header = codec._HEADER.pack(len(body), ord("Z"))
+        with pytest.raises(WireProtocolError, match="format marker"):
+            read_frame(io.BytesIO(header + body))
+
+    def test_undecodable_payload_raises_typed(self):
+        body = b"\xff\xfe not a payload"
+        header = codec._HEADER.pack(len(body), codec._FMT_JSON)
+        with pytest.raises(WireProtocolError):
+            read_frame(io.BytesIO(header + body))
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_version_mismatch_raises_typed(self, fmt):
+        frame = encode_msg({"v": WIRE_VERSION + 1, "id": 1, "type": "ok"},
+                           prefer=fmt)
+        with pytest.raises(WireProtocolError) as ei:
+            read_frame(io.BytesIO(frame))
+        assert ei.value.details["got_version"] == WIRE_VERSION + 1
+        assert ei.value.details["want_version"] == WIRE_VERSION
+
+    def test_write_frame_lock_serializes_whole_frames(self):
+        import threading
+
+        class Chunky:
+            """Records write() call boundaries to prove frames are
+            written as one chunk under the lock."""
+
+            def __init__(self):
+                self.chunks = []
+
+            def write(self, data):
+                self.chunks.append(bytes(data))
+
+            def flush(self):
+                pass
+
+        out = Chunky()
+        lock = threading.Lock()
+        threads = [
+            threading.Thread(target=write_frame,
+                             args=(out, {"v": WIRE_VERSION, "id": i,
+                                         "type": "ok",
+                                         "result": list(range(100))}),
+                             kwargs={"lock": lock})
+            for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # each chunk is one complete frame: parseable in isolation
+        ids = {read_frame(io.BytesIO(c))["id"] for c in out.chunks}
+        assert ids == set(range(8))
+
+
+# ======================================================================
+# error taxonomy
+# ======================================================================
+class TestErrorTaxonomy:
+
+    def test_every_serving_error_subclass_round_trips(self):
+        registry = _error_registry()
+        serving = {name: cls for name, cls in registry.items()
+                   if isinstance(cls, type)
+                   and issubclass(cls, ServingError)}
+        assert len(serving) >= 18  # the whole taxonomy, not a sample
+        for name, cls in sorted(serving.items()):
+            exc = cls(f"{name} happened", hint_a=3, hint_b="x")
+            out = decode_error(encode_error(exc))
+            assert type(out) is cls, name
+            assert str(out) == str(exc), name
+            assert out.details == exc.details, name
+            assert out.reason == exc.reason, name
+            assert out.retry_elsewhere == exc.retry_elsewhere, name
+
+    @pytest.mark.parametrize("fmt", FORMATS)
+    def test_capacity_hints_survive_the_full_frame_path(self, fmt):
+        exc = QueueFullError("decode pool saturated", pool="decode",
+                             queue_depth=17, est_wait_s=0.25)
+        msg = roundtrip({"v": WIRE_VERSION, "id": 3, "type": "err",
+                         "error": encode_error(exc)}, prefer=fmt)
+        out = decode_error(msg["error"])
+        assert isinstance(out, QueueFullError)
+        assert out.details["pool"] == "decode"
+        assert out.details["queue_depth"] == 17
+        assert out.details["est_wait_s"] == 0.25
+        assert out.retry_elsewhere == exc.retry_elsewhere
+
+    def test_trust_boundary_errors_round_trip(self):
+        for cls in (KVTierCorruptionError, WeightPublicationError,
+                    TimeoutError):
+            out = decode_error(encode_error(cls("validator said no")))
+            assert type(out) is cls
+            assert "validator said no" in str(out)
+
+    def test_wire_errors_themselves_round_trip(self):
+        for cls in (WireProtocolError, WireTimeoutError):
+            exc = cls("boom", op="probe")
+            out = decode_error(encode_error(exc))
+            assert type(out) is cls and out.details == {"op": "probe"}
+
+    def test_unknown_code_decodes_typed_never_bare(self):
+        payload = {"code": "FutureFancyError", "message": "from the future",
+                   "reason": "fancy", "retry_elsewhere": True,
+                   "details": {"x": 1}}
+        out = decode_error(payload)
+        assert type(out) is WireProtocolError  # typed, retryable
+        assert isinstance(out, ServingError)
+        assert out.details["remote_code"] == "FutureFancyError"
+        assert out.details["remote_reason"] == "fancy"
+        assert out.details["x"] == 1
+        assert "from the future" in str(out)
+
+    def test_empty_payload_decodes_typed(self):
+        out = decode_error({})
+        assert type(out) is WireProtocolError
+
+    def test_non_serving_exception_encodes_with_safe_defaults(self):
+        payload = encode_error(ValueError("surprise"))
+        assert payload["code"] == "ValueError"
+        assert payload["retry_elsewhere"] is True  # safe default
+        out = decode_error(payload)
+        assert type(out) is WireProtocolError  # ValueError is not wire-typed
+        assert out.details["remote_code"] == "ValueError"

@@ -1,0 +1,507 @@
+"""WireReplica <-> ReplicaServer: the Replica seam over a real socket.
+
+Every test runs a REAL framed-protocol connection (TCP loopback or a
+unix socket); the replica behind the server is a real
+``ServingGateway`` over the deterministic :class:`FakeEngine`, so the
+streams have known bit-exact contents. Covered:
+
+- submit/stream round-trips and concurrent stream multiplexing on one
+  connection;
+- the router contracts across the wire: ``tokens(timeout=...)`` raises
+  ``queue.Empty`` on a stall, typed ``ServingError``s cross with their
+  retry hints, cancel propagates, FleetRouter fails over a killed wire
+  replica with a bit-identical replayed stream;
+- handoff records (ndarray KV carriers, hash-chained keys) cross the
+  wire and still pass ``check_handoff_record`` on the importing side —
+  and torn records still FAIL it, typed;
+- reconnect-with-backoff after server death; blackholed sockets hit
+  I/O deadlines (``WireTimeoutError``); torn frames surface typed;
+- ``FaultyReplica`` fault scripts compose behind the wire;
+- ``DS_FLEET_TRANSPORT``: inproc (and unset) builds a plain
+  ``GatewayReplica`` — the byte-identical off-state — and ``wire``
+  builds the client.
+"""
+
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.prefix_cache.radix_index import _chunk_key
+from deepspeed_tpu.serving import ServingConfig
+from deepspeed_tpu.serving.admission import (QueueFullError,
+                                             RequestCancelledError,
+                                             ServingError)
+from deepspeed_tpu.serving.fleet import (FaultyReplica, FleetConfig,
+                                         FleetRouter, GatewayReplica,
+                                         ReplicaDiedError)
+from deepspeed_tpu.serving.fleet.replica import Replica
+from deepspeed_tpu.serving.fleet.wire import (ReplicaServer, WireReplica,
+                                              WireTimeoutError, make_replica,
+                                              transport_mode)
+from deepspeed_tpu.utils.sanitize import (KVTierCorruptionError,
+                                          check_handoff_record)
+from unit.common.fault_injection import WireFaultProxy
+from unit.inference.serving.test_admission import FakeEngine
+
+
+class SlowEngine(FakeEngine):
+    """FakeEngine that paces generation: tokens trickle out slowly
+    enough that a cancel sent mid-stream reliably beats completion."""
+
+    def put(self, uids, chunks, sample=None):
+        time.sleep(0.05)
+        return super().put(uids, chunks, sample=sample)
+
+
+def gateway_replica(name, engine_cls=FakeEngine, **serving_cfg):
+    serving_cfg.setdefault("max_burst", 1)
+    return GatewayReplica(name, lambda: engine_cls(),
+                          serving_config=ServingConfig(**serving_cfg))
+
+
+def serve(replica, bind="127.0.0.1:0", **client_kw):
+    """Start a ReplicaServer for ``replica``; return (server, client)."""
+    srv = ReplicaServer(replica, bind=bind)
+    addr = srv.start()
+    client_kw.setdefault("timeout_s", 5.0)
+    client_kw.setdefault("probe_timeout_s", 1.0)
+    client_kw.setdefault("connect_timeout_s", 1.0)
+    client_kw.setdefault("backoff_s", 0.02)
+    cli = WireReplica(replica.name, addr, **client_kw)
+    return srv, cli
+
+
+@pytest.fixture
+def stack():
+    """One served GatewayReplica(FakeEngine) + wire client, torn down."""
+    rep = gateway_replica("w0")
+    srv, cli = serve(rep)
+    yield srv, cli, rep
+    cli.close()
+    srv.stop()
+    try:
+        rep.shutdown()
+    except Exception:
+        pass
+
+
+# ======================================================================
+# submit / stream
+# ======================================================================
+class TestStreaming:
+
+    def test_submit_streams_expected_tokens(self, stack):
+        _srv, cli, _rep = stack
+        h = cli.submit(np.array([1, 2, 3], np.int32), max_new_tokens=4)
+        got = list(h.tokens(timeout=10))
+        assert got == FakeEngine.expected_tokens(0, 3, 4)
+        assert h.status == "completed" and h.done
+        assert h.uid == 0  # the REMOTE gateway-local uid
+
+    def test_result_matches_tokens(self, stack):
+        _srv, cli, _rep = stack
+        h = cli.submit([4, 5], max_new_tokens=3)
+        assert h.result(timeout=10) == FakeEngine.expected_tokens(0, 2, 3)
+
+    def test_concurrent_streams_multiplex_one_connection(self, stack):
+        _srv, cli, _rep = stack
+        results = {}
+
+        def run(i):
+            h = cli.submit([1] * 3, max_new_tokens=3)
+            results[h.uid] = h.result(timeout=30)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 6  # six distinct remote uids
+        for uid, got in results.items():
+            assert got == FakeEngine.expected_tokens(uid, 3, 3)
+        assert cli.reconnects == 1  # ONE socket carried all of them
+
+    def test_probe_alive_load_and_stats(self, stack):
+        _srv, cli, rep = stack
+        assert cli.probe() is True and cli.alive() is True
+        assert cli.load() == rep.load() == 0
+        assert cli.weight_version() == rep.weight_version()
+        stats = cli.stats()
+        assert stats["state"] == "running"
+        assert stats["wire_address"] == cli.address
+
+    def test_shutdown_detaches_but_stop_remote_stops_server(self):
+        rep = gateway_replica("w0")
+        srv, cli = serve(rep)
+        probe_cli = WireReplica("w0", srv.address, timeout_s=5.0,
+                                probe_timeout_s=1.0, backoff_s=0.02)
+        try:
+            cli.shutdown()  # client-side detach only
+            assert srv.state == "serving"
+            assert probe_cli.probe() is True  # replica still serving
+            probe_cli.stop_remote()  # explicit remote stop
+            deadline = time.monotonic() + 5
+            while srv.state != "stopped" and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert srv.state == "stopped"
+        finally:
+            probe_cli.close()
+            cli.close()
+            srv.stop()
+            try:
+                rep.shutdown()
+            except Exception:
+                pass
+
+    def test_cancel_propagates_typed(self):
+        # a genuinely slow engine: cancel lands mid-generation, the
+        # gateway's terminal error crosses back as a typed err frame
+        rep = gateway_replica("slow", engine_cls=SlowEngine)
+        srv, cli = serve(rep)
+        try:
+            h = cli.submit([1, 2, 3], max_new_tokens=50)
+            it = h.tokens(timeout=5.0)
+            next(it)  # at least one token streamed before the cancel
+            h.cancel()
+            with pytest.raises(RequestCancelledError):
+                for _ in it:
+                    pass
+            assert h.status == "failed"
+        finally:
+            cli.close()
+            srv.stop()
+
+
+# ======================================================================
+# router contracts over the wire
+# ======================================================================
+class TestRouterContracts:
+
+    def test_stall_raises_queue_empty(self):
+        faulty = FaultyReplica(gateway_replica("hang"), hang_at_token=1)
+        srv, cli = serve(faulty)
+        try:
+            h = cli.submit([7, 8, 9], max_new_tokens=5)
+            it = h.tokens(timeout=0.4)
+            assert next(it) == FakeEngine.expected_tokens(0, 3, 1)[0]
+            with pytest.raises(_queue.Empty):  # the router's stall signal
+                next(it)
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_typed_reject_crosses_with_hints(self):
+        faulty = FaultyReplica(gateway_replica("rej"), reject_next=1)
+        srv, cli = serve(faulty)
+        try:
+            with pytest.raises(QueueFullError) as ei:
+                cli.submit([1], max_new_tokens=1)
+            assert ei.value.details["injected"] is True
+            assert ei.value.details["queue_depth"] == 0
+            assert ei.value.retry_elsewhere is True
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_fleet_router_fails_over_wire_replica_bit_identical(self):
+        """A wire replica crashing mid-stream must look exactly like an
+        in-process crash to the router: typed failure, replay on the
+        survivor, replayed prefix verified, zero duplicate tokens."""
+        faulty = FaultyReplica(gateway_replica("r0"), crash_at_token=2)
+        srv0, cli0 = serve(faulty)
+        srv1, cli1 = serve(gateway_replica("r1"))
+        router = FleetRouter(
+            [cli0, cli1],
+            config=FleetConfig(retry_backoff_s=0.005,
+                               heartbeat_interval_s=0.05,
+                               stream_token_timeout_s=5.0),
+            auto_heartbeat=False)
+        try:
+            h = router.submit([1, 2, 3], max_new_tokens=4)
+            got = h.result(timeout=30)
+            # r0 streamed tokens 0-1 before dying; r1's replay (same
+            # remote uid 0, same FakeEngine arithmetic) must splice
+            # bit-identically
+            assert got == FakeEngine.expected_tokens(0, 3, 4)
+            assert h.replica_trail == ["r0", "r1"]
+            assert router.snapshot()["counters"]["failovers"] >= 1
+        finally:
+            router.shutdown()
+            for c, s in ((cli0, srv0), (cli1, srv1)):
+                c.close()
+                s.stop()
+
+    def test_router_failover_on_server_death(self):
+        """Hard server stop (the kill -9 shape at the socket level):
+        in-flight streams fail typed and the request completes on the
+        surviving wire replica with the identical stream."""
+        slow = FaultyReplica(gateway_replica("r0"), slow_token_s=0.1)
+        srv0, cli0 = serve(slow)
+        srv1, cli1 = serve(gateway_replica("r1"))
+        router = FleetRouter(
+            [cli0, cli1],
+            config=FleetConfig(retry_backoff_s=0.005,
+                               heartbeat_interval_s=0.05,
+                               stream_token_timeout_s=5.0),
+            auto_heartbeat=False)
+        try:
+            h = router.submit([5, 6, 7], max_new_tokens=6)
+            deadline = time.monotonic() + 10
+            while not h._collected and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert h._collected, "no token ever streamed"
+            srv0.stop()  # connection dies with frames in flight
+            got = h.result(timeout=30)
+            assert got == FakeEngine.expected_tokens(0, 3, 6)
+            assert h.replica_trail[0] == "r0"
+            assert h.replica_trail[-1] == "r1"
+        finally:
+            router.shutdown()
+            for c, s in ((cli0, srv0), (cli1, srv1)):
+                c.close()
+                s.stop()
+
+
+# ======================================================================
+# handoff across the wire
+# ======================================================================
+def make_handoff_record(block_size=4, n_entries=3, seed=0):
+    """A validator-passing handoff record with real ndarray KV
+    carriers and properly hash-chained keys."""
+    rng = np.random.RandomState(seed)
+    entries, pk = [], None
+    for i in range(n_entries):
+        tokens = tuple(int(t) for t in rng.randint(0, 997, size=block_size))
+        key = _chunk_key(pk, tokens)
+        k = rng.randn(2, block_size, 4).astype(np.float32)
+        v = rng.randn(2, block_size, 4).astype(np.float32)
+        entries.append({"key": key, "parent_key": pk, "tokens": tokens,
+                        "handle": {"k": k, "v": v},
+                        "nbytes": int(k.nbytes + v.nbytes),
+                        "quant_error": 0.0})
+        pk = key
+    return {"version": 1, "block_size": block_size, "root_key": None,
+            "quantized": False, "entries": entries}
+
+
+class _HandoffEndpoint(Replica):
+    """Minimal replica: exports a fixed record, validates imports with
+    the REAL trust-boundary check before adopting."""
+
+    def __init__(self, name, record=None):
+        self.name = name
+        self.role = "unified"
+        self.record = record
+        self.imported = []
+
+    def take_handoff(self, uid):
+        return self.record
+
+    def import_handoff(self, record):
+        check_handoff_record(record)  # the unconditional validator
+        self.imported.append(record)
+        return sum(len(e["tokens"]) for e in record["entries"])
+
+    def probe(self):
+        return True
+
+    def alive(self):
+        return True
+
+    def shutdown(self):
+        pass
+
+
+class TestHandoffAcrossWire:
+
+    def test_record_round_trips_validated_and_bit_identical(self):
+        record = make_handoff_record()
+        src = _HandoffEndpoint("prefill", record)
+        dst = _HandoffEndpoint("decode")
+        srv_a, cli_a = serve(src)
+        srv_b, cli_b = serve(dst)
+        try:
+            taken = cli_a.take_handoff(uid=0)
+            # the claimed record is indistinguishable from a local
+            # export: tuple tokens, validator-clean
+            assert isinstance(taken["entries"][0]["tokens"], tuple)
+            check_handoff_record(taken)
+            imported = cli_b.import_handoff(taken)
+            assert imported == 3 * 4
+            adopted = dst.imported[0]
+            for orig, got in zip(record["entries"], adopted["entries"]):
+                assert got["key"] == orig["key"]
+                assert tuple(got["tokens"]) == orig["tokens"]
+                for carrier in ("k", "v"):  # KV crosses bit-identical
+                    assert (got["handle"][carrier].tobytes()
+                            == orig["handle"][carrier].tobytes())
+                    assert (got["handle"][carrier].dtype
+                            == orig["handle"][carrier].dtype)
+        finally:
+            for c, s in ((cli_a, srv_a), (cli_b, srv_b)):
+                c.close()
+                s.stop()
+
+    def test_torn_record_rejected_typed_on_the_importing_side(self):
+        record = make_handoff_record()
+        src = FaultyReplica(_HandoffEndpoint("prefill", record),
+                            corrupt_handoff=True)
+        dst = _HandoffEndpoint("decode")
+        srv_a, cli_a = serve(src)
+        srv_b, cli_b = serve(dst)
+        try:
+            torn = cli_a.take_handoff(uid=0)
+            with pytest.raises(KVTierCorruptionError):
+                cli_b.import_handoff(torn)
+            assert dst.imported == []  # nothing adopted
+        finally:
+            for c, s in ((cli_a, srv_a), (cli_b, srv_b)):
+                c.close()
+                s.stop()
+
+
+# ======================================================================
+# process/wire fault modes
+# ======================================================================
+class TestWireFaults:
+
+    def test_server_death_fails_fast_then_reconnects(self, tmp_path):
+        bind = f"unix:{tmp_path}/r0.sock"
+        rep = gateway_replica("w0")
+        srv, cli = serve(rep, bind=bind)
+        assert cli.probe() is True
+        srv.stop()
+        assert cli.probe() is False  # typed-degraded, no hang
+        assert cli.load() == float("inf")
+        assert cli.alive() is False
+        # a replacement process binds the SAME address (what the
+        # supervisor guarantees) and the client transparently reconnects
+        rep2 = gateway_replica("w0")
+        srv2 = ReplicaServer(rep2, bind=bind)
+        srv2.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not cli.probe():
+                time.sleep(0.02)  # ride out the connect backoff
+            assert cli.probe() is True
+            h = cli.submit([1, 2], max_new_tokens=2)
+            assert h.result(timeout=10) == FakeEngine.expected_tokens(0, 2, 2)
+            assert cli.reconnects >= 2
+        finally:
+            cli.close()
+            srv2.stop()
+
+    def test_blackholed_socket_hits_io_deadline(self):
+        rep = gateway_replica("w0")
+        srv = ReplicaServer(rep, bind="127.0.0.1:0")
+        addr = srv.start()
+        proxy = WireFaultProxy(addr, mode="blackhole")
+        cli = WireReplica("w0", proxy.address, timeout_s=0.5,
+                          probe_timeout_s=0.3, connect_timeout_s=1.0)
+        try:
+            t0 = time.monotonic()
+            assert cli.probe() is False  # deadline, not a wedge
+            assert time.monotonic() - t0 < 2.0
+            with pytest.raises((WireTimeoutError, ReplicaDiedError)):
+                cli._call("weight_version")  # unary deadline is typed
+        finally:
+            cli.close()
+            proxy.close()
+            srv.stop()
+
+    def test_torn_frame_surfaces_typed(self):
+        rep = gateway_replica("w0")
+        srv = ReplicaServer(rep, bind="127.0.0.1:0")
+        addr = srv.start()
+        proxy = WireFaultProxy(addr, mode="torn", torn_after=20)
+        cli = WireReplica("w0", proxy.address, timeout_s=1.0,
+                          probe_timeout_s=1.0)
+        try:
+            with pytest.raises(ServingError):  # typed, never bare
+                cli._call("weight_version")
+        finally:
+            cli.close()
+            proxy.close()
+            srv.stop()
+
+    def test_proxy_pass_mode_is_transparent(self):
+        rep = gateway_replica("w0")
+        srv = ReplicaServer(rep, bind="127.0.0.1:0")
+        addr = srv.start()
+        proxy = WireFaultProxy(addr, mode="pass")
+        cli = WireReplica("w0", proxy.address, timeout_s=5.0)
+        try:
+            h = cli.submit([1, 2, 3], max_new_tokens=3)
+            assert h.result(timeout=10) == FakeEngine.expected_tokens(0, 3, 3)
+            assert proxy.forwarded > 0
+        finally:
+            cli.close()
+            proxy.close()
+            srv.stop()
+
+    def test_dropped_connection_fails_pending_typed(self):
+        faulty = FaultyReplica(gateway_replica("w0"), hang_at_token=0)
+        srv = ReplicaServer(faulty, bind="127.0.0.1:0")
+        addr = srv.start()
+        proxy = WireFaultProxy(addr, mode="pass")
+        cli = WireReplica("w0", proxy.address, timeout_s=5.0)
+        try:
+            h = cli.submit([1, 2], max_new_tokens=4)  # stream hangs
+            proxy.drop_connections()  # hard cut with the stream open
+            with pytest.raises(ServingError):
+                list(h.tokens(timeout=5.0))
+            assert h.status == "failed"
+        finally:
+            cli.close()
+            proxy.close()
+            srv.stop()
+
+
+# ======================================================================
+# transport selection (DS_FLEET_TRANSPORT)
+# ======================================================================
+class TestTransportKnob:
+
+    def test_unset_and_inproc_build_plain_gateway_replica(self, monkeypatch):
+        monkeypatch.delenv("DS_FLEET_TRANSPORT", raising=False)
+        assert transport_mode() == "inproc"
+        rep = make_replica("r0", lambda: FakeEngine(),
+                           ServingConfig(max_burst=1))
+        assert type(rep) is GatewayReplica  # the exact pre-wire fleet
+        monkeypatch.setenv("DS_FLEET_TRANSPORT", "inproc")
+        rep2 = make_replica("r0", lambda: FakeEngine(),
+                            ServingConfig(max_burst=1))
+        assert type(rep2) is GatewayReplica
+        # identical behavior to a hand-built replica: same stream
+        h = rep2.submit([1, 2, 3], max_new_tokens=3)
+        assert h.result(timeout=10) == FakeEngine.expected_tokens(0, 3, 3)
+        rep.shutdown()
+        rep2.shutdown()
+
+    def test_wire_mode_builds_client_for_address(self, monkeypatch):
+        monkeypatch.setenv("DS_FLEET_TRANSPORT", "wire")
+        assert transport_mode() == "wire"
+        srv, cli0 = serve(gateway_replica("w0"))
+        try:
+            rep = make_replica("w0", address=cli0.address, timeout_s=5.0)
+            assert isinstance(rep, WireReplica)
+            h = rep.submit([9, 9], max_new_tokens=2)
+            assert h.result(timeout=10) == FakeEngine.expected_tokens(0, 2, 2)
+            rep.close()
+        finally:
+            cli0.close()
+            srv.stop()
+
+    def test_wire_mode_requires_address(self, monkeypatch):
+        monkeypatch.setenv("DS_FLEET_TRANSPORT", "wire")
+        with pytest.raises(ValueError, match="address"):
+            make_replica("r0", lambda: FakeEngine())
+
+    def test_invalid_mode_rejected(self, monkeypatch):
+        monkeypatch.setenv("DS_FLEET_TRANSPORT", "carrier-pigeon")
+        with pytest.raises(ValueError, match="DS_FLEET_TRANSPORT"):
+            transport_mode()

@@ -1,0 +1,297 @@
+"""FleetSupervisor: real replica processes under real signals.
+
+The cross-process acceptance for the wire fleet: ``bin/ds_replica``
+workers spawned by :class:`FleetSupervisor`, killed with real
+``SIGKILL``, hung past the heartbeat watchdog, crash-looped past the
+failure budget — and on the traffic side, a :class:`FleetRouter` over
+:class:`WireReplica` clients that must fail a mid-stream ``kill -9``
+over to the surviving process with a bit-identical replayed stream.
+
+Heavy workers (they import jax in the child) are shared per class;
+budget/watchdog/stop tests use tiny argv-compatible stub workers with
+no jax import, so they stay fast.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.serving.fleet import FleetConfig, FleetRouter
+from deepspeed_tpu.serving.fleet.wire import (FleetSupervisor,
+                                              ReplicaProcSpec, WireReplica)
+from unit.common.fault_injection import kill_process
+from unit.inference.serving.test_admission import FakeEngine
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DS_SKIP_MULTIPROC") == "1",
+    reason="multiprocess tests disabled (DS_SKIP_MULTIPROC=1)")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+CHILD_ENV = {"PYTHONPATH": f"{REPO}:{os.path.join(REPO, 'tests')}",
+             "JAX_PLATFORMS": "cpu"}
+
+
+def factory_spec(name, fn="make_slow_replica"):
+    return ReplicaProcSpec(
+        name,
+        cmd=[sys.executable, os.path.join(REPO, "bin", "ds_replica"),
+             "--factory", f"unit.common.wire_workers:{fn}"],
+        env=CHILD_ENV)
+
+
+def wire_client(sup, name, **kw):
+    kw.setdefault("timeout_s", 15.0)
+    kw.setdefault("probe_timeout_s", 3.0)
+    kw.setdefault("connect_timeout_s", 5.0)
+    kw.setdefault("backoff_s", 0.05)
+    return WireReplica(name, sup.address(name, timeout=30.0), **kw)
+
+
+def wait_until(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ======================================================================
+# the real thing: ds_replica workers, FakeEngine gateways inside
+# ======================================================================
+class TestSupervisedFleet:
+    """One two-replica fleet shared by the ordered tests below (child
+    startup imports jax — ~10s per process)."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self, tmp_path_factory):
+        run_dir = tmp_path_factory.mktemp("fleet")
+        sup = FleetSupervisor(
+            [factory_spec("r0"), factory_spec("r1")],
+            run_dir=str(run_dir), max_restarts=3, monitor_interval=0.1,
+            watchdog_timeout=0, grace=5.0)
+        sup.start()
+        clients = {}
+        try:
+            for name in ("r0", "r1"):
+                clients[name] = wire_client(sup, name)
+                wait_until(clients[name].probe, 60.0,
+                           f"replica {name} to come up")
+            yield sup, clients
+        finally:
+            for cli in clients.values():
+                cli.close()
+            sup.stop()
+
+    def test_spawn_announce_and_serve(self, fleet):
+        # probes only: both gateways must stay pristine (uid counter 0)
+        # so the kill -9 replay below is bit-identical on the survivor
+        sup, clients = fleet
+        for name in ("r0", "r1"):
+            assert sup.running(name)
+            assert sup.address(name).startswith("unix:")
+            assert clients[name].alive() is True
+            assert clients[name].load() == 0
+
+    def test_kill9_midstream_fails_over_bit_identical(self, fleet):
+        """THE acceptance: SIGKILL a replica process with a stream in
+        flight; the router completes the request on the surviving
+        process, replayed prefix verified, stream bit-identical to the
+        canonical uid-0 FakeEngine stream. Zero lost requests."""
+        sup, clients = fleet
+        # the router gets its OWN clients: router.shutdown() detaches
+        # them (WireReplica.shutdown closes the client side only — the
+        # processes stay up for the tests that follow)
+        router = FleetRouter(
+            [wire_client(sup, "r0"), wire_client(sup, "r1")],
+            config=FleetConfig(retry_backoff_s=0.05,
+                               heartbeat_interval_s=0.2,
+                               stream_token_timeout_s=20.0),
+            auto_heartbeat=False)
+        try:
+            # SlowFakeEngine paces ~50ms/token: 40 tokens ≈ 2s window
+            h = router.submit([1, 2, 3], max_new_tokens=40)
+            wait_until(lambda: len(h._collected) >= 2, 30.0,
+                       "the stream to start")
+            victim = h.replica_trail[0]
+            kill_process(sup.pid(victim))  # real SIGKILL, mid-stream
+            got = h.result(timeout=60)
+            assert got == FakeEngine.expected_tokens(0, 3, 40)
+            survivor = ({"r0", "r1"} - {victim}).pop()
+            assert h.replica_trail == [victim, survivor]
+            assert router.snapshot()["counters"]["failovers"] >= 1
+        finally:
+            router.shutdown()
+
+    def test_killed_replica_relaunches_on_same_address(self, fleet):
+        """The supervisor half of recovery: the monitor relaunches the
+        SIGKILLed process (rc normalized to 137), the replacement binds
+        the SAME unix socket, and the existing WireReplica reconnects
+        to it without re-discovery."""
+        sup, clients = fleet
+        stats = sup.stats()
+        killed = [n for n, s in stats.items() if s["restarts"] > 0]
+        assert killed, "previous test killed one replica"
+        name = killed[0]
+        wait_until(lambda: sup.running(name), 60.0,
+                   f"{name} to be relaunched")
+        cli = clients[name]
+        wait_until(cli.probe, 60.0, f"{name} to serve again")
+        # fresh gateway in the replacement process: uid counter reset
+        h = cli.submit([1, 2, 3], max_new_tokens=4)
+        assert h.result(timeout=30) == FakeEngine.expected_tokens(0, 3, 4)
+        assert sup.stats()[name]["state"] == "running"
+
+
+# ======================================================================
+# supervision mechanics: stub workers, no jax in the child
+# ======================================================================
+STUB = textwrap.dedent("""\
+    import argparse, json, os, signal, sys, time
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--name"); p.add_argument("--bind")
+    p.add_argument("--heartbeat-file"); p.add_argument("--announce-file")
+    p.add_argument("--beats", type=int, default=-1)
+    p.add_argument("--exit-rc", type=int, default=None)
+    p.add_argument("--ignore-term", action="store_true")
+    args = p.parse_args()
+
+    if args.exit_rc is not None:
+        sys.exit(args.exit_rc)  # immediate-crash worker
+    if args.ignore_term:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    else:
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    if args.announce_file:
+        with open(args.announce_file, "w") as fd:
+            fd.write(args.bind or "")
+    n = 0
+    while True:
+        if args.beats < 0 or n < args.beats:
+            n += 1
+            tmp = args.heartbeat_file + ".tmp"
+            with open(tmp, "w") as fd:
+                json.dump({"beats": n, "time": time.time()}, fd)
+            os.replace(tmp, args.heartbeat_file)
+        time.sleep(0.1)
+""")
+
+
+@pytest.fixture
+def stub(tmp_path):
+    path = tmp_path / "stub_worker.py"
+    path.write_text(STUB)
+
+    def spec(name, *extra, **kw):
+        return ReplicaProcSpec(
+            name, cmd=[sys.executable, str(path)] + list(extra), **kw)
+
+    return spec
+
+
+class TestSupervisionMechanics:
+
+    def test_crash_loop_exhausts_budget_peers_unaffected(self, stub,
+                                                         tmp_path):
+        sup = FleetSupervisor(
+            [stub("crasher", "--exit-rc", "3"), stub("steady")],
+            run_dir=str(tmp_path / "run"), max_restarts=2,
+            failure_window=300.0, monitor_interval=0.05,
+            watchdog_timeout=0, grace=0.5)
+        sup.start()
+        try:
+            wait_until(
+                lambda: sup.stats()["crasher"]["state"] == "failed",
+                20.0, "the crash loop to exhaust the budget")
+            stats = sup.stats()
+            # budget: the initial launch + max_restarts relaunches
+            assert stats["crasher"]["restarts"] == 2
+            assert stats["crasher"]["failures_in_window"] == 3
+            assert stats["steady"]["state"] == "running"
+            assert sup.running("steady")  # peers keep serving
+        finally:
+            sup.stop()
+
+    def test_hang_watchdog_escalates_and_relaunches(self, stub, tmp_path):
+        # beats 3 times (~0.3s) then stops; SIGTERM is ignored, so the
+        # relaunch requires the full SIGTERM -> grace -> SIGKILL path
+        sup = FleetSupervisor(
+            [stub("wedge", "--beats", "3", "--ignore-term")],
+            run_dir=str(tmp_path / "run"), max_restarts=1,
+            monitor_interval=0.05, watchdog_timeout=1.0, grace=0.3)
+        sup.start()
+        try:
+            wait_until(lambda: sup.stats()["wedge"]["hangs"] >= 1, 30.0,
+                       "the watchdog to fire")
+            wait_until(lambda: sup.stats()["wedge"]["restarts"] >= 1,
+                       10.0, "the hung replica to be relaunched")
+            # the replacement wedges too; with max_restarts=1 the
+            # second hang exhausts the budget
+            wait_until(
+                lambda: sup.stats()["wedge"]["state"] == "failed",
+                30.0, "the second hang to exhaust the budget")
+            assert sup.stats()["wedge"]["hangs"] == 2
+        finally:
+            sup.stop()
+
+    def test_sigkill_rc_is_normalized(self, stub, tmp_path):
+        sup = FleetSupervisor(
+            [stub("victim")], run_dir=str(tmp_path / "run"),
+            max_restarts=1, monitor_interval=0.05, watchdog_timeout=0,
+            grace=0.5)
+        sup.start()
+        try:
+            wait_until(lambda: sup.running("victim"), 10.0, "launch")
+            pid = sup.pid("victim")
+            sup.kill("victim")  # SIGKILL via the supervisor's own hook
+            wait_until(
+                lambda: sup.running("victim") and sup.pid("victim") != pid,
+                20.0, "the relaunch")
+            assert sup.stats()["victim"]["restarts"] == 1
+        finally:
+            sup.stop()
+
+    def test_stop_is_graceful_for_cooperative_workers(self, stub,
+                                                      tmp_path):
+        sup = FleetSupervisor(
+            [stub("a"), stub("b")], run_dir=str(tmp_path / "run"),
+            monitor_interval=0.05, watchdog_timeout=0, grace=5.0)
+        sup.start()
+        try:
+            # the announce file is written AFTER the SIGTERM handler is
+            # installed — a poll()-based wait would race worker startup
+            wait_until(
+                lambda: all(os.path.exists(sup._children[n].announce_file)
+                            for n in ("a", "b")),
+                10.0, "both workers ready")
+        finally:
+            t0 = time.monotonic()
+            sup.stop()
+        took = time.monotonic() - t0
+        assert took < 4.0  # SIGTERM honored: nobody sat out the grace
+        for name in ("a", "b"):
+            child = sup._children[name]
+            assert child.popen.poll() == 0  # clean exits, no SIGKILL
+            assert sup.stats()[name]["state"] == "stopped"
+
+    def test_announce_fallback_is_the_assigned_bind(self, stub,
+                                                    tmp_path):
+        # a worker that never writes the announce file (exit-rc crashes
+        # immediately): address() falls back to the deterministic bind
+        sup = FleetSupervisor(
+            [stub("mute", "--exit-rc", "0")],
+            run_dir=str(tmp_path / "run"), max_restarts=0,
+            monitor_interval=0.05, watchdog_timeout=0, grace=0.5)
+        sup.start()
+        try:
+            addr = sup.address("mute", timeout=0.3)
+            assert addr == f"unix:{tmp_path / 'run' / 'mute.sock'}"
+        finally:
+            sup.stop()

@@ -136,6 +136,14 @@ THREAD_SHARED_REGISTRY = {
     # fleet: relay threads + heartbeat thread + client threads all touch
     # router/health/replica state
     "FleetRouter": {"_counters", "_relays", "_closed"},
+    # wire transport: the supervisor monitor thread relaunches children
+    # while operator threads kill/stop/query; the client's reader thread
+    # demuxes into state client threads register/release; the server's
+    # accept/dispatch/relay threads share conn + stream registries
+    "FleetSupervisor": {"_children", "_stopped", "restarts_total"},
+    "WireReplica": {"_sock", "_wfile", "_reader", "_pending", "_next_rid",
+                    "_backoff", "_retry_at", "_closed", "reconnects"},
+    "ReplicaServer": {"_state", "_conns", "_streams", "served"},
     "ReplicaHealth": {"_state", "_consecutive_failures", "_half_open_ok",
                       "_next_probe_at", "_probe_backoff", "transitions"},
     "GatewayReplica": {"gateway", "restarts"},
@@ -183,9 +191,20 @@ LOCK_ORDER = {
     # lock), and calls into its publisher, so both rank below rank 10
     "FleetRefreshController._lock": 4,
     "WeightPublisher._lock": 6,
+    # the fleet supervisor is an outermost orchestrator: its monitor
+    # thread only spawns/kills OS processes and never calls into the
+    # router, but operator code may stop the fleet while holding no
+    # other lock — rank it above (outside) the router
+    "FleetSupervisor._lock": 8,
     "FleetRouter._lock": 10,
+    # the wire client is called FROM router relay threads (rank 10) and
+    # itself takes only its own lock (socket I/O happens outside it)
+    "WireReplica._lock": 12,
     "HandoffManager._lock": 14,
     "PoolScheduler._lock": 16,
+    # the replica server dispatches into the gateway (ranks 20+) while
+    # holding nothing; its own lock guards only conn/stream registries
+    "ReplicaServer._lock": 17,
     # the online SLO controller decides under its own lock and actuates
     # gateway knobs outside it, so it ranks between the router and the
     # gateway's own locks; the trace recorder is a leaf (submit-path
