@@ -45,7 +45,15 @@ _NULL = ("null",)
 class SchemaCompileError(ValueError):
     """Typed compile-time rejection: malformed regex/schema, an
     unsupported JSON-schema construct, or a schema whose token DFA has
-    a reachable dead-end state (no legal next token) for this vocab."""
+    a reachable dead-end state (no legal next token) for this vocab.
+
+    Registered in the fleet's wire-error registry: a remote submit with
+    a bad schema raises this on the worker and must decode as the SAME
+    type on the client — and never be retried on another replica, since
+    a schema that fails to compile here fails everywhere."""
+
+    reason = "schema_compile"
+    retry_elsewhere = False
 
 
 # ------------------------------------------------- smart constructors

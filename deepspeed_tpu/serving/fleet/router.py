@@ -230,7 +230,11 @@ class FleetRouter:
         path leaves the handle unfinished."""
         cfg = self.config
         excluded = set()  # replicas that already failed THIS request
-        rng = random.Random(hash((self._seed, handle.uid)))
+        # backoff-jitter seed: derive_seed, NOT Python hash() — hash is
+        # PYTHONHASHSEED-salted for str/bytes, so a uid type change
+        # would silently desynchronize retry schedules across processes
+        from deepspeed_tpu.inference.structured.prng import derive_seed
+        rng = random.Random(derive_seed(self._seed, handle.uid))
         try:
             if self.pools is not None:
                 if self._serve_disagg(handle, rng, excluded):

@@ -174,12 +174,42 @@ def read_frame(rfile):
     return decode_body(fmt, body)
 
 
+# DS_SANITIZE self-check seam: the frame encoder write_frame uses.
+# Resolved lazily at the first write (not at import) so tests can flip
+# the env knob; when sanitize is OFF this IS encode_msg — verbatim, no
+# wrapper — so the off-state has zero per-frame overhead (asserted by
+# tests/unit/tooling/test_sanitize.py).
+_frame_encoder = None
+
+
+def _reparse_frame(data):
+    """The receive path applied to an in-memory frame: header split +
+    decode_body (version check included) — what the peer would see."""
+    _length, fmt = _HEADER.unpack(data[:_HEADER.size])
+    return decode_body(fmt, data[_HEADER.size:])
+
+
+def _encoder():
+    global _frame_encoder
+    if _frame_encoder is None:
+        from deepspeed_tpu.utils.sanitize import checked_frame_encoder
+        _frame_encoder = checked_frame_encoder(encode_msg, _reparse_frame)
+    return _frame_encoder
+
+
+def _reset_frame_encoder():
+    """Test hook: re-sample DS_SANITIZE at the next write_frame."""
+    global _frame_encoder
+    _frame_encoder = None
+
+
 def write_frame(wfile, msg, lock=None, prefer=None):
     """Serialize + write one frame. ``lock`` (when given) makes the
     write atomic against other threads sharing the connection —
     responses from per-request relay threads interleave at frame
-    granularity, never mid-frame."""
-    data = encode_msg(msg, prefer=prefer)
+    granularity, never mid-frame. Under DS_SANITIZE=1 every frame is
+    round-trip-verified before the first byte is written."""
+    data = _encoder()(msg, prefer=prefer)
     if lock is not None:
         with lock:
             wfile.write(data)

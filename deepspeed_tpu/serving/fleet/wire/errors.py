@@ -55,15 +55,17 @@ def _error_registry():
     if _registry_cache is not None:
         return _registry_cache
     # import every module that defines ServingError subclasses so the
-    # subclass walk is complete
+    # subclass walk is complete (graft-lint's wire-contract rule keeps
+    # this list in sync with the tree — a module defining a subclass
+    # that is missing here is a lint error)
     import deepspeed_tpu.serving.admission  # noqa: F401
     import deepspeed_tpu.serving.fleet.handoff  # noqa: F401
     import deepspeed_tpu.serving.fleet.replica  # noqa: F401
     import deepspeed_tpu.serving.fleet.router  # noqa: F401
     import deepspeed_tpu.serving.lora.store  # noqa: F401
     import deepspeed_tpu.serving.refresh.controller  # noqa: F401
-    from deepspeed_tpu.utils.sanitize import (KVTierCorruptionError,
-                                              WeightPublicationError)
+    from deepspeed_tpu.inference.structured.grammar import SchemaCompileError
+    from deepspeed_tpu.utils import sanitize as _sanitize
 
     registry = {}
 
@@ -73,12 +75,20 @@ def _error_registry():
             walk(sub)
 
     walk(ServingError)
-    # trust-boundary rejections that cross the wire typed: a decode
-    # replica rejecting a forged handoff record, a replica rejecting a
-    # torn weight publication, a refresh adoption blowing its deadline
-    registry["KVTierCorruptionError"] = KVTierCorruptionError
-    registry["WeightPublicationError"] = WeightPublicationError
+    # trust-boundary rejections that cross the wire typed: the whole
+    # SanitizerError family (a decode replica rejecting a forged
+    # handoff record, a torn weight publication, a DS_SANITIZE worker
+    # tripping an invariant mid-request), the structured-decoding
+    # compile rejection (raised at remote submit — retry_elsewhere is
+    # FALSE: a malformed schema is malformed fleet-wide), and
+    # ``TimeoutError`` for refresh deadlines
+    walk(_sanitize.SanitizerError)
+    registry["SchemaCompileError"] = SchemaCompileError
     registry["TimeoutError"] = TimeoutError
+    if _sanitize.sanitize_enabled():
+        # asserted complete against the live subclass walk exactly once,
+        # before the cache is published
+        _sanitize.check_error_registry(registry, ServingError)
     _registry_cache = registry
     return registry
 

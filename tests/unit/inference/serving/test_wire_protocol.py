@@ -30,6 +30,7 @@ from deepspeed_tpu.serving.fleet.wire.errors import (WireProtocolError,
                                                      decode_error,
                                                      encode_error)
 from deepspeed_tpu.utils.sanitize import (KVTierCorruptionError,
+                                          SanitizerError,
                                           WeightPublicationError)
 
 FORMATS = [codec._FMT_JSON] + (
@@ -231,3 +232,43 @@ class TestErrorTaxonomy:
         out = decode_error(payload)
         assert type(out) is WireProtocolError  # ValueError is not wire-typed
         assert out.details["remote_code"] == "ValueError"
+
+    def test_sanitizer_error_family_round_trips(self):
+        """The whole SanitizerError family is registered via the live
+        subclass walk — a DS_SANITIZE worker tripping an invariant
+        mid-request must surface typed on the client, not degrade to a
+        retryable WireProtocolError."""
+        registry = _error_registry()
+        from deepspeed_tpu.utils import sanitize
+
+        def walk(cls):
+            yield cls
+            for sub in cls.__subclasses__():
+                if sub.__module__ == sanitize.__name__:
+                    yield from walk(sub)
+
+        family = list(walk(SanitizerError))
+        assert len(family) >= 8  # the whole family, not a sample
+        for cls in family:
+            assert registry[cls.__name__] is cls
+            out = decode_error(encode_error(cls("invariant tripped")))
+            assert type(out) is cls
+            assert "invariant tripped" in str(out)
+            # retry_elsewhere must be False: a sanitizer trip is a bug,
+            # not a capacity signal — never bounce it to another replica
+            assert out.retry_elsewhere is False
+
+    def test_schema_compile_error_round_trips_not_retryable(self):
+        """A bad schema rejected at remote submit must decode as the
+        SAME type with retry_elsewhere=False — the schema is malformed
+        fleet-wide, so failover would just burn every replica."""
+        from deepspeed_tpu.inference.structured.grammar import \
+            SchemaCompileError
+        exc = SchemaCompileError("unsupported keyword: patternProperties")
+        payload = encode_error(exc)
+        assert payload["reason"] == "schema_compile"
+        assert payload["retry_elsewhere"] is False
+        out = decode_error(payload)
+        assert type(out) is SchemaCompileError
+        assert isinstance(out, ValueError)  # local except clauses still fire
+        assert "patternProperties" in str(out)

@@ -1,9 +1,12 @@
-"""graft-lint rule-family tests: each of the five families has a
-positive (seeded violation caught), a negative (idiomatic clean code
-passes), a pragma case, and the baseline mechanism is covered
-end-to-end."""
+"""graft-lint rule-family tests: each rule family has a positive
+(seeded violation caught), a negative (idiomatic clean code passes), a
+pragma case, and the baseline mechanism is covered end-to-end. The
+cross-file families (lock-order, wire-contract) additionally carry
+mutation tests over the REAL source files — delete one side of the
+contract and the gate must fail naming the missing symbol."""
 
 import json
+import os
 import textwrap
 
 import pytest
@@ -771,3 +774,538 @@ class TestCliBaselineAndFilters:
         capsys.readouterr()
         assert main(["--only=nope", str(mixed)]) == 2
         assert "unknown rule" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------- wire-contract
+REPLICA_REL = "deepspeed_tpu/serving/fleet/replica.py"
+CLIENT_REL = "deepspeed_tpu/serving/fleet/wire/client.py"
+SERVER_REL = "deepspeed_tpu/serving/fleet/wire/server.py"
+ERRORS_REL = "deepspeed_tpu/serving/fleet/wire/errors.py"
+SEAM_FILES = (REPLICA_REL, CLIENT_REL, SERVER_REL, ERRORS_REL)
+
+REPLICA_SRC = """
+    class ServingError(Exception):
+        reason = "serving_error"
+        retry_elsewhere = False
+
+
+    class Replica:
+        def probe(self):
+            raise NotImplementedError
+
+        def drain(self):
+            raise NotImplementedError
+"""
+
+CLIENT_SRC = """
+    class WireReplica:
+        def probe(self):
+            return self._call("probe")
+
+        def drain(self):
+            return self._call("drain")
+"""
+
+SERVER_SRC = """
+    class ReplicaServer:
+        def _unary(self, op, msg):
+            if op == "probe":
+                return {"ok": True}
+            if op == "drain":
+                return {"ok": True}
+            return None
+"""
+
+ERRORS_SRC = """
+    def _error_registry():
+        import deepspeed_tpu.serving.fleet.replica  # noqa: F401
+        return {}
+"""
+
+
+def write_wire_tree(tmp_path, replica=REPLICA_SRC, client=CLIENT_SRC,
+                    server=SERVER_SRC, errors=ERRORS_SRC):
+    for rel, src in ((REPLICA_REL, replica), (CLIENT_REL, client),
+                     (SERVER_REL, server), (ERRORS_REL, errors)):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+
+def wire_lint(tmp_path, baseline=None):
+    vs, baselined = lint_paths([str(tmp_path)], baseline=baseline,
+                               root=str(tmp_path), only={"wire-contract"})
+    return vs, baselined
+
+
+def copy_real_seam(tmp_path, mutate=None):
+    """Mirror the real wire seam into tmp_path preserving the
+    deepspeed_tpu/... layout (module dotted names derive from the
+    relpath, so the mirror must keep the real structure)."""
+    from tools.graft_lint.cli import REPO_ROOT
+    for rel in SEAM_FILES:
+        with open(os.path.join(REPO_ROOT, rel)) as fd:
+            src = fd.read()
+        if mutate is not None:
+            src = mutate(rel, src)
+        dest = tmp_path / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(src)
+
+
+class TestWireContract:
+
+    def test_consistent_seam_clean(self, tmp_path):
+        write_wire_tree(tmp_path)
+        vs, _ = wire_lint(tmp_path)
+        assert vs == []
+
+    def test_missing_client_relay(self, tmp_path):
+        write_wire_tree(tmp_path, client="""
+            class WireReplica:
+                def probe(self):
+                    return self._call("probe")
+        """)
+        vs, _ = wire_lint(tmp_path)
+        assert [v.symbol for v in vs] == ["WireReplica.drain"]
+        assert vs[0].path == CLIENT_REL
+        assert "no WireReplica relay" in vs[0].message
+
+    def test_relay_that_never_sends_its_op(self, tmp_path):
+        write_wire_tree(tmp_path, client="""
+            class WireReplica:
+                def probe(self):
+                    return self._call("probe")
+
+                def drain(self):
+                    return None
+        """)
+        vs, _ = wire_lint(tmp_path)
+        assert [v.symbol for v in vs] == ["WireReplica.drain"]
+        assert "never sends wire op" in vs[0].message
+
+    def test_missing_server_op(self, tmp_path):
+        write_wire_tree(tmp_path, server="""
+            class ReplicaServer:
+                def _unary(self, op, msg):
+                    if op == "probe":
+                        return {"ok": True}
+                    return None
+        """)
+        vs, _ = wire_lint(tmp_path)
+        assert [v.symbol for v in vs] == ["ReplicaServer.drain"]
+        assert vs[0].path == SERVER_REL
+        assert "never handles it" in vs[0].message
+
+    def test_dead_server_op(self, tmp_path):
+        write_wire_tree(tmp_path, server="""
+            class ReplicaServer:
+                def _unary(self, op, msg):
+                    if op == "probe":
+                        return {"ok": True}
+                    if op == "drain":
+                        return {"ok": True}
+                    if op == "zap":
+                        return {"ok": True}
+                    return None
+        """)
+        vs, _ = wire_lint(tmp_path)
+        assert [v.symbol for v in vs] == ["ReplicaServer.zap"]
+        assert "no client relay" in vs[0].message
+
+    def test_registry_import_completeness(self, tmp_path):
+        replica = REPLICA_SRC + """
+    class BoomError(ServingError):
+        reason = "boom"
+        retry_elsewhere = True
+"""
+        write_wire_tree(tmp_path, replica=replica, errors="""
+            def _error_registry():
+                return {}
+        """)
+        vs, _ = wire_lint(tmp_path)
+        assert [v.symbol for v in vs] == \
+            ["deepspeed_tpu.serving.fleet.replica"]
+        assert vs[0].path == ERRORS_REL
+        assert "BoomError" in vs[0].message
+        # with the lazy import present the same tree is clean
+        write_wire_tree(tmp_path, replica=replica)
+        vs, _ = wire_lint(tmp_path)
+        assert vs == []
+
+    def test_error_shape_and_ctor_checks(self, tmp_path):
+        write_wire_tree(tmp_path, replica=REPLICA_SRC + """
+    class Intermediate(ServingError):
+        reason = "mid"
+        retry_elsewhere = False
+
+
+    class InheritsError(Intermediate):
+        pass
+
+
+    class ShapelessError(ServingError):
+        pass
+
+
+    class PickyError(ServingError):
+        reason = "picky"
+        retry_elsewhere = False
+
+        def __init__(self, message, extra):
+            super().__init__(message)
+""")
+        vs, _ = wire_lint(tmp_path)
+        assert {v.symbol for v in vs} == {"ShapelessError", "PickyError"}
+        by_sym = {v.symbol: v for v in vs}
+        assert "reason/retry_elsewhere" in by_sym["ShapelessError"].message
+        assert "not constructible" in by_sym["PickyError"].message
+
+    def test_single_file_lint_never_reports_missing_counterpart(self,
+                                                                tmp_path):
+        # parity checks require BOTH sides linted — a lone file is clean
+        write_wire_tree(tmp_path)
+        for rel in (REPLICA_REL, CLIENT_REL, SERVER_REL):
+            assert lint_file(str(tmp_path / rel), relpath=rel,
+                             only={"wire-contract"}) == []
+
+    def test_pragma_suppresses_at_anchor(self, tmp_path):
+        write_wire_tree(tmp_path, client="""
+            # ds-lint: disable=wire-contract -- fixture: relay omitted on purpose
+            class WireReplica:
+                def probe(self):
+                    return self._call("probe")
+        """)
+        vs, _ = wire_lint(tmp_path)
+        assert vs == []
+
+    def test_baseline_keys_on_symbol(self, tmp_path):
+        write_wire_tree(tmp_path, server="""
+            class ReplicaServer:
+                def _unary(self, op, msg):
+                    if op == "probe":
+                        return {"ok": True}
+                    return None
+        """)
+        baseline = {("wire-contract", SERVER_REL, "ReplicaServer.drain")}
+        vs, baselined = wire_lint(tmp_path, baseline=baseline)
+        assert vs == [] and baselined == 1
+
+    def test_payload_dicts_must_be_literal_keyed(self):
+        vs = lint_src("""
+            def relay(self, wfile, rid, extra):
+                k = "dyn"
+                write_frame(wfile, {k: 1})
+                self._send(rid, "out", {**extra})
+                payload = {"v": 1, "ids": {1, 2}}
+                self._safe_send(payload)
+        """, relpath=SERVER_REL)
+        assert rules_of(vs) == ["wire-contract"] * 3
+        msgs = " ".join(v.message for v in vs)
+        assert "non-literal" in msgs and "**-" in msgs and "set" in msgs
+
+    def test_literal_payloads_clean_and_rule_scoped_to_wire_files(self):
+        clean = lint_src("""
+            def relay(self, wfile, rid):
+                write_frame(wfile, {"v": 1, "type": "ok", "ids": [1, 2]})
+        """, relpath=SERVER_REL)
+        assert clean == []
+        # same dynamic-key dict outside the wire seam: not this rule's job
+        elsewhere = lint_src("""
+            def relay(self, wfile, k):
+                write_frame(wfile, {k: 1})
+        """, relpath="deepspeed_tpu/somewhere/mod.py")
+        assert elsewhere == []
+
+
+class TestWireContractMutationGate:
+    """The acceptance gate: mutate the REAL seam files and ds_lint must
+    fail naming the missing symbol — proof the rule guards production
+    wiring, not just fixtures."""
+
+    def _lint(self, tmp_path):
+        vs, _ = wire_lint(tmp_path)
+        return vs
+
+    def test_real_seam_is_clean_unmutated(self, tmp_path):
+        copy_real_seam(tmp_path)
+        assert self._lint(tmp_path) == []
+
+    def test_deleting_a_server_op_bites(self, tmp_path):
+        def mutate(rel, src):
+            if rel.endswith("server.py"):
+                out = src.replace('op == "drain"', 'op == "never_drain"')
+                assert out != src
+                return out
+            return src
+        copy_real_seam(tmp_path, mutate)
+        vs = self._lint(tmp_path)
+        assert {v.symbol for v in vs} == {"ReplicaServer.drain",
+                                          "ReplicaServer.never_drain"}
+
+    def test_deleting_a_client_relay_bites(self, tmp_path):
+        def mutate(rel, src):
+            if rel.endswith("client.py"):
+                out = src.replace("def drain(", "def detached_drain(")
+                assert out != src
+                return out
+            return src
+        copy_real_seam(tmp_path, mutate)
+        vs = self._lint(tmp_path)
+        assert {v.symbol for v in vs} == {"WireReplica.drain"}
+        assert "no WireReplica relay" in vs[0].message
+
+    def test_deleting_a_registry_import_bites(self, tmp_path):
+        def mutate(rel, src):
+            if rel.endswith("errors.py"):
+                out = src.replace(
+                    "    import deepspeed_tpu.serving.fleet.replica"
+                    "  # noqa: F401\n", "")
+                assert out != src
+                return out
+            return src
+        copy_real_seam(tmp_path, mutate)
+        vs = self._lint(tmp_path)
+        assert [v.symbol for v in vs] == \
+            ["deepspeed_tpu.serving.fleet.replica"]
+        assert "decode as WireProtocolError" in vs[0].message
+
+
+# ------------------------------------------------------- replay-determinism
+SCHED_REL = "deepspeed_tpu/inference/v2/scheduler.py"
+
+
+class TestReplayDeterminism:
+
+    def test_unseeded_entropy_flagged(self):
+        vs = lint_src("""
+            import os
+            import random
+            import uuid
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    a = random.random()
+                    b = os.urandom(8)
+                    c = uuid.uuid4()
+                    return a, b, c
+        """, relpath=SCHED_REL)
+        assert rules_of(vs) == ["replay-determinism"] * 3
+
+    def test_seeded_rngs_clean(self):
+        vs = lint_src("""
+            import random
+
+            import numpy as np
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs, seed):
+                    rng = random.Random(seed)
+                    g = np.random.default_rng(seed)
+                    return rng.random() + g.random()
+        """, relpath=SCHED_REL)
+        assert vs == []
+
+    def test_wall_clock_into_state_flagged(self):
+        vs = lint_src("""
+            import time
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    stamp = time.time()
+                    return stamp
+        """, relpath=SCHED_REL)
+        assert rules_of(vs) == ["replay-determinism"]
+        assert "wall" in vs[0].message
+
+    def test_deadline_and_metrics_idioms_exempt(self):
+        vs = lint_src("""
+            import time
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    now = time.monotonic()
+                    deadline = time.monotonic() + 0.5
+                    while time.monotonic() < deadline:
+                        pass
+                    elapsed = time.monotonic() - now
+                    return len(reqs) if elapsed else 0
+        """, relpath=SCHED_REL)
+        assert vs == []
+
+    def test_salted_hash_and_id_keys_flagged(self):
+        vs = lint_src("""
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    return {hash(r.key): id(r) for r in reqs}
+        """, relpath=SCHED_REL)
+        assert rules_of(vs) == ["replay-determinism"] * 2
+        msgs = " ".join(v.message for v in vs)
+        assert "PYTHONHASHSEED" in msgs and "process-local address" in msgs
+
+    def test_set_iteration_order_flagged_sorted_clean(self):
+        vs = lint_src("""
+            class DynamicSplitFuseScheduler:
+                def __init__(self):
+                    self._live = set()
+
+                def _plan(self, reqs):
+                    pending = set(reqs)
+                    out = []
+                    for r in pending:
+                        out.append(r)
+                    for r in self._live:
+                        out.append(r)
+                    out.extend(list(pending))
+                    pending.pop()
+                    return out
+        """, relpath=SCHED_REL)
+        assert rules_of(vs) == ["replay-determinism"] * 4
+        assert lint_src("""
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    pending = set(reqs)
+                    return [r for r in sorted(pending)]
+        """, relpath=SCHED_REL) == []
+
+    def test_scope_is_the_declared_set_only(self):
+        # same entropy OUTSIDE a REPLAY_CRITICAL symbol / file: clean
+        src = """
+            import random
+
+
+            class DynamicSplitFuseScheduler:
+                def summarize(self, reqs):
+                    return random.random()
+        """
+        assert lint_src(src, relpath=SCHED_REL) == []
+        bad_plan = """
+            import random
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    return random.random()
+        """
+        assert lint_src(bad_plan,
+                        relpath="deepspeed_tpu/other/mod.py") == []
+        assert rules_of(lint_src(bad_plan, relpath=SCHED_REL)) == \
+            ["replay-determinism"]
+
+    def test_star_entry_covers_whole_module(self):
+        vs = lint_src("""
+            import random
+
+
+            def draw():
+                return random.random()
+        """, relpath="deepspeed_tpu/inference/structured/prng.py")
+        assert rules_of(vs) == ["replay-determinism"]
+
+    def test_pragma_suppresses(self):
+        assert lint_src("""
+            import time
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    stamp = time.time()  # ds-lint: disable=replay-determinism -- trace header only
+                    return stamp
+        """, relpath=SCHED_REL) == []
+
+    def test_replay_critical_names_real_symbols(self):
+        """Every REPLAY_CRITICAL entry must point at a symbol that still
+        exists — catch silent renames exactly like the thread-shared
+        registry test does for classes."""
+        import ast
+        from tools.graft_lint.cli import REPO_ROOT
+        from tools.graft_lint.linter import REPLAY_CRITICAL
+        for suffix, entries in REPLAY_CRITICAL.items():
+            path = os.path.join(REPO_ROOT, "deepspeed_tpu", suffix)
+            assert os.path.exists(path), suffix
+            if "*" in entries:
+                continue
+            with open(path) as fd:
+                tree = ast.parse(fd.read())
+            qualnames = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    qualnames.add(node.name)
+                    for m in node.body:
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                            qualnames.add(f"{node.name}.{m.name}")
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    qualnames.add(node.name)
+            for entry in entries:
+                assert entry in qualnames, (suffix, entry)
+
+
+# --------------------------------------------------- bin/ shebang sniffing
+class TestShebangSniff:
+
+    def test_extensionless_python_script_linted(self, tmp_path):
+        script = tmp_path / "ds_tool"
+        script.write_text("#!/usr/bin/env python3\nimport os\n"
+                          "v = os.environ.get('DS_X')\n")
+        vs, _ = lint_paths([str(tmp_path)], root=str(tmp_path))
+        assert rules_of(vs) == ["env-registry"]
+        assert vs[0].path == "ds_tool"
+
+    def test_non_python_extensionless_files_ignored(self, tmp_path):
+        (tmp_path / "Makefile").write_text("all:\n\techo DS_X\n")
+        (tmp_path / "run_sh").write_text("#!/bin/sh\necho DS_X\n")
+        vs, _ = lint_paths([str(tmp_path)], root=str(tmp_path))
+        assert vs == []
+
+
+# --------------------------------------------- new-rule CLI + baseline shapes
+class TestNewRuleCli:
+
+    def _mutated_tree(self, tmp_path):
+        """Real seam minus one server op, plus an unseeded scheduler —
+        one finding per new rule family."""
+        def mutate(rel, src):
+            if rel.endswith("server.py"):
+                return src.replace('op == "drain"', 'op == "never_drain"')
+            return src
+        copy_real_seam(tmp_path, mutate)
+        sched = tmp_path / SCHED_REL
+        sched.parent.mkdir(parents=True, exist_ok=True)
+        sched.write_text(textwrap.dedent("""
+            import random
+
+
+            class DynamicSplitFuseScheduler:
+                def _plan(self, reqs):
+                    return random.random()
+        """))
+        return str(tmp_path / "deepspeed_tpu")
+
+    def test_only_combined_new_rules(self, tmp_path, capsys):
+        from tools.graft_lint.cli import main
+        pkg = self._mutated_tree(tmp_path)
+        assert main(["--only=wire-contract,replay-determinism",
+                     "--no-baseline", "--format=json", pkg]) == 1
+        report = json.loads(capsys.readouterr().out)
+        rules = {v["rule"] for v in report["violations"]}
+        assert rules == {"wire-contract", "replay-determinism"}
+
+    def test_update_baseline_roundtrips_new_finding_shapes(self, tmp_path,
+                                                           capsys):
+        from tools.graft_lint.cli import main
+        pkg = self._mutated_tree(tmp_path)
+        bl = tmp_path / "baseline.json"
+        assert main(["--update-baseline", "--baseline", str(bl), pkg]) == 0
+        entries = load_baseline(str(bl))
+        assert {"wire-contract", "replay-determinism"} <= \
+            {rule for rule, _, _ in entries}
+        capsys.readouterr()
+        # the freshly written baseline suppresses the same findings
+        assert main(["--baseline", str(bl), pkg]) == 0
+        assert "baselined" in capsys.readouterr().out

@@ -1,10 +1,12 @@
-"""Tier-1 regression gate: ds_lint must stay clean on deepspeed_tpu/.
+"""Tier-1 regression gate: ds_lint must stay clean on deepspeed_tpu/
+plus the shebang-sniffed entry-point scripts in bin/.
 
 A new violation fails this test; fix it, pragma it with a reason, or
 (for pre-existing debt only) add a baseline entry. Every rule family —
-including lock-order and knob-docs — runs repo-wide here with ZERO
-baseline entries, and per-rule wall times are reported so a rule that
-regresses the gate's latency is visible in the failure output.
+including the cross-file wire-contract parity pass and the
+replay-determinism scan — runs repo-wide here with ZERO baseline
+entries, and per-rule wall times are reported so a rule that regresses
+the gate's latency is visible in the failure output.
 """
 
 import os
@@ -16,6 +18,8 @@ from tools.graft_lint.linter import (KNOB_DOCS, RULES, lint_paths,
                                      load_baseline)
 
 PKG = os.path.join(REPO_ROOT, "deepspeed_tpu")
+# the same default scope bin/ds_lint lints: the package plus bin/
+SCOPE = [PKG, os.path.join(REPO_ROOT, "bin")]
 
 
 def _fmt(violations):
@@ -27,7 +31,7 @@ def _fmt(violations):
 def test_ds_lint_clean_on_package():
     baseline = (load_baseline(DEFAULT_BASELINE)
                 if os.path.exists(DEFAULT_BASELINE) else set())
-    violations, _ = lint_paths([PKG], baseline=baseline, root=REPO_ROOT)
+    violations, _ = lint_paths(SCOPE, baseline=baseline, root=REPO_ROOT)
     assert violations == [], _fmt(violations)
 
 
@@ -41,11 +45,21 @@ def test_each_rule_clean_standalone_with_timings():
         if rule == KNOB_DOCS:
             violations = check_knob_docs()
         else:
-            violations, _ = lint_paths([PKG], baseline=set(),
+            violations, _ = lint_paths(SCOPE, baseline=set(),
                                        root=REPO_ROOT, only={rule})
         timings.append(f"{rule}: {time.perf_counter() - start:.3f}s")
         assert violations == [], (
             f"[{rule}] not clean ({'; '.join(timings)})" + _fmt(violations))
+
+
+def test_new_rules_combined_cli_clean(capsys):
+    """`bin/ds_lint --only=wire-contract,replay-determinism` — the
+    round-24 gate invocation — is clean on the default repo-wide scope
+    (cross-file parity merged across the whole seam, baseline unused)."""
+    from tools.graft_lint.cli import main
+    assert main(["--only=wire-contract,replay-determinism",
+                 "--no-baseline"]) == 0
+    assert "0 violation(s)" in capsys.readouterr().out
 
 
 def test_knob_docs_in_sync():

@@ -2,10 +2,16 @@
 
 - on: an injected NaN in the v2 forward raises SanitizerNaNError; a
   forged allocator mirror corruption raises AllocatorCorruptionError; a
-  forged radix-trie refcount skew raises PrefixCacheCorruptionError.
-- off: the same paths are silent and maybe_checkify_jit lowers to HLO
-  byte-identical to a plain jax.jit (zero hot-path cost).
+  forged radix-trie refcount skew raises PrefixCacheCorruptionError;
+  the wire codec round-trip-verifies every frame before send and the
+  error registry is audited against the live subclass walk.
+- off: the same paths are silent, maybe_checkify_jit lowers to HLO
+  byte-identical to a plain jax.jit, and the codec's frame encoder IS
+  encode_msg (identity — zero per-frame cost).
 """
+
+import gc
+import io
 
 import jax
 import jax.numpy as jnp
@@ -15,12 +21,19 @@ import pytest
 from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
 from deepspeed_tpu.inference.v2.prefix_cache.manager import PrefixCacheManager
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.serving.admission import ServingError
+from deepspeed_tpu.serving.fleet.wire import codec
 from deepspeed_tpu.utils.sanitize import (AllocatorCorruptionError,
                                           PrefixCacheCorruptionError,
                                           SanitizerNaNError,
+                                          WireFrameCorruptionError,
+                                          WireRegistryError,
+                                          check_error_registry,
                                           check_prefix_index,
+                                          checked_frame_encoder,
                                           maybe_checkify_jit,
-                                          sanitize_enabled)
+                                          sanitize_enabled,
+                                          wire_structural_equal)
 
 
 def small_engine(dtype=jnp.float32):
@@ -144,3 +157,133 @@ class TestSanitizeOff:
 
 def plain_out(f, x):
     return jax.jit(f)(x, x)
+
+
+# ======================================================================
+# wire-codec self-check + error-registry audit (the wire-contract twin)
+# ======================================================================
+class TestWireFrameSelfCheck:
+
+    @pytest.fixture(autouse=True)
+    def _fresh_encoder(self):
+        codec._reset_frame_encoder()
+        yield
+        codec._reset_frame_encoder()
+
+    def test_off_state_is_encode_msg_verbatim(self, monkeypatch):
+        monkeypatch.delenv("DS_SANITIZE", raising=False)
+        # IDENTITY, not equivalence: zero wrapper, zero per-frame cost
+        assert codec._encoder() is codec.encode_msg
+        def enc(msg, prefer=None):
+            return b""
+        assert checked_frame_encoder(enc, None, enabled=False) is enc
+
+    def test_clean_frames_pass_under_sanitize(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        assert codec._encoder() is not codec.encode_msg
+        assert codec._encoder()._ds_sanitized
+        buf = io.BytesIO()
+        msg = {"v": 1, "type": "submit", "id": 7,
+               "blocks": np.arange(6, dtype=np.int32).reshape(2, 3),
+               "raw": b"\x00\xff", "shape": (2, 3)}
+        codec.write_frame(buf, msg)
+        out = codec.read_frame(io.BytesIO(buf.getvalue()))
+        assert out["id"] == 7
+
+    def test_corrupted_encoder_caught_before_send(self, monkeypatch):
+        """The acceptance fixture: a deliberately corrupted encoder (a
+        stand-in for a torn buffer / tampering bug) must raise BEFORE
+        any byte reaches the stream."""
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        real = codec.encode_msg
+
+        def corrupt(msg, prefer=None):
+            return real(dict(msg, id=msg["id"] + 1), prefer=prefer)
+
+        monkeypatch.setattr(codec, "encode_msg", corrupt)
+        buf = io.BytesIO()
+        with pytest.raises(WireFrameCorruptionError):
+            codec.write_frame(buf, {"v": 1, "type": "probe", "id": 3})
+        assert buf.getvalue() == b""  # nothing left the process
+
+    def test_lossy_payload_caught(self, monkeypatch):
+        # int-keyed dicts genuinely mangle under JSON (keys become
+        # strings) — the self-check attributes that to the sender
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        with pytest.raises(WireFrameCorruptionError):
+            codec.write_frame(io.BytesIO(),
+                              {"v": 1, "type": "x", "id": 1, "m": {5: "a"}},
+                              prefer=codec._FMT_JSON)
+
+    def test_off_state_lossy_payload_silent(self, monkeypatch):
+        monkeypatch.delenv("DS_SANITIZE", raising=False)
+        codec.write_frame(io.BytesIO(),
+                          {"v": 1, "type": "x", "id": 1, "m": {5: "a"}},
+                          prefer=codec._FMT_JSON)  # mangles silently
+
+    def test_structural_equality_honors_codec_normalizations(self):
+        assert wire_structural_equal((1, 2, (3,)), [1, 2, [3]])
+        assert wire_structural_equal(np.int32(5), 5)
+        assert wire_structural_equal(float("nan"), float("nan"))
+        assert wire_structural_equal(
+            {"a": np.ones(3, np.float32)}, {"a": np.ones(3, np.float32)})
+        assert not wire_structural_equal(
+            np.ones(3, np.float32), np.ones(3, np.float64))
+        assert not wire_structural_equal({"k": 1}, {"k": 2})
+        assert not wire_structural_equal({5: "a"}, {"5": "a"})
+        assert not wire_structural_equal(1, True)  # type-exact scalars
+
+
+class TestWireRegistryAudit:
+
+    def test_real_registry_passes_audit_and_rebuilds(self, monkeypatch):
+        monkeypatch.setenv("DS_SANITIZE", "1")
+        from deepspeed_tpu.serving.fleet.wire import errors
+        monkeypatch.setattr(errors, "_registry_cache", None)
+        registry = errors._error_registry()  # audited before caching
+        assert "SchemaCompileError" in registry
+        assert "WireFrameCorruptionError" in registry
+
+    def test_unregistered_live_subclass_caught(self):
+        from deepspeed_tpu.serving.fleet.wire.errors import _error_registry
+        registry = dict(_error_registry())
+
+        class GhostError(ServingError):
+            reason = "ghost"
+            retry_elsewhere = False
+
+        try:
+            with pytest.raises(WireRegistryError) as err:
+                check_error_registry(registry, ServingError)
+            assert "GhostError" in str(err.value)
+        finally:
+            del GhostError
+            gc.collect()  # drop it from ServingError.__subclasses__
+
+    def test_unconstructible_registered_type_caught(self):
+        from deepspeed_tpu.serving.fleet.wire.errors import _error_registry
+        registry = dict(_error_registry())
+
+        class NeedyError(ServingError):
+            reason = "needy"
+            retry_elsewhere = False
+
+            def __init__(self, message, extra):
+                super().__init__(message)
+                self.extra = extra
+
+        # register every live subclass (including test strays pinned by
+        # pytest traceback refs) so only the ctor probe can fire
+        def walk(cls):
+            registry.setdefault(cls.__name__, cls)
+            for sub in cls.__subclasses__():
+                walk(sub)
+        walk(ServingError)
+        assert registry["NeedyError"] is NeedyError
+        try:
+            with pytest.raises(WireRegistryError) as err:
+                check_error_registry(registry, ServingError)
+            assert "not constructible" in str(err.value)
+        finally:
+            del registry["NeedyError"], NeedyError
+            gc.collect()

@@ -163,7 +163,8 @@ def main(argv=None):
         description="TPU-hazard static analysis for deepspeed_tpu "
                     f"(rules: {', '.join(RULES)})")
     parser.add_argument("paths", nargs="*",
-                        help="files/dirs to lint (default: deepspeed_tpu/)")
+                        help="files/dirs to lint (default: deepspeed_tpu/ "
+                             "plus the executable scripts in bin/)")
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline JSON (default: tools/graft_lint/"
@@ -208,7 +209,11 @@ def main(argv=None):
         print(f"ds_lint: {len(violations)} knob-docs violation(s)")
         return 1 if violations else 0
 
-    paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu")]
+    # default repo-wide scope: the package plus bin/ — the entry-point
+    # scripts are extensionless but shebang-sniffed by _iter_py_files,
+    # so they are held to every rule family too
+    paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu"),
+                           os.path.join(REPO_ROOT, "bin")]
     if args.update_sync_budget:
         count = write_sync_budget(paths)
         print(f"ds_lint: host-sync pragma budget recorded at {count} "

@@ -19,8 +19,11 @@ SPEC_CONSISTENCY = "spec-consistency"
 ENV_REGISTRY = "env-registry"
 LOCK_ORDER_RULE = "lock-order"
 KNOB_DOCS = "knob-docs"  # cross-artifact rule, driven by cli.check_knob_docs
+WIRE_CONTRACT = "wire-contract"  # cross-file parity over the process boundary
+REPLAY_DETERMINISM = "replay-determinism"
 RULES = (JIT_PURITY, HOST_SYNC, THREAD_SHARED, SPEC_CONSISTENCY,
-         ENV_REGISTRY, LOCK_ORDER_RULE, KNOB_DOCS)
+         ENV_REGISTRY, LOCK_ORDER_RULE, KNOB_DOCS, WIRE_CONTRACT,
+         REPLAY_DETERMINISM)
 
 # Must mirror deepspeed_tpu/parallel/topology.py MESH_AXES — the linter
 # cannot import the package (no jax at lint time); a unit test asserts
@@ -328,6 +331,84 @@ _JNP_CTORS = {"jnp.array": 2, "jnp.asarray": 2, "jnp.ones": 2,
               "jnp.zeros": 2, "jnp.full": 3}  # value -> positional arity
 #  with dtype
 
+# wire-contract: the files whose hand-maintained agreement IS the
+# cross-process protocol. Suffix-matched (like _HOT_PATHS) so fixture
+# mirrors under a tmp root are held to the same contract in tests.
+_WIRE_REPLICA_FILE = "serving/fleet/replica.py"
+_WIRE_CLIENT_FILE = "serving/fleet/wire/client.py"
+_WIRE_SERVER_FILE = "serving/fleet/wire/server.py"
+_WIRE_ERRORS_FILE = "serving/fleet/wire/errors.py"
+
+# Wire ops with no same-named abstract Replica method: ``cancel`` is
+# handle-level (client side lives on _WireHandle, server side on the
+# stream registry), so it is exempt from the method<->op parity check
+# but still held to client<->server parity.
+_WIRE_HANDLE_OPS = {"cancel"}
+
+# Codec-send call names whose dict arguments must be literal-keyed
+# wire-safe payloads (checked on the wire client/server files only).
+_WIRE_SEND_FUNCS = {"write_frame", "send", "_send", "_safe_send"}
+
+# replay-determinism scope: file suffix -> REPLAY_CRITICAL qualnames.
+# Everything listed here feeds bit-identical replay — failover replay
+# verification, disagg continuation verify, refresh canary compare,
+# autotune trace replay — so any nondeterminism (unseeded RNG, wall
+# clock flowing into token-visible state, unordered set iteration,
+# salted hashes) silently breaks exactness fleet-wide. An entry may be
+# a function, a ``Class.method``, a class name (every method is then
+# critical), or ``"*"`` (the whole module). Rationale per entry lives
+# in docs/LINTING.md.
+REPLAY_CRITICAL = {
+    "inference/v2/engine_v2.py": {
+        "InferenceEngineV2.put",
+        "InferenceEngineV2.decode_burst",
+        "InferenceEngineV2.decode_burst_async",
+        "InferenceEngineV2.verify_burst",
+        "InferenceEngineV2.draw_seed",
+        "AsyncBurstHandle.fetch",
+    },
+    "inference/v2/scheduler.py": {
+        "DynamicSplitFuseScheduler._plan",
+        "DynamicSplitFuseScheduler._try_burst",
+        "DynamicSplitFuseScheduler._try_spec_burst",
+        "DynamicSplitFuseScheduler._plan_async_k",
+    },
+    "inference/structured/prng.py": {"*"},
+    "inference/structured/sampling.py": {"*"},
+    "inference/v2/kv_tier/tier_manager.py": {
+        "TierManager.export_chain",
+        "TierManager.import_chain",
+    },
+    "serving/fleet/handoff.py": {"HandoffManager"},
+    "serving/fleet/router.py": {
+        "FleetRouter._serve",
+        "FleetRouter._serve_disagg",
+        "FleetRouter._attempt",
+        "FleetRouter._backoff",
+    },
+    "autotuning/trace.py": {
+        "synthesize_trace",
+        "replay_lockstep",
+        "replay_realtime",
+    },
+}
+
+# Wall-clock reads that are nondeterministic across replays.
+_REPLAY_WALL_CLOCK = {"time.time", "time.time_ns", "time.monotonic",
+                      "time.monotonic_ns", "time.perf_counter",
+                      "time.perf_counter_ns", "time.process_time",
+                      "datetime.now", "datetime.datetime.now",
+                      "datetime.utcnow", "datetime.datetime.utcnow"}
+# Deadline/metrics idiom: a clock read assigned to a *-named local (or
+# combined arithmetically / compared — elapsed math and deadline checks)
+# never reaches token-visible state; anything else in a REPLAY_CRITICAL
+# scope is flagged.
+_CLOCK_IDIOM_NAMES = ("deadline", "timeout", "expire", "until", "retry",
+                      "start", "t0", "now", "beat", "elapsed", "wall")
+# Seeded RNG constructors: allowed in REPLAY_CRITICAL scope when given
+# an explicit seed argument.
+_SEEDED_RNG_CTORS = {"Random", "default_rng", "RandomState", "Generator"}
+
 
 # ----------------------------------------------------------------- helpers
 def _dotted(node):
@@ -432,6 +513,10 @@ class FileLinter:
         # surviving lock-acquisition edges (rank-clean, unpragma'd) for
         # the cross-file cycle pass run by lint_paths/lint_file
         self.lock_edges = []
+        # per-file wire-contract facts (op tables, relay methods, error
+        # classes) for the cross-file parity pass; filled by
+        # check_wire_contract, merged by wire_contract_violations
+        self.wire_info = None
         # parent / scope bookkeeping filled by _annotate
         self._parents = {}
         self._qualnames = {}
@@ -1150,6 +1235,330 @@ class FileLinter:
         target_key = f"{ctx['cls']}.{target}"
         return {e["key"] for e in ctx["held"]} == {target_key}
 
+    # -- rule 7: wire-contract ---------------------------------------------
+    def check_wire_contract(self):
+        """Collect this file's wire-contract facts (Replica interface,
+        client relays + ops sent, server op table, error-registry
+        imports, error-class shapes) onto ``self.wire_info`` for the
+        cross-file parity pass, and run the per-file payload check:
+        dict literals handed to the codec must be literal-keyed."""
+        info = {"relpath": self.relpath,
+                "pragmas": _parse_pragmas(self.source),
+                "classes": [], "replica_methods": {}, "client_methods": {},
+                "client_ops": {}, "server_ops": {}, "registry_imports": {},
+                "replica_line": 1, "client_line": 1, "server_line": 1,
+                "registry_line": 1}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_wire_class(node, info)
+            elif isinstance(node, ast.FunctionDef) and \
+                    node.name == "_error_registry" and \
+                    self.relpath.endswith(_WIRE_ERRORS_FILE):
+                info["registry_line"] = node.lineno
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Import):
+                        for alias in sub.names:
+                            info["registry_imports"].setdefault(
+                                alias.name, sub.lineno)
+                    elif isinstance(sub, ast.ImportFrom) and sub.module:
+                        info["registry_imports"].setdefault(
+                            sub.module, sub.lineno)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    op = None
+                    if f.attr == "_call" and node.args:
+                        op = node.args[0]
+                    elif f.attr == "_send" and len(node.args) >= 2:
+                        op = node.args[1]
+                    if isinstance(op, ast.Constant) and \
+                            isinstance(op.value, str):
+                        info["client_ops"].setdefault(op.value, node.lineno)
+            if isinstance(node, ast.Compare) and \
+                    isinstance(node.left, ast.Name) and \
+                    node.left.id == "op" and len(node.ops) == 1 and \
+                    isinstance(node.ops[0], ast.Eq) and \
+                    isinstance(node.comparators[0], ast.Constant) and \
+                    isinstance(node.comparators[0].value, str):
+                info["server_ops"].setdefault(node.comparators[0].value,
+                                              node.lineno)
+        if self.relpath.endswith((_WIRE_CLIENT_FILE, _WIRE_SERVER_FILE)):
+            self._check_wire_payloads()
+        self.wire_info = info
+
+    def _collect_wire_class(self, node, info):
+        bases = [b for b in (_last(_dotted(b)) for b in node.bases) if b]
+        init = next((m for m in node.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        ctor_ok = True
+        if init is not None:
+            a = init.args
+            required = len(a.posonlyargs) + len(a.args) - len(a.defaults)
+            accepts_msg = (len(a.posonlyargs) + len(a.args) >= 2) or \
+                a.vararg is not None
+            kw_required = any(d is None for d in a.kw_defaults)
+            ctor_ok = accepts_msg and required <= 2 and not kw_required
+        declared = set()
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        declared.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                declared.add(stmt.target.id)
+        info["classes"].append({
+            "name": node.name, "bases": bases, "line": node.lineno,
+            "has_reason": "reason" in declared,
+            "has_retry": "retry_elsewhere" in declared,
+            "ctor_ok": ctor_ok})
+        methods = {m.name: m.lineno for m in node.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and not m.name.startswith("_")}
+        if node.name == "Replica" and \
+                self.relpath.endswith(_WIRE_REPLICA_FILE):
+            info["replica_methods"] = methods
+            info["replica_line"] = node.lineno
+        elif node.name == "WireReplica" and \
+                self.relpath.endswith(_WIRE_CLIENT_FILE):
+            info["client_methods"] = methods
+            info["client_line"] = node.lineno
+        elif node.name == "ReplicaServer" and \
+                self.relpath.endswith(_WIRE_SERVER_FILE):
+            info["server_line"] = node.lineno
+
+    def _check_wire_payloads(self):
+        """Dict payloads handed to the codec (`write_frame`, `.send`,
+        `._send`, `._safe_send`) must have literal string keys and no
+        set values — non-literal keys defeat static parity checking and
+        sets do not survive either wire format."""
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            local_dicts = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Dict):
+                    local_dicts[node.targets[0].id] = node.value
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _last(_dotted(node.func))
+                if name not in _WIRE_SEND_FUNCS:
+                    continue
+                for arg in node.args:
+                    d = arg if isinstance(arg, ast.Dict) else \
+                        (local_dicts.get(arg.id)
+                         if isinstance(arg, ast.Name) else None)
+                    if d is None:
+                        continue
+                    for k in d.keys:
+                        if k is None:
+                            self._emit(WIRE_CONTRACT, node,
+                                       "codec payload built with a **-"
+                                       "expansion — wire payload dicts "
+                                       "must be literal-keyed so the "
+                                       "contract is statically checkable")
+                        elif not (isinstance(k, ast.Constant)
+                                  and isinstance(k.value, str)):
+                            self._emit(WIRE_CONTRACT, k,
+                                       "non-literal / non-string key in a "
+                                       "codec payload dict — wire envelope "
+                                       "keys must be string literals "
+                                       "(msgpack/JSON both require it and "
+                                       "static parity checks depend on it)")
+                    for v in d.values:
+                        for sub in ast.walk(v):
+                            if isinstance(sub, (ast.Set, ast.SetComp)):
+                                self._emit(WIRE_CONTRACT, sub,
+                                           "set literal inside a codec "
+                                           "payload — sets survive neither "
+                                           "msgpack nor JSON; use a sorted "
+                                           "list")
+
+    # -- rule 8: replay-determinism ----------------------------------------
+    def check_replay_determinism(self):
+        entries = None
+        for suffix, names in REPLAY_CRITICAL.items():
+            if self.relpath.endswith(suffix):
+                entries = names
+                break
+        if entries is None:
+            return
+        whole = "*" in entries
+
+        def critical(fn):
+            if whole:
+                return True
+            qn = self._qualname(fn)
+            return any(qn == e or qn.startswith(e + ".") for e in entries)
+
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not critical(fn):
+                continue
+            owner = self._owner_fn(fn)
+            if owner is not None and critical(owner):
+                continue  # nested def: walked with its owner
+            self._check_replay_fn(fn)
+
+    def _check_replay_fn(self, fn):
+        set_names = self._settish_locals(fn)
+        set_attrs = self._settish_class_attrs(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._check_replay_call(node, set_names, set_attrs)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if self._is_settish(node.iter, set_names, set_attrs):
+                    self._emit(REPLAY_DETERMINISM, node,
+                               "iteration over an unordered set in a "
+                               "REPLAY_CRITICAL scope — set order varies "
+                               "across processes and feeds packing/replay "
+                               "order; wrap in sorted(...)")
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    if self._is_settish(gen.iter, set_names, set_attrs):
+                        self._emit(REPLAY_DETERMINISM, node,
+                                   "comprehension over an unordered set in "
+                                   "a REPLAY_CRITICAL scope — wrap the "
+                                   "iterable in sorted(...)")
+
+    def _check_replay_call(self, node, set_names, set_attrs):
+        dotted = _dotted(node.func)
+        name = _last(dotted)
+        if dotted is not None:
+            if dotted.startswith("random."):
+                if not (name in _SEEDED_RNG_CTORS and node.args):
+                    self._emit(REPLAY_DETERMINISM, node,
+                               f"{dotted}() in a REPLAY_CRITICAL scope "
+                               f"draws from process-local entropy — seed "
+                               f"explicitly (random.Random(derive_seed(...))"
+                               f") or thread the counter PRNG through")
+                return
+            if dotted.startswith(("np.random.", "numpy.random.")):
+                if not (name in _SEEDED_RNG_CTORS and node.args):
+                    self._emit(REPLAY_DETERMINISM, node,
+                               f"module-level {dotted}() in a "
+                               f"REPLAY_CRITICAL scope is unseeded global "
+                               f"state — use a seeded np.random.default_rng"
+                               f"(seed) / the counter PRNG")
+                return
+            if dotted == "os.urandom" or dotted.startswith("secrets.") or \
+                    name in ("uuid1", "uuid4"):
+                self._emit(REPLAY_DETERMINISM, node,
+                           f"{dotted or name}() is OS entropy — a replay "
+                           f"can never reproduce it; derive identity/seeds "
+                           f"from (DS_SEED, request uid, position)")
+                return
+            if dotted in _REPLAY_WALL_CLOCK:
+                if not self._clock_idiom_exempt(node):
+                    self._emit(REPLAY_DETERMINISM, node,
+                               f"{dotted}() outside a deadline/metrics "
+                               f"idiom in a REPLAY_CRITICAL scope — wall "
+                               f"clock flowing into token-visible state "
+                               f"breaks bit-identical replay")
+                return
+        if isinstance(node.func, ast.Name) and node.func.id in ("id", "hash"):
+            which = "id() is a process-local address" if \
+                node.func.id == "id" else \
+                "hash() is PYTHONHASHSEED-salted for str/bytes"
+            self._emit(REPLAY_DETERMINISM, node,
+                       f"{which} — keys/seeds derived from it differ "
+                       f"across processes and replays; use derive_seed() "
+                       f"or an explicit stable key")
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "pop" and not node.args and \
+                self._is_settish(node.func.value, set_names, set_attrs):
+            self._emit(REPLAY_DETERMINISM, node,
+                       "set.pop() removes an arbitrary element — "
+                       "nondeterministic in a REPLAY_CRITICAL scope; pop "
+                       "from a sorted/ordered structure")
+            return
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in ("list", "tuple", "enumerate", "iter") and \
+                node.args and \
+                self._is_settish(node.args[0], set_names, set_attrs):
+            self._emit(REPLAY_DETERMINISM, node,
+                       f"{node.func.id}() over an unordered set in a "
+                       f"REPLAY_CRITICAL scope — materialized order varies "
+                       f"across processes; use sorted(...)")
+
+    def _clock_idiom_exempt(self, node):
+        """Deadline/metrics idioms: the clock read participates in
+        arithmetic/comparison (elapsed math, deadline checks) or is
+        assigned to a deadline/metrics-named local."""
+        p = self._parents.get(node)
+        while p is not None and not isinstance(p, ast.stmt):
+            if isinstance(p, (ast.BinOp, ast.Compare)):
+                return True
+            p = self._parents.get(p)
+        if isinstance(p, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = p.targets if isinstance(p, ast.Assign) else [p.target]
+            for t in targets:
+                n = t.id if isinstance(t, ast.Name) else _self_attr(t)
+                if n and any(h in n.lower() for h in _CLOCK_IDIOM_NAMES):
+                    return True
+        return False
+
+    def _settish_locals(self, fn):
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                v = node.value
+                if isinstance(v, (ast.Set, ast.SetComp)) or (
+                        isinstance(v, ast.Call)
+                        and _last(_dotted(v.func)) in ("set", "frozenset")):
+                    out.add(node.targets[0].id)
+        return out
+
+    def _settish_class_attrs(self, fn):
+        """self-attributes assigned a set in the enclosing class's
+        ``__init__`` — iterating them in a critical method is flagged."""
+        cls = self._parents.get(fn)
+        while cls is not None and not isinstance(cls, ast.ClassDef):
+            cls = self._parents.get(cls)
+        if cls is None:
+            return set()
+        init = next((m for m in cls.body if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            return set()
+        out = set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                attr = _self_attr(node.targets[0])
+                v = node.value
+                if attr and (isinstance(v, (ast.Set, ast.SetComp)) or (
+                        isinstance(v, ast.Call)
+                        and _last(_dotted(v.func)) in ("set", "frozenset"))):
+                    out.add(attr)
+        return out
+
+    def _is_settish(self, expr, set_names, set_attrs):
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call) and \
+                _last(_dotted(expr.func)) in ("set", "frozenset"):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in set_names
+        attr = _self_attr(expr)
+        if attr is not None:
+            return attr in set_attrs
+        if isinstance(expr, ast.BinOp) and \
+                isinstance(expr.op, (ast.BitOr, ast.BitAnd, ast.Sub,
+                                     ast.BitXor)):
+            return self._is_settish(expr.left, set_names, set_attrs) or \
+                self._is_settish(expr.right, set_names, set_attrs)
+        return False
+
     # -- driver ------------------------------------------------------------
     def run(self, only=None):
         checks = {
@@ -1159,6 +1568,8 @@ class FileLinter:
             SPEC_CONSISTENCY: self.check_spec_consistency,
             ENV_REGISTRY: self.check_env_registry,
             LOCK_ORDER_RULE: self.check_lock_order,
+            WIRE_CONTRACT: self.check_wire_contract,
+            REPLAY_DETERMINISM: self.check_replay_determinism,
         }
         for rule, check in checks.items():
             if only is None or rule in only:
@@ -1227,24 +1638,188 @@ def lock_cycle_violations(edges):
     return violations
 
 
+def wire_contract_violations(infos):
+    """Cross-file wire-contract parity over the merged per-file facts
+    (``FileLinter.wire_info``). Each agreement is only checked when
+    both sides were actually linted, so single-file invocations never
+    report a "missing" counterpart they simply did not see:
+
+    - every abstract ``Replica`` method needs a ``WireReplica`` relay,
+      a client op send, and a ``ReplicaServer`` op-table entry;
+    - every op the client sends must be dispatched by the server, and
+      every server op must be reachable from a relay (else dead);
+    - every module defining a ``ServingError`` subclass must appear in
+      ``_error_registry()``'s lazy import list;
+    - every ``ServingError`` subclass declares class-level ``reason`` /
+      ``retry_elsewhere`` (itself or via a subtree ancestor) and stays
+      constructible as ``cls(message)`` — what ``decode_error`` does.
+
+    Violations honor inline pragmas of the file they anchor in."""
+    replica = client = server = errors_info = None
+    all_classes = []
+    for info in infos:
+        if info is None:
+            continue
+        rp = info["relpath"]
+        if rp.endswith(_WIRE_REPLICA_FILE):
+            replica = info
+        if rp.endswith(_WIRE_CLIENT_FILE):
+            client = info
+        if rp.endswith(_WIRE_SERVER_FILE):
+            server = info
+        if rp.endswith(_WIRE_ERRORS_FILE):
+            errors_info = info
+        for c in info["classes"]:
+            all_classes.append((info, c))
+    out = []
+
+    def emit(info, line, symbol, message):
+        disabled = info["pragmas"].get(line, ())
+        if WIRE_CONTRACT in disabled or "all" in disabled:
+            return
+        out.append(Violation(rule=WIRE_CONTRACT, path=info["relpath"],
+                             line=line, col=0, symbol=symbol,
+                             message=message))
+
+    # ServingError subtree, transitive by base NAME across files
+    subtree, known, changed = {}, {"ServingError"}, True
+    while changed:
+        changed = False
+        for info, c in all_classes:
+            if c["name"] in known:
+                continue
+            if any(b in known for b in c["bases"]):
+                known.add(c["name"])
+                subtree[c["name"]] = (info, c)
+                changed = True
+
+    def _inherits(c, field):
+        seen = set()
+        while True:
+            if c[field]:
+                return True
+            parent = next((b for b in c["bases"] if b in subtree
+                           and b not in seen), None)
+            if parent is None:
+                return False
+            seen.add(parent)
+            c = subtree[parent][1]
+
+    for name in sorted(subtree):
+        info, c = subtree[name]
+        if not _inherits(c, "has_reason") or not _inherits(c, "has_retry"):
+            emit(info, c["line"], name,
+                 f"ServingError subclass {name} does not declare "
+                 f"class-level reason/retry_elsewhere — the wire encodes "
+                 f"both, and inheriting the base defaults makes the "
+                 f"remote routing decision wrong or ambiguous")
+        if not c["ctor_ok"]:
+            emit(info, c["line"], name,
+                 f"ServingError subclass {name} is not constructible as "
+                 f"{name}(message) — decode_error() rebuilds it exactly "
+                 f"that way, so extra required __init__ params break "
+                 f"error decoding at the first remote failure")
+
+    if errors_info is not None:
+        imports = errors_info["registry_imports"]
+        by_module = {}
+        for name in sorted(subtree):
+            info, _c = subtree[name]
+            if info is errors_info:
+                continue
+            mod = info["relpath"]
+            mod = mod[:-3] if mod.endswith(".py") else mod
+            by_module.setdefault(mod.replace("/", "."), []).append(name)
+        for mod, names in sorted(by_module.items()):
+            if mod not in imports:
+                emit(errors_info, errors_info["registry_line"], mod,
+                     f"_error_registry() never imports {mod}, which "
+                     f"defines ServingError subclass(es) "
+                     f"{', '.join(sorted(names))} — until the module is "
+                     f"imported those errors decode as WireProtocolError "
+                     f"(wrong type, wrong retry semantics); add the "
+                     f"import to the lazy list in wire/errors.py")
+
+    if replica is not None and client is not None:
+        for m in sorted(replica["replica_methods"]):
+            if m not in client["client_methods"]:
+                emit(client, client["client_line"], f"WireReplica.{m}",
+                     f"abstract Replica method {m}() has no WireReplica "
+                     f"relay — a remote fleet silently loses the method "
+                     f"(AttributeError / base default instead of the "
+                     f"worker's answer); add the relay in wire/client.py")
+            elif m not in client["client_ops"]:
+                emit(client, client["client_methods"][m],
+                     f"WireReplica.{m}",
+                     f"WireReplica.{m}() never sends wire op {m!r} — the "
+                     f"relay exists but does not cross the process "
+                     f"boundary")
+    if client is not None and server is not None:
+        for op in sorted(client["client_ops"]):
+            if op not in server["server_ops"]:
+                emit(server, server["server_line"], f"ReplicaServer.{op}",
+                     f"client relays send wire op {op!r} but "
+                     f"ReplicaServer._dispatch/_unary never handles it — "
+                     f"that is a runtime WireProtocolError('unknown wire "
+                     f"op') under traffic; add the op to the server table")
+        for op in sorted(server["server_ops"]):
+            if op in client["client_ops"] or op in _WIRE_HANDLE_OPS:
+                continue
+            if replica is not None and op in replica["replica_methods"]:
+                continue
+            emit(server, server["server_ops"][op], f"ReplicaServer.{op}",
+                 f"server wire op {op!r} has no client relay — dead "
+                 f"(untestable) dispatch arm; remove it or add the "
+                 f"WireReplica relay")
+    if replica is not None and server is not None:
+        for m in sorted(replica["replica_methods"]):
+            if m in server["server_ops"]:
+                continue
+            if client is not None and m in client["client_ops"]:
+                continue  # reported via the client->server check above
+            emit(server, server["server_line"], f"ReplicaServer.{m}",
+                 f"abstract Replica method {m}() has no ReplicaServer op "
+                 f"— adding a Replica method requires wiring BOTH the "
+                 f"client relay and the server dispatch arm (see the "
+                 f"checklist in docs/LINTING.md)")
+    return out
+
+
 def _lint_one(path, source, relpath, only=None):
-    """→ (violations, lock_edges) for one file, pragma-filtered."""
+    """→ (violations, linter) for one file, pragma-filtered. The
+    returned linter carries cross-file state (lock edges, wire info)."""
     linter = FileLinter(path, source, relpath=relpath)
-    return linter.run(only=only), linter.lock_edges
+    return linter.run(only=only), linter
 
 
 def lint_file(path, source=None, relpath=None, only=None):
     """All unsuppressed-by-pragma violations for one file, including a
     per-file lock-cycle pass (lint_paths instead runs one merged pass
-    over every file so cross-file cycles surface)."""
+    over every file so cross-file cycles surface) and the wire-contract
+    parity pass over this file's facts alone."""
     if source is None:
         with open(path) as fd:
             source = fd.read()
-    violations, edges = _lint_one(path, source, relpath, only=only)
+    violations, linter = _lint_one(path, source, relpath, only=only)
     if only is None or LOCK_ORDER_RULE in only:
-        violations = violations + lock_cycle_violations(edges)
-        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        violations = violations + lock_cycle_violations(linter.lock_edges)
+    if only is None or WIRE_CONTRACT in only:
+        violations = violations + wire_contract_violations(
+            [linter.wire_info])
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations
+
+
+def _has_python_shebang(path):
+    """Extensionless executable-script sniff: ``bin/ds_serve``-style
+    entry points announce themselves with a ``#!...python`` first line
+    and are held to every rule like any ``.py`` module."""
+    try:
+        with open(path, "rb") as fd:
+            first = fd.readline(160)
+    except OSError:
+        return False
+    return first.startswith(b"#!") and b"python" in first
 
 
 def _iter_py_files(paths):
@@ -1256,8 +1831,11 @@ def _iter_py_files(paths):
                 dirnames[:] = sorted(d for d in dirnames
                                      if d != "__pycache__")
                 for fn in sorted(filenames):
+                    full = os.path.join(dirpath, fn)
                     if fn.endswith(".py"):
-                        yield os.path.join(dirpath, fn)
+                        yield full
+                    elif "." not in fn and _has_python_shebang(full):
+                        yield full
 
 
 def count_host_sync_pragmas(paths):
@@ -1296,21 +1874,27 @@ def lint_paths(paths, baseline=None, root=None, only=None):
     root = root or os.getcwd()
     violations, baselined = [], 0
     all_edges = []
+    wire_infos = []
     for path in _iter_py_files(paths):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         with open(path) as fd:
             source = fd.read()
-        file_violations, edges = _lint_one(path, source, rel, only=only)
-        all_edges.extend(edges)
+        file_violations, linter = _lint_one(path, source, rel, only=only)
+        all_edges.extend(linter.lock_edges)
+        wire_infos.append(linter.wire_info)
         for v in file_violations:
             if (v.rule, v.path, v.symbol) in baseline:
                 baselined += 1
                 continue
             violations.append(v)
+    merged = []
     if only is None or LOCK_ORDER_RULE in only:
-        for v in lock_cycle_violations(all_edges):
-            if (v.rule, v.path, v.symbol) in baseline:
-                baselined += 1
-                continue
-            violations.append(v)
+        merged.extend(lock_cycle_violations(all_edges))
+    if only is None or WIRE_CONTRACT in only:
+        merged.extend(wire_contract_violations(wire_infos))
+    for v in merged:
+        if (v.rule, v.path, v.symbol) in baseline:
+            baselined += 1
+            continue
+        violations.append(v)
     return violations, baselined
